@@ -1,0 +1,102 @@
+// Native TPU discovery shim.
+//
+// Role: the reference driver's only native component is its cgo NVML binding
+// (lengrongfu/k8s-dra-driver, vendor/github.com/NVIDIA/go-nvml — an 11k-line
+// C header bridged into Go; SURVEY.md §2b).  The TPU equivalent needs no
+// vendor ML library: chips are plain PCI accel devices, so the native layer's
+// job is fast, dependency-free probing of /sys and device-node creation with
+// proper error reporting.  Exposed to Python via ctypes (no pybind11 in the
+// image).
+//
+// Exported C ABI:
+//   tpud_count_accel(dev_root)                      -> #accel char devices
+//   tpud_chip_meta(sysfs_root, index, buf, buflen)  -> "key=value\n" blob
+//   tpud_mknod_char(path, major, minor, mode)       -> 0 or -errno
+//   tpud_read_file(path, buf, buflen)               -> bytes read or -errno
+
+#include <cerrno>
+#include <cstdio>
+#include <cstring>
+#include <dirent.h>
+#include <string>
+#include <sys/stat.h>
+#include <sys/sysmacros.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+extern "C" {
+
+static int read_small_file(const std::string &path, std::string *out) {
+  FILE *f = ::fopen(path.c_str(), "r");
+  if (!f) return -errno;
+  char buf[512];
+  size_t n = ::fread(buf, 1, sizeof(buf) - 1, f);
+  ::fclose(f);
+  buf[n] = '\0';
+  // strip trailing whitespace/newline
+  while (n > 0 && (buf[n - 1] == '\n' || buf[n - 1] == ' ')) buf[--n] = '\0';
+  out->assign(buf, n);
+  return (int)n;
+}
+
+int tpud_count_accel(const char *dev_root) {
+  std::string dir = std::string(dev_root ? dev_root : "/") + "/dev";
+  DIR *d = ::opendir(dir.c_str());
+  if (!d) return -errno;
+  int count = 0;
+  struct dirent *e;
+  while ((e = ::readdir(d)) != nullptr) {
+    if (::strncmp(e->d_name, "accel", 5) != 0) continue;
+    std::string p = dir + "/" + e->d_name;
+    struct stat st;
+    if (::stat(p.c_str(), &st) == 0 && S_ISCHR(st.st_mode)) count++;
+  }
+  ::closedir(d);
+  return count;
+}
+
+int tpud_chip_meta(const char *sysfs_root, int index, char *buf, int buflen) {
+  std::string base = std::string(sysfs_root ? sysfs_root : "/sys") +
+                     "/class/accel/accel" + std::to_string(index) + "/device";
+  std::string out, val;
+  const char *keys[] = {"vendor", "device", "numa_node", "subsystem_device"};
+  for (const char *k : keys) {
+    if (read_small_file(base + "/" + k, &val) >= 0) {
+      out += k;
+      out += "=";
+      out += val;
+      out += "\n";
+    }
+  }
+  // PCI address = basename of the device symlink target.
+  char link[512];
+  ssize_t n = ::readlink(base.c_str(), link, sizeof(link) - 1);
+  if (n > 0) {
+    link[n] = '\0';
+    const char *slash = ::strrchr(link, '/');
+    out += "pci_address=";
+    out += (slash ? slash + 1 : link);
+    out += "\n";
+  }
+  if ((int)out.size() >= buflen) return -ERANGE;
+  ::memcpy(buf, out.c_str(), out.size() + 1);
+  return (int)out.size();
+}
+
+int tpud_mknod_char(const char *path, int major_no, int minor_no, int mode) {
+  if (::mknod(path, (mode_t)(mode | S_IFCHR), makedev(major_no, minor_no)) != 0)
+    return -errno;
+  if (::chmod(path, (mode_t)mode) != 0) return -errno;
+  return 0;
+}
+
+int tpud_read_file(const char *path, char *buf, int buflen) {
+  std::string out;
+  int n = read_small_file(path, &out);
+  if (n < 0) return n;
+  if ((int)out.size() >= buflen) return -ERANGE;
+  ::memcpy(buf, out.c_str(), out.size() + 1);
+  return (int)out.size();
+}
+
+}  // extern "C"

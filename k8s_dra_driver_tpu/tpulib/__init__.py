@@ -1,0 +1,31 @@
+"""tpulib: TPU chip discovery and device modeling (role of the reference's
+nvlib.go + deviceinfo.go + allocatable.go, see SURVEY.md §2)."""
+
+from .chiplib import (  # noqa: F401
+    ICI_CHANNEL_COUNT,
+    ChipLib,
+    ChipLibConfig,
+    FakeChipLib,
+    RealChipLib,
+    SHARING_EXCLUSIVE,
+    SHARING_PROCESS_SHARED,
+    SHARING_TIME_SHARED,
+)
+from .deviceinfo import (  # noqa: F401
+    AllocatableDevice,
+    AllocatableDevices,
+    ChipDeviceType,
+    ChipInfo,
+    IciChannelDeviceType,
+    IciChannelInfo,
+    TensorCoreDeviceType,
+    TensorCoreInfo,
+    counter_sets,
+)
+from .topology import (  # noqa: F401
+    GENERATIONS,
+    Coord,
+    MeshShape,
+    enumerate_submeshes,
+    is_contiguous_submesh,
+)

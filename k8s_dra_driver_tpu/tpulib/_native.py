@@ -1,0 +1,100 @@
+"""ctypes loader for the native discovery shim (see native/tpu_discovery.cpp).
+
+Builds on demand with the in-tree Makefile if the shared object is missing
+(g++ is part of the toolchain; pybind11 is not, hence ctypes).  All callers
+must tolerate ``available == False`` — the pure-Python sysfs fallback in
+``chiplib.RealChipLib`` has identical semantics.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+
+logger = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
+_SO_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libtpudiscovery.so"))
+
+
+class NativeShim:
+    def __init__(self, lib: ctypes.CDLL | None):
+        self._lib = lib
+        self.available = lib is not None
+        if lib is not None:
+            lib.tpud_count_accel.argtypes = [ctypes.c_char_p]
+            lib.tpud_count_accel.restype = ctypes.c_int
+            lib.tpud_chip_meta.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_int,
+                ctypes.c_char_p,
+                ctypes.c_int,
+            ]
+            lib.tpud_chip_meta.restype = ctypes.c_int
+            lib.tpud_mknod_char.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_int,
+                ctypes.c_int,
+                ctypes.c_int,
+            ]
+            lib.tpud_mknod_char.restype = ctypes.c_int
+            lib.tpud_read_file.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_char_p,
+                ctypes.c_int,
+            ]
+            lib.tpud_read_file.restype = ctypes.c_int
+
+    def count_accel(self, dev_root: str) -> int:
+        return self._lib.tpud_count_accel(dev_root.encode())
+
+    def chip_meta(self, sysfs_root: str, index: int) -> dict[str, str]:
+        buf = ctypes.create_string_buffer(4096)
+        n = self._lib.tpud_chip_meta(sysfs_root.encode(), index, buf, len(buf))
+        if n < 0:
+            return {}
+        meta = {}
+        for line in buf.value.decode().splitlines():
+            if "=" in line:
+                k, v = line.split("=", 1)
+                meta[k] = v
+        return meta
+
+    def mknod_char(self, path: str, major: int, minor: int, mode: int) -> None:
+        rc = self._lib.tpud_mknod_char(path.encode(), major, minor, mode)
+        if rc != 0:
+            raise OSError(-rc, os.strerror(-rc), path)
+
+    def read_file(self, path: str) -> str:
+        buf = ctypes.create_string_buffer(4096)
+        n = self._lib.tpud_read_file(path.encode(), buf, len(buf))
+        if n < 0:
+            raise OSError(-n, os.strerror(-n), path)
+        return buf.value.decode()
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-s", "-C", _NATIVE_DIR],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception as e:  # toolchain absent or build failure: fall back
+        logger.warning("native shim build failed: %s", e)
+        return False
+
+
+def load(allow_build: bool = True) -> NativeShim:
+    if not os.path.exists(_SO_PATH) and allow_build:
+        _build()
+    if os.path.exists(_SO_PATH):
+        try:
+            return NativeShim(ctypes.CDLL(_SO_PATH))
+        except OSError as e:
+            logger.warning("failed to load native shim: %s", e)
+    return NativeShim(None)

@@ -1,0 +1,490 @@
+"""Chip enumeration library: the hardware seam of the driver.
+
+TPU-native analog of the reference's deviceLib (lengrongfu/k8s-dra-driver,
+cmd/nvidia-dra-plugin/nvlib.go:40-46, :111-200): where the reference wraps
+NVML via cgo to enumerate GPUs/MIG devices/IMEX channels, we enumerate TPU
+chips from ``/dev/accel*`` + sysfs (optionally accelerated by the C++ shim in
+``k8s_dra_driver_tpu/native``) and synthesise TensorCore partitions and ICI
+channels from generation/topology metadata.
+
+Unlike the reference — whose only backend is real hardware, making its test
+story "run the demo on GPUs" (SURVEY.md §4) — the backend here is an abstract
+interface with a first-class ``FakeChipLib``, so every layer above (device
+state, CDI, gRPC plugin, controller) is testable hermetically.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import glob
+import hashlib
+import logging
+import os
+import re
+import stat
+from typing import Optional
+
+from .deviceinfo import (
+    AllocatableDevice,
+    AllocatableDevices,
+    ChipInfo,
+    IciChannelInfo,
+    TensorCoreInfo,
+)
+from .topology import GENERATIONS, Coord, MeshShape, default_slice_shapes
+
+logger = logging.getLogger(__name__)
+
+
+def _safe_int(value, default: int) -> int:
+    """Tolerant int parse for sysfs values (kernel files can hold garbage)."""
+    try:
+        return int(str(value).strip())
+    except (TypeError, ValueError):
+        return default
+
+
+def _hostpath(root: str, rel: str) -> str:
+    """Join a host-root prefix with a relative path; root='/' must yield
+    absolute paths, not cwd-relative ones."""
+    return os.path.join(root.rstrip("/") or "/", rel)
+
+
+# Accelerator-type prefixes as they appear in TPU_ACCELERATOR_TYPE; GKE uses
+# "v5litepod-16" for v5e and "v5p-8" for v5p.
+_GENERATION_ALIASES = {
+    "v5litepod": "v5e",
+    "v5lite": "v5e",
+    "v5": "v5p",
+}
+
+
+def normalize_generation(gen: str) -> str:
+    gen = gen.strip().lower()
+    if gen in GENERATIONS:
+        return gen
+    return _GENERATION_ALIASES.get(gen, "v4")
+
+# Mirror of the reference's IMEX channel capacity constants
+# (cmd/nvidia-dra-plugin/nvlib.go:441-444): how many interconnect channels a
+# single driver instance will advertise.
+ICI_CHANNEL_COUNT = 2048
+
+# Sharing modes for a chip runtime (role of NVML compute modes,
+# nvlib.go:541-558).
+SHARING_EXCLUSIVE = "exclusive"
+SHARING_TIME_SHARED = "time-shared"
+SHARING_PROCESS_SHARED = "process-shared"
+
+
+@dataclasses.dataclass
+class ChipLibConfig:
+    """Host-side knobs (role of driver-root flags, main.go:73-123)."""
+
+    dev_root: str = "/"
+    sysfs_root: str = "/sys"
+    # Metadata overrides; on real hosts these come from the TPU runtime env
+    # (GKE sets TPU_* env on node pools) or the C++ shim's sysfs probe.
+    generation: Optional[str] = None
+    slice_id: Optional[str] = None
+    slice_topology: Optional[str] = None
+    host_id: int = 0
+    hosts_per_slice: int = 1
+
+
+class ChipLib(abc.ABC):
+    """Interface mirrored from deviceLib (nvlib.go:40-46)."""
+
+    @abc.abstractmethod
+    def init(self) -> None: ...
+
+    @abc.abstractmethod
+    def shutdown(self) -> None: ...
+
+    @abc.abstractmethod
+    def enumerate_chips(self) -> list[ChipInfo]: ...
+
+    def enumerate_all_possible_devices(
+        self, device_classes: set[str]
+    ) -> AllocatableDevices:
+        """Enumerate chips + core partitions + ICI channels
+        (enumerateAllPossibleDevices, nvlib.go:111-136)."""
+        devices: AllocatableDevices = {}
+        chips = self.enumerate_chips()
+        if "chip" in device_classes or "tensorcore" in device_classes:
+            for chip in chips:
+                if "chip" in device_classes:
+                    d = AllocatableDevice(chip=chip)
+                    devices[d.canonical_name()] = d
+                if "tensorcore" in device_classes:
+                    for tc in self.enumerate_core_partitions(chip):
+                        d = AllocatableDevice(tensorcore=tc)
+                        devices[d.canonical_name()] = d
+        if "ici" in device_classes:
+            slice_id = chips[0].slice_id if chips else ""
+            for ch in self.enumerate_ici_channels(slice_id):
+                d = AllocatableDevice(ici_channel=ch)
+                devices[d.canonical_name()] = d
+        return devices
+
+    def enumerate_core_partitions(self, chip: ChipInfo) -> list[TensorCoreInfo]:
+        """Sub-chip partitions for a chip (role of MIG profile/placement
+        enumeration, nvlib.go:244-295)."""
+        spec = GENERATIONS.get(chip.generation)
+        if spec is None or not spec.partitionable or chip.cores < 2:
+            return []
+        return [
+            TensorCoreInfo(parent=chip, core_index=i) for i in range(chip.cores)
+        ]
+
+    def enumerate_ici_channels(
+        self, slice_id: str = ""
+    ) -> list[IciChannelInfo]:
+        """All possible interconnect channels (enumerateImexChannels,
+        nvlib.go:182-200; count hardcoded like nvlib.go:441-444)."""
+        return [
+            IciChannelInfo(channel=i, slice_id=slice_id)
+            for i in range(ICI_CHANNEL_COUNT)
+        ]
+
+    # --- side-effecting operations used at Prepare time -------------------
+
+    @abc.abstractmethod
+    def set_sharing_mode(self, chip_uuids: list[str], mode: str) -> None:
+        """Set the chip runtime sharing mode (role of setComputeMode /
+        setTimeSlice exec'ing nvidia-smi, nvlib.go:521-558)."""
+
+    @abc.abstractmethod
+    def create_ici_channel_device(self, channel: int) -> str:
+        """Materialise the per-channel device node (role of
+        createImexChannelDevice's mknod, nvlib.go:490-519). Returns path."""
+
+
+# ---------------------------------------------------------------------------
+# Fake backend (the testing seam the reference lacked — SURVEY.md §4)
+# ---------------------------------------------------------------------------
+
+
+class FakeChipLib(ChipLib):
+    """In-memory chip backend with a configurable slice topology."""
+
+    def __init__(
+        self,
+        generation: str = "v5p",
+        topology: str = "2x2x1",
+        host_id: int = 0,
+        hosts_per_slice: int = 1,
+        slice_id: str = "",
+        chips_per_host: Optional[int] = None,
+    ):
+        self.generation = generation
+        self.topology = MeshShape.parse(topology)
+        self.host_id = host_id
+        self.hosts_per_slice = hosts_per_slice
+        self.slice_id = slice_id or f"{generation}-{self.topology}-fake"
+        self.chips_per_host = (
+            chips_per_host
+            if chips_per_host is not None
+            else self.topology.num_chips // hosts_per_slice
+        )
+        self.initialized = False
+        # Side-effect journals for test assertions.
+        self.sharing_modes: dict[str, str] = {}
+        self.created_channels: list[int] = []
+
+    def init(self) -> None:
+        self.initialized = True
+
+    def shutdown(self) -> None:
+        self.initialized = False
+
+    def enumerate_chips(self) -> list[ChipInfo]:
+        spec = GENERATIONS[self.generation]
+        all_coords = list(self.topology.coords())
+        lo = self.host_id * self.chips_per_host
+        hi = lo + self.chips_per_host
+        chips = []
+        for local_idx, coord in enumerate(all_coords[lo:hi]):
+            serial = hashlib.sha256(
+                f"{self.slice_id}/{coord}".encode()
+            ).hexdigest()[:12]
+            chips.append(
+                ChipInfo(
+                    index=local_idx,
+                    uuid=f"TPU-{serial}",
+                    generation=self.generation,
+                    device_paths=[f"/dev/accel{local_idx}"],
+                    hbm_bytes=spec.hbm_bytes,
+                    cores=spec.cores_per_chip,
+                    coord=coord,
+                    slice_id=self.slice_id,
+                    slice_topology=self.topology,
+                    host_id=self.host_id,
+                    hosts_per_slice=self.hosts_per_slice,
+                    pci_address=f"0000:{local_idx:02x}:00.0",
+                    numa_node=local_idx % 2,
+                    driver_version="1.0.0",
+                    firmware_version="1.0.0",
+                )
+            )
+        return chips
+
+    def set_sharing_mode(self, chip_uuids: list[str], mode: str) -> None:
+        for u in chip_uuids:
+            self.sharing_modes[u] = mode
+
+    def create_ici_channel_device(self, channel: int) -> str:
+        self.created_channels.append(channel)
+        return f"/dev/tpu-ici-channels/channel{channel}"
+
+
+# ---------------------------------------------------------------------------
+# Real backend: /dev/accel* + sysfs probing (C++ shim with Python fallback)
+# ---------------------------------------------------------------------------
+
+ICI_CHANNEL_DIR = "dev/tpu-ici-channels"
+
+
+class RealChipLib(ChipLib):
+    """Probes the host for TPU chips.
+
+    Discovery sources, in order (mirrors the reference's layered root
+    resolution, cmd/nvidia-dra-plugin/root.go:29-81):
+
+    1. The native C++ shim (``libtpudiscovery.so``), which walks
+       ``/sys/class/accel`` / ``/sys/bus/pci`` and reads vendor/device ids,
+       NUMA nodes, and PCI addresses without spawning processes.
+    2. A pure-Python sysfs/glob fallback with identical semantics, used when
+       the shim is not built (e.g. unit tests on dev machines).
+    3. TPU runtime environment metadata for slice identity/topology —
+       the variables the GKE TPU node pools export (``TPU_WORKER_ID``,
+       ``TPU_ACCELERATOR_TYPE``, ``TPU_TOPOLOGY``, ``TPU_WORKER_HOSTNAMES``)
+       — overridable via ``ChipLibConfig``.
+    """
+
+    # PCI vendor id for Google; TPU device ids per generation.
+    GOOGLE_PCI_VENDOR = "0x1ae0"
+    PCI_DEVICE_GENERATIONS = {
+        "0x0027": "v2",
+        "0x0056": "v3",
+        "0x005e": "v4",
+        "0x0063": "v5e",
+        "0x0062": "v5p",
+        "0x006f": "v6e",
+    }
+
+    def __init__(self, config: Optional[ChipLibConfig] = None):
+        self.config = config or ChipLibConfig()
+        self.initialized = False
+        self._native = None
+
+    def init(self) -> None:
+        from . import _native
+
+        # Building at plugin startup is opt-in: container images ship the .so
+        # prebuilt, and the package dir may be read-only at runtime.
+        allow_build = os.environ.get("TPU_DRA_BUILD_NATIVE", "") == "1"
+        self._native = _native.load(allow_build=allow_build)
+        self.initialized = True
+
+    def shutdown(self) -> None:
+        self.initialized = False
+
+    # -- metadata ----------------------------------------------------------
+
+    def _env(self, name: str, default: str = "") -> str:
+        return os.environ.get(name, default)
+
+    def _detect_generation(self, pci_device_id: str) -> str:
+        if self.config.generation:
+            return normalize_generation(self.config.generation)
+        accel = self._env("TPU_ACCELERATOR_TYPE")  # e.g. "v5p-16", "v5litepod-8"
+        if accel:
+            return normalize_generation(accel.split("-")[0])
+        return self.PCI_DEVICE_GENERATIONS.get(pci_device_id, "v4")
+
+    def _slice_metadata(self, generation: str, n_local: int):
+        slice_id = self.config.slice_id or self._env(
+            "TPU_SLICE_ID", self._env("MEGASCALE_SLICE_ID", "")
+        )
+        topo_s = self.config.slice_topology or self._env("TPU_TOPOLOGY", "")
+        host_id = self.config.host_id or _safe_int(
+            self._env("TPU_WORKER_ID", "0"), 0
+        )
+        hostnames = self._env("TPU_WORKER_HOSTNAMES", "")
+        hosts = (
+            self.config.hosts_per_slice
+            if self.config.hosts_per_slice > 1
+            else (len(hostnames.split(",")) if hostnames else 1)
+        )
+        if topo_s:
+            topology = MeshShape.parse(topo_s)
+        else:
+            topology = default_slice_shapes(generation, n_local * hosts)
+        if not slice_id:
+            slice_id = f"{generation}-{topology}-{os.uname().nodename}"
+        return slice_id, topology, host_id, hosts
+
+    # -- device probing ----------------------------------------------------
+
+    def _probe_accel_nodes(self) -> list[tuple[int, str, str]]:
+        """Find (index, path, kind) for TPU device nodes.
+
+        kind is "accel" for /dev/accel* char devices (sysfs metadata
+        available) or "vfio" for /dev/vfio/* group nodes (v5p+ GKE hosts;
+        no accel-class sysfs entry, so metadata comes from env only).
+        """
+        nodes = []
+        for path in sorted(glob.glob(_hostpath(self.config.dev_root, "dev/accel[0-9]*"))):
+            m = re.search(r"accel(\d+)$", path)
+            if not m:
+                continue
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            if stat.S_ISCHR(st.st_mode):
+                nodes.append((int(m.group(1)), path, "accel"))
+        if not nodes:
+            vfio_paths = sorted(
+                glob.glob(_hostpath(self.config.dev_root, "dev/vfio/[0-9]*"))
+            )
+            for local_idx, path in enumerate(vfio_paths):
+                nodes.append((local_idx, path, "vfio"))
+        return nodes
+
+    def _sysfs_chip_meta(self, index: int) -> dict[str, str]:
+        """Read PCI metadata for accel device `index` from sysfs."""
+        if self._native is not None and self._native.available:
+            meta = self._native.chip_meta(self.config.sysfs_root, index)
+            if meta:
+                return meta
+        base = f"{self.config.sysfs_root}/class/accel/accel{index}/device"
+        meta = {}
+        for key in ("vendor", "device", "numa_node"):
+            try:
+                with open(f"{base}/{key}") as f:
+                    meta[key] = f.read().strip()
+            except OSError:
+                pass
+        try:
+            meta["pci_address"] = os.path.basename(os.readlink(base))
+        except OSError:
+            meta["pci_address"] = ""
+        return meta
+
+    def enumerate_chips(self) -> list[ChipInfo]:
+        nodes = self._probe_accel_nodes()
+        # Reject foreign accel-class devices (other vendors' NPUs also appear
+        # as /dev/accelN): keep a node only if its sysfs vendor is Google or
+        # vendor metadata is unavailable (vfio nodes, stripped sysfs).
+        kept = []
+        for index, path, kind in nodes:
+            if kind == "accel":
+                vendor = self._sysfs_chip_meta(index).get("vendor", "")
+                if vendor and vendor != self.GOOGLE_PCI_VENDOR:
+                    logger.info("skipping non-TPU accel device %s (vendor %s)",
+                                path, vendor)
+                    continue
+            kept.append((index, path, kind))
+        nodes = kept
+        if not nodes:
+            logger.warning("no TPU device nodes found under %s", self.config.dev_root)
+            return []
+        first_meta = (
+            self._sysfs_chip_meta(nodes[0][0]) if nodes[0][2] == "accel" else {}
+        )
+        generation = self._detect_generation(first_meta.get("device", ""))
+        spec = GENERATIONS.get(generation, GENERATIONS["v4"])
+        slice_id, topology, host_id, hosts = self._slice_metadata(
+            generation, len(nodes)
+        )
+        all_coords = list(topology.coords())
+        chips = []
+        for local_idx, (index, path, kind) in enumerate(nodes):
+            meta = self._sysfs_chip_meta(index) if kind == "accel" else {}
+            # Global position = host offset + local ordinal.
+            gpos = host_id * len(nodes) + local_idx
+            coord = all_coords[gpos] if gpos < len(all_coords) else Coord(0, 0, 0)
+            uid_src = meta.get("pci_address") or f"{slice_id}/{index}"
+            serial = hashlib.sha256(uid_src.encode()).hexdigest()[:12]
+            chips.append(
+                ChipInfo(
+                    index=index,
+                    uuid=f"TPU-{serial}",
+                    generation=generation,
+                    device_paths=[path],
+                    hbm_bytes=spec.hbm_bytes,
+                    cores=spec.cores_per_chip,
+                    coord=coord,
+                    slice_id=slice_id,
+                    slice_topology=topology,
+                    host_id=host_id,
+                    hosts_per_slice=hosts,
+                    pci_address=meta.get("pci_address", ""),
+                    numa_node=_safe_int(meta.get("numa_node"), -1),
+                    driver_version=self._libtpu_version(),
+                )
+            )
+        return chips
+
+    def _libtpu_version(self) -> str:
+        try:
+            import importlib.metadata as md
+
+            return md.version("libtpu")
+        except Exception:
+            return "0.0.0"
+
+    # -- side effects ------------------------------------------------------
+
+    def set_sharing_mode(self, chip_uuids: list[str], mode: str) -> None:
+        """Record the requested per-chip sharing mode.
+
+        The TPU runtime has no persistent on-device mode like NVML compute
+        modes; sharing is realised at Prepare time through the env/flags the
+        CDI spec injects (TPU_PROCESS_BOUNDS, multi-process flags — see
+        plugin/sharing.py).  We persist the requested mode in a small state
+        dir so that concurrent claims on one chip can be validated against it
+        (role of nvidia-smi -c, nvlib.go:541-558).
+        """
+        state_dir = _hostpath(self.config.dev_root, "var/run/tpu-dra")
+        os.makedirs(state_dir, exist_ok=True)
+        for u in chip_uuids:
+            with open(os.path.join(state_dir, f"{u}.mode"), "w") as f:
+                f.write(mode)
+
+    def create_ici_channel_device(self, channel: int) -> str:
+        """mknod the per-channel device (createImexChannelDevice,
+        nvlib.go:490-519)."""
+        dirpath = _hostpath(self.config.dev_root, ICI_CHANNEL_DIR)
+        os.makedirs(dirpath, exist_ok=True)
+        path = os.path.join(dirpath, f"channel{channel}")
+        if os.path.exists(path):
+            return path
+        major = self._ici_major()
+        if self._native is not None and self._native.available:
+            self._native.mknod_char(path, major, channel, 0o666)
+        else:
+            os.mknod(path, 0o666 | stat.S_IFCHR, os.makedev(major, channel))
+            os.chmod(path, 0o666)
+        return path
+
+    def _ici_major(self) -> int:
+        """Device major for ICI channel nodes from /proc/devices
+        (role of nvlib.go:446-488)."""
+        proc = _hostpath(self.config.dev_root, "proc/devices")
+        try:
+            with open(proc) as f:
+                for line in f:
+                    parts = line.split()
+                    if len(parts) == 2 and parts[1] in (
+                        "tpu-ici",
+                        "vfio",
+                        "accel",
+                    ):
+                        return int(parts[0])
+        except OSError:
+            pass
+        return 511  # dynamic-major fallback

@@ -1,0 +1,274 @@
+"""Allocatable-device model and ResourceSlice attribute rendering.
+
+TPU-native analog of the reference's deviceinfo.go + allocatable.go
+(lengrongfu/k8s-dra-driver, cmd/nvidia-dra-plugin/deviceinfo.go:30-217,
+allocatable.go:25-108): three device kinds form a tagged union —
+
+- ``ChipInfo``        — a whole TPU chip            (reference: GpuInfo)
+- ``TensorCoreInfo``  — a sub-chip core partition   (reference: MigDeviceInfo)
+- ``IciChannelInfo``  — an interconnect channel     (reference: ImexChannelInfo)
+
+Each renders itself to a ``resource.k8s.io`` Device (plain dict in k8s wire
+shape) with topology-first attributes so the stock scheduler's CEL /
+matchAttribute machinery can express things the reference could not, e.g.
+"4 chips forming a contiguous 2x2 sub-mesh on one host".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from .topology import GENERATIONS, Coord, MeshShape
+
+# Device type tags (reference: cmd/nvidia-dra-plugin/types.go:19-24).
+ChipDeviceType = "chip"
+TensorCoreDeviceType = "tensorcore"
+IciChannelDeviceType = "ici"
+UnknownDeviceType = "unknown"
+
+ATTR_PREFIX = "tpu.google.com"
+
+
+def _attr(value: Any) -> dict[str, Any]:
+    """Wrap a value in the DRA DeviceAttribute union shape."""
+    if isinstance(value, bool):
+        return {"bool": value}
+    if isinstance(value, int):
+        return {"int": value}
+    if isinstance(value, str):
+        # Version-ish strings go in the version slot, everything else string.
+        return {"string": value}
+    raise TypeError(f"unsupported attribute type: {type(value)!r}")
+
+
+def _version_attr(value: str) -> dict[str, Any]:
+    return {"version": value}
+
+
+@dataclasses.dataclass
+class ChipInfo:
+    """A whole TPU chip (reference GpuInfo, deviceinfo.go:30-43)."""
+
+    index: int                      # host-local chip index (device ordinal)
+    uuid: str                       # stable id, e.g. "TPU-<serial>"
+    generation: str                 # "v4" | "v5e" | "v5p" | "v6e" | ...
+    device_paths: list[str]         # e.g. ["/dev/accel0"] or vfio group nodes
+    hbm_bytes: int
+    cores: int                      # TensorCores on this chip
+    coord: Coord                    # ICI coordinates within the slice
+    slice_id: str                   # pod-slice identity, e.g. "v5p-16-abcd"
+    slice_topology: MeshShape       # physical shape of the owning slice
+    host_id: int                    # worker index within the slice
+    hosts_per_slice: int
+    pci_address: str = ""
+    numa_node: int = -1
+    driver_version: str = "0.0.0"   # libtpu version
+    firmware_version: str = "0.0.0"
+
+    def canonical_name(self) -> str:
+        return f"tpu-{self.index}"
+
+    def canonical_index(self) -> str:
+        return str(self.index)
+
+    def uuids(self) -> list[str]:
+        return [self.uuid]
+
+    def get_device(self) -> dict[str, Any]:
+        """Render as a resource.k8s.io Device (deviceinfo.go:98-140 analog)."""
+        spec = GENERATIONS.get(self.generation)
+        peak_flops = int(spec.peak_bf16_flops) if spec else 0
+        return {
+            "name": self.canonical_name(),
+            "basic": {
+                "attributes": {
+                    "type": _attr(ChipDeviceType),
+                    "uuid": _attr(self.uuid),
+                    "index": _attr(self.index),
+                    "generation": _attr(self.generation),
+                    "cores": _attr(self.cores),
+                    "iciX": _attr(self.coord.x),
+                    "iciY": _attr(self.coord.y),
+                    "iciZ": _attr(self.coord.z),
+                    "coord": _attr(str(self.coord)),
+                    "sliceId": _attr(self.slice_id),
+                    "sliceTopology": _attr(str(self.slice_topology)),
+                    "hostId": _attr(self.host_id),
+                    "hostsPerSlice": _attr(self.hosts_per_slice),
+                    "pcieAddress": _attr(self.pci_address),
+                    "numaNode": _attr(self.numa_node),
+                    "driverVersion": _version_attr(self.driver_version),
+                    "firmwareVersion": _version_attr(self.firmware_version),
+                },
+                "capacity": {
+                    "hbm": {"value": str(self.hbm_bytes)},
+                    "tensorcores": {"value": str(self.cores)},
+                    "peakBf16Flops": {"value": str(peak_flops)},
+                },
+            },
+        }
+
+
+@dataclasses.dataclass
+class TensorCoreInfo:
+    """A sub-chip TensorCore partition (reference MigDeviceInfo,
+    deviceinfo.go:45-56).
+
+    Where MIG slices a GPU into profiles with memory slices, TPU sub-chip
+    partitioning hands out individual TensorCores of a multi-core chip: on
+    v4/v5p each chip has two cores that can run independent programs when not
+    fused in megacore mode.  Each core partition is advertised as a
+    first-class device that consumes a share of its parent chip's counters.
+    """
+
+    parent: ChipInfo
+    core_index: int                 # 0..cores-1 within the parent chip
+    profile: str = "1c"             # partition profile name ("1c" = one core)
+
+    @property
+    def uuid(self) -> str:
+        return f"{self.parent.uuid}-core-{self.core_index}"
+
+    def canonical_name(self) -> str:
+        # reference: fmt "gpu-%d-mig-%d-%d-%d" deviceinfo.go:80-88
+        return f"tpu-{self.parent.index}-core-{self.core_index}"
+
+    def canonical_index(self) -> str:
+        return f"{self.parent.index}:{self.core_index}"
+
+    def uuids(self) -> list[str]:
+        return [self.uuid]
+
+    def get_device(self) -> dict[str, Any]:
+        hbm_share = self.parent.hbm_bytes // max(self.parent.cores, 1)
+        spec = GENERATIONS.get(self.parent.generation)
+        flops_share = (
+            int(spec.peak_bf16_flops) // max(self.parent.cores, 1) if spec else 0
+        )
+        dev = {
+            "name": self.canonical_name(),
+            "basic": {
+                "attributes": {
+                    "type": _attr(TensorCoreDeviceType),
+                    "uuid": _attr(self.uuid),
+                    "parentUuid": _attr(self.parent.uuid),
+                    "parentIndex": _attr(self.parent.index),
+                    "index": _attr(self.core_index),
+                    "profile": _attr(self.profile),
+                    "generation": _attr(self.parent.generation),
+                    "coord": _attr(str(self.parent.coord)),
+                    "sliceId": _attr(self.parent.slice_id),
+                    "hostId": _attr(self.parent.host_id),
+                    "driverVersion": _version_attr(self.parent.driver_version),
+                },
+                "capacity": {
+                    "hbm": {"value": str(hbm_share)},
+                    "tensorcores": {"value": "1"},
+                    "peakBf16Flops": {"value": str(flops_share)},
+                },
+            },
+        }
+        # consumesCounters ties core partitions of one chip together so the
+        # scheduler cannot double-book a chip as both whole and partitioned
+        # (role of MIG memory-slice capacities, deviceinfo.go:184-198).
+        dev["basic"]["consumesCounters"] = [
+            {
+                "counterSet": f"chip-{self.parent.index}-counters",
+                "counters": {
+                    "cores": {"value": "1"},
+                    "hbm": {"value": str(hbm_share)},
+                },
+            }
+        ]
+        return dev
+
+
+@dataclasses.dataclass
+class IciChannelInfo:
+    """A cross-host interconnect channel (reference ImexChannelInfo,
+    deviceinfo.go:58-61).
+
+    IMEX channels gate NVLink cross-node memory export; the TPU analog is a
+    claimable channel on a slice's ICI/DCN domain.  Workloads that want
+    cross-host collectives claim one channel per pod from the slice's domain
+    pool; preparation materialises the common launch environment (coordinator
+    address, megascale ids) that makes jax.distributed over ICI/DCN work.
+    """
+
+    channel: int
+    slice_id: str = ""
+
+    def canonical_name(self) -> str:
+        return f"ici-channel-{self.channel}"
+
+    def uuids(self) -> list[str]:
+        return [f"ici-channel-{self.channel}"]
+
+    def get_device(self) -> dict[str, Any]:
+        return {
+            "name": self.canonical_name(),
+            "basic": {
+                "attributes": {
+                    "type": _attr(IciChannelDeviceType),
+                    "channel": _attr(self.channel),
+                    "sliceId": _attr(self.slice_id),
+                },
+            },
+        }
+
+
+@dataclasses.dataclass
+class AllocatableDevice:
+    """Tagged union over the three device kinds (allocatable.go:27-31)."""
+
+    chip: Optional[ChipInfo] = None
+    tensorcore: Optional[TensorCoreInfo] = None
+    ici_channel: Optional[IciChannelInfo] = None
+
+    def type(self) -> str:
+        if self.chip is not None:
+            return ChipDeviceType
+        if self.tensorcore is not None:
+            return TensorCoreDeviceType
+        if self.ici_channel is not None:
+            return IciChannelDeviceType
+        return UnknownDeviceType
+
+    @property
+    def impl(self):
+        return self.chip or self.tensorcore or self.ici_channel
+
+    def canonical_name(self) -> str:
+        return self.impl.canonical_name()
+
+    def get_device(self) -> dict[str, Any]:
+        return self.impl.get_device()
+
+
+# name -> AllocatableDevice (reference: AllocatableDevices map, allocatable.go:25)
+AllocatableDevices = dict[str, AllocatableDevice]
+
+
+def chip_uuids(devices: AllocatableDevices) -> list[str]:
+    return sorted(
+        d.chip.uuid for d in devices.values() if d.chip is not None
+    )
+
+
+def counter_sets(devices: AllocatableDevices) -> list[dict[str, Any]]:
+    """SharedCounter sets for partitionable chips (one per multi-core chip)."""
+    out = []
+    for d in devices.values():
+        if d.chip is None or d.chip.cores < 2:
+            continue
+        out.append(
+            {
+                "name": f"chip-{d.chip.index}-counters",
+                "counters": {
+                    "cores": {"value": str(d.chip.cores)},
+                    "hbm": {"value": str(d.chip.hbm_bytes)},
+                },
+            }
+        )
+    return out

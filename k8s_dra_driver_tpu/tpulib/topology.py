@@ -1,0 +1,171 @@
+"""TPU generation specs and ICI topology math.
+
+This is the TPU-native replacement for the reference's GPU architecture /
+CUDA-compute-capability attribute surface (nvlib.go:202-313 in
+lengrongfu/k8s-dra-driver): instead of `architecture` + `cudaComputeCapability`
+we model the things a scheduler (and a JAX workload) actually needs on TPU —
+generation, cores per chip, HBM, peak FLOPs, and the chip's coordinates in the
+ICI mesh, so that multi-chip claims can demand *contiguous sub-meshes* via
+attribute selectors (the capability the reference deliberately skipped for
+dynamic MIG, device_state.go:512).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Iterator
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerationSpec:
+    """Static per-generation hardware description."""
+
+    name: str                   # "v4", "v5e", "v5p", "v6e"
+    cores_per_chip: int         # TensorCores per chip
+    hbm_bytes: int
+    # Peak dense bf16 FLOP/s per chip (both cores). Used by the workload layer
+    # for MFU accounting and published as a capacity so schedulers can reason
+    # about "how much compute" a claim grants.
+    peak_bf16_flops: float
+    # ICI mesh dimensionality: v4/v5p are 3D tori, v5e/v6e are 2D meshes.
+    ici_dims: int
+    # Whether two cores can be addressed as independent sub-chip devices
+    # ("megacore" generations fuse them; pre-v4 and v5e expose one core/chip).
+    partitionable: bool
+
+
+GENERATIONS: dict[str, GenerationSpec] = {
+    "v2": GenerationSpec("v2", 2, 8 << 30, 45e12, 2, True),
+    "v3": GenerationSpec("v3", 2, 16 << 30, 123e12, 2, True),
+    "v4": GenerationSpec("v4", 2, 32 << 30, 275e12, 3, True),
+    "v5e": GenerationSpec("v5e", 1, 16 << 30, 197e12, 2, False),
+    "v5p": GenerationSpec("v5p", 2, 95 << 30, 459e12, 3, True),
+    "v6e": GenerationSpec("v6e", 1, 32 << 30, 918e12, 2, False),
+}
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Coord:
+    """Chip coordinate in the ICI mesh (z is 0 for 2D generations)."""
+
+    x: int
+    y: int
+    z: int = 0
+
+    def as_tuple(self) -> tuple[int, int, int]:
+        return (self.x, self.y, self.z)
+
+    def __str__(self) -> str:  # "1,2,0"
+        return f"{self.x},{self.y},{self.z}"
+
+    @classmethod
+    def parse(cls, s: str) -> "Coord":
+        parts = [int(p) for p in s.split(",")]
+        while len(parts) < 3:
+            parts.append(0)
+        return cls(*parts[:3])
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshShape:
+    """Physical topology of a slice, e.g. 2x2x1 (v5e-4) or 4x4x4 (v5p-128)."""
+
+    x: int
+    y: int
+    z: int = 1
+
+    def __str__(self) -> str:
+        return f"{self.x}x{self.y}x{self.z}"
+
+    @classmethod
+    def parse(cls, s: str) -> "MeshShape":
+        parts = [int(p) for p in s.lower().split("x")]
+        while len(parts) < 3:
+            parts.append(1)
+        return cls(*parts[:3])
+
+    @property
+    def num_chips(self) -> int:
+        return self.x * self.y * self.z
+
+    def coords(self) -> Iterator[Coord]:
+        for x, y, z in itertools.product(
+            range(self.x), range(self.y), range(self.z)
+        ):
+            yield Coord(x, y, z)
+
+    def contains(self, c: Coord) -> bool:
+        return 0 <= c.x < self.x and 0 <= c.y < self.y and 0 <= c.z < self.z
+
+
+def is_contiguous_submesh(coords: list[Coord]) -> bool:
+    """True iff `coords` form a dense axis-aligned box in the ICI mesh.
+
+    This is the predicate behind gang allocation of multi-chip claims: a claim
+    for N chips is only useful if the chips are an unbroken sub-mesh, because
+    XLA's collective performance model assumes torus/mesh neighbours.  The
+    scheduler enforces it via matchAttribute on the submesh id we publish; this
+    helper is what the fake/real chiplibs and tests use to validate that.
+    """
+    if not coords:
+        return False
+    if len(set(coords)) != len(coords):
+        return False
+    xs = [c.x for c in coords]
+    ys = [c.y for c in coords]
+    zs = [c.z for c in coords]
+    dims = (
+        max(xs) - min(xs) + 1,
+        max(ys) - min(ys) + 1,
+        max(zs) - min(zs) + 1,
+    )
+    return dims[0] * dims[1] * dims[2] == len(coords)
+
+
+def enumerate_submeshes(
+    shape: MeshShape, sub: MeshShape
+) -> Iterator[tuple[Coord, list[Coord]]]:
+    """Yield (origin, member coords) for every axis-aligned `sub` box in `shape`.
+
+    Used by the plugin to publish "submesh" attributes (the TPU analog of MIG
+    placement enumeration, nvlib.go:244-295: for every profile, every placement
+    it fits is advertised so the scheduler can pick a non-overlapping one).
+    """
+    for ox in range(shape.x - sub.x + 1):
+        for oy in range(shape.y - sub.y + 1):
+            for oz in range(shape.z - sub.z + 1):
+                origin = Coord(ox, oy, oz)
+                members = [
+                    Coord(ox + dx, oy + dy, oz + dz)
+                    for dx, dy, dz in itertools.product(
+                        range(sub.x), range(sub.y), range(sub.z)
+                    )
+                ]
+                yield origin, members
+
+
+def default_slice_shapes(generation: str, num_chips: int) -> MeshShape:
+    """Best-effort physical shape for a slice of `num_chips` chips."""
+    spec = GENERATIONS.get(generation, GENERATIONS["v4"])
+    if spec.ici_dims == 2:
+        # Square-ish 2D mesh.
+        x = 1
+        for cand in range(1, int(num_chips**0.5) + 1):
+            if num_chips % cand == 0:
+                x = cand
+        return MeshShape(x, num_chips // x, 1)
+    # 3D torus: cube-ish factorisation.
+    best = (1, 1, num_chips)
+    for x in range(1, num_chips + 1):
+        if num_chips % x:
+            continue
+        rem = num_chips // x
+        for y in range(1, rem + 1):
+            if rem % y:
+                continue
+            z = rem // y
+            cand = tuple(sorted((x, y, z)))
+            if max(cand) - min(cand) < max(best) - min(best):
+                best = cand
+    return MeshShape(*best)

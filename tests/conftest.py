@@ -1,0 +1,18 @@
+"""Test-wide configuration.
+
+All tests run on CPU with a virtual 8-device platform so that multi-chip
+sharding paths (dp/fsdp/tp/sp meshes, ring attention, collectives) compile and
+execute without TPU hardware.  This is the testing seam the reference lacked
+(SURVEY.md §4): its only integration story was "run on real GPUs".
+"""
+
+import os
+
+# Must be set before jax is imported anywhere in the test session.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
