@@ -1,0 +1,17 @@
+"""CDI spec generation for TPU containers."""
+
+from .spec import (
+    CDI_VERSION,
+    CDIHandler,
+    ContainerEdits,
+    chip_visibility_env,
+    tensorcore_visibility_env,
+)
+
+__all__ = [
+    "CDI_VERSION",
+    "CDIHandler",
+    "ContainerEdits",
+    "chip_visibility_env",
+    "tensorcore_visibility_env",
+]

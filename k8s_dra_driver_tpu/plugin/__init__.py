@@ -1,0 +1,33 @@
+"""Node plugin: Prepare/Unprepare engine, sharing managers, DRA gRPC server."""
+
+from .checkpoint import CheckpointManager, CorruptCheckpointError
+from .device_state import DeviceState, PrepareError
+from .prepared import (
+    KubeletDevice,
+    PreparedClaim,
+    PreparedDevice,
+    PreparedDeviceGroup,
+)
+from .sharing import (
+    ModeConflictError,
+    ProcessShareManager,
+    SharingError,
+    SharingStateStore,
+    TimeShareManager,
+)
+
+__all__ = [
+    "CheckpointManager",
+    "CorruptCheckpointError",
+    "DeviceState",
+    "PrepareError",
+    "KubeletDevice",
+    "PreparedClaim",
+    "PreparedDevice",
+    "PreparedDeviceGroup",
+    "TimeShareManager",
+    "ProcessShareManager",
+    "SharingStateStore",
+    "SharingError",
+    "ModeConflictError",
+]

@@ -1,0 +1,85 @@
+"""Checksummed, versioned checkpoint store for prepared claims.
+
+Role of the reference's checkpoint (lengrongfu/k8s-dra-driver,
+cmd/nvidia-dra-plugin/checkpoint.go:9-53 + the vendored kubelet
+checkpointmanager): a single JSON file under the plugin registration dir
+holding every prepared claim, so Prepare is idempotent across kubelet retries
+and plugin restarts (device_state.go:134-156).
+
+Differences from the reference: writes are atomic (tempfile + rename — the
+kubelet manager does the same via its store), and corrupt checkpoints raise
+``CorruptCheckpointError`` instead of silently resetting, so operators see
+the condition.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+
+CHECKPOINT_VERSION = "v1"
+
+
+class CorruptCheckpointError(RuntimeError):
+    pass
+
+
+def _checksum(payload: dict) -> str:
+    """Stable digest over the payload with the checksum field zeroed
+    (compute-then-verify pattern, checkpoint.go:28-53)."""
+    clone = dict(payload)
+    clone["checksum"] = ""
+    blob = json.dumps(clone, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+class CheckpointManager:
+    """File-backed store of {claim_uid: prepared-claim JSON}."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def exists(self) -> bool:
+        return os.path.exists(self.path)
+
+    def create_if_missing(self) -> None:
+        """device_state.go:109-125 analog: start from an empty map."""
+        if not self.exists():
+            self.write({})
+
+    def read(self) -> dict[str, dict]:
+        with open(self.path) as f:
+            payload = json.load(f)
+        want = payload.get("checksum", "")
+        if _checksum(payload) != want:
+            raise CorruptCheckpointError(
+                f"checkpoint {self.path}: checksum mismatch"
+            )
+        if payload.get("version") != CHECKPOINT_VERSION:
+            raise CorruptCheckpointError(
+                f"checkpoint {self.path}: unknown version {payload.get('version')!r}"
+            )
+        return payload["preparedClaims"]
+
+    def write(self, prepared_claims: dict[str, dict]) -> None:
+        payload = {
+            "version": CHECKPOINT_VERSION,
+            "preparedClaims": prepared_claims,
+            "checksum": "",
+        }
+        payload["checksum"] = _checksum(payload)
+        d = os.path.dirname(self.path) or "."
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".ckpt.tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(payload, f, indent=1)
+            os.rename(tmp, self.path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise

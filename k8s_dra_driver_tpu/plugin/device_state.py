@@ -1,0 +1,411 @@
+"""DeviceState: the idempotent Prepare/Unprepare engine.
+
+Analog of the reference's device_state.go (lengrongfu/k8s-dra-driver,
+cmd/nvidia-dra-plugin/device_state.go:57-558): holds the allocatable map, CDI
+handler, sharing managers and checkpoint manager; resolves opaque configs
+with class<claim precedence + per-type defaults; applies sharing / channel
+configs; and records everything in a checkpoint so kubelet retries and
+plugin restarts are safe.
+
+The claim objects handled here are resource.k8s.io/v1alpha3 ResourceClaims
+in wire (dict) form with ``status.allocation.devices.results`` and
+``status.allocation.devices.config``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Optional
+
+from ..api.v1alpha1 import (
+    API_VERSION,
+    IciChannelConfig,
+    TensorCoreConfig,
+    TpuChipConfig,
+    decode_config,
+)
+from ..cdi.spec import CDIHandler, ContainerEdits, claim_visibility_env
+from ..tpulib.chiplib import SHARING_EXCLUSIVE, ChipLib
+from ..tpulib.deviceinfo import (
+    AllocatableDevice,
+    AllocatableDevices,
+    ChipDeviceType,
+    IciChannelDeviceType,
+    TensorCoreDeviceType,
+)
+from .checkpoint import CheckpointManager
+from .prepared import (
+    KubeletDevice,
+    PreparedClaim,
+    PreparedDevice,
+    PreparedDeviceGroup,
+)
+from .sharing import ProcessShareManager, SharingStateStore, TimeShareManager
+
+logger = logging.getLogger(__name__)
+
+
+class PrepareError(RuntimeError):
+    pass
+
+
+# Which config kind governs which device type (role of the type-compatibility
+# switch in device_state.go:225-259).
+_CONFIG_TYPE_FOR_DEVICE = {
+    ChipDeviceType: TpuChipConfig,
+    TensorCoreDeviceType: TensorCoreConfig,
+    IciChannelDeviceType: IciChannelConfig,
+}
+
+
+class OpaqueDeviceConfig:
+    """A decoded opaque config + the requests it applies to."""
+
+    def __init__(self, requests: list[str], config: Any, source: str):
+        self.requests = requests
+        self.config = config
+        self.source = source  # "default" | "FromClass" | "FromClaim"
+
+    def applies_to(self, request: str) -> bool:
+        return not self.requests or request in self.requests
+
+
+class DeviceState:
+    """NewDeviceState analog (device_state.go:57-126)."""
+
+    def __init__(
+        self,
+        chiplib: ChipLib,
+        cdi: CDIHandler,
+        checkpoint: CheckpointManager,
+        driver_name: str,
+        pool_name: str,
+        state_dir: str,
+        device_classes: Optional[set[str]] = None,
+    ):
+        self.chiplib = chiplib
+        self.cdi = cdi
+        self.checkpoint = checkpoint
+        self.driver_name = driver_name
+        self.pool_name = pool_name
+        self.device_classes = device_classes or {"chip", "tensorcore", "ici"}
+        self._lock = threading.Lock()
+
+        self.chiplib.init()
+        self.allocatable: AllocatableDevices = (
+            self.chiplib.enumerate_all_possible_devices(self.device_classes)
+        )
+        self.cdi.create_standard_device_spec_file(self.allocatable)
+
+        share_state = SharingStateStore(f"{state_dir}/sharing")
+        self.ts_manager = TimeShareManager(self.chiplib, share_state)
+        self.ps_manager = ProcessShareManager(
+            self.chiplib, share_state, f"{state_dir}/process-share"
+        )
+        self.share_state = share_state
+        self.checkpoint.create_if_missing()
+
+    # ------------------------------------------------------------------
+    # Prepare
+    # ------------------------------------------------------------------
+
+    def prepare(self, claim: dict) -> list[KubeletDevice]:
+        """Idempotent prepare (device_state.go:128-159)."""
+        claim_uid = claim["metadata"]["uid"]
+        with self._lock:
+            prepared_claims = self.checkpoint.read()
+            if claim_uid in prepared_claims:
+                cached = PreparedClaim.from_dict(prepared_claims[claim_uid])
+                return cached.get_devices()
+            prepared = self._prepare_devices(claim)
+            prepared_claims[claim_uid] = prepared.to_dict()
+            self.checkpoint.write(prepared_claims)
+            return prepared.get_devices()
+
+    def _allocation_results(self, claim: dict) -> list[dict]:
+        alloc = ((claim.get("status") or {}).get("allocation") or {})
+        results = ((alloc.get("devices") or {}).get("results")) or []
+        return [r for r in results if r.get("driver", self.driver_name) == self.driver_name]
+
+    def get_opaque_device_configs(self, claim: dict) -> list[OpaqueDeviceConfig]:
+        """Decode class/claim opaque configs, lowest→highest precedence
+        (GetOpaqueDeviceConfigs analog, device_state.go:457-510)."""
+        alloc = ((claim.get("status") or {}).get("allocation") or {})
+        raw_configs = ((alloc.get("devices") or {}).get("config")) or []
+        from_class: list[OpaqueDeviceConfig] = []
+        from_claim: list[OpaqueDeviceConfig] = []
+        for rc in raw_configs:
+            opaque = rc.get("opaque")
+            if not opaque or opaque.get("driver") != self.driver_name:
+                continue
+            params = opaque.get("parameters")
+            if params is None:
+                raise PrepareError("opaque config with no parameters")
+            cfg = decode_config(params)
+            entry = OpaqueDeviceConfig(
+                list(rc.get("requests", [])), cfg, rc.get("source", "FromClaim")
+            )
+            if rc.get("source") == "FromClass":
+                from_class.append(entry)
+            else:
+                from_claim.append(entry)
+        defaults = [
+            OpaqueDeviceConfig([], TpuChipConfig.default(), "default"),
+            OpaqueDeviceConfig([], TensorCoreConfig.default(), "default"),
+            OpaqueDeviceConfig([], IciChannelConfig.default(), "default"),
+        ]
+        # Precedence: defaults < FromClass < FromClaim (device_state.go:210-221).
+        return defaults + from_class + from_claim
+
+    def _resolve_config(
+        self, configs: list[OpaqueDeviceConfig], request: str, device_type: str
+    ) -> OpaqueDeviceConfig:
+        """Highest-precedence type-compatible config for one allocation
+        result (device_state.go:225-259)."""
+        want_cls = _CONFIG_TYPE_FOR_DEVICE[device_type]
+        for c in reversed(configs):
+            if isinstance(c.config, want_cls) and c.applies_to(request):
+                return c
+        raise PrepareError(
+            f"no config applies to request {request!r} ({device_type})"
+        )
+
+    def _prepare_devices(self, claim: dict) -> PreparedClaim:
+        """device_state.go:192-348 analog."""
+        claim_uid = claim["metadata"]["uid"]
+        results = self._allocation_results(claim)
+        if not results:
+            raise PrepareError(
+                f"claim {claim_uid} has no allocation for driver {self.driver_name}"
+            )
+        configs = self.get_opaque_device_configs(claim)
+
+        # Group allocation results by their resolved config instance.
+        grouped: dict[int, tuple[OpaqueDeviceConfig, list[tuple[str, AllocatableDevice]]]] = {}
+        for r in results:
+            name = r["device"]
+            dev = self.allocatable.get(name)
+            if dev is None:
+                raise PrepareError(f"allocated device {name!r} is not allocatable here")
+            cfg = self._resolve_config(configs, r.get("request", ""), dev.type())
+            key = id(cfg)
+            grouped.setdefault(key, (cfg, []))[1].append((r.get("request", ""), dev))
+
+        groups: list[PreparedDeviceGroup] = []
+        claim_device_edits: dict[str, ContainerEdits] = {}
+        # (strategy, uuids) per applied group, for rollback on partial failure.
+        applied: list[tuple[str, list[str]]] = []
+        try:
+            for cfg, members in grouped.values():
+                config = cfg.config
+                config.normalize()
+                config.validate()
+                devices = [d for _, d in members]
+                group_edits = self._apply_config(claim_uid, config, devices)
+                applied.append(
+                    (
+                        self._config_strategy(config.to_dict()),
+                        [u for d in devices for u in d.impl.uuids()],
+                    )
+                )
+
+                prepared_devices = []
+                for request, dev in members:
+                    name = dev.canonical_name()
+                    cdi_ids = [self.cdi.get_standard_device(name)]
+                    per_dev = self._device_edits(dev, group_edits)
+                    if per_dev is not None:
+                        claim_device_edits[name] = per_dev
+                        cdi_ids.append(self.cdi.get_claim_device(claim_uid, name))
+                    prepared_devices.append(
+                        PreparedDevice(
+                            type=dev.type(),
+                            name=name,
+                            uuids=dev.impl.uuids(),
+                            kubelet_device=KubeletDevice(
+                                request_names=[request] if request else [],
+                                pool_name=self.pool_name,
+                                device_name=name,
+                                cdi_device_ids=cdi_ids,
+                            ),
+                            chip_index=(dev.chip.index if dev.chip else
+                                        dev.tensorcore.parent.index if dev.tensorcore else None),
+                            core_index=(dev.tensorcore.core_index if dev.tensorcore else None),
+                            channel=(dev.ici_channel.channel if dev.ici_channel else None),
+                            channel_path=group_edits.channel_paths.get(name, ""),
+                        )
+                    )
+                groups.append(
+                    PreparedDeviceGroup(devices=prepared_devices, config=config.to_dict())
+                )
+        except BaseException:
+            # Roll back acquisitions from already-applied groups; otherwise a
+            # half-prepared claim that kubelet never retries (pod deleted)
+            # would pin chips in a stale sharing mode forever.
+            for strategy, uuids in applied:
+                try:
+                    self._release_group(claim_uid, strategy, uuids)
+                except Exception:
+                    logger.exception(
+                        "rollback of claim %s (%s) failed", claim_uid, strategy
+                    )
+            raise
+
+        # Visibility env over the WHOLE claim (all groups), so multi-group
+        # allocations present every chip to libtpu.
+        all_devices = [d for _, (_, ms) in grouped.items() for _, d in ms]
+        common_env = claim_visibility_env(
+            [d.chip for d in all_devices if d.chip is not None],
+            [d.tensorcore for d in all_devices if d.tensorcore is not None],
+        )
+
+        self.cdi.create_claim_spec_file(claim_uid, claim_device_edits, common_env)
+        return PreparedClaim(
+            claim_uid=claim_uid,
+            namespace=claim["metadata"].get("namespace", ""),
+            name=claim["metadata"].get("name", ""),
+            groups=groups,
+        )
+
+    class _GroupEdits:
+        """Edits produced by applying one config to its devices."""
+
+        def __init__(self):
+            self.shared: ContainerEdits = ContainerEdits()
+            self.channel_paths: dict[str, str] = {}
+
+    def _apply_config(
+        self, claim_uid: str, config, devices: list[AllocatableDevice]
+    ) -> "_GroupEdits":
+        """applyConfig dispatch (device_state.go:261-297)."""
+        out = DeviceState._GroupEdits()
+        if isinstance(config, (TpuChipConfig, TensorCoreConfig)):
+            out.shared = self._apply_sharing_config(claim_uid, config, devices)
+        elif isinstance(config, IciChannelConfig):
+            out.channel_paths = self._apply_ici_channel_config(devices)
+        else:
+            raise PrepareError(f"unknown config type: {type(config)!r}")
+        return out
+
+    def _apply_sharing_config(
+        self, claim_uid: str, config, devices: list[AllocatableDevice]
+    ) -> ContainerEdits:
+        """applySharingConfig analog (device_state.go:380-428)."""
+        sharing = config.sharing
+        if sharing.is_time_shared():
+            return self.ts_manager.set_time_share(
+                claim_uid, devices, sharing.get_time_shared_config()
+            )
+        if sharing.is_process_shared():
+            session = self.ps_manager.new_session(
+                claim_uid, devices, sharing.get_process_shared_config()
+            )
+            session.start()
+            return session.container_edits()
+        # Exclusive: acquire so a concurrent shared claim on the same chip
+        # (via adminAccess or scheduler bug) is detected, not silently run.
+        for d in devices:
+            for u in d.impl.uuids():
+                self.share_state.acquire(u, claim_uid, SHARING_EXCLUSIVE)
+        return ContainerEdits(env={"TPU_DRA_SHARING": "exclusive"})
+
+    def _apply_ici_channel_config(
+        self, devices: list[AllocatableDevice]
+    ) -> dict[str, str]:
+        """applyImexChannelConfig analog (device_state.go:430-444)."""
+        paths: dict[str, str] = {}
+        for d in devices:
+            ch = d.ici_channel
+            if ch is None:
+                raise PrepareError(
+                    f"IciChannelConfig applied to non-channel device {d.canonical_name()}"
+                )
+            paths[d.canonical_name()] = self.chiplib.create_ici_channel_device(
+                ch.channel
+            )
+        return paths
+
+    def _device_edits(
+        self, dev: AllocatableDevice, group_edits: "_GroupEdits"
+    ) -> Optional[ContainerEdits]:
+        """Claim-spec edits for one device, or None if nothing beyond the
+        base spec is needed."""
+        edits = ContainerEdits(
+            env=dict(group_edits.shared.env),
+            mounts=list(group_edits.shared.mounts),
+        )
+        path = group_edits.channel_paths.get(dev.canonical_name())
+        if path:
+            edits.device_nodes.append(path)
+        if not (edits.env or edits.mounts or edits.device_nodes):
+            return None
+        return edits
+
+    # ------------------------------------------------------------------
+    # Unprepare
+    # ------------------------------------------------------------------
+
+    def unprepare(self, claim_uid: str) -> None:
+        """Idempotent unprepare (device_state.go:161-190)."""
+        with self._lock:
+            prepared_claims = self.checkpoint.read()
+            if claim_uid not in prepared_claims:
+                logger.info("claim %s not in checkpoint; nothing to unprepare", claim_uid)
+                return
+            prepared = PreparedClaim.from_dict(prepared_claims[claim_uid])
+            self._unprepare_devices(claim_uid, prepared)
+            self.cdi.delete_claim_spec_file(claim_uid)
+            del prepared_claims[claim_uid]
+            self.checkpoint.write(prepared_claims)
+
+    @staticmethod
+    def _config_strategy(config_dict: dict) -> str:
+        """Sharing strategy recorded in a group's wire-form config
+        ("" for channel configs)."""
+        if config_dict.get("kind") == "IciChannelConfig":
+            return ""
+        return (config_dict.get("sharing") or {}).get("strategy", "")
+
+    def _release_group(self, claim_uid: str, strategy: str, uuids: list[str]) -> None:
+        """Undo one group's sharing acquisition (shared by unprepare and
+        prepare-rollback)."""
+        if strategy == "ProcessShared":
+            self.ps_manager.stop_session(claim_uid, uuids)
+        elif strategy == "TimeShared":
+            self.ts_manager.reset(claim_uid, uuids)
+        elif strategy:
+            for u in uuids:
+                self.share_state.release(u, claim_uid)
+        # ICI channel device nodes are shared across claims on the node
+        # and cheap; they are left in place (mirrors the reference, which
+        # never removes IMEX channel nodes it mknod'ed).
+
+    def _unprepare_devices(self, claim_uid: str, prepared: PreparedClaim) -> None:
+        """unprepareDevices analog (device_state.go:350-365)."""
+        for group in prepared.groups:
+            self._release_group(
+                claim_uid,
+                self._config_strategy(group.config),
+                [u for d in group.devices for u in d.uuids],
+            )
+
+    # ------------------------------------------------------------------
+    # Publication
+    # ------------------------------------------------------------------
+
+    def published_resources(self) -> dict[str, Any]:
+        """DriverResources (pool spec) for the ResourceSlice controller —
+        node-local devices only, ICI channels are published by the cluster
+        controller (driver.go:69-80 excludes IMEX likewise)."""
+        from ..tpulib.deviceinfo import counter_sets
+
+        devices = []
+        for name, dev in sorted(self.allocatable.items()):
+            if dev.ici_channel is not None:
+                continue
+            devices.append(dev.get_device())
+        return {
+            "devices": devices,
+            "sharedCounters": counter_sets(self.allocatable),
+        }

@@ -1,0 +1,26 @@
+"""Filesystem helpers shared by the CDI writer, checkpoint, and state stores."""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+
+def atomic_write_json(path: str, obj: dict, indent: int | None = 2) -> None:
+    """Write JSON via tempfile + rename so readers never see a torn file
+    (the property kubelet's checkpoint store provides in the reference)."""
+    d = os.path.dirname(path) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(obj, f, indent=indent, sort_keys=True)
+            f.write("\n")
+        os.rename(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise

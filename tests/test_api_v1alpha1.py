@@ -1,0 +1,249 @@
+"""Tests for the tpu.google.com/v1alpha1 opaque config API.
+
+Coverage model: the reference's only unit-test file
+(api/nvidia.com/resource/gpu/v1alpha1/sharing_test.go — UUID/index keys,
+defaults, overrides, unit conversion, error sentinels) plus decoder and
+Normalize/Validate paths it left untested.
+"""
+
+import pytest
+
+from k8s_dra_driver_tpu.api.v1alpha1 import (
+    EXCLUSIVE,
+    PROCESS_SHARED,
+    TIME_SHARED,
+    ConfigError,
+    ErrInvalidDeviceSelector,
+    ErrInvalidLimit,
+    IciChannelConfig,
+    PerChipHbmLimit,
+    TensorCoreConfig,
+    TpuChipConfig,
+    decode_config,
+    parse_quantity,
+    to_mebibytes_string,
+)
+
+UUIDS = ["TPU-aaaa00000000", "TPU-bbbb00000000", "TPU-cccc00000000"]
+
+
+class TestQuantity:
+    @pytest.mark.parametrize(
+        "s,expect",
+        [
+            ("1Ki", 1024),
+            ("16Gi", 16 << 30),
+            ("512Mi", 512 << 20),
+            ("4G", 4 * 10**9),
+            ("100M", 10**8),
+            ("123", 123),
+            (123, 123),
+            ("1.5Gi", int(1.5 * (1 << 30))),
+            ("2e3", 2000),
+        ],
+    )
+    def test_parse(self, s, expect):
+        assert parse_quantity(s) == expect
+
+    @pytest.mark.parametrize("s", ["", "abc", "1X", "Gi", "--3"])
+    def test_parse_invalid(self, s):
+        with pytest.raises(ValueError):
+            parse_quantity(s)
+
+    def test_render(self):
+        assert to_mebibytes_string(16 << 30) == "16384Mi"
+
+
+class TestPerChipHbmLimit:
+    """Table mirror of sharing_test.go:28-160."""
+
+    def test_default_only(self):
+        out = PerChipHbmLimit().normalize(UUIDS, "1Gi")
+        assert out == {u: "1024Mi" for u in UUIDS}
+
+    def test_no_default_no_entries(self):
+        assert PerChipHbmLimit().normalize(UUIDS, None) == {}
+
+    def test_index_key_resolves_positionally(self):
+        out = PerChipHbmLimit({"1": "2Gi"}).normalize(UUIDS, None)
+        assert out == {UUIDS[1]: "2048Mi"}
+
+    def test_uuid_key(self):
+        out = PerChipHbmLimit({UUIDS[2]: "512Mi"}).normalize(UUIDS, None)
+        assert out == {UUIDS[2]: "512Mi"}
+
+    def test_override_beats_default(self):
+        out = PerChipHbmLimit({"0": "2Gi"}).normalize(UUIDS, "1Gi")
+        assert out[UUIDS[0]] == "2048Mi"
+        assert out[UUIDS[1]] == "1024Mi"
+
+    def test_decimal_unit_conversion(self):
+        out = PerChipHbmLimit({"0": "4G"}).normalize(UUIDS, None)
+        # 4e9 bytes is not a whole number of MiB; normalization rounds up.
+        assert out == {UUIDS[0]: f"{-(-4 * 10**9 // (1 << 20))}Mi"}
+
+    def test_unknown_uuid_rejected(self):
+        with pytest.raises(ErrInvalidDeviceSelector):
+            PerChipHbmLimit({"TPU-ffff00000000": "1Gi"}).normalize(UUIDS, None)
+
+    def test_index_out_of_range_rejected(self):
+        with pytest.raises(ErrInvalidDeviceSelector):
+            PerChipHbmLimit({"7": "1Gi"}).normalize(UUIDS, None)
+
+    def test_bad_limit_rejected(self):
+        with pytest.raises(ValueError):
+            PerChipHbmLimit({"0": "wat"}).normalize(UUIDS, None)
+        with pytest.raises(ErrInvalidLimit):
+            PerChipHbmLimit({"0": "0"}).normalize(UUIDS, None)
+
+    def test_validate_selector_syntax(self):
+        PerChipHbmLimit({"0": "1Gi", UUIDS[0]: "1Gi", "0:1": "1Gi"}).validate()
+        with pytest.raises(ErrInvalidDeviceSelector):
+            PerChipHbmLimit({"gpu-0": "1Gi"}).validate()
+
+
+class TestDecode:
+    def test_chip_config_roundtrip(self):
+        raw = {
+            "apiVersion": "tpu.google.com/v1alpha1",
+            "kind": "TpuChipConfig",
+            "sharing": {
+                "strategy": "ProcessShared",
+                "processSharedConfig": {"maxProcesses": 4},
+            },
+        }
+        cfg = decode_config(raw)
+        assert isinstance(cfg, TpuChipConfig)
+        cfg.normalize()
+        cfg.validate()
+        assert cfg.sharing.get_process_shared_config().max_processes == 4
+        assert cfg.to_dict()["sharing"]["strategy"] == "ProcessShared"
+
+    def test_unknown_kind(self):
+        with pytest.raises(ConfigError):
+            decode_config(
+                {"apiVersion": "tpu.google.com/v1alpha1", "kind": "GpuConfig"}
+            )
+
+    def test_unknown_api_version(self):
+        with pytest.raises(ConfigError):
+            decode_config({"apiVersion": "gpu.nvidia.com/v1alpha1",
+                           "kind": "TpuChipConfig"})
+
+    def test_strict_unknown_fields(self):
+        with pytest.raises(ValueError, match="unknown field"):
+            decode_config(
+                {
+                    "apiVersion": "tpu.google.com/v1alpha1",
+                    "kind": "TpuChipConfig",
+                    "sharing": {"strategy": "Exclusive", "bogus": 1},
+                }
+            )
+
+    def test_ici_channel_config(self):
+        cfg = decode_config(
+            {"apiVersion": "tpu.google.com/v1alpha1", "kind": "IciChannelConfig"}
+        )
+        assert isinstance(cfg, IciChannelConfig)
+        cfg.normalize()
+        cfg.validate()
+
+
+class TestNormalizeValidate:
+    def test_default_is_exclusive(self):
+        cfg = TpuChipConfig.default()
+        cfg.normalize()
+        cfg.validate()
+        assert cfg.sharing.is_exclusive()
+
+    def test_time_shared_fills_interval(self):
+        cfg = decode_config(
+            {
+                "apiVersion": "tpu.google.com/v1alpha1",
+                "kind": "TpuChipConfig",
+                "sharing": {"strategy": "TimeShared"},
+            }
+        )
+        cfg.normalize()
+        cfg.validate()
+        ts = cfg.sharing.get_time_shared_config()
+        assert ts.interval == "Default"
+        assert ts.quantum_level() == 0
+
+    def test_bad_interval_rejected(self):
+        cfg = decode_config(
+            {
+                "apiVersion": "tpu.google.com/v1alpha1",
+                "kind": "TpuChipConfig",
+                "sharing": {
+                    "strategy": "TimeShared",
+                    "timeSharedConfig": {"interval": "Forever"},
+                },
+            }
+        )
+        cfg.normalize()
+        with pytest.raises(ValueError, match="interval"):
+            cfg.validate()
+
+    def test_process_shared_defaults(self):
+        cfg = TpuChipConfig.from_dict(
+            {"kind": "TpuChipConfig", "sharing": {"strategy": "ProcessShared"}}
+        )
+        cfg.normalize()
+        cfg.validate()
+        assert cfg.sharing.get_process_shared_config().max_processes == 2
+
+    def test_process_shared_bounds(self):
+        for bad in [0, 65, -1]:
+            cfg = TpuChipConfig.from_dict(
+                {
+                    "sharing": {
+                        "strategy": "ProcessShared",
+                        "processSharedConfig": {"maxProcesses": bad},
+                    }
+                }
+            )
+            cfg.normalize()
+            with pytest.raises(ValueError, match="maxProcesses"):
+                cfg.validate()
+
+    def test_core_percentage_bounds(self):
+        cfg = TpuChipConfig.from_dict(
+            {
+                "sharing": {
+                    "strategy": "ProcessShared",
+                    "processSharedConfig": {"defaultActiveCorePercentage": 101},
+                }
+            }
+        )
+        cfg.normalize()
+        with pytest.raises(ValueError, match="CorePercentage"):
+            cfg.validate()
+
+    def test_wrong_strategy_accessor_raises(self):
+        cfg = TpuChipConfig.default()
+        cfg.normalize()
+        with pytest.raises(ValueError):
+            cfg.sharing.get_process_shared_config()
+
+    def test_exclusive_rejects_subconfig(self):
+        cfg = TpuChipConfig.from_dict(
+            {
+                "sharing": {
+                    "strategy": "Exclusive",
+                    "timeSharedConfig": {"interval": "Short"},
+                }
+            }
+        )
+        with pytest.raises(ValueError, match="Exclusive"):
+            cfg.validate()
+
+    def test_tensorcore_exclusive_only(self):
+        for strategy in ("TimeShared", "ProcessShared"):
+            cfg = TensorCoreConfig.from_dict({"sharing": {"strategy": strategy}})
+            cfg.normalize()
+            with pytest.raises(ConfigError, match="only Exclusive"):
+                cfg.validate()
+        cfg = TensorCoreConfig.from_dict({"sharing": {"strategy": "Exclusive"}})
+        cfg.normalize()
+        cfg.validate()

@@ -1,0 +1,121 @@
+"""Tests for TPU CDI spec generation."""
+
+import json
+
+from k8s_dra_driver_tpu.cdi import (
+    CDIHandler,
+    ContainerEdits,
+    chip_visibility_env,
+    tensorcore_visibility_env,
+)
+from k8s_dra_driver_tpu.tpulib import FakeChipLib
+
+
+def make_devices(generation="v5p", topology="2x2x1", classes=("chip",)):
+    lib = FakeChipLib(generation=generation, topology=topology)
+    lib.init()
+    return lib.enumerate_all_possible_devices(set(classes))
+
+
+class TestBaseSpec:
+    def test_standard_spec_contents(self, tmp_path):
+        h = CDIHandler(str(tmp_path))
+        devs = make_devices()
+        path = h.create_standard_device_spec_file(devs)
+        spec = json.loads(open(path).read())
+        assert spec["cdiVersion"] == "0.7.0"
+        assert spec["kind"] == "k8s.tpu.google.com/chip"
+        names = [d["name"] for d in spec["devices"]]
+        assert names == sorted(devs)
+        tpu0 = next(d for d in spec["devices"] if d["name"] == "tpu-0")
+        assert tpu0["containerEdits"]["deviceNodes"] == [
+            {"path": "/dev/accel0", "type": "c", "permissions": "rw"}
+        ]
+        assert "TPU_DRA_MANAGED=1" in spec["containerEdits"]["env"]
+
+    def test_tensorcore_inherits_parent_node(self, tmp_path):
+        h = CDIHandler(str(tmp_path))
+        devs = make_devices(classes=("chip", "tensorcore"))
+        path = h.create_standard_device_spec_file(devs)
+        spec = json.loads(open(path).read())
+        core = next(
+            d for d in spec["devices"] if d["name"] == "tpu-1-core-0"
+        )
+        assert core["containerEdits"]["deviceNodes"][0]["path"] == "/dev/accel1"
+
+    def test_rewrite_is_idempotent(self, tmp_path):
+        h = CDIHandler(str(tmp_path))
+        devs = make_devices()
+        p1 = h.create_standard_device_spec_file(devs)
+        p2 = h.create_standard_device_spec_file(devs)
+        assert p1 == p2
+        assert len(list(tmp_path.iterdir())) == 1
+
+
+class TestClaimSpec:
+    def test_claim_spec_lifecycle(self, tmp_path):
+        h = CDIHandler(str(tmp_path))
+        edits = {
+            "tpu-0": ContainerEdits(
+                env={"TPU_VISIBLE_CHIPS": "0"}, device_nodes=["/dev/accel0"]
+            )
+        }
+        path = h.create_claim_spec_file(
+            "uid-123", edits, common_env={"TPU_SLICE_ID": "s1"}
+        )
+        spec = json.loads(open(path).read())
+        assert spec["kind"] == "k8s.tpu.google.com/claim"
+        assert spec["devices"][0]["name"] == "uid-123-tpu-0"
+        assert "TPU_VISIBLE_CHIPS=0" in spec["devices"][0]["containerEdits"]["env"]
+        assert "TPU_SLICE_ID=s1" in spec["containerEdits"]["env"]
+        assert h.list_claim_spec_uids() == ["uid-123"]
+        h.delete_claim_spec_file("uid-123")
+        assert h.list_claim_spec_uids() == []
+        h.delete_claim_spec_file("uid-123")  # idempotent
+
+    def test_qualified_names(self, tmp_path):
+        h = CDIHandler(str(tmp_path))
+        assert h.get_standard_device("tpu-0") == "k8s.tpu.google.com/chip=tpu-0"
+        assert (
+            h.get_claim_device("u1", "tpu-0")
+            == "k8s.tpu.google.com/claim=u1-tpu-0"
+        )
+
+
+class TestVisibilityEnv:
+    def test_chip_env(self):
+        lib = FakeChipLib(generation="v5p", topology="2x2x1", slice_id="s9")
+        chips = lib.enumerate_chips()
+        env = chip_visibility_env(chips)
+        assert env["TPU_VISIBLE_CHIPS"] == "0,1,2,3"
+        assert env["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,2,1"
+        assert env["TPU_ACCELERATOR_TYPE"] == "v5p-4"
+        assert env["TPU_SLICE_ID"] == "s9"
+        assert env["TPU_TOPOLOGY"] == "2x2x1"
+        assert env["TPU_SKIP_MDS_QUERY"] == "true"
+
+    def test_single_chip_bounds(self):
+        lib = FakeChipLib(generation="v5e", topology="2x2x1")
+        env = chip_visibility_env(lib.enumerate_chips()[:1])
+        assert env["TPU_VISIBLE_CHIPS"] == "0"
+        assert env["TPU_CHIPS_PER_HOST_BOUNDS"] == "1,1,1"
+
+    def test_empty(self):
+        assert chip_visibility_env([]) == {}
+        assert tensorcore_visibility_env([]) == {}
+
+    def test_tensorcore_env(self):
+        lib = FakeChipLib(generation="v5p", topology="2x1x1")
+        chips = lib.enumerate_chips()
+        cores = lib.enumerate_core_partitions(chips[0])
+        env = tensorcore_visibility_env(cores[:1])
+        assert env["TPU_VISIBLE_CHIPS"] == "0"
+        assert env["TPU_VISIBLE_CORES"] == "0:0"
+        assert env["TPU_MEGACORE"] == "0"
+
+    def test_merge_edits(self):
+        a = ContainerEdits(env={"A": "1"}, device_nodes=["/dev/accel0"])
+        b = ContainerEdits(env={"B": "2"}, device_nodes=["/dev/accel0", "/dev/accel1"])
+        m = a.merge(b)
+        assert m.env == {"A": "1", "B": "2"}
+        assert m.device_nodes == ["/dev/accel0", "/dev/accel1"]

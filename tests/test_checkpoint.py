@@ -1,0 +1,55 @@
+"""Checkpoint store tests (pattern: reference checkpoint.go:9-53 had none)."""
+
+import json
+
+import pytest
+
+from k8s_dra_driver_tpu.plugin.checkpoint import (
+    CheckpointManager,
+    CorruptCheckpointError,
+)
+
+
+class TestCheckpoint:
+    def test_create_if_missing_then_read_empty(self, tmp_path):
+        m = CheckpointManager(str(tmp_path / "c.json"))
+        assert not m.exists()
+        m.create_if_missing()
+        assert m.exists()
+        assert m.read() == {}
+        # Second call is a no-op, not a reset.
+        m.write({"uid": {"claimUID": "uid"}})
+        m.create_if_missing()
+        assert m.read() == {"uid": {"claimUID": "uid"}}
+
+    def test_roundtrip(self, tmp_path):
+        m = CheckpointManager(str(tmp_path / "c.json"))
+        data = {"u1": {"claimUID": "u1", "groups": []}}
+        m.write(data)
+        assert m.read() == data
+
+    def test_corruption_detected(self, tmp_path):
+        p = tmp_path / "c.json"
+        m = CheckpointManager(str(p))
+        m.write({"u1": {"claimUID": "u1"}})
+        payload = json.loads(p.read_text())
+        payload["preparedClaims"]["u2"] = {"claimUID": "u2"}  # tamper
+        p.write_text(json.dumps(payload))
+        with pytest.raises(CorruptCheckpointError, match="checksum"):
+            m.read()
+
+    def test_unknown_version_rejected(self, tmp_path):
+        p = tmp_path / "c.json"
+        m = CheckpointManager(str(p))
+        m.write({})
+        payload = json.loads(p.read_text())
+        payload["version"] = "v999"
+        # Recompute a valid checksum for the tampered version to isolate the
+        # version check.
+        from k8s_dra_driver_tpu.plugin.checkpoint import _checksum
+
+        payload["checksum"] = ""
+        payload["checksum"] = _checksum(payload)
+        p.write_text(json.dumps(payload))
+        with pytest.raises(CorruptCheckpointError, match="version"):
+            m.read()

@@ -1,0 +1,308 @@
+"""End-to-end tests of the Prepare/Unprepare engine on the fake backend.
+
+This is the coverage the reference could only get manually on GPU hardware
+(SURVEY.md §4): full claim lifecycle against DeviceState with checkpoint,
+CDI files, and sharing state asserted on disk.
+"""
+
+import json
+import os
+
+import pytest
+
+from k8s_dra_driver_tpu.cdi import CDIHandler
+from k8s_dra_driver_tpu.plugin.checkpoint import CheckpointManager
+from k8s_dra_driver_tpu.plugin.device_state import DeviceState, PrepareError
+from k8s_dra_driver_tpu.plugin.sharing import ModeConflictError
+from k8s_dra_driver_tpu.tpulib import FakeChipLib
+
+DRIVER = "tpu.google.com"
+
+
+def make_state(tmp_path, generation="v5p", topology="2x2x1", chiplib=None):
+    lib = chiplib or FakeChipLib(generation=generation, topology=topology)
+    return DeviceState(
+        chiplib=lib,
+        cdi=CDIHandler(str(tmp_path / "cdi")),
+        checkpoint=CheckpointManager(str(tmp_path / "checkpoint.json")),
+        driver_name=DRIVER,
+        pool_name="node-a",
+        state_dir=str(tmp_path / "state"),
+    ), lib
+
+
+def make_claim(
+    uid,
+    devices,
+    requests=None,
+    configs=None,
+    name="claim-1",
+    namespace="default",
+):
+    """Build a v1alpha3 ResourceClaim in wire form with an allocation."""
+    results = []
+    for i, dev in enumerate(devices):
+        results.append(
+            {
+                "request": (requests[i] if requests else "req-0"),
+                "driver": DRIVER,
+                "pool": "node-a",
+                "device": dev,
+            }
+        )
+    return {
+        "metadata": {"name": name, "namespace": namespace, "uid": uid},
+        "status": {
+            "allocation": {
+                "devices": {"results": results, "config": configs or []}
+            }
+        },
+    }
+
+
+def opaque(params, source="FromClaim", requests=None):
+    return {
+        "source": source,
+        "requests": requests or [],
+        "opaque": {"driver": DRIVER, "parameters": params},
+    }
+
+
+class TestPrepareBasic:
+    def test_single_chip_exclusive_default(self, tmp_path):
+        state, lib = make_state(tmp_path)
+        claim = make_claim("uid-1", ["tpu-0"])
+        devices = state.prepare(claim)
+        assert len(devices) == 1
+        d = devices[0]
+        assert d.device_name == "tpu-0"
+        assert d.pool_name == "node-a"
+        assert d.cdi_device_ids == [
+            "k8s.tpu.google.com/chip=tpu-0",
+            "k8s.tpu.google.com/claim=uid-1-tpu-0",
+        ]
+        # Claim CDI spec exists and carries visibility env.
+        spec_path = tmp_path / "cdi" / "k8s.tpu.google.com-claim_uid-1.json"
+        spec = json.loads(spec_path.read_text())
+        assert "TPU_VISIBLE_CHIPS=0" in spec["containerEdits"]["env"]
+        assert any(
+            "TPU_DRA_SHARING=exclusive" in d["containerEdits"]["env"]
+            for d in spec["devices"]
+        )
+
+    def test_prepare_is_idempotent(self, tmp_path):
+        state, _ = make_state(tmp_path)
+        claim = make_claim("uid-1", ["tpu-0"])
+        first = state.prepare(claim)
+        second = state.prepare(claim)
+        assert [d.to_dict() for d in first] == [d.to_dict() for d in second]
+
+    def test_multi_chip_claim_env_bounds(self, tmp_path):
+        state, _ = make_state(tmp_path)
+        claim = make_claim(
+            "uid-2", ["tpu-0", "tpu-1", "tpu-2", "tpu-3"],
+            requests=["r0", "r0", "r0", "r0"],
+        )
+        devices = state.prepare(claim)
+        assert len(devices) == 4
+        spec = json.loads(
+            (tmp_path / "cdi" / "k8s.tpu.google.com-claim_uid-2.json").read_text()
+        )
+        env = spec["containerEdits"]["env"]
+        assert "TPU_VISIBLE_CHIPS=0,1,2,3" in env
+        assert "TPU_CHIPS_PER_HOST_BOUNDS=2,2,1" in env
+
+    def test_unknown_device_rejected(self, tmp_path):
+        state, _ = make_state(tmp_path)
+        with pytest.raises(PrepareError, match="not allocatable"):
+            state.prepare(make_claim("uid-3", ["tpu-99"]))
+
+    def test_no_allocation_rejected(self, tmp_path):
+        state, _ = make_state(tmp_path)
+        claim = {"metadata": {"uid": "uid-4", "name": "x", "namespace": "d"}}
+        with pytest.raises(PrepareError, match="no allocation"):
+            state.prepare(claim)
+
+    def test_foreign_driver_results_ignored(self, tmp_path):
+        state, _ = make_state(tmp_path)
+        claim = make_claim("uid-5", ["tpu-0"])
+        claim["status"]["allocation"]["devices"]["results"].append(
+            {"request": "r1", "driver": "gpu.nvidia.com", "pool": "p", "device": "gpu-0"}
+        )
+        devices = state.prepare(claim)
+        assert [d.device_name for d in devices] == ["tpu-0"]
+
+
+class TestSharingConfigs:
+    def test_time_shared(self, tmp_path):
+        state, lib = make_state(tmp_path)
+        claim = make_claim(
+            "uid-ts", ["tpu-0", "tpu-1"],
+            requests=["r", "r"],
+            configs=[opaque({
+                "apiVersion": "tpu.google.com/v1alpha1",
+                "kind": "TpuChipConfig",
+                "sharing": {"strategy": "TimeShared",
+                            "timeSharedConfig": {"interval": "Long"}},
+            })],
+        )
+        state.prepare(claim)
+        chips = lib.enumerate_chips()
+        assert lib.sharing_modes[chips[0].uuid] == "time-shared"
+        spec = json.loads(
+            (tmp_path / "cdi" / "k8s.tpu.google.com-claim_uid-ts.json").read_text()
+        )
+        dev_env = spec["devices"][0]["containerEdits"]["env"]
+        assert "TPU_DRA_SHARING=time-shared" in dev_env
+        assert "TPU_DRA_TIMESHARE_QUANTUM=3" in dev_env
+        # Unprepare resets to exclusive.
+        state.unprepare("uid-ts")
+        assert lib.sharing_modes[chips[0].uuid] == "exclusive"
+
+    def test_process_shared_with_hbm_limit(self, tmp_path):
+        state, lib = make_state(tmp_path)
+        claim = make_claim(
+            "uid-ps", ["tpu-0"],
+            configs=[opaque({
+                "apiVersion": "tpu.google.com/v1alpha1",
+                "kind": "TpuChipConfig",
+                "sharing": {
+                    "strategy": "ProcessShared",
+                    "processSharedConfig": {
+                        "maxProcesses": 4,
+                        "defaultHbmLimit": "8Gi",
+                    },
+                },
+            })],
+        )
+        state.prepare(claim)
+        spec = json.loads(
+            (tmp_path / "cdi" / "k8s.tpu.google.com-claim_uid-ps.json").read_text()
+        )
+        env = spec["devices"][0]["containerEdits"]["env"]
+        assert "TPU_DRA_MAX_PROCESSES=4" in env
+        assert f"TPU_DRA_HBM_LIMIT_BYTES={8 << 30}" in env
+        mounts = spec["devices"][0]["containerEdits"]["mounts"]
+        assert mounts[0]["containerPath"] == "/var/run/tpu-dra-shared"
+        # Shared dir exists on disk until unprepare.
+        assert os.path.isdir(mounts[0]["hostPath"])
+        state.unprepare("uid-ps")
+        assert not os.path.isdir(mounts[0]["hostPath"])
+
+    def test_mode_conflict_across_claims(self, tmp_path):
+        state, _ = make_state(tmp_path)
+        ts = {
+            "apiVersion": "tpu.google.com/v1alpha1",
+            "kind": "TpuChipConfig",
+            "sharing": {"strategy": "TimeShared"},
+        }
+        state.prepare(make_claim("uid-a", ["tpu-0"], configs=[opaque(ts)]))
+        ps = {
+            "apiVersion": "tpu.google.com/v1alpha1",
+            "kind": "TpuChipConfig",
+            "sharing": {"strategy": "ProcessShared"},
+        }
+        with pytest.raises(ModeConflictError):
+            state.prepare(make_claim("uid-b", ["tpu-0"], configs=[opaque(ps)]))
+        # Same mode is compatible.
+        state.prepare(make_claim("uid-c", ["tpu-0"], configs=[opaque(ts)]))
+
+    def test_class_claim_precedence(self, tmp_path):
+        state, _ = make_state(tmp_path)
+        class_cfg = opaque(
+            {
+                "apiVersion": "tpu.google.com/v1alpha1",
+                "kind": "TpuChipConfig",
+                "sharing": {"strategy": "TimeShared"},
+            },
+            source="FromClass",
+        )
+        claim_cfg = opaque(
+            {
+                "apiVersion": "tpu.google.com/v1alpha1",
+                "kind": "TpuChipConfig",
+                "sharing": {"strategy": "ProcessShared"},
+            },
+            source="FromClaim",
+        )
+        claim = make_claim("uid-p", ["tpu-0"], configs=[class_cfg, claim_cfg])
+        state.prepare(claim)
+        spec = json.loads(
+            (tmp_path / "cdi" / "k8s.tpu.google.com-claim_uid-p.json").read_text()
+        )
+        assert any(
+            "TPU_DRA_SHARING=process-shared" in d["containerEdits"]["env"]
+            for d in spec["devices"]
+        )
+
+    def test_tensorcore_partition_claim(self, tmp_path):
+        state, _ = make_state(tmp_path)
+        claim = make_claim("uid-tc", ["tpu-0-core-0"])
+        devices = state.prepare(claim)
+        assert devices[0].device_name == "tpu-0-core-0"
+        spec = json.loads(
+            (tmp_path / "cdi" / "k8s.tpu.google.com-claim_uid-tc.json").read_text()
+        )
+        env = spec["containerEdits"]["env"]
+        assert "TPU_VISIBLE_CORES=0:0" in env
+        assert "TPU_MEGACORE=0" in env
+
+
+class TestIciChannels:
+    def test_channel_claim_creates_device(self, tmp_path):
+        state, lib = make_state(tmp_path)
+        claim = make_claim(
+            "uid-ici", ["ici-channel-3"],
+            configs=[opaque({
+                "apiVersion": "tpu.google.com/v1alpha1",
+                "kind": "IciChannelConfig",
+            })],
+        )
+        devices = state.prepare(claim)
+        assert devices[0].device_name == "ici-channel-3"
+        assert lib.created_channels == [3]
+        spec = json.loads(
+            (tmp_path / "cdi" / "k8s.tpu.google.com-claim_uid-ici.json").read_text()
+        )
+        nodes = spec["devices"][0]["containerEdits"]["deviceNodes"]
+        assert nodes[0]["path"].endswith("channel3")
+
+
+class TestCheckpointResume:
+    def test_unprepare_survives_restart(self, tmp_path):
+        lib = FakeChipLib(generation="v5p", topology="2x2x1")
+        state, _ = make_state(tmp_path, chiplib=lib)
+        claim = make_claim(
+            "uid-r", ["tpu-0"],
+            configs=[opaque({
+                "apiVersion": "tpu.google.com/v1alpha1",
+                "kind": "TpuChipConfig",
+                "sharing": {"strategy": "TimeShared"},
+            })],
+        )
+        state.prepare(claim)
+        uuid = lib.enumerate_chips()[0].uuid
+        assert lib.sharing_modes[uuid] == "time-shared"
+        # "Restart": new DeviceState over the same dirs + fresh fake lib
+        # with identical chips.
+        lib2 = FakeChipLib(generation="v5p", topology="2x2x1")
+        state2, _ = make_state(tmp_path, chiplib=lib2)
+        state2.unprepare("uid-r")
+        assert lib2.sharing_modes[uuid] == "exclusive"
+        assert state2.checkpoint.read() == {}
+
+    def test_unprepare_unknown_claim_is_noop(self, tmp_path):
+        state, _ = make_state(tmp_path)
+        state.unprepare("never-prepared")
+
+
+class TestPublishedResources:
+    def test_excludes_ici_channels(self, tmp_path):
+        state, _ = make_state(tmp_path)
+        res = state.published_resources()
+        names = [d["name"] for d in res["devices"]]
+        assert "tpu-0" in names
+        assert all(not n.startswith("ici-") for n in names)
+        # v5p 2x2x1: 4 chips + 8 cores.
+        assert len(names) == 12
+        assert len(res["sharedCounters"]) == 4

@@ -1,0 +1,133 @@
+"""Tests for the minimal kube client layer (fake semantics)."""
+
+import threading
+
+import pytest
+
+from k8s_dra_driver_tpu.kube import (
+    NODES,
+    RESOURCE_CLAIMS,
+    RESOURCE_SLICES,
+    AlreadyExistsError,
+    ConflictError,
+    FakeKubeClient,
+    NotFoundError,
+    matches_labels,
+    parse_label_selector,
+)
+
+
+def mk(name, labels=None, namespace=None, **extra):
+    md = {"name": name}
+    if labels:
+        md["labels"] = labels
+    if namespace:
+        md["namespace"] = namespace
+    return {"metadata": md, **extra}
+
+
+class TestSelectors:
+    def test_parse(self):
+        assert parse_label_selector("a=b, c=d") == {"a": "b", "c": "d"}
+        assert parse_label_selector("") == {}
+        assert parse_label_selector("exists") == {"exists": None}
+
+    def test_match(self):
+        obj = mk("x", labels={"a": "b", "z": "1"})
+        assert matches_labels(obj, "a=b")
+        assert matches_labels(obj, "a=b,z=1")
+        assert not matches_labels(obj, "a=c")
+        assert not matches_labels(obj, "missing=1")
+        assert matches_labels(obj, "z")
+        assert matches_labels(obj, None)
+
+
+class TestFakeCrud:
+    def test_create_get_roundtrip(self):
+        c = FakeKubeClient()
+        created = c.create(RESOURCE_SLICES, mk("s1", spec={"driver": "tpu"}))
+        assert created["metadata"]["resourceVersion"] == "1"
+        got = c.get(RESOURCE_SLICES, "s1")
+        assert got["spec"] == {"driver": "tpu"}
+
+    def test_get_missing_raises(self):
+        with pytest.raises(NotFoundError):
+            FakeKubeClient().get(RESOURCE_SLICES, "nope")
+
+    def test_double_create_conflicts(self):
+        c = FakeKubeClient()
+        c.create(RESOURCE_SLICES, mk("s1"))
+        with pytest.raises(AlreadyExistsError):
+            c.create(RESOURCE_SLICES, mk("s1"))
+
+    def test_update_bumps_rv_and_checks_conflict(self):
+        c = FakeKubeClient()
+        obj = c.create(RESOURCE_SLICES, mk("s1"))
+        obj["spec"] = {"x": 1}
+        updated = c.update(RESOURCE_SLICES, obj)
+        assert updated["metadata"]["resourceVersion"] != "1"
+        # Stale RV rejected.
+        obj["metadata"]["resourceVersion"] = "1"
+        with pytest.raises(ConflictError):
+            c.update(RESOURCE_SLICES, obj)
+
+    def test_namespacing(self):
+        c = FakeKubeClient()
+        c.create(RESOURCE_CLAIMS, mk("claim", namespace="a"), namespace="a")
+        c.create(RESOURCE_CLAIMS, mk("claim", namespace="b"), namespace="b")
+        assert len(c.list(RESOURCE_CLAIMS)) == 2
+        assert len(c.list(RESOURCE_CLAIMS, namespace="a")) == 1
+        c.delete(RESOURCE_CLAIMS, "claim", namespace="a")
+        assert len(c.list(RESOURCE_CLAIMS)) == 1
+
+    def test_list_label_filtering(self):
+        c = FakeKubeClient()
+        c.create(NODES, mk("n1", labels={"tpu.google.com/slice-id": "s1"}))
+        c.create(NODES, mk("n2", labels={"tpu.google.com/slice-id": "s2"}))
+        c.create(NODES, mk("n3"))
+        assert len(c.list(NODES, label_selector="tpu.google.com/slice-id")) == 2
+        assert [
+            n["metadata"]["name"]
+            for n in c.list(NODES, label_selector="tpu.google.com/slice-id=s2")
+        ] == ["n2"]
+
+    def test_apply_create_then_update(self):
+        c = FakeKubeClient()
+        c.apply(RESOURCE_SLICES, mk("s1", spec={"v": 1}))
+        out = c.apply(RESOURCE_SLICES, mk("s1", spec={"v": 2}))
+        assert out["spec"] == {"v": 2}
+        assert len(c.list(RESOURCE_SLICES)) == 1
+
+    def test_fault_injection(self):
+        c = FakeKubeClient()
+        c.fault_injector = lambda verb, gvr, name: (
+            ConflictError("boom") if verb == "create" else None
+        )
+        with pytest.raises(ConflictError):
+            c.create(RESOURCE_SLICES, mk("s1"))
+
+
+class TestFakeWatch:
+    def test_watch_seed_and_stream(self):
+        c = FakeKubeClient()
+        c.create(NODES, mk("n1", labels={"x": "1"}))
+        w = c.watch(NODES, label_selector="x=1")
+        c.create(NODES, mk("n2", labels={"x": "1"}))
+        c.create(NODES, mk("n3"))  # filtered out
+        c.delete(NODES, "n1")
+        got = []
+        for ev in w.events(timeout=0.2):
+            got.append((ev.type, ev.object["metadata"]["name"]))
+            if len(got) == 3:
+                break
+        assert got == [("ADDED", "n1"), ("ADDED", "n2"), ("DELETED", "n1")]
+        w.stop()
+
+    def test_watch_stop_unblocks(self):
+        c = FakeKubeClient()
+        w = c.watch(NODES)
+        t = threading.Thread(target=lambda: list(w.events()))
+        t.start()
+        w.stop()
+        t.join(timeout=2)
+        assert not t.is_alive()

@@ -1,0 +1,123 @@
+"""Regression tests for the first code-review pass on the driver core."""
+
+import json
+
+import pytest
+
+from k8s_dra_driver_tpu.api.v1alpha1 import to_mebibytes_string
+from k8s_dra_driver_tpu.kube import parse_label_selector
+from k8s_dra_driver_tpu.plugin.sharing import (
+    CorruptShareStateError,
+    ModeConflictError,
+    SharingStateStore,
+)
+from tests.test_device_state import make_claim, make_state, opaque
+
+
+class TestExclusiveIsExclusive:
+    def test_second_exclusive_claim_rejected(self, tmp_path):
+        state, _ = make_state(tmp_path)
+        state.prepare(make_claim("uid-1", ["tpu-0"]))
+        with pytest.raises(ModeConflictError, match="exclusively held"):
+            state.prepare(make_claim("uid-2", ["tpu-0"]))
+
+    def test_reacquire_same_claim_ok(self, tmp_path):
+        store = SharingStateStore(str(tmp_path))
+        store.acquire("TPU-x", "c1", "exclusive")
+        store.acquire("TPU-x", "c1", "exclusive")  # idempotent retry
+        assert store.get("TPU-x").claims == {"c1": {}}
+
+
+class TestMultiGroupVisibilityEnv:
+    def test_two_configs_full_chip_set(self, tmp_path):
+        state, _ = make_state(tmp_path)
+        ts = {
+            "apiVersion": "tpu.google.com/v1alpha1",
+            "kind": "TpuChipConfig",
+            "sharing": {"strategy": "TimeShared"},
+        }
+        claim = make_claim(
+            "uid-mg",
+            ["tpu-0", "tpu-1", "tpu-2", "tpu-3"],
+            requests=["ra", "ra", "rb", "rb"],
+            configs=[opaque(ts, requests=["ra"]), opaque(ts, requests=["rb"])],
+        )
+        state.prepare(claim)
+        spec = json.loads(
+            (tmp_path / "cdi" / "k8s.tpu.google.com-claim_uid-mg.json").read_text()
+        )
+        env = spec["containerEdits"]["env"]
+        assert "TPU_VISIBLE_CHIPS=0,1,2,3" in env
+        assert "TPU_CHIPS_PER_HOST_BOUNDS=2,2,1" in env
+
+
+class TestPartialPrepareRollback:
+    def test_failed_group_rolls_back_earlier_groups(self, tmp_path):
+        state, lib = make_state(tmp_path)
+        ts = {
+            "apiVersion": "tpu.google.com/v1alpha1",
+            "kind": "TpuChipConfig",
+            "sharing": {"strategy": "TimeShared"},
+        }
+        ps = {
+            "apiVersion": "tpu.google.com/v1alpha1",
+            "kind": "TpuChipConfig",
+            "sharing": {"strategy": "ProcessShared"},
+        }
+        # Claim B holds tpu-1 process-shared.
+        state.prepare(make_claim("uid-b", ["tpu-1"], configs=[opaque(ps)]))
+        # Claim A wants tpu-0 (group 1, ok) + tpu-1 (group 2, conflicts).
+        claim_a = make_claim(
+            "uid-a",
+            ["tpu-0", "tpu-1"],
+            requests=["r0", "r1"],
+            configs=[opaque(ts, requests=["r0"]), opaque(ts, requests=["r1"])],
+        )
+        with pytest.raises(ModeConflictError):
+            state.prepare(claim_a)
+        # tpu-0 must be free again: a fresh exclusive claim succeeds.
+        state.prepare(make_claim("uid-c", ["tpu-0"]))
+
+    def test_failed_prepare_not_checkpointed(self, tmp_path):
+        state, _ = make_state(tmp_path)
+        ps = {
+            "apiVersion": "tpu.google.com/v1alpha1",
+            "kind": "TpuChipConfig",
+            "sharing": {"strategy": "ProcessShared"},
+        }
+        state.prepare(make_claim("uid-b", ["tpu-0"], configs=[opaque(ps)]))
+        with pytest.raises(ModeConflictError):
+            state.prepare(make_claim("uid-a", ["tpu-0"]))
+        assert "uid-a" not in state.checkpoint.read()
+
+
+class TestShareStateDurability:
+    def test_corrupt_state_raises(self, tmp_path):
+        store = SharingStateStore(str(tmp_path))
+        store.acquire("TPU-x", "c1", "time-shared")
+        (tmp_path / "TPU-x.share.json").write_text("{torn")
+        with pytest.raises(CorruptShareStateError):
+            store.get("TPU-x")
+
+    def test_missing_state_is_free(self, tmp_path):
+        store = SharingStateStore(str(tmp_path))
+        st = store.get("TPU-never-seen")
+        assert st.claims == {}
+
+
+class TestSelectorOperators:
+    def test_not_equal_rejected(self):
+        with pytest.raises(ValueError, match="unsupported"):
+            parse_label_selector("env!=prod")
+
+    def test_set_operators_rejected(self):
+        with pytest.raises(ValueError, match="unsupported"):
+            parse_label_selector("env in (a,b)")
+
+
+class TestQuantityRounding:
+    def test_sub_mebibyte_rounds_up(self):
+        assert to_mebibytes_string(512 << 10) == "1Mi"
+        assert to_mebibytes_string(1) == "1Mi"
+        assert to_mebibytes_string(1 << 20) == "1Mi"
+        assert to_mebibytes_string((1 << 20) + 1) == "2Mi"
