@@ -1,0 +1,88 @@
+"""tpu-dra-controller entrypoint.
+
+CLI analog of the reference's controller main (lengrongfu/k8s-dra-driver,
+cmd/nvidia-dra-controller/main.go:73-241): metrics + health HTTP endpoint and
+the ICI slice manager, started only when the ``ici`` device class is enabled
+(main.go:171-176 analog).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+from ..utils.cli import env as _env
+from ..utils.cli import install_signal_stop, make_kube_client
+from ..utils.metrics import Gauge, MetricsServer, Registry
+from .slice_manager import IciSliceManager
+
+logger = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu-dra-controller",
+        description="TPU DRA cluster controller (ICI channel publisher)",
+    )
+    p.add_argument("--driver-name", default=_env("DRIVER_NAME", "tpu.google.com"))
+    p.add_argument("--pod-name", default=_env("POD_NAME", ""),
+                   help="controller pod name, for slice ownerReferences [POD_NAME]")
+    p.add_argument("--pod-uid", default=_env("POD_UID", ""))
+    p.add_argument("--namespace", default=_env("NAMESPACE", "default"))
+    p.add_argument("--device-classes",
+                   default=_env("DEVICE_CLASSES", "chip,tensorcore,ici"))
+    p.add_argument("--http-port", type=int,
+                   default=int(_env("HTTP_PORT", "8080")),
+                   help="metrics/health endpoint port; 0 disables")
+    p.add_argument("--kubeconfig", default=_env("KUBECONFIG", ""))
+    p.add_argument("--log-level", default=_env("LOG_LEVEL", "INFO"))
+    p.add_argument("--log-json", action="store_true")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from ..utils.logging import setup_logging
+
+    setup_logging(level=args.log_level, json_format=args.log_json)
+
+    registry = Registry()
+    domains_gauge = Gauge(
+        "tpu_dra_ici_domains", "Known ICI slice domains", registry
+    )
+    metrics = None
+    if args.http_port:
+        metrics = MetricsServer(registry, port=args.http_port)
+        metrics.start()
+        logger.info("metrics on :%d/metrics", metrics.port)
+
+    client = make_kube_client(args.kubeconfig)
+
+    manager = None
+    if "ici" in args.device_classes.split(","):
+        owner = None
+        if args.pod_name and args.pod_uid:
+            owner = {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "name": args.pod_name,
+                "uid": args.pod_uid,
+            }
+        manager = IciSliceManager(client, args.driver_name, owner=owner)
+        manager.start()
+        logger.info("ICI slice manager started")
+
+    stop = install_signal_stop()
+    while not stop.wait(timeout=10):
+        if manager is not None:
+            domains_gauge.set(len(manager.domains()))
+    if manager is not None:
+        manager.stop(cleanup=True)
+    if metrics is not None:
+        metrics.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,294 @@
+"""IciSliceManager: cluster-level publisher of interconnect-channel pools.
+
+Analog of the reference's IMEX manager (lengrongfu/k8s-dra-driver,
+cmd/nvidia-dra-controller/imex.go:67-422). The mapping:
+
+- IMEX *domain* (nodes labeled ``nvidia.com/gpu.imex-domain``, imex.go:39)
+  → TPU *pod slice*: nodes labeled ``tpu.google.com/slice-id``. All hosts of
+  one multi-host slice share the label, the way IMEX-domain nodes do.
+- IMEX *clique* (``nvidia.com/gpu.clique``) → optional
+  ``tpu.google.com/clique-id`` sub-domain (e.g. an ICI sub-ring).
+- IMEX channels 0-2047, 128 per ResourceSlice (imex.go:42-45) → ICI
+  channels with identical capacity constants.
+- Channel pools are **network resources**: ResourceSlices with a
+  NodeSelector on the slice label instead of a nodeName
+  (imex.go:381-422), so the scheduler can place a claim on any host of
+  the slice, which is exactly the gang-scheduling seam multi-host JAX
+  jobs need.
+
+Workloads claim one channel per pod; Prepare on the node then materialises
+the channel device node + the distributed-init env (see plugin side).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import logging
+import threading
+from typing import Optional
+
+from ..kube.client import NODES, KubeClient, Watch
+from ..kube.resourceslice import (
+    DriverResources,
+    Pool,
+    ResourceSliceController,
+)
+from ..tpulib.deviceinfo import IciChannelInfo
+
+logger = logging.getLogger(__name__)
+
+SLICE_LABEL = "tpu.google.com/slice-id"
+CLIQUE_LABEL = "tpu.google.com/clique-id"
+
+# Capacity constants mirroring imex.go:42-45 / nvlib.go:441-444.
+CHANNELS_PER_DRIVER = 2048
+CHANNELS_PER_POOL = 128
+
+
+@dataclasses.dataclass(frozen=True)
+class DomainKey:
+    """(slice, clique) identity (imex.go's domain+cliqueID offsets)."""
+
+    slice_id: str
+    clique_id: str = ""
+
+    @property
+    def pool_name(self) -> str:
+        # Slice/clique ids may themselves contain hyphens, so plain
+        # concatenation is ambiguous (("a-b","") vs ("a","b")); a short
+        # digest of the unambiguous identity disambiguates.
+        digest = hashlib.sha256(
+            f"{self.slice_id}/{self.clique_id}".encode()
+        ).hexdigest()[:6]
+        base = f"ici-{self.slice_id}"
+        if self.clique_id:
+            base = f"{base}-{self.clique_id}"
+        return f"{base}-{digest}"
+
+
+class OffsetAllocator:
+    """Slots of CHANNELS_PER_POOL within CHANNELS_PER_DRIVER
+    (offset allocator analog, imex.go:329-368)."""
+
+    def __init__(self):
+        self._offsets: dict[DomainKey, int] = {}
+
+    def add(self, key: DomainKey) -> int:
+        if key in self._offsets:
+            return self._offsets[key]
+        used = set(self._offsets.values())
+        for offset in range(0, CHANNELS_PER_DRIVER, CHANNELS_PER_POOL):
+            if offset not in used:
+                self._offsets[key] = offset
+                return offset
+        raise RuntimeError(
+            f"out of ICI channel capacity ({CHANNELS_PER_DRIVER}) for {key}"
+        )
+
+    def remove(self, key: DomainKey) -> None:
+        self._offsets.pop(key, None)
+
+    def restore(self, key: DomainKey, offset: int) -> None:
+        """Pin a known offset during crash recovery."""
+        self._offsets[key] = offset
+
+    def get(self, key: DomainKey) -> Optional[int]:
+        return self._offsets.get(key)
+
+
+class IciSliceManager:
+    """StartIMEXManager analog (imex.go:67-118)."""
+
+    SCOPE = "controller"  # OWNER_LABEL value for cluster-published slices
+
+    def __init__(
+        self,
+        client: KubeClient,
+        driver_name: str = "tpu.google.com",
+        owner: Optional[dict] = None,
+    ):
+        self.client = client
+        self.driver_name = driver_name
+        self.slice_controller = ResourceSliceController(
+            client, driver_name, scope=self.SCOPE, owner=owner
+        )
+        self.offsets = OffsetAllocator()
+        # DomainKey -> set of node names carrying the label
+        self._domains: dict[DomainKey, set[str]] = {}
+        # node name -> its current DomainKey (for relabel/delete handling)
+        self._node_domain: dict[str, DomainKey] = {}
+        self._lock = threading.Lock()
+        self._watch: Optional[Watch] = None
+        self._thread: Optional[threading.Thread] = None
+        self._settle_timer: Optional[threading.Timer] = None
+        self._stop = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._recover_offsets()
+        self.slice_controller.start()
+        self._watch = self.client.watch(NODES, label_selector=SLICE_LABEL)
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="ici-slice-manager"
+        )
+        self._thread.start()
+        # After the watch seeds current nodes, reconcile once: prunes pools
+        # of domains that vanished while we were down and releases their
+        # recovered offsets.
+        self._settle_timer = threading.Timer(2.0, self._settle_recovery)
+        self._settle_timer.daemon = True
+        self._settle_timer.start()
+
+    def _settle_recovery(self) -> None:
+        with self._lock:
+            live = set(self._domains)
+            for key in [k for k in self.offsets._offsets if k not in live]:
+                logger.info(
+                    "dropping recovered offset for vanished domain %s",
+                    key.pool_name,
+                )
+                self.offsets.remove(key)
+            self._publish_locked()
+
+    def _recover_offsets(self) -> None:
+        """Re-seed the offset allocator from slices published by a previous
+        controller incarnation, so a restart never renumbers a domain's
+        channels while claims referencing the old device names are live
+        (the durability imex.go gets implicitly from deleting+rebuilding
+        all slices under a single long-lived process)."""
+        try:
+            existing = self.slice_controller._list_driver_slices()
+        except Exception:
+            logger.exception("offset recovery list failed; starting fresh")
+            return
+        for sl in existing:
+            devices = sl.get("spec", {}).get("devices", [])
+            if not devices:
+                continue
+            attrs0 = devices[0].get("basic", {}).get("attributes", {})
+            slice_id = attrs0.get("sliceId", {}).get("string", "")
+            first_channel = attrs0.get("channel", {}).get("int")
+            if not slice_id or first_channel is None:
+                continue
+            clique = ""
+            sel = (sl["spec"].get("nodeSelector") or {}).get(
+                "nodeSelectorTerms", []
+            )
+            for term in sel:
+                for expr in term.get("matchExpressions", []):
+                    if expr.get("key") == CLIQUE_LABEL and expr.get("values"):
+                        clique = expr["values"][0]
+            key = DomainKey(slice_id, clique)
+            offset = (first_channel // CHANNELS_PER_POOL) * CHANNELS_PER_POOL
+            self.offsets.restore(key, offset)
+            logger.info(
+                "recovered ICI domain %s at offset %d", key.pool_name, offset
+            )
+
+    def stop(self, cleanup: bool = True) -> None:
+        """Stop + optionally delete all our slices
+        (cleanupResourceSlices analog, imex.go:308-326)."""
+        self._stop.set()
+        if self._settle_timer is not None:
+            self._settle_timer.cancel()
+        if self._watch is not None:
+            self._watch.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self.slice_controller.stop(delete_slices=cleanup)
+
+    # -- node event stream (streamImexDomains analog, imex.go:217-305) -----
+
+    def _run(self) -> None:
+        assert self._watch is not None
+        for ev in self._watch.events():
+            if self._stop.is_set():
+                return
+            try:
+                self._handle(ev.type, ev.object)
+            except Exception:
+                logger.exception("error handling node event")
+
+    def _handle(self, ev_type: str, node: dict) -> None:
+        name = node["metadata"]["name"]
+        labels = (node["metadata"].get("labels")) or {}
+        slice_id = labels.get(SLICE_LABEL, "")
+        with self._lock:
+            changed = False
+            old_key = self._node_domain.get(name)
+            if ev_type == "DELETED" or not slice_id:
+                if old_key is not None:
+                    changed |= self._remove_node(name, old_key)
+            else:
+                new_key = DomainKey(slice_id, labels.get(CLIQUE_LABEL, ""))
+                if old_key is not None and old_key != new_key:
+                    changed |= self._remove_node(name, old_key)
+                changed |= self._add_node(name, new_key)
+            # Republish only on membership change — node heartbeats arrive
+            # as MODIFIED events continuously and must not trigger reconciles.
+            if changed:
+                self._publish_locked()
+
+    def _add_node(self, name: str, key: DomainKey) -> bool:
+        if self._node_domain.get(name) == key:
+            return False
+        self._node_domain[name] = key
+        members = self._domains.setdefault(key, set())
+        if not members:
+            offset = self.offsets.add(key)
+            logger.info(
+                "ICI domain %s appeared (offset %d)", key.pool_name, offset
+            )
+        members.add(name)
+        return True
+
+    def _remove_node(self, name: str, key: DomainKey) -> bool:
+        self._node_domain.pop(name, None)
+        members = self._domains.get(key)
+        if members is None:
+            return False
+        members.discard(name)
+        if not members:
+            del self._domains[key]
+            self.offsets.remove(key)
+            logger.info("ICI domain %s vanished", key.pool_name)
+        return True
+
+    # -- pool generation (generateImexChannelPool analog, imex.go:381-422) --
+
+    def _channel_pool(self, key: DomainKey) -> Pool:
+        offset = self.offsets.get(key)
+        assert offset is not None
+        devices = []
+        for i in range(offset, offset + CHANNELS_PER_POOL):
+            info = IciChannelInfo(channel=i, slice_id=key.slice_id)
+            devices.append(info.get_device())
+        match_exprs = [
+            {"key": SLICE_LABEL, "operator": "In", "values": [key.slice_id]}
+        ]
+        if key.clique_id:
+            match_exprs.append(
+                {"key": CLIQUE_LABEL, "operator": "In",
+                 "values": [key.clique_id]}
+            )
+        return Pool(
+            devices=devices,
+            node_selector={
+                "nodeSelectorTerms": [{"matchExpressions": match_exprs}]
+            },
+        )
+
+    def _publish_locked(self) -> None:
+        pools = {
+            key.pool_name: self._channel_pool(key) for key in self._domains
+        }
+        self.slice_controller.update(DriverResources(pools=pools))
+
+    # -- introspection -----------------------------------------------------
+
+    def domains(self) -> dict[DomainKey, set[str]]:
+        with self._lock:
+            return {k: set(v) for k, v in self._domains.items()}

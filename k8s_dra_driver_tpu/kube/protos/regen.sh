@@ -1,0 +1,8 @@
+#!/bin/sh
+# Regenerate protobuf message code. grpc_tools is not installed, so only
+# message classes are generated; the gRPC service wiring is hand-written in
+# k8s_dra_driver_tpu/plugin/grpc_services.py against these messages.
+set -e
+cd "$(dirname "$0")"
+protoc --python_out=. dra_v1alpha4.proto pluginregistration_v1.proto
+echo "generated: dra_v1alpha4_pb2.py pluginregistration_v1_pb2.py"

@@ -1,0 +1,194 @@
+"""Driver: the DRA node-service implementation.
+
+Analog of the reference's driver.go (lengrongfu/k8s-dra-driver,
+cmd/nvidia-dra-plugin/driver.go:38-166): wires DeviceState to the gRPC
+surface, serializes Prepare/Unprepare under a mutex, isolates per-claim
+errors in-band (a failing claim never fails the whole RPC), publishes
+node-local devices as ResourceSlices, and verifies claim UIDs against the
+API server before preparing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from typing import Optional
+
+from ..cdi.spec import CDIHandler
+from ..kube.client import RESOURCE_CLAIMS, KubeClient
+from ..kube.protos import dra_v1alpha4_pb2 as drapb
+from ..kube.resourceslice import DriverResources, Pool
+from ..tpulib.chiplib import ChipLib
+from ..utils.metrics import Counter, Gauge, Histogram, Registry
+from .checkpoint import CheckpointManager
+from .device_state import DeviceState
+from .grpc_services import NodeServicer
+from .kubeletplugin import KubeletPlugin
+
+logger = logging.getLogger(__name__)
+
+DRIVER_NAME = "tpu.google.com"
+
+
+@dataclasses.dataclass
+class DriverConfig:
+    """Flags/env surface (main.go:73-123 analog)."""
+
+    node_name: str
+    chiplib: ChipLib
+    kube_client: Optional[KubeClient] = None
+    driver_name: str = DRIVER_NAME
+    cdi_root: str = "/var/run/cdi"
+    plugin_root: str = "/var/lib/kubelet/plugins/tpu.google.com"
+    registrar_root: str = "/var/lib/kubelet/plugins_registry"
+    state_root: str = "/var/lib/tpu-dra"
+    device_classes: frozenset = frozenset({"chip", "tensorcore", "ici"})
+    node_uid: str = ""
+
+    @property
+    def plugin_socket(self) -> str:
+        return f"{self.plugin_root}/dra.sock"
+
+    @property
+    def registrar_socket(self) -> str:
+        return f"{self.registrar_root}/{self.driver_name}-dra.sock"
+
+    @property
+    def checkpoint_path(self) -> str:
+        return f"{self.state_root}/checkpoint.json"
+
+
+class Driver(NodeServicer):
+    """NewDriver analog (driver.go:38-84)."""
+
+    def __init__(self, config: DriverConfig, registry: Optional[Registry] = None):
+        self.config = config
+        self._lock = threading.Lock()  # serializes claim ops (driver.go:32)
+        # Node-plugin metrics — a gap in the reference, whose plugin exposes
+        # none (SURVEY.md §5).
+        self.registry = registry or Registry()
+        self._m_prepares = Counter(
+            "tpu_dra_claim_prepares_total", "Claim prepare attempts", self.registry
+        )
+        self._m_unprepares = Counter(
+            "tpu_dra_claim_unprepares_total", "Claim unprepare attempts", self.registry
+        )
+        self._m_prepare_latency = Histogram(
+            "tpu_dra_claim_prepare_seconds", "Prepare latency", self.registry
+        )
+        self.state = DeviceState(
+            chiplib=config.chiplib,
+            cdi=CDIHandler(config.cdi_root, driver_name=config.driver_name),
+            checkpoint=CheckpointManager(config.checkpoint_path),
+            driver_name=config.driver_name,
+            pool_name=config.node_name,
+            state_dir=f"{config.state_root}/state",
+            device_classes=set(config.device_classes),
+        )
+        self.plugin = KubeletPlugin(
+            node_server=self,
+            driver_name=config.driver_name,
+            node_name=config.node_name,
+            plugin_socket=config.plugin_socket,
+            registrar_socket=config.registrar_socket,
+            kube_client=config.kube_client,
+            node_uid=config.node_uid,
+        )
+
+    def start(self) -> None:
+        self.plugin.start()
+        if self.config.kube_client is not None:
+            self.publish_resources()
+
+    def shutdown(self) -> None:
+        self.plugin.stop()
+        self.state.chiplib.shutdown()
+
+    def publish_resources(self) -> None:
+        """Publish node-local devices (driver.go:69-80 analog; ICI channels
+        are excluded — the cluster controller publishes those as network
+        resources, mirroring IMEX)."""
+        res = self.state.published_resources()
+        self.plugin.publish_resources(
+            DriverResources(
+                pools={
+                    self.config.node_name: Pool(
+                        devices=res["devices"],
+                        shared_counters=res["sharedCounters"],
+                        node_name=self.config.node_name,
+                    )
+                }
+            )
+        )
+
+    # ------------------------------------------------------------------
+    # DRA node service (driver.go:94-152)
+    # ------------------------------------------------------------------
+
+    def NodePrepareResources(self, request, context):
+        response = drapb.NodePrepareResourcesResponse()
+        for claim in request.claims:
+            response.claims[claim.uid].CopyFrom(self._prepare_claim(claim))
+        return response
+
+    def _prepare_claim(self, claim) -> drapb.NodePrepareResourceResponse:
+        """nodePrepareResource analog (driver.go:116-139): per-claim errors
+        are returned in-band, never raised."""
+        with self._lock, self._m_prepare_latency.time():
+            try:
+                resource_claim = self._fetch_claim(claim)
+                devices = self.state.prepare(resource_claim)
+                self._m_prepares.inc(result="ok")
+            except Exception as e:
+                self._m_prepares.inc(result="error")
+                logger.exception("prepare of claim %s failed", claim.uid)
+                return drapb.NodePrepareResourceResponse(
+                    error=f"error preparing devices for claim {claim.uid}: {e}"
+                )
+            return drapb.NodePrepareResourceResponse(
+                devices=[
+                    drapb.Device(
+                        request_names=d.request_names,
+                        pool_name=d.pool_name,
+                        device_name=d.device_name,
+                        cdi_device_ids=d.cdi_device_ids,
+                    )
+                    for d in devices
+                ]
+            )
+
+    def _fetch_claim(self, claim) -> dict:
+        """GET the ResourceClaim and verify identity (driver.go:120-131)."""
+        if self.config.kube_client is None:
+            raise RuntimeError("no kube client configured")
+        obj = self.config.kube_client.get(
+            RESOURCE_CLAIMS, claim.name, namespace=claim.namespace
+        )
+        uid = obj["metadata"].get("uid", "")
+        if uid != claim.uid:
+            raise RuntimeError(
+                f"claim {claim.namespace}/{claim.name} UID mismatch: "
+                f"kubelet={claim.uid} apiserver={uid} (deleted+recreated?)"
+            )
+        return obj
+
+    def NodeUnprepareResources(self, request, context):
+        response = drapb.NodeUnprepareResourcesResponse()
+        for claim in request.claims:
+            with self._lock:
+                try:
+                    self.state.unprepare(claim.uid)
+                    self._m_unprepares.inc(result="ok")
+                    response.claims[claim.uid].CopyFrom(
+                        drapb.NodeUnprepareResourceResponse()
+                    )
+                except Exception as e:
+                    self._m_unprepares.inc(result="error")
+                    logger.exception("unprepare of claim %s failed", claim.uid)
+                    response.claims[claim.uid].CopyFrom(
+                        drapb.NodeUnprepareResourceResponse(
+                            error=f"error unpreparing claim {claim.uid}: {e}"
+                        )
+                    )
+        return response

@@ -1,0 +1,120 @@
+"""gRPC service wiring for the DRA node service + kubelet registration.
+
+grpc_tools (the protoc gRPC python plugin) is not available in this
+environment, so the service descriptors are hand-written against the
+protoc-generated message classes — functionally equivalent to *_pb2_grpc.py
+output. The served APIs are wire-compatible with what kubelet speaks to the
+reference driver (lengrongfu/k8s-dra-driver vendor/k8s.io/kubelet/pkg/apis/
+dra/v1alpha4/api.proto and pluginregistration/v1/api.proto).
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from ..kube.protos import dra_v1alpha4_pb2 as drapb
+from ..kube.protos import pluginregistration_v1_pb2 as regpb
+
+DRA_SERVICE_NAME = "v1alpha3.Node"
+REGISTRATION_SERVICE_NAME = "pluginregistration.Registration"
+
+
+# ---------------------------------------------------------------------------
+# DRA Node service
+# ---------------------------------------------------------------------------
+
+
+class NodeServicer:
+    """Service interface (implemented by plugin.driver.Driver)."""
+
+    def NodePrepareResources(self, request, context):
+        raise NotImplementedError
+
+    def NodeUnprepareResources(self, request, context):
+        raise NotImplementedError
+
+
+def add_node_servicer_to_server(servicer: NodeServicer, server: grpc.Server) -> None:
+    handlers = {
+        "NodePrepareResources": grpc.unary_unary_rpc_method_handler(
+            servicer.NodePrepareResources,
+            request_deserializer=drapb.NodePrepareResourcesRequest.FromString,
+            response_serializer=drapb.NodePrepareResourcesResponse.SerializeToString,
+        ),
+        "NodeUnprepareResources": grpc.unary_unary_rpc_method_handler(
+            servicer.NodeUnprepareResources,
+            request_deserializer=drapb.NodeUnprepareResourcesRequest.FromString,
+            response_serializer=drapb.NodeUnprepareResourcesResponse.SerializeToString,
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(DRA_SERVICE_NAME, handlers),)
+    )
+
+
+class NodeStub:
+    """Client stub (used by tests / a fake kubelet)."""
+
+    def __init__(self, channel: grpc.Channel):
+        self.NodePrepareResources = channel.unary_unary(
+            f"/{DRA_SERVICE_NAME}/NodePrepareResources",
+            request_serializer=drapb.NodePrepareResourcesRequest.SerializeToString,
+            response_deserializer=drapb.NodePrepareResourcesResponse.FromString,
+        )
+        self.NodeUnprepareResources = channel.unary_unary(
+            f"/{DRA_SERVICE_NAME}/NodeUnprepareResources",
+            request_serializer=drapb.NodeUnprepareResourcesRequest.SerializeToString,
+            response_deserializer=drapb.NodeUnprepareResourcesResponse.FromString,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Kubelet plugin registration service
+# ---------------------------------------------------------------------------
+
+
+class RegistrationServicer:
+    """Served by the plugin on the registration UDS
+    (registrationserver.go:37-54 analog)."""
+
+    def GetInfo(self, request, context):
+        raise NotImplementedError
+
+    def NotifyRegistrationStatus(self, request, context):
+        raise NotImplementedError
+
+
+def add_registration_servicer_to_server(
+    servicer: RegistrationServicer, server: grpc.Server
+) -> None:
+    handlers = {
+        "GetInfo": grpc.unary_unary_rpc_method_handler(
+            servicer.GetInfo,
+            request_deserializer=regpb.InfoRequest.FromString,
+            response_serializer=regpb.PluginInfo.SerializeToString,
+        ),
+        "NotifyRegistrationStatus": grpc.unary_unary_rpc_method_handler(
+            servicer.NotifyRegistrationStatus,
+            request_deserializer=regpb.RegistrationStatus.FromString,
+            response_serializer=regpb.RegistrationStatusResponse.SerializeToString,
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(REGISTRATION_SERVICE_NAME, handlers),)
+    )
+
+
+class RegistrationStub:
+    """Client stub (role of kubelet's plugin watcher)."""
+
+    def __init__(self, channel: grpc.Channel):
+        self.GetInfo = channel.unary_unary(
+            f"/{REGISTRATION_SERVICE_NAME}/GetInfo",
+            request_serializer=regpb.InfoRequest.SerializeToString,
+            response_deserializer=regpb.PluginInfo.FromString,
+        )
+        self.NotifyRegistrationStatus = channel.unary_unary(
+            f"/{REGISTRATION_SERVICE_NAME}/NotifyRegistrationStatus",
+            request_serializer=regpb.RegistrationStatus.SerializeToString,
+            response_deserializer=regpb.RegistrationStatusResponse.FromString,
+        )

@@ -1,0 +1,167 @@
+"""Kubelet-plugin framework: registration + DRA gRPC servers over UDS.
+
+Re-implementation of the vendored framework the reference builds on
+(lengrongfu/k8s-dra-driver, vendor/k8s.io/dynamic-resource-allocation/
+kubeletplugin/draplugin.go:263-420, nonblockinggrpcserver.go,
+registrationserver.go): two non-blocking gRPC servers on unix sockets —
+
+1. the **registration server** on the kubelet plugin-watcher socket, serving
+   ``pluginregistration.Registration`` (GetInfo/NotifyRegistrationStatus);
+2. the **DRA node server** on the driver's own socket, serving
+   ``v1alpha3.Node`` (NodePrepareResources/NodeUnprepareResources);
+
+plus lazy ResourceSlice publication via ``publish_resources``
+(draplugin.go:376-420 analog).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from ..kube.client import KubeClient
+from ..kube.protos import pluginregistration_v1_pb2 as regpb
+from ..kube.resourceslice import DriverResources, ResourceSliceController
+from .grpc_services import (
+    NodeServicer,
+    RegistrationServicer,
+    add_node_servicer_to_server,
+    add_registration_servicer_to_server,
+)
+
+logger = logging.getLogger(__name__)
+
+DRA_API_VERSION = "v1alpha4"
+
+
+def _serve_uds(path: str, register) -> grpc.Server:
+    """Start a non-blocking gRPC server on a unix socket
+    (nonblockinggrpcserver.go analog)."""
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    if os.path.exists(path):
+        os.unlink(path)  # stale socket from a previous run
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+    register(server)
+    server.add_insecure_port(f"unix://{path}")
+    server.start()
+    return server
+
+
+class _RegistrationService(RegistrationServicer):
+    """registrationserver.go:37-54 analog."""
+
+    def __init__(self, plugin: "KubeletPlugin"):
+        self.plugin = plugin
+
+    def GetInfo(self, request, context):
+        return regpb.PluginInfo(
+            type="DRAPlugin",
+            name=self.plugin.driver_name,
+            endpoint=self.plugin.plugin_socket,
+            supported_versions=[DRA_API_VERSION],
+        )
+
+    def NotifyRegistrationStatus(self, request, context):
+        logger.info(
+            "kubelet registration status: registered=%s error=%r",
+            request.plugin_registered,
+            request.error,
+        )
+        self.plugin._registration_status = {
+            "pluginRegistered": request.plugin_registered,
+            "error": request.error,
+        }
+        return regpb.RegistrationStatusResponse()
+
+
+class KubeletPlugin:
+    """DRAPlugin analog (draplugin.go:39-67): owns both servers and the
+    slice controller; exposes Stop / PublishResources / RegistrationStatus."""
+
+    def __init__(
+        self,
+        node_server: NodeServicer,
+        driver_name: str,
+        node_name: str,
+        plugin_socket: str,
+        registrar_socket: str,
+        kube_client: Optional[KubeClient] = None,
+        node_uid: str = "",
+    ):
+        self.node_server = node_server
+        self.driver_name = driver_name
+        self.node_name = node_name
+        self.plugin_socket = plugin_socket
+        self.registrar_socket = registrar_socket
+        self.kube_client = kube_client
+        self.node_uid = node_uid
+        self._dra_server: Optional[grpc.Server] = None
+        self._reg_server: Optional[grpc.Server] = None
+        self._slice_controller: Optional[ResourceSliceController] = None
+        self._registration_status: Optional[dict] = None
+        self._lock = threading.Lock()
+
+    # -- lifecycle (draplugin.go:263-362 analog) ---------------------------
+
+    def start(self) -> None:
+        self._dra_server = _serve_uds(
+            self.plugin_socket,
+            lambda s: add_node_servicer_to_server(self.node_server, s),
+        )
+        self._reg_server = _serve_uds(
+            self.registrar_socket,
+            lambda s: add_registration_servicer_to_server(
+                _RegistrationService(self), s
+            ),
+        )
+        logger.info(
+            "kubelet plugin serving: dra=%s registrar=%s",
+            self.plugin_socket,
+            self.registrar_socket,
+        )
+
+    def stop(self, delete_slices: bool = False) -> None:
+        if self._slice_controller is not None:
+            self._slice_controller.stop(delete_slices=delete_slices)
+            self._slice_controller = None
+        for server in (self._reg_server, self._dra_server):
+            if server is not None:
+                server.stop(grace=2).wait()
+        self._reg_server = self._dra_server = None
+        for path in (self.plugin_socket, self.registrar_socket):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    # -- resource publication (draplugin.go:376-420 analog) ----------------
+
+    def publish_resources(self, resources: DriverResources) -> None:
+        if self.kube_client is None:
+            raise RuntimeError("publish_resources requires a kube client")
+        with self._lock:
+            if self._slice_controller is None:
+                owner = None
+                if self.node_uid:
+                    owner = {
+                        "apiVersion": "v1",
+                        "kind": "Node",
+                        "name": self.node_name,
+                        "uid": self.node_uid,
+                    }
+                self._slice_controller = ResourceSliceController(
+                    self.kube_client,
+                    self.driver_name,
+                    scope=self.node_name,
+                    owner=owner,
+                )
+                self._slice_controller.start()
+            self._slice_controller.update(resources)
+
+    def registration_status(self) -> Optional[dict]:
+        return self._registration_status

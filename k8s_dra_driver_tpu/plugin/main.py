@@ -1,0 +1,140 @@
+"""tpu-dra-plugin entrypoint.
+
+CLI analog of the reference's plugin main (lengrongfu/k8s-dra-driver,
+cmd/nvidia-dra-plugin/main.go:69-206): every flag has an env-var mirror,
+directories are created up front, and the process serves until SIGINT/SIGTERM.
+
+Run on a TPU host:
+    python -m k8s_dra_driver_tpu.plugin.main --node-name=$NODE_NAME
+
+Run hermetically (no hardware, no cluster) for development:
+    python -m k8s_dra_driver_tpu.plugin.main --node-name=dev \
+        --fake-topology=2x2x1 --fake-generation=v5p --no-kube
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+from ..kube.client import NODES
+from ..tpulib.chiplib import ChipLib, ChipLibConfig, FakeChipLib, RealChipLib
+from ..utils.cli import env as _env
+from ..utils.cli import install_signal_stop, make_kube_client
+from .driver import Driver, DriverConfig
+
+logger = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu-dra-plugin",
+        description="TPU DRA kubelet plugin (node agent)",
+    )
+    p.add_argument("--node-name", default=_env("NODE_NAME"),
+                   help="name of the node this plugin runs on [NODE_NAME]")
+    p.add_argument("--driver-name", default=_env("DRIVER_NAME", "tpu.google.com"),
+                   help="DRA driver name [DRIVER_NAME]")
+    p.add_argument("--cdi-root", default=_env("CDI_ROOT", "/var/run/cdi"),
+                   help="directory for CDI spec files [CDI_ROOT]")
+    p.add_argument("--plugin-root",
+                   default=_env("PLUGIN_ROOT", "/var/lib/kubelet/plugins/tpu.google.com"),
+                   help="kubelet plugin dir (DRA socket) [PLUGIN_ROOT]")
+    p.add_argument("--registrar-root",
+                   default=_env("REGISTRAR_ROOT", "/var/lib/kubelet/plugins_registry"),
+                   help="kubelet plugin-watcher dir [REGISTRAR_ROOT]")
+    p.add_argument("--state-root", default=_env("STATE_ROOT", "/var/lib/tpu-dra"),
+                   help="driver state dir (checkpoint, sharing) [STATE_ROOT]")
+    p.add_argument("--device-classes",
+                   default=_env("DEVICE_CLASSES", "chip,tensorcore,ici"),
+                   help="comma-separated device classes to serve [DEVICE_CLASSES]")
+    p.add_argument("--dev-root", default=_env("DEV_ROOT", "/"),
+                   help="host root containing /dev [DEV_ROOT]")
+    p.add_argument("--sysfs-root", default=_env("SYSFS_ROOT", "/sys"),
+                   help="sysfs mount [SYSFS_ROOT]")
+    p.add_argument("--kubeconfig", default=_env("KUBECONFIG", ""),
+                   help="kubeconfig path (default: in-cluster) [KUBECONFIG]")
+    p.add_argument("--no-kube", action="store_true",
+                   help="run without a Kubernetes API server (dev mode)")
+    p.add_argument("--fake-topology", default=_env("FAKE_TOPOLOGY", ""),
+                   help="serve a fake chip backend with this topology, e.g. 2x2x1")
+    p.add_argument("--fake-generation", default=_env("FAKE_GENERATION", "v5p"))
+    p.add_argument("--http-port", type=int, default=int(_env("HTTP_PORT", "0")),
+                   help="metrics/health endpoint port; 0 disables [HTTP_PORT]")
+    p.add_argument("--log-level", default=_env("LOG_LEVEL", "INFO"))
+    p.add_argument("--log-json", action="store_true",
+                   help="structured JSON logs [LOG_JSON]")
+    return p
+
+
+def make_chiplib(args) -> ChipLib:
+    if args.fake_topology:
+        return FakeChipLib(
+            generation=args.fake_generation, topology=args.fake_topology
+        )
+    return RealChipLib(
+        ChipLibConfig(dev_root=args.dev_root, sysfs_root=args.sysfs_root)
+    )
+
+
+def lookup_node_uid(client, node_name: str) -> str:
+    try:
+        return client.get(NODES, node_name)["metadata"].get("uid", "")
+    except Exception:
+        logger.warning("could not resolve node UID for %s", node_name)
+        return ""
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from ..utils.logging import setup_logging
+
+    setup_logging(level=args.log_level, json_format=args.log_json)
+    if not args.node_name:
+        logger.error("--node-name (or NODE_NAME) is required")
+        return 2
+
+    kube_client = None
+    node_uid = ""
+    if not args.no_kube:
+        kube_client = make_kube_client(args.kubeconfig)
+        node_uid = lookup_node_uid(kube_client, args.node_name)
+
+    config = DriverConfig(
+        node_name=args.node_name,
+        chiplib=make_chiplib(args),
+        kube_client=kube_client,
+        driver_name=args.driver_name,
+        cdi_root=args.cdi_root,
+        plugin_root=args.plugin_root,
+        registrar_root=args.registrar_root,
+        state_root=args.state_root,
+        device_classes=frozenset(args.device_classes.split(",")),
+        node_uid=node_uid,
+    )
+    driver = Driver(config)
+    driver.start()
+    metrics = None
+    if args.http_port:
+        from ..utils.metrics import MetricsServer
+
+        metrics = MetricsServer(driver.registry, port=args.http_port)
+        metrics.start()
+        logger.info("metrics on :%d/metrics", metrics.port)
+    logger.info(
+        "tpu-dra-plugin started: node=%s devices=%d",
+        args.node_name,
+        len(driver.state.allocatable),
+    )
+
+    stop = install_signal_stop()
+    stop.wait()
+    if metrics is not None:
+        metrics.stop()
+    driver.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

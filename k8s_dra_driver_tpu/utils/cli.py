@@ -1,0 +1,46 @@
+"""Shared CLI plumbing for the plugin and controller entrypoints.
+
+Role of the reference's pkg/flags (lengrongfu/k8s-dra-driver,
+pkg/flags/{kubeclient,logging}.go): the env-mirrored flag helpers, kube
+client bootstrap, and signal wiring both binaries share.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+
+
+def env(name: str, default: str = "") -> str:
+    return os.environ.get(name, default)
+
+
+def make_kube_client(kubeconfig: str = ""):
+    """In-cluster config unless a kubeconfig is given
+    (NewClientSets analog, pkg/flags/kubeclient.go:70-106)."""
+    from ..kube.client import RealKubeClient, RestConfig
+
+    cfg = (
+        RestConfig.from_kubeconfig(kubeconfig)
+        if kubeconfig
+        else RestConfig.auto()
+    )
+    return RealKubeClient(cfg)
+
+
+def install_signal_stop() -> threading.Event:
+    """SIGINT/SIGTERM → Event (signal loop analog, plugin main.go:177-205)."""
+    stop = threading.Event()
+
+    def handle(signum, frame):
+        import logging
+
+        logging.getLogger(__name__).info(
+            "received signal %d; shutting down", signum
+        )
+        stop.set()
+
+    signal.signal(signal.SIGINT, handle)
+    signal.signal(signal.SIGTERM, handle)
+    return stop

@@ -1,0 +1,165 @@
+"""Cluster controller tests: ICI domain lifecycle against the fake API."""
+
+import time
+
+import pytest
+
+from k8s_dra_driver_tpu.controller.slice_manager import (
+    CHANNELS_PER_POOL,
+    CLIQUE_LABEL,
+    SLICE_LABEL,
+    DomainKey,
+    IciSliceManager,
+    OffsetAllocator,
+)
+from k8s_dra_driver_tpu.kube import NODES, RESOURCE_SLICES, FakeKubeClient
+
+
+def node(name, slice_id=None, clique=None):
+    labels = {}
+    if slice_id:
+        labels[SLICE_LABEL] = slice_id
+    if clique:
+        labels[CLIQUE_LABEL] = clique
+    return {"metadata": {"name": name, "labels": labels}}
+
+
+def wait_for(predicate, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+class TestOffsetAllocator:
+    def test_slots_and_reuse(self):
+        a = OffsetAllocator()
+        k1, k2 = DomainKey("s1"), DomainKey("s2")
+        assert a.add(k1) == 0
+        assert a.add(k2) == 128
+        assert a.add(k1) == 0  # stable
+        a.remove(k1)
+        assert a.add(DomainKey("s3")) == 0  # slot reused
+
+    def test_capacity_exhaustion(self):
+        a = OffsetAllocator()
+        for i in range(2048 // 128):
+            a.add(DomainKey(f"s{i}"))
+        with pytest.raises(RuntimeError, match="capacity"):
+            a.add(DomainKey("overflow"))
+
+
+class TestDomainLifecycle:
+    def test_domain_appears_and_publishes(self):
+        client = FakeKubeClient()
+        mgr = IciSliceManager(client)
+        mgr.start()
+        client.create(NODES, node("n1", "slice-a"))
+        client.create(NODES, node("n2", "slice-a"))
+        assert wait_for(
+            lambda: any(
+                s["spec"].get("nodeSelector")
+                for s in client.list(RESOURCE_SLICES)
+            )
+        )
+        mgr.slice_controller.sync_once()
+        slices = client.list(RESOURCE_SLICES)
+        assert len(slices) == 1
+        spec = slices[0]["spec"]
+        assert len(spec["devices"]) == CHANNELS_PER_POOL
+        sel = spec["nodeSelector"]["nodeSelectorTerms"][0]["matchExpressions"]
+        assert sel[0] == {
+            "key": SLICE_LABEL, "operator": "In", "values": ["slice-a"]
+        }
+        assert mgr.domains() == {DomainKey("slice-a"): {"n1", "n2"}}
+        mgr.stop()
+        assert client.list(RESOURCE_SLICES) == []
+
+    def test_domain_vanishes_when_last_node_leaves(self):
+        client = FakeKubeClient()
+        mgr = IciSliceManager(client)
+        mgr.start()
+        client.create(NODES, node("n1", "slice-a"))
+        assert wait_for(lambda: mgr.domains())
+        client.delete(NODES, "n1")
+        assert wait_for(lambda: not mgr.domains())
+        mgr.slice_controller.sync_once()
+        assert client.list(RESOURCE_SLICES) == []
+        mgr.stop(cleanup=False)
+
+    def test_relabel_moves_node_between_domains(self):
+        client = FakeKubeClient()
+        mgr = IciSliceManager(client)
+        mgr.start()
+        obj = client.create(NODES, node("n1", "slice-a"))
+        assert wait_for(lambda: DomainKey("slice-a") in mgr.domains())
+        obj["metadata"]["labels"][SLICE_LABEL] = "slice-b"
+        client.update(NODES, obj)
+        assert wait_for(
+            lambda: mgr.domains().keys() == {DomainKey("slice-b")}
+        )
+        mgr.stop(cleanup=False)
+
+    def test_cliques_form_separate_pools(self):
+        client = FakeKubeClient()
+        mgr = IciSliceManager(client)
+        mgr.start()
+        client.create(NODES, node("n1", "slice-a", clique="c0"))
+        client.create(NODES, node("n2", "slice-a", clique="c1"))
+        assert wait_for(lambda: len(mgr.domains()) == 2)
+        mgr.slice_controller.sync_once()
+        slices = client.list(RESOURCE_SLICES)
+        assert len(slices) == 2
+        # Different channel ranges per clique.
+        firsts = sorted(
+            s["spec"]["devices"][0]["basic"]["attributes"]["channel"]["int"]
+            for s in slices
+        )
+        assert firsts == [0, 128]
+        mgr.stop(cleanup=False)
+
+    def test_pre_existing_nodes_seed_domains(self):
+        client = FakeKubeClient()
+        client.create(NODES, node("n1", "slice-a"))
+        mgr = IciSliceManager(client)
+        mgr.start()
+        assert wait_for(lambda: mgr.domains())
+        mgr.stop(cleanup=False)
+
+    def test_pool_names_unambiguous(self):
+        # ("a-b", "") and ("a", "b") must not collide.
+        assert DomainKey("a-b").pool_name != DomainKey("a", "b").pool_name
+
+
+class TestOffsetRecovery:
+    def test_restart_preserves_channel_numbering(self):
+        client = FakeKubeClient()
+        mgr = IciSliceManager(client)
+        mgr.start()
+        client.create(NODES, node("n1", "slice-a"))
+        client.create(NODES, node("n2", "slice-b"))
+        assert wait_for(lambda: len(mgr.domains()) == 2)
+        mgr.slice_controller.sync_once()
+        offset_b = mgr.offsets.get(DomainKey("slice-b"))
+        assert offset_b == 128
+        mgr.stop(cleanup=False)  # crash: slices stay in the API server
+
+        # slice-a's node vanishes while the controller is down.
+        client.delete(NODES, "n1")
+        mgr2 = IciSliceManager(client)
+        mgr2.start()
+        assert wait_for(lambda: mgr2.domains())
+        # slice-b keeps channel range 128..255 even though it is now the
+        # only (first-seen) domain.
+        assert mgr2.offsets.get(DomainKey("slice-b")) == 128
+        # After recovery settles, slice-a's stale pool is pruned.
+        mgr2._settle_timer.cancel()
+        mgr2._settle_recovery()
+        mgr2.slice_controller.sync_once()
+        slices = client.list(RESOURCE_SLICES)
+        assert len(slices) == 1
+        first = slices[0]["spec"]["devices"][0]["basic"]["attributes"]
+        assert first["channel"]["int"] == 128
+        mgr2.stop(cleanup=False)

@@ -1,0 +1,66 @@
+"""Metrics registry + HTTP endpoint tests."""
+
+import urllib.request
+
+from k8s_dra_driver_tpu.utils.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsServer,
+    Registry,
+)
+
+
+class TestRegistry:
+    def test_counter_labels(self):
+        r = Registry()
+        c = Counter("tpu_dra_prepares_total", "Prepares", r)
+        c.inc(result="ok")
+        c.inc(result="ok")
+        c.inc(result="error")
+        text = r.render()
+        assert 'tpu_dra_prepares_total{result="ok"} 2' in text
+        assert 'tpu_dra_prepares_total{result="error"} 1' in text
+        assert "# TYPE tpu_dra_prepares_total counter" in text
+
+    def test_gauge(self):
+        r = Registry()
+        g = Gauge("tpu_dra_chips", "Chips", r)
+        g.set(4)
+        assert "tpu_dra_chips 4" in r.render()
+        g.set(2)
+        assert "tpu_dra_chips 2" in r.render()
+
+    def test_histogram_buckets(self):
+        r = Registry()
+        h = Histogram("lat", "Latency", r, buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(5.0)
+        text = r.render()
+        assert 'lat_bucket{le="0.1"} 1' in text
+        assert 'lat_bucket{le="1"} 2' in text
+        assert 'lat_bucket{le="+Inf"} 3' in text
+        assert "lat_count 3" in text
+
+    def test_histogram_timer(self):
+        r = Registry()
+        h = Histogram("t", "T", r)
+        with h.time():
+            pass
+        assert "t_count 1" in r.render()
+
+
+class TestServer:
+    def test_metrics_and_health_endpoints(self):
+        r = Registry()
+        Gauge("up", "Up", r).set(1)
+        srv = MetricsServer(r, host="127.0.0.1", port=0)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            body = urllib.request.urlopen(f"{base}/metrics").read().decode()
+            assert "up 1" in body
+            assert urllib.request.urlopen(f"{base}/healthz").read() == b"ok"
+        finally:
+            srv.stop()

@@ -1,0 +1,170 @@
+"""End-to-end plugin tests: a fake kubelet over real gRPC/UDS.
+
+The integration surface the reference only exercised manually on GPU
+hardware (SURVEY.md §4): start the full plugin (fake chip backend + fake
+API server), register like the kubelet plugin-watcher would, and drive
+NodePrepareResources/NodeUnprepareResources through a real grpc channel.
+"""
+
+import time
+
+import grpc
+import pytest
+
+from k8s_dra_driver_tpu.kube import (
+    NODES,
+    RESOURCE_CLAIMS,
+    RESOURCE_SLICES,
+    FakeKubeClient,
+)
+from k8s_dra_driver_tpu.kube.protos import dra_v1alpha4_pb2 as drapb
+from k8s_dra_driver_tpu.kube.protos import pluginregistration_v1_pb2 as regpb
+from k8s_dra_driver_tpu.plugin.driver import Driver, DriverConfig
+from k8s_dra_driver_tpu.plugin.grpc_services import NodeStub, RegistrationStub
+from k8s_dra_driver_tpu.tpulib import FakeChipLib
+
+DRIVER = "tpu.google.com"
+
+
+@pytest.fixture
+def harness(tmp_path):
+    client = FakeKubeClient()
+    client.create(NODES, {"metadata": {"name": "node-a", "uid": "node-uid-1"}})
+    config = DriverConfig(
+        node_name="node-a",
+        chiplib=FakeChipLib(generation="v5p", topology="2x2x1"),
+        kube_client=client,
+        cdi_root=str(tmp_path / "cdi"),
+        plugin_root=str(tmp_path / "plugin"),
+        registrar_root=str(tmp_path / "registry"),
+        state_root=str(tmp_path / "state"),
+        node_uid="node-uid-1",
+    )
+    driver = Driver(config)
+    driver.start()
+    yield driver, client, config
+    driver.shutdown()
+
+
+def add_claim(client, uid, devices, name="claim-1", namespace="default"):
+    results = [
+        {"request": "req-0", "driver": DRIVER, "pool": "node-a", "device": d}
+        for d in devices
+    ]
+    claim = {
+        "metadata": {"name": name, "namespace": namespace, "uid": uid},
+        "status": {"allocation": {"devices": {"results": results, "config": []}}},
+    }
+    client.create(RESOURCE_CLAIMS, claim, namespace=namespace)
+    return claim
+
+
+class TestRegistration:
+    def test_get_info_and_notify(self, harness):
+        driver, _, config = harness
+        with grpc.insecure_channel(f"unix://{config.registrar_socket}") as ch:
+            stub = RegistrationStub(ch)
+            info = stub.GetInfo(regpb.InfoRequest())
+            assert info.type == "DRAPlugin"
+            assert info.name == DRIVER
+            assert info.endpoint == config.plugin_socket
+            assert list(info.supported_versions) == ["v1alpha4"]
+            stub.NotifyRegistrationStatus(
+                regpb.RegistrationStatus(plugin_registered=True)
+            )
+        assert driver.plugin.registration_status() == {
+            "pluginRegistered": True,
+            "error": "",
+        }
+
+
+class TestPrepareOverGrpc:
+    def test_prepare_unprepare_roundtrip(self, harness):
+        driver, client, config = harness
+        add_claim(client, "uid-1", ["tpu-0", "tpu-1"])
+        with grpc.insecure_channel(f"unix://{config.plugin_socket}") as ch:
+            stub = NodeStub(ch)
+            resp = stub.NodePrepareResources(
+                drapb.NodePrepareResourcesRequest(
+                    claims=[
+                        drapb.Claim(uid="uid-1", name="claim-1", namespace="default")
+                    ]
+                )
+            )
+            result = resp.claims["uid-1"]
+            assert result.error == ""
+            assert len(result.devices) == 2
+            assert result.devices[0].pool_name == "node-a"
+            assert result.devices[0].cdi_device_ids[0].startswith(
+                "k8s.tpu.google.com/chip="
+            )
+            # Unprepare.
+            uresp = stub.NodeUnprepareResources(
+                drapb.NodeUnprepareResourcesRequest(
+                    claims=[
+                        drapb.Claim(uid="uid-1", name="claim-1", namespace="default")
+                    ]
+                )
+            )
+            assert uresp.claims["uid-1"].error == ""
+        assert driver.state.checkpoint.read() == {}
+
+    def test_per_claim_error_isolation(self, harness):
+        """One bad claim must not fail the RPC or the good claim
+        (driver.go:124-138 analog)."""
+        _, client, config = harness
+        add_claim(client, "uid-good", ["tpu-0"], name="good")
+        add_claim(client, "uid-bad", ["tpu-404"], name="bad")
+        with grpc.insecure_channel(f"unix://{config.plugin_socket}") as ch:
+            stub = NodeStub(ch)
+            resp = stub.NodePrepareResources(
+                drapb.NodePrepareResourcesRequest(
+                    claims=[
+                        drapb.Claim(uid="uid-good", name="good", namespace="default"),
+                        drapb.Claim(uid="uid-bad", name="bad", namespace="default"),
+                        drapb.Claim(uid="uid-missing", name="ghost", namespace="default"),
+                    ]
+                )
+            )
+        assert resp.claims["uid-good"].error == ""
+        assert "not allocatable" in resp.claims["uid-bad"].error
+        assert "uid-missing" in resp.claims["uid-missing"].error
+
+    def test_uid_mismatch_rejected(self, harness):
+        """Deleted+recreated claim with same name must not prepare
+        (driver.go:120-131 analog)."""
+        _, client, config = harness
+        add_claim(client, "uid-new", ["tpu-0"], name="claim-x")
+        with grpc.insecure_channel(f"unix://{config.plugin_socket}") as ch:
+            stub = NodeStub(ch)
+            resp = stub.NodePrepareResources(
+                drapb.NodePrepareResourcesRequest(
+                    claims=[
+                        drapb.Claim(uid="uid-old", name="claim-x", namespace="default")
+                    ]
+                )
+            )
+        assert "UID mismatch" in resp.claims["uid-old"].error
+
+
+class TestSlicePublication:
+    def test_slices_published_on_start(self, harness):
+        _, client, _ = harness
+        # Publication is async (background reconciler); poll briefly.
+        deadline = time.monotonic() + 5
+        slices = []
+        while time.monotonic() < deadline:
+            slices = client.list(RESOURCE_SLICES)
+            if slices:
+                break
+            time.sleep(0.05)
+        assert len(slices) == 1
+        spec = slices[0]["spec"]
+        assert spec["driver"] == DRIVER
+        assert spec["nodeName"] == "node-a"
+        assert spec["pool"]["name"] == "node-a"
+        names = [d["name"] for d in spec["devices"]]
+        # 4 chips + 8 tensorcores, no ici channels.
+        assert len(names) == 12
+        assert slices[0]["metadata"]["ownerReferences"][0]["uid"] == "node-uid-1"
+        assert spec["sharedCounters"][0]["counters"]["cores"]["value"] == "2"

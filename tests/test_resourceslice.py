@@ -1,0 +1,141 @@
+"""ResourceSlice controller tests (reconcile diff, chunking, cleanup)."""
+
+from k8s_dra_driver_tpu.kube import RESOURCE_SLICES, FakeKubeClient
+from k8s_dra_driver_tpu.kube.resourceslice import (
+    MAX_DEVICES_PER_SLICE,
+    DriverResources,
+    Pool,
+    ResourceSliceController,
+)
+
+DRIVER = "tpu.google.com"
+
+
+def dev(name):
+    return {"name": name, "basic": {"attributes": {}}}
+
+
+def make_controller(client=None, owner=None, scope="node-a"):
+    client = client or FakeKubeClient()
+    return ResourceSliceController(client, DRIVER, scope=scope, owner=owner), client
+
+
+class TestSync:
+    def test_create_update_delete(self):
+        ctl, client = make_controller()
+        ctl.update(DriverResources(pools={
+            "node-a": Pool(devices=[dev("tpu-0"), dev("tpu-1")], node_name="node-a")
+        }))
+        ctl.sync_once()
+        slices = client.list(RESOURCE_SLICES)
+        assert len(slices) == 1
+        assert slices[0]["spec"]["devices"] == [dev("tpu-0"), dev("tpu-1")]
+        assert slices[0]["spec"]["nodeName"] == "node-a"
+
+        # Update: one device disappears.
+        ctl.update(DriverResources(pools={
+            "node-a": Pool(devices=[dev("tpu-0")], node_name="node-a")
+        }))
+        ctl.sync_once()
+        slices = client.list(RESOURCE_SLICES)
+        assert slices[0]["spec"]["devices"] == [dev("tpu-0")]
+
+        # Pool removed → slice deleted.
+        ctl.update(DriverResources())
+        ctl.sync_once()
+        assert client.list(RESOURCE_SLICES) == []
+
+    def test_idempotent_sync_no_rv_churn(self):
+        ctl, client = make_controller()
+        ctl.update(DriverResources(pools={
+            "p": Pool(devices=[dev("tpu-0")], node_name="n")
+        }))
+        ctl.sync_once()
+        rv1 = client.list(RESOURCE_SLICES)[0]["metadata"]["resourceVersion"]
+        ctl.sync_once()
+        rv2 = client.list(RESOURCE_SLICES)[0]["metadata"]["resourceVersion"]
+        assert rv1 == rv2  # no spurious updates
+
+    def test_chunking_over_max(self):
+        ctl, client = make_controller()
+        n = MAX_DEVICES_PER_SLICE + 5
+        ctl.update(DriverResources(pools={
+            "big": Pool(devices=[dev(f"d-{i}") for i in range(n)], node_name="n")
+        }))
+        ctl.sync_once()
+        slices = client.list(RESOURCE_SLICES)
+        assert len(slices) == 2
+        counts = sorted(len(s["spec"]["devices"]) for s in slices)
+        assert counts == [5, MAX_DEVICES_PER_SLICE]
+        assert all(
+            s["spec"]["pool"]["resourceSliceCount"] == 2 for s in slices
+        )
+
+    def test_network_pool_node_selector(self):
+        ctl, client = make_controller()
+        selector = {
+            "nodeSelectorTerms": [
+                {"matchExpressions": [
+                    {"key": "tpu.google.com/slice-id", "operator": "In",
+                     "values": ["slice-1"]}
+                ]}
+            ]
+        }
+        ctl.update(DriverResources(pools={
+            "slice-1-ici": Pool(
+                devices=[dev("ici-channel-0")], node_selector=selector
+            )
+        }))
+        ctl.sync_once()
+        spec = client.list(RESOURCE_SLICES)[0]["spec"]
+        assert spec["nodeSelector"] == selector
+        assert "nodeName" not in spec
+
+    def test_foreign_driver_slices_untouched(self):
+        client = FakeKubeClient()
+        client.create(RESOURCE_SLICES, {
+            "metadata": {"name": "other"},
+            "spec": {"driver": "gpu.nvidia.com", "devices": []},
+        })
+        ctl, _ = make_controller(client)
+        ctl.update(DriverResources())
+        ctl.sync_once()
+        assert [s["metadata"]["name"] for s in client.list(RESOURCE_SLICES)] == [
+            "other"
+        ]
+
+    def test_publishers_do_not_prune_each_other(self):
+        """Multiple publishers share one driver name (every node plugin +
+        the cluster controller); each must only manage its own slices."""
+        client = FakeKubeClient()
+        ctl_a, _ = make_controller(client, scope="node-a")
+        ctl_b, _ = make_controller(client, scope="node-b")
+        ctl_a.update(DriverResources(pools={
+            "node-a": Pool(devices=[dev("tpu-0")], node_name="node-a")
+        }))
+        ctl_b.update(DriverResources(pools={
+            "node-b": Pool(devices=[dev("tpu-0")], node_name="node-b")
+        }))
+        ctl_a.sync_once()
+        ctl_b.sync_once()
+        assert len(client.list(RESOURCE_SLICES)) == 2
+        # Re-sync of A must not delete B's slice (and vice versa).
+        ctl_a.sync_once()
+        ctl_b.sync_once()
+        assert len(client.list(RESOURCE_SLICES)) == 2
+        # Cleanup-stop of A keeps B's slice.
+        ctl_a.stop(delete_slices=True)
+        remaining = client.list(RESOURCE_SLICES)
+        assert len(remaining) == 1
+        assert remaining[0]["spec"]["nodeName"] == "node-b"
+
+    def test_stop_with_cleanup(self):
+        ctl, client = make_controller()
+        ctl.update(DriverResources(pools={
+            "p": Pool(devices=[dev("tpu-0")], node_name="n")
+        }))
+        ctl.start()
+        ctl.sync_once()
+        assert client.list(RESOURCE_SLICES)
+        ctl.stop(delete_slices=True)
+        assert client.list(RESOURCE_SLICES) == []
