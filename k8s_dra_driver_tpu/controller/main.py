@@ -36,6 +36,11 @@ def build_parser() -> argparse.ArgumentParser:
                    default=int(_env("HTTP_PORT", "8080")),
                    help="metrics/health endpoint port; 0 disables")
     p.add_argument("--kubeconfig", default=_env("KUBECONFIG", ""))
+    p.add_argument("--cleanup-on-exit", action="store_true",
+                   help="delete published ResourceSlices on shutdown. Only "
+                        "for decommissioning: a rolling restart must NOT "
+                        "clean up, or channel offsets lose their recovery "
+                        "source and domains get renumbered under live claims")
     p.add_argument("--log-level", default=_env("LOG_LEVEL", "INFO"))
     p.add_argument("--log-json", action="store_true")
     return p
@@ -78,7 +83,7 @@ def main(argv=None) -> int:
         if manager is not None:
             domains_gauge.set(len(manager.domains()))
     if manager is not None:
-        manager.stop(cleanup=True)
+        manager.stop(cleanup=args.cleanup_on_exit)
     if metrics is not None:
         metrics.stop()
     return 0

@@ -121,7 +121,6 @@ class IciSliceManager:
         self._lock = threading.Lock()
         self._watch: Optional[Watch] = None
         self._thread: Optional[threading.Thread] = None
-        self._settle_timer: Optional[threading.Timer] = None
         self._stop = threading.Event()
 
     # -- lifecycle ---------------------------------------------------------
@@ -129,28 +128,42 @@ class IciSliceManager:
     def start(self) -> None:
         self._recover_offsets()
         self.slice_controller.start()
+        # Seed domains from a synchronous node list BEFORE settling, so
+        # recovered offsets are only dropped for domains that are truly gone
+        # — never because watch events were slow to arrive.
+        try:
+            seed = self.client.list(NODES, label_selector=SLICE_LABEL)
+        except Exception:
+            logger.exception("initial node list failed; watch will recover")
+            seed = []
+        with self._lock:
+            for node in seed:
+                labels = (node["metadata"].get("labels")) or {}
+                slice_id = labels.get(SLICE_LABEL, "")
+                if slice_id:
+                    self._add_node(
+                        node["metadata"]["name"],
+                        DomainKey(slice_id, labels.get(CLIQUE_LABEL, "")),
+                    )
+            self._settle_recovery_locked()
         self._watch = self.client.watch(NODES, label_selector=SLICE_LABEL)
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="ici-slice-manager"
         )
         self._thread.start()
-        # After the watch seeds current nodes, reconcile once: prunes pools
-        # of domains that vanished while we were down and releases their
-        # recovered offsets.
-        self._settle_timer = threading.Timer(2.0, self._settle_recovery)
-        self._settle_timer.daemon = True
-        self._settle_timer.start()
 
-    def _settle_recovery(self) -> None:
-        with self._lock:
-            live = set(self._domains)
-            for key in [k for k in self.offsets._offsets if k not in live]:
-                logger.info(
-                    "dropping recovered offset for vanished domain %s",
-                    key.pool_name,
-                )
-                self.offsets.remove(key)
-            self._publish_locked()
+    def _settle_recovery_locked(self) -> None:
+        """Drop recovered offsets whose domain no longer has nodes, and
+        publish the now-authoritative pool set (prunes stale pools of
+        domains that vanished while the controller was down)."""
+        live = set(self._domains)
+        for key in [k for k in self.offsets._offsets if k not in live]:
+            logger.info(
+                "dropping recovered offset for vanished domain %s",
+                key.pool_name,
+            )
+            self.offsets.remove(key)
+        self._publish_locked()
 
     def _recover_offsets(self) -> None:
         """Re-seed the offset allocator from slices published by a previous
@@ -191,8 +204,6 @@ class IciSliceManager:
         """Stop + optionally delete all our slices
         (cleanupResourceSlices analog, imex.go:308-326)."""
         self._stop.set()
-        if self._settle_timer is not None:
-            self._settle_timer.cancel()
         if self._watch is not None:
             self._watch.stop()
         if self._thread is not None:
@@ -235,14 +246,25 @@ class IciSliceManager:
     def _add_node(self, name: str, key: DomainKey) -> bool:
         if self._node_domain.get(name) == key:
             return False
-        self._node_domain[name] = key
-        members = self._domains.setdefault(key, set())
-        if not members:
-            offset = self.offsets.add(key)
+        if key not in self._domains:
+            # Allocate BEFORE inserting the domain: on capacity exhaustion
+            # nothing is left half-registered (an offset-less domain would
+            # wedge every subsequent publish).
+            try:
+                offset = self.offsets.add(key)
+            except RuntimeError:
+                logger.error(
+                    "cannot admit ICI domain %s: channel capacity exhausted "
+                    "(%d domains of %d channels)",
+                    key.pool_name, CHANNELS_PER_DRIVER // CHANNELS_PER_POOL,
+                    CHANNELS_PER_POOL,
+                )
+                return False
             logger.info(
                 "ICI domain %s appeared (offset %d)", key.pool_name, offset
             )
-        members.add(name)
+        self._node_domain[name] = key
+        self._domains.setdefault(key, set()).add(name)
         return True
 
     def _remove_node(self, name: str, key: DomainKey) -> bool:
@@ -282,9 +304,11 @@ class IciSliceManager:
         )
 
     def _publish_locked(self) -> None:
-        pools = {
-            key.pool_name: self._channel_pool(key) for key in self._domains
-        }
+        pools = {}
+        for key in self._domains:
+            if self.offsets.get(key) is None:
+                continue  # not admitted (capacity exhausted)
+            pools[key.pool_name] = self._channel_pool(key)
         self.slice_controller.update(DriverResources(pools=pools))
 
     # -- introspection -----------------------------------------------------

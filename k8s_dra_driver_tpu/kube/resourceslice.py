@@ -22,7 +22,7 @@ import threading
 from typing import Optional
 
 from .client import RESOURCE_SLICES, GVR, KubeClient
-from .errors import ConflictError, NotFoundError
+from .errors import AlreadyExistsError, ConflictError, NotFoundError
 
 logger = logging.getLogger(__name__)
 
@@ -40,13 +40,14 @@ OWNER_LABEL = "tpu.google.com/owned-by"
 
 @dataclasses.dataclass
 class Pool:
-    """One pool of devices (DriverResources.Pools entry analog)."""
+    """One pool of devices (DriverResources.Pools entry analog). The pool
+    generation is managed by the controller (bumped on content change), not
+    supplied by callers."""
 
     devices: list[dict]
     shared_counters: list[dict] = dataclasses.field(default_factory=list)
     node_name: str = ""                       # node-local pools
     node_selector: Optional[dict] = None      # network pools
-    generation: int = 1
 
 
 @dataclasses.dataclass
@@ -79,6 +80,7 @@ class ResourceSliceController:
         self.gvr = gvr
         self._desired = DriverResources()
         self._lock = threading.Lock()
+        self._sync_lock = threading.Lock()  # one reconcile pass at a time
         self._trigger = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -115,10 +117,12 @@ class ResourceSliceController:
 
     def sync_once(self) -> None:
         """One reconcile pass (exposed for tests and for callers that want
-        synchronous publication before serving)."""
-        with self._lock:
-            desired = self._desired
-        self._sync(desired)
+        synchronous publication before serving). Serialized against the
+        background reconciler."""
+        with self._sync_lock:
+            with self._lock:
+                desired = self._desired
+            self._sync(desired)
 
     # -- reconcile loop ----------------------------------------------------
 
@@ -140,7 +144,9 @@ class ResourceSliceController:
     def _slice_name(self, pool_name: str, index: int) -> str:
         return f"{pool_name}-{self.driver_name.replace('.', '-')}-{index}"
 
-    def _build_slices(self, pool_name: str, pool: Pool) -> list[dict]:
+    def _build_slices(
+        self, pool_name: str, pool: Pool, generation: int
+    ) -> list[dict]:
         chunks = [
             pool.devices[i : i + MAX_DEVICES_PER_SLICE]
             for i in range(0, len(pool.devices), MAX_DEVICES_PER_SLICE)
@@ -151,7 +157,7 @@ class ResourceSliceController:
                 "driver": self.driver_name,
                 "pool": {
                     "name": pool_name,
-                    "generation": pool.generation,
+                    "generation": generation,
                     "resourceSliceCount": len(chunks),
                 },
                 "devices": chunk,
@@ -189,18 +195,66 @@ class ResourceSliceController:
             if s.get("spec", {}).get("driver") == self.driver_name
         ]
 
+    @staticmethod
+    def _spec_sans_generation(spec: dict) -> dict:
+        clone = dict(spec)
+        clone["pool"] = {
+            k: v for k, v in spec.get("pool", {}).items() if k != "generation"
+        }
+        return clone
+
     def _sync(self, desired: DriverResources) -> None:
-        """Name-keyed create/update/delete diff."""
+        """Name-keyed create/update/delete diff.
+
+        Pool generation is bumped whenever the pool's content changes, so
+        during a multi-slice transition (some slices updated, stale ones not
+        yet deleted) schedulers can discard lower-generation slices — the
+        upstream resourceslice controller's protocol.
+        """
+        have = {s["metadata"]["name"]: s for s in self._list_driver_slices()}
+        gen_by_pool: dict[str, int] = {}
+        for s in have.values():
+            pool_md = s.get("spec", {}).get("pool", {})
+            name = pool_md.get("name", "")
+            gen_by_pool[name] = max(
+                gen_by_pool.get(name, 0), pool_md.get("generation", 0)
+            )
+
         want: dict[str, dict] = {}
         for pool_name, pool in desired.pools.items():
-            for sl in self._build_slices(pool_name, pool):
+            current_gen = gen_by_pool.get(pool_name, 0) or 1
+            trial = self._build_slices(pool_name, pool, current_gen)
+            changed = False
+            for sl in trial:
+                existing = have.get(sl["metadata"]["name"])
+                if existing is None or self._spec_sans_generation(
+                    existing["spec"]
+                ) != self._spec_sans_generation(sl["spec"]):
+                    changed = True
+                    break
+            # Any stale slice of this pool beyond the trial set also counts
+            # as a change (shrinking pool).
+            trial_names = {sl["metadata"]["name"] for sl in trial}
+            stale = [
+                n for n, s in have.items()
+                if s["spec"].get("pool", {}).get("name") == pool_name
+                and n not in trial_names
+            ]
+            if stale:
+                changed = True
+            if changed and gen_by_pool.get(pool_name):
+                trial = self._build_slices(pool_name, pool, current_gen + 1)
+            for sl in trial:
                 want[sl["metadata"]["name"]] = sl
-        have = {s["metadata"]["name"]: s for s in self._list_driver_slices()}
 
         for name, sl in want.items():
             existing = have.get(name)
             if existing is None:
-                self.client.create(self.gvr, sl)
+                try:
+                    self.client.create(self.gvr, sl)
+                except AlreadyExistsError:
+                    # Raced a concurrent writer; converge next pass.
+                    self._trigger.set()
             elif existing.get("spec") != sl["spec"]:
                 merged = dict(sl)
                 merged["metadata"] = dict(sl["metadata"])

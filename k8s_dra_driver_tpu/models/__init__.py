@@ -1,0 +1,31 @@
+"""Model family: Llama-3 causal LMs with sharded training."""
+
+from .llama import (
+    PRESETS,
+    LlamaConfig,
+    forward,
+    init_params,
+    loss_fn,
+    param_specs,
+)
+from .train import (
+    TrainState,
+    init_train_state,
+    make_eval_step,
+    make_optimizer,
+    make_train_step,
+)
+
+__all__ = [
+    "LlamaConfig",
+    "PRESETS",
+    "init_params",
+    "param_specs",
+    "forward",
+    "loss_fn",
+    "TrainState",
+    "make_optimizer",
+    "init_train_state",
+    "make_train_step",
+    "make_eval_step",
+]

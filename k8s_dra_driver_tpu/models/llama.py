@@ -1,0 +1,228 @@
+"""Llama-3 family causal LM, tpu-first.
+
+The flagship workload for DRA-claimed slices (BASELINE.md: ≥50% MFU for a
+ResourceClaim-scheduled Llama-3-8B on a v5p-16). Design choices:
+
+- **Pure pytrees + lax.scan over layers**: one compiled block regardless of
+  depth — fast compiles, natural remat boundary, and XLA sees a single
+  fusion region per layer.
+- **Stacked layer params** (leading L dim) so the scan carries no Python
+  structure; sharding specs broadcast over the stack dim.
+- **Logical-axis sharding** via parallel.sharding: Megatron-style tensor
+  parallel (column-parallel wq/gate/up, row-parallel wo/down), fsdp on the
+  complementary dim, optional ring-attention sequence parallelism.
+- **bf16 params / f32 logits+loss**: MXU-native compute, stable softmax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.attention import flash_attention
+from ..ops.norms import rmsnorm
+from ..ops.rotary import apply_rope, rope_frequencies
+from ..parallel.ring import ring_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    mlp_hidden: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.n_heads
+
+    def flops_per_token(self) -> float:
+        """Dense fwd+bwd FLOPs/token ≈ 6N + attention term."""
+        n = self.num_params()
+        attn = 12 * self.n_layers * self.hidden * self.max_seq_len
+        return 6 * n + attn
+
+    def num_params(self) -> int:
+        h, m, v, l = self.hidden, self.mlp_hidden, self.vocab_size, self.n_layers
+        kv = self.n_kv_heads * self.head_dim
+        per_layer = (
+            h * h              # wq
+            + 2 * h * kv       # wk, wv
+            + h * h            # wo
+            + 3 * h * m        # gate, up, down
+            + 2 * h            # norms
+        )
+        return v * h + l * per_layer + h + h * v
+
+
+PRESETS: dict[str, LlamaConfig] = {
+    # Hermetic-test size.
+    "tiny": LlamaConfig(
+        vocab_size=256, hidden=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        mlp_hidden=128, max_seq_len=128, dtype=jnp.float32,
+    ),
+    # Single-chip bench sizes.
+    "160m": LlamaConfig(
+        vocab_size=32000, hidden=768, n_layers=12, n_heads=12, n_kv_heads=12,
+        mlp_hidden=2048, max_seq_len=2048,
+    ),
+    "1b": LlamaConfig(
+        vocab_size=128256, hidden=2048, n_layers=16, n_heads=32, n_kv_heads=8,
+        mlp_hidden=8192, max_seq_len=8192,
+    ),
+    "3b": LlamaConfig(
+        vocab_size=128256, hidden=3072, n_layers=28, n_heads=24, n_kv_heads=8,
+        mlp_hidden=8192, max_seq_len=8192,
+    ),
+    "8b": LlamaConfig(),  # Llama-3-8B
+    "70b": LlamaConfig(
+        vocab_size=128256, hidden=8192, n_layers=80, n_heads=64, n_kv_heads=8,
+        mlp_hidden=28672, max_seq_len=8192,
+    ),
+}
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+# ---------------------------------------------------------------------------
+
+
+def init_params(config: LlamaConfig, key: jax.Array) -> dict:
+    """Initialize the parameter pytree (layers stacked on axis 0)."""
+    c = config
+    keys = jax.random.split(key, 10)
+    h, m, v, l = c.hidden, c.mlp_hidden, c.vocab_size, c.n_layers
+    hq = c.n_heads * c.head_dim
+    hkv = c.n_kv_heads * c.head_dim
+
+    def norm_init(k, *shape, fan_in):
+        scale = 1.0 / math.sqrt(fan_in)
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(c.dtype)
+
+    return {
+        "embed": norm_init(keys[0], v, h, fan_in=h),
+        "layers": {
+            "wq": norm_init(keys[1], l, h, hq, fan_in=h),
+            "wk": norm_init(keys[2], l, h, hkv, fan_in=h),
+            "wv": norm_init(keys[3], l, h, hkv, fan_in=h),
+            "wo": norm_init(keys[4], l, hq, h, fan_in=hq),
+            "w_gate": norm_init(keys[5], l, h, m, fan_in=h),
+            "w_up": norm_init(keys[6], l, h, m, fan_in=h),
+            "w_down": norm_init(keys[7], l, m, h, fan_in=m),
+            "ln_attn": jnp.ones((l, h), c.dtype),
+            "ln_mlp": jnp.ones((l, h), c.dtype),
+        },
+        "final_norm": jnp.ones((h,), c.dtype),
+        "lm_head": norm_init(keys[8], h, v, fan_in=h),
+    }
+
+
+def param_specs(config: LlamaConfig) -> dict:
+    """PartitionSpecs per param (Megatron TP + fsdp on the other dim).
+
+    Layer stacks carry a leading None for the scan dim.
+    """
+    col = P(None, "fsdp", "tensor")     # column-parallel: out dim sharded
+    row = P(None, "tensor", "fsdp")     # row-parallel: in dim sharded
+    return {
+        "embed": P("tensor", "fsdp"),
+        "layers": {
+            "wq": col,
+            "wk": col,
+            "wv": col,
+            "wo": row,
+            "w_gate": col,
+            "w_up": col,
+            "w_down": row,
+            "ln_attn": P(None, None),
+            "ln_mlp": P(None, None),
+        },
+        "final_norm": P(None),
+        "lm_head": P("fsdp", "tensor"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _attention_block(x, layer, config: LlamaConfig, cos, sin, mesh, use_ring):
+    c = config
+    b, s, _ = x.shape
+    xn = rmsnorm(x, layer["ln_attn"], c.norm_eps)
+    q = (xn @ layer["wq"]).reshape(b, s, c.n_heads, c.head_dim)
+    k = (xn @ layer["wk"]).reshape(b, s, c.n_kv_heads, c.head_dim)
+    v = (xn @ layer["wv"]).reshape(b, s, c.n_kv_heads, c.head_dim)
+    q = q.transpose(0, 2, 1, 3)  # [B, H, S, D]
+    k = k.transpose(0, 2, 1, 3)
+    v = v.transpose(0, 2, 1, 3)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    if use_ring and mesh is not None:
+        o = ring_attention(q, k, v, mesh, causal=True)
+    else:
+        o = flash_attention(q, k, v, causal=True)
+    o = o.transpose(0, 2, 1, 3).reshape(b, s, c.n_heads * c.head_dim)
+    return x + (o @ layer["wo"]).astype(x.dtype)
+
+
+def _mlp_block(x, layer, config: LlamaConfig):
+    xn = rmsnorm(x, layer["ln_mlp"], config.norm_eps)
+    gate = jax.nn.silu((xn @ layer["w_gate"]).astype(jnp.float32))
+    up = (xn @ layer["w_up"]).astype(jnp.float32)
+    return x + ((gate * up).astype(x.dtype) @ layer["w_down"]).astype(x.dtype)
+
+
+def forward(
+    params: dict,
+    tokens: jax.Array,                  # [B, S] int32
+    config: LlamaConfig,
+    mesh: Optional[Mesh] = None,
+    use_ring: bool = False,
+    remat: bool = False,
+) -> jax.Array:
+    """Causal LM forward → logits [B, S, V] (f32)."""
+    c = config
+    s = tokens.shape[1]
+    x = params["embed"][tokens]          # [B, S, H]
+    cos, sin = rope_frequencies(c.head_dim, s, c.rope_theta, dtype=jnp.float32)
+
+    def block(x, layer):
+        x = _attention_block(x, layer, c, cos, sin, mesh, use_ring)
+        x = _mlp_block(x, layer, c)
+        return x, None
+
+    if remat:
+        block = jax.checkpoint(block, prevent_cse=False)
+    x, _ = jax.lax.scan(block, x, params["layers"])
+    x = rmsnorm(x, params["final_norm"], c.norm_eps)
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def loss_fn(
+    params: dict,
+    tokens: jax.Array,                   # [B, S+1]: inputs + shifted targets
+    config: LlamaConfig,
+    mesh: Optional[Mesh] = None,
+    use_ring: bool = False,
+    remat: bool = True,
+) -> jax.Array:
+    """Next-token cross-entropy (mean over tokens)."""
+    inputs = tokens[:, :-1]
+    targets = tokens[:, 1:]
+    logits = forward(params, inputs, config, mesh, use_ring, remat)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)

@@ -1,0 +1,124 @@
+"""Training loop machinery: sharded train step with optax.
+
+tpu-first: the whole step (fwd, bwd, optimizer) is one jit with donated
+state; params/opt-state are sharded by the model's param specs (fsdp/tp)
+and batches by (data, fsdp); remat is on by default so HBM holds weights +
+optimizer + one layer's activations, not the full activation stack.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .llama import LlamaConfig, init_params, loss_fn, param_specs
+
+
+@dataclasses.dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+
+def make_optimizer(
+    lr: float = 3e-4,
+    weight_decay: float = 0.1,
+    b1: float = 0.9,
+    b2: float = 0.95,
+    grad_clip: float = 1.0,
+    warmup_steps: int = 100,
+    total_steps: int = 10000,
+) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=lr,
+        warmup_steps=warmup_steps,
+        decay_steps=total_steps,
+        end_value=lr * 0.1,
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay),
+    )
+
+
+def init_train_state(
+    config: LlamaConfig,
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation,
+    seed: int = 0,
+) -> TrainState:
+    """Initialize params + opt state directly sharded on the mesh (no
+    host-memory staging of the full model: init is jitted with sharded
+    outputs)."""
+    pspecs = param_specs(config)
+    param_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs)
+
+    @functools.partial(jax.jit, out_shardings=param_shardings)
+    def _init(key):
+        return init_params(config, key)
+
+    params = _init(jax.random.PRNGKey(seed))
+    # Optimizer moments inherit their params' shardings via XLA sharding
+    # propagation — adamw state is structurally a copy of the param tree.
+    opt_state = jax.jit(optimizer.init)(params)
+    return TrainState(
+        params=params,
+        opt_state=opt_state,
+        step=jnp.zeros((), jnp.int32),
+    )
+
+
+def make_train_step(
+    config: LlamaConfig,
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation,
+    use_ring: bool = False,
+    remat: bool = True,
+):
+    """Build the jitted train step: (state, tokens[B, S+1]) → (state, loss)."""
+    batch_sharding = NamedSharding(mesh, P(("data", "fsdp"), None))
+
+    def step(state: TrainState, tokens: jax.Array):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state.params, tokens, config, mesh, use_ring, remat
+        )
+        updates, new_opt = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        new_params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(
+                params=new_params, opt_state=new_opt, step=state.step + 1
+            ),
+            loss,
+        )
+
+    return jax.jit(
+        step,
+        in_shardings=(None, batch_sharding),
+        donate_argnums=(0,),
+    )
+
+
+def make_eval_step(config: LlamaConfig, mesh: Mesh, use_ring: bool = False):
+    batch_sharding = NamedSharding(mesh, P(("data", "fsdp"), None))
+
+    def step(params, tokens):
+        return loss_fn(params, tokens, config, mesh, use_ring, remat=False)
+
+    return jax.jit(step, in_shardings=(None, batch_sharding))
+
+
+jax.tree_util.register_dataclass(
+    TrainState,
+    data_fields=["params", "opt_state", "step"],
+    meta_fields=[],
+)

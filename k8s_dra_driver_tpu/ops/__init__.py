@@ -1,0 +1,14 @@
+"""TPU ops: flash attention (Pallas), fused norms, rotary embeddings."""
+
+from .attention import attention_reference, flash_attention
+from .norms import rmsnorm, rmsnorm_reference
+from .rotary import apply_rope, rope_frequencies
+
+__all__ = [
+    "flash_attention",
+    "attention_reference",
+    "rmsnorm",
+    "rmsnorm_reference",
+    "apply_rope",
+    "rope_frequencies",
+]

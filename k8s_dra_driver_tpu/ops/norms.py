@@ -1,0 +1,92 @@
+"""RMSNorm: fused Pallas TPU kernel + XLA fallback.
+
+RMSNorm is HBM-bandwidth-bound; the win on TPU is doing the mean-square,
+rsqrt and scale in one VMEM round-trip in f32 regardless of input dtype.
+XLA usually fuses this well on its own — the kernel exists to pin the f32
+accumulation (bf16 inputs must not accumulate in bf16) and as the template
+for further fusions (residual-add + norm).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def rmsnorm_reference(x: jax.Array, weight: jax.Array, eps: float = 1e-6):
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps) * weight.astype(jnp.float32)).astype(dtype)
+
+
+def _rmsnorm_kernel(x_ref, w_ref, o_ref, *, eps: float):
+    x = x_ref[:].astype(jnp.float32)
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    o_ref[:] = (
+        x * jax.lax.rsqrt(var + eps) * w_ref[:].astype(jnp.float32)
+    ).astype(o_ref.dtype)
+
+
+def _rmsnorm_pallas(x, weight, eps, block_rows, interpret):
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    rows = 1
+    for s in orig_shape[:-1]:
+        rows *= s
+    xr = x.reshape(rows, d)
+    block_rows = min(block_rows, rows)
+    if rows % block_rows:
+        return rmsnorm_reference(x, weight, eps)
+    out = pl.pallas_call(
+        functools.partial(_rmsnorm_kernel, eps=eps),
+        grid=(rows // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, d), x.dtype),
+        interpret=interpret,
+    )(xr, weight)
+    return out.reshape(orig_shape)
+
+
+# Differentiable wrapper: pallas forward, reference-recompute backward
+# (pallas_call has no automatic VJP).
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _rmsnorm_diff(x, weight, eps, block_rows, interpret):
+    return _rmsnorm_pallas(x, weight, eps, block_rows, interpret)
+
+
+def _rmsnorm_diff_fwd(x, weight, eps, block_rows, interpret):
+    return _rmsnorm_pallas(x, weight, eps, block_rows, interpret), (x, weight)
+
+
+def _rmsnorm_diff_bwd(eps, block_rows, interpret, res, g):
+    x, weight = res
+    _, vjp = jax.vjp(
+        lambda x_, w_: rmsnorm_reference(x_, w_, eps), x, weight
+    )
+    return vjp(g)
+
+
+_rmsnorm_diff.defvjp(_rmsnorm_diff_fwd, _rmsnorm_diff_bwd)
+
+
+def rmsnorm(
+    x: jax.Array,
+    weight: jax.Array,
+    eps: float = 1e-6,
+    block_rows: int = 256,
+    force_pallas: bool = False,
+    interpret: bool = False,
+) -> jax.Array:
+    """RMSNorm over the last dim. x: [..., D], weight: [D]."""
+    on_tpu = jax.default_backend() == "tpu"
+    if not (on_tpu or force_pallas):
+        return rmsnorm_reference(x, weight, eps)
+    return _rmsnorm_diff(x, weight, eps, block_rows, interpret or not on_tpu)

@@ -1,0 +1,44 @@
+"""Rotary position embeddings (RoPE).
+
+Deliberately plain jnp: RoPE is a cheap elementwise op sandwiched between
+the QKV projection and attention, and XLA fuses it into the surrounding
+matmuls — a custom kernel would only break that fusion.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_frequencies(
+    head_dim: int,
+    max_seq_len: int,
+    theta: float = 500000.0,
+    dtype=jnp.float32,
+) -> tuple[jax.Array, jax.Array]:
+    """cos/sin tables [S, D/2]. theta=5e5 is the Llama-3 base."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rope(
+    x: jax.Array,          # [B, H, S, D]
+    cos: jax.Array,        # [S, D/2] (or sliced to positions)
+    sin: jax.Array,
+    positions: jax.Array | None = None,   # [S] absolute positions
+) -> jax.Array:
+    if positions is not None:
+        cos = cos[positions]
+        sin = sin[positions]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    cos = cos[None, None, :, :]
+    sin = sin[None, None, :, :]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)

@@ -1,0 +1,37 @@
+"""Parallelism: meshes, sharding rules, ring attention, distributed init."""
+
+from .distributed import coordinator_from_env, initialize_distributed
+from .mesh import (
+    AXES,
+    MeshConfig,
+    auto_mesh_config,
+    build_mesh,
+    host_mesh_shape,
+    mesh_from_env,
+)
+from .ring import ring_attention, ulysses_attention
+from .sharding import (
+    DEFAULT_RULES,
+    batch_sharding,
+    named_sharding,
+    shard_pytree,
+    spec_for,
+)
+
+__all__ = [
+    "AXES",
+    "MeshConfig",
+    "auto_mesh_config",
+    "build_mesh",
+    "mesh_from_env",
+    "host_mesh_shape",
+    "ring_attention",
+    "ulysses_attention",
+    "coordinator_from_env",
+    "initialize_distributed",
+    "DEFAULT_RULES",
+    "spec_for",
+    "named_sharding",
+    "shard_pytree",
+    "batch_sharding",
+]

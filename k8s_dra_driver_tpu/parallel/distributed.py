@@ -1,0 +1,67 @@
+"""Multi-host initialization from DRA-injected environment.
+
+The consumer side of the driver's ICI-channel prepare: the cluster
+controller publishes per-slice channel pools (controller/slice_manager.py),
+the node plugin injects slice/worker env (cdi/spec.py), and a pod entrypoint
+calls ``initialize_distributed()`` before building a mesh. Maps onto
+``jax.distributed.initialize``, which wires the cross-host coordination the
+reference's world relies on NCCL/IMEX for — on TPU the data plane is ICI/DCN
+driven by XLA collectives, so all that's needed is coordinator bootstrap.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+def coordinator_from_env() -> Optional[str]:
+    """Coordinator address for jax.distributed.
+
+    Priority: explicit TPU_DRA_COORDINATOR (set by the channel prepare),
+    then the GKE-style TPU_WORKER_HOSTNAMES list (worker 0 coordinates).
+    """
+    addr = os.environ.get("TPU_DRA_COORDINATOR", "")
+    if addr:
+        return addr
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if hostnames:
+        first = hostnames.split(",")[0].strip()
+        port = os.environ.get("TPU_DRA_COORDINATOR_PORT", "8476")
+        return f"{first}:{port}"
+    return None
+
+
+def initialize_distributed(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize jax.distributed from env; no-op for single-host jobs.
+
+    Returns True if distributed mode was initialized.
+    """
+    import jax
+
+    coordinator = coordinator or coordinator_from_env()
+    if num_processes is None:
+        hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+        num_processes = len(hosts.split(",")) if hosts else 1
+    if process_id is None:
+        process_id = int(os.environ.get("TPU_WORKER_ID", "0") or 0)
+    if coordinator is None or num_processes <= 1:
+        logger.info("single-host job; skipping jax.distributed")
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    logger.info(
+        "jax.distributed up: %d processes, this is %d, coordinator %s",
+        num_processes, process_id, coordinator,
+    )
+    return True

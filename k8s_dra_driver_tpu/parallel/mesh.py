@@ -1,0 +1,121 @@
+"""Device-mesh construction from DRA-injected topology.
+
+This is the workload side of the driver contract: the node plugin injects
+``TPU_VISIBLE_CHIPS`` / ``TPU_TOPOLOGY`` / ``TPU_WORKER_ID`` (cdi/spec.py),
+the cluster controller's ICI channel prepare adds coordinator env, and this
+module turns that into a ``jax.sharding.Mesh`` whose axis layout matches the
+physical ICI topology — so XLA's collectives ride ICI neighbours instead of
+arbitrary device orderings.
+
+Axis convention (outer → inner): ``("data", "fsdp", "sequence", "tensor")``.
+- ``tensor``  — innermost, mapped onto directly-connected chips: per-op
+  all-reduces must be the cheapest collective.
+- ``sequence`` — ring/all-to-all sequence parallelism for long context.
+- ``fsdp``    — parameter sharding; all-gathers overlap with compute.
+- ``data``    — pure data parallel, outermost (can span DCN between slices).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("data", "fsdp", "sequence", "tensor")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical parallelism degrees. Product must equal the device count."""
+
+    data: int = 1
+    fsdp: int = 1
+    sequence: int = 1
+    tensor: int = 1
+
+    @property
+    def shape(self) -> tuple[int, int, int, int]:
+        return (self.data, self.fsdp, self.sequence, self.tensor)
+
+    @property
+    def num_devices(self) -> int:
+        return math.prod(self.shape)
+
+    def __str__(self) -> str:
+        return "x".join(
+            f"{a}={d}" for a, d in zip(AXES, self.shape) if d > 1
+        ) or "single"
+
+
+def auto_mesh_config(
+    n_devices: int,
+    *,
+    model_needs_tensor: int = 1,
+    long_context: bool = False,
+) -> MeshConfig:
+    """Reasonable default factorization for ``n_devices``.
+
+    Heuristic from the scaling playbook: give the model its required tensor
+    degree, spend the next factor on sequence if long-context, and the rest
+    on fsdp (which subsumes data parallel at these scales).
+    """
+    if n_devices % model_needs_tensor:
+        raise ValueError(
+            f"{n_devices} devices not divisible by tensor={model_needs_tensor}"
+        )
+    rest = n_devices // model_needs_tensor
+    sequence = 1
+    if long_context and rest % 2 == 0:
+        sequence = min(rest, 4)
+        while rest % sequence:
+            sequence //= 2
+        rest //= sequence
+    return MeshConfig(
+        data=1, fsdp=rest, sequence=sequence, tensor=model_needs_tensor
+    )
+
+
+def build_mesh(
+    config: Optional[MeshConfig] = None,
+    devices: Optional[list] = None,
+) -> Mesh:
+    """Create a Mesh over ``devices`` (default: all).
+
+    Devices are ordered by (slice, host, local index) before reshaping so
+    the innermost mesh axes land on intra-host / ICI-adjacent chips. JAX's
+    own device order already follows physical topology on TPU; we keep it
+    and only reshape.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if config is None:
+        config = auto_mesh_config(len(devices))
+    if config.num_devices != len(devices):
+        raise ValueError(
+            f"mesh {config} needs {config.num_devices} devices, "
+            f"have {len(devices)}"
+        )
+    arr = np.array(devices).reshape(config.shape)
+    return Mesh(arr, AXES)
+
+
+def mesh_from_env(config: Optional[MeshConfig] = None) -> Mesh:
+    """Build the mesh inside a DRA-prepared container.
+
+    Honors the env the driver injected: if ``TPU_VISIBLE_CHIPS`` restricted
+    the chip set, jax.devices() already reflects it; multi-host jobs call
+    ``initialize_distributed`` (distributed.py) first.
+    """
+    return build_mesh(config)
+
+
+def host_mesh_shape() -> tuple[int, ...]:
+    """Physical bounds of this host's chips from driver-injected env."""
+    bounds = os.environ.get("TPU_CHIPS_PER_HOST_BOUNDS", "")
+    if not bounds:
+        return (len(jax.local_devices()),)
+    return tuple(int(x) for x in bounds.split(","))

@@ -1,0 +1,180 @@
+"""Ring attention: sequence parallelism over the "sequence" mesh axis.
+
+Long-context support: each device holds one sequence shard of Q/K/V; K/V
+blocks rotate around the ring via ``ppermute`` (XLA lowers this onto ICI
+neighbour links on TPU) while each device accumulates blockwise attention
+with the online-softmax recurrence — so memory per device is O(S/n) with no
+materialized [S, S] scores, and the N-1 hops hide behind the per-step
+attention compute.
+
+Also provides the Ulysses-style alternative (`all_to_all` heads↔sequence):
+cheaper for many-head models on all-to-all-friendly topologies; ring wins on
+plain ICI tori at long S.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, scale, q_offset, kv_offset, causal):
+    """One blockwise attention step in f32: returns (scores-max m, denom l,
+    unnormalized out) for the online-softmax merge.
+
+    q: [B, H, Sq, D]; k,v: [B, H, Skv, D]. Offsets are the global sequence
+    positions of element 0, used for causal masking across shards.
+    """
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        sq, skv = q.shape[-2], k.shape[-2]
+        qpos = q_offset + jnp.arange(sq)[:, None]
+        kpos = kv_offset + jnp.arange(skv)[None, :]
+        mask = kpos <= qpos
+        s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)            # [B,H,Sq,1]
+    # Fully-masked rows: m = NEG_INF; zero their contribution.
+    p = jnp.exp(s - jax.lax.stop_gradient(m))
+    if causal:
+        p = jnp.where(mask, p, 0.0)
+        m = jnp.where(m <= NEG_INF, NEG_INF, m)
+    l = jnp.sum(p, axis=-1, keepdims=True)            # [B,H,Sq,1]
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return m, l, o
+
+
+def _merge(m1, l1, o1, m2, l2, o2):
+    """Merge two online-softmax partials."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    return m, l1 * a1 + l2 * a2, o1 * a1 + o2 * a2
+
+
+def _ring_attention_local(
+    q, k, v, *, axis_name: str, scale: float, causal: bool
+):
+    """Per-shard body (runs inside shard_map). q,k,v: [B, H, S_local, D]."""
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    s_local = q.shape[-2]
+    q32 = q.astype(jnp.float32)
+    q_offset = idx * s_local
+
+    m = jnp.full(q.shape[:-1] + (1,), NEG_INF, jnp.float32)
+    l = jnp.zeros_like(m)
+    o = jnp.zeros(q.shape, jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(t, carry):
+        m, l, o, k_cur, v_cur = carry
+        # After t hops, we hold the block originally on device (idx - t).
+        kv_idx = (idx - t) % n
+        m2, l2, o2 = _block_attend(
+            q32, k_cur.astype(jnp.float32), v_cur.astype(jnp.float32),
+            scale, q_offset, kv_idx * s_local, causal,
+        )
+        m, l, o = _merge(m, l, o, m2, l2, o2)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return m, l, o, k_nxt, v_nxt
+
+    m, l, o, _, _ = jax.lax.fori_loop(0, n, step, (m, l, o, k, v))
+    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis_name: str = "sequence",
+    causal: bool = True,
+    scale: Optional[float] = None,
+    batch_axes: tuple = ("data", "fsdp"),
+    head_axis: Optional[str] = "tensor",
+) -> jax.Array:
+    """Sequence-parallel attention. q,k,v: [B, H, S, D] sharded with S over
+    ``axis_name`` (and optionally B over batch axes / H over tensor)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else d ** -0.5
+    if k.shape[1] != q.shape[1]:  # GQA: replicate kv heads first
+        reps = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, reps, axis=1)
+        v = jnp.repeat(v, reps, axis=1)
+    spec = P(batch_axes, head_axis, axis_name, None)
+    fn = shard_map(
+        functools.partial(
+            _ring_attention_local,
+            axis_name=axis_name,
+            scale=scale,
+            causal=causal,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis_name: str = "sequence",
+    causal: bool = True,
+    scale: Optional[float] = None,
+    batch_axes: tuple = ("data", "fsdp"),
+    attn_fn=None,
+) -> jax.Array:
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style): exchange
+    sequence shards for head shards, run full-sequence attention locally on
+    H/n heads, exchange back. Requires H % n == 0."""
+    from ..ops.attention import attention_reference
+
+    d = q.shape[-1]
+    scale = scale if scale is not None else d ** -0.5
+    if k.shape[1] != q.shape[1]:
+        reps = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, reps, axis=1)
+        v = jnp.repeat(v, reps, axis=1)
+    attn = attn_fn or (
+        lambda q_, k_, v_: attention_reference(q_, k_, v_, causal, scale)
+    )
+
+    def local(q, k, v):
+        # [B, H, S/n, D] → all-to-all → [B, H/n, S, D]
+        def a2a(x, split_axis, concat_axis):
+            return jax.lax.all_to_all(
+                x, axis_name, split_axis=split_axis,
+                concat_axis=concat_axis, tiled=True,
+            )
+
+        qh = a2a(q, 1, 2)
+        kh = a2a(k, 1, 2)
+        vh = a2a(v, 1, 2)
+        oh = attn(qh, kh, vh)
+        return a2a(oh, 2, 1)
+
+    spec = P(batch_axes, None, axis_name, None)
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

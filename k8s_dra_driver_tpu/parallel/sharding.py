@@ -1,0 +1,62 @@
+"""Sharding rules: logical axis names → mesh partition specs.
+
+The tpu-idiomatic way to scale (scaling-book recipe): annotate arrays with
+*logical* axes, map logical → mesh axes in one table, and let pjit/XLA
+insert the collectives. Changing the parallelism strategy is then a table
+edit, not a model edit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Logical axis → mesh axis (None = replicated). The model layer tags params
+# and activations with the left-hand names.
+DEFAULT_RULES: dict[str, Optional[str | tuple[str, ...]]] = {
+    "batch": ("data", "fsdp"),    # data-parallel batch split
+    "seq": "sequence",            # sequence parallelism (ring attention)
+    "embed": None,                # model dim of activations: replicated
+    "vocab": "tensor",
+    "embed_fsdp": "fsdp",         # param model-dim rows: fsdp-sharded
+    "heads": "tensor",            # attention heads: tensor parallel
+    "kv_heads": "tensor",
+    "mlp": "tensor",              # mlp hidden: tensor parallel
+    "head_dim": None,
+    "layers": None,
+}
+
+
+def spec_for(*logical_axes: Optional[str], rules=None) -> P:
+    """PartitionSpec for an array whose dims carry these logical names."""
+    rules = rules or DEFAULT_RULES
+    parts = []
+    for name in logical_axes:
+        if name is None:
+            parts.append(None)
+        else:
+            parts.append(rules.get(name))
+    # Trailing Nones are implicit.
+    while parts and parts[-1] is None:
+        parts.pop()
+    return P(*parts)
+
+
+def named_sharding(mesh: Mesh, *logical_axes, rules=None) -> NamedSharding:
+    return NamedSharding(mesh, spec_for(*logical_axes, rules=rules))
+
+
+def shard_pytree(tree: Any, mesh: Mesh, spec_tree: Any) -> Any:
+    """Device-put a pytree with per-leaf PartitionSpecs."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        tree,
+        spec_tree,
+    )
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Input batch: split over (data, fsdp) — every chip sees distinct rows."""
+    return named_sharding(mesh, "batch", "seq")

@@ -8,11 +8,24 @@ execute without TPU hardware.  This is the testing seam the reference lacked
 
 import os
 
-# Must be set before jax is imported anywhere in the test session.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax is imported anywhere in the test session. Force —
+# don't setdefault — because the environment may preset JAX_PLATFORMS to a
+# real TPU platform plugin, and tests must run hermetically on the 8-device
+# virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# A site plugin may re-register a hardware platform at jax import time and
+# prepend it to jax_platforms; pin the config itself to be sure. Guarded so
+# the pure-Kubernetes suites still run where jax is absent.
+try:
+    import jax  # noqa: E402
+except ImportError:
+    pass
+else:
+    jax.config.update("jax_platforms", "cpu")

@@ -50,6 +50,26 @@ class TestOffsetAllocator:
         with pytest.raises(RuntimeError, match="capacity"):
             a.add(DomainKey("overflow"))
 
+    def test_exhaustion_does_not_wedge_publishing(self):
+        """An unadmittable 17th domain must not break other domains'
+        publication (half-registered domains used to trip an assert)."""
+        client = FakeKubeClient()
+        mgr = IciSliceManager(client)
+        for i in range(16):
+            client.create(NODES, node(f"n{i}", f"slice-{i:02d}"))
+        mgr.start()
+        assert wait_for(lambda: len(mgr.domains()) == 16)
+        client.create(NODES, node("n-over", "slice-overflow"))
+        # Overflow domain rejected; the others still publish fine.
+        client.create(NODES, node("n17", "slice-00"))
+        assert wait_for(
+            lambda: "n17" in mgr.domains().get(DomainKey("slice-00"), set())
+        )
+        mgr.slice_controller.sync_once()
+        assert len(client.list(RESOURCE_SLICES)) == 16
+        assert DomainKey("slice-overflow") not in mgr.domains()
+        mgr.stop(cleanup=False)
+
 
 class TestDomainLifecycle:
     def test_domain_appears_and_publishes(self):
@@ -154,9 +174,8 @@ class TestOffsetRecovery:
         # slice-b keeps channel range 128..255 even though it is now the
         # only (first-seen) domain.
         assert mgr2.offsets.get(DomainKey("slice-b")) == 128
-        # After recovery settles, slice-a's stale pool is pruned.
-        mgr2._settle_timer.cancel()
-        mgr2._settle_recovery()
+        # Recovery settles synchronously in start(); slice-a's stale pool
+        # is pruned on the next sync.
         mgr2.slice_controller.sync_once()
         slices = client.list(RESOURCE_SLICES)
         assert len(slices) == 1

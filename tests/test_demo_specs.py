@@ -1,0 +1,119 @@
+"""Validate the demo specs and deployment manifests.
+
+The reference's demo YAMLs are behaviorally load-bearing (SURVEY.md §4:
+"behavioral test fixtures are the demo specs") but nothing validates them.
+Here every manifest must parse, reference real device classes, and any
+embedded opaque config must decode through the real config API.
+"""
+
+import glob
+import os
+
+import yaml
+
+from k8s_dra_driver_tpu.api.v1alpha1 import decode_config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+KNOWN_DEVICE_CLASSES = {
+    "tpu.google.com",
+    "tensorcore.tpu.google.com",
+    "ici.tpu.google.com",
+}
+
+
+def all_docs(pattern):
+    for path in sorted(glob.glob(os.path.join(REPO, pattern), recursive=True)):
+        with open(path) as f:
+            for doc in yaml.safe_load_all(f):
+                if doc:
+                    yield path, doc
+
+
+def iter_device_specs(doc):
+    """Yield devices specs from claims/templates."""
+    kind = doc.get("kind")
+    if kind == "ResourceClaim":
+        yield doc["spec"]["devices"]
+    elif kind == "ResourceClaimTemplate":
+        yield doc["spec"]["spec"]["devices"]
+
+
+class TestDemoSpecs:
+    def test_all_specs_parse(self):
+        docs = list(all_docs("demo/specs/**/*.yaml"))
+        assert len(docs) >= 10
+
+    def test_device_classes_known(self):
+        classes_defined = {
+            doc["metadata"]["name"]
+            for _, doc in all_docs("deployments/manifests/deviceclasses.yaml")
+            if doc["kind"] == "DeviceClass"
+        }
+        assert classes_defined == KNOWN_DEVICE_CLASSES
+        for path, doc in all_docs("demo/specs/**/*.yaml"):
+            for devices in iter_device_specs(doc):
+                for req in devices.get("requests", []):
+                    assert req["deviceClassName"] in KNOWN_DEVICE_CLASSES, (
+                        path, req)
+
+    def test_opaque_configs_decode(self):
+        found = 0
+        for path, doc in all_docs("demo/specs/**/*.yaml"):
+            for devices in iter_device_specs(doc):
+                for cfg in devices.get("config", []):
+                    opaque = cfg.get("opaque")
+                    if not opaque:
+                        continue
+                    assert opaque["driver"] == "tpu.google.com", path
+                    decoded = decode_config(opaque["parameters"])
+                    decoded.normalize()
+                    decoded.validate()
+                    found += 1
+        assert found >= 4  # TS, PS variants across the specs
+
+    def test_config_requests_reference_real_requests(self):
+        for path, doc in all_docs("demo/specs/**/*.yaml"):
+            for devices in iter_device_specs(doc):
+                request_names = {
+                    r["name"] for r in devices.get("requests", [])
+                }
+                for cfg in devices.get("config", []):
+                    for r in cfg.get("requests", []):
+                        assert r in request_names, (path, r)
+                for con in devices.get("constraints", []):
+                    for r in con.get("requests", []):
+                        assert r in request_names, (path, r)
+
+    def test_pods_reference_declared_claims(self):
+        for path, doc in all_docs("demo/specs/**/*.yaml"):
+            if doc.get("kind") != "Pod":
+                continue
+            declared = {c["name"] for c in doc["spec"].get("resourceClaims", [])}
+            for container in doc["spec"]["containers"]:
+                for claim in (container.get("resources", {}).get("claims")) or []:
+                    assert claim["name"] in declared, (path, claim)
+
+
+class TestDeploymentManifests:
+    def test_manifests_parse_and_have_rbac(self):
+        kinds = [
+            d["kind"]
+            for _, d in all_docs("deployments/manifests/*.yaml")
+        ]
+        assert "DaemonSet" in kinds
+        assert "Deployment" in kinds
+        assert kinds.count("ClusterRole") == 2
+        assert kinds.count("ClusterRoleBinding") == 2
+
+    def test_plugin_mounts_required_paths(self):
+        for _, doc in all_docs("deployments/manifests/plugin-daemonset.yaml"):
+            if doc["kind"] != "DaemonSet":
+                continue
+            paths = {
+                v["hostPath"]["path"]
+                for v in doc["spec"]["template"]["spec"]["volumes"]
+            }
+            assert "/var/lib/kubelet/plugins_registry" in paths
+            assert "/var/run/cdi" in paths
+            assert "/dev" in paths

@@ -104,6 +104,41 @@ class TestSync:
             "other"
         ]
 
+    def test_generation_bumps_on_change(self):
+        ctl, client = make_controller()
+        ctl.update(DriverResources(pools={
+            "p": Pool(devices=[dev("tpu-0")], node_name="n")
+        }))
+        ctl.sync_once()
+        gen1 = client.list(RESOURCE_SLICES)[0]["spec"]["pool"]["generation"]
+        # Unchanged content: same generation.
+        ctl.sync_once()
+        assert client.list(RESOURCE_SLICES)[0]["spec"]["pool"]["generation"] == gen1
+        # Content change: generation bumps.
+        ctl.update(DriverResources(pools={
+            "p": Pool(devices=[dev("tpu-0"), dev("tpu-1")], node_name="n")
+        }))
+        ctl.sync_once()
+        gen2 = client.list(RESOURCE_SLICES)[0]["spec"]["pool"]["generation"]
+        assert gen2 == gen1 + 1
+
+    def test_generation_bumps_on_shrink_across_slices(self):
+        ctl, client = make_controller()
+        n = MAX_DEVICES_PER_SLICE + 2
+        ctl.update(DriverResources(pools={
+            "p": Pool(devices=[dev(f"d{i}") for i in range(n)], node_name="n")
+        }))
+        ctl.sync_once()
+        assert len(client.list(RESOURCE_SLICES)) == 2
+        ctl.update(DriverResources(pools={
+            "p": Pool(devices=[dev(f"d{i}") for i in range(3)], node_name="n")
+        }))
+        ctl.sync_once()
+        slices = client.list(RESOURCE_SLICES)
+        assert len(slices) == 1
+        assert slices[0]["spec"]["pool"]["generation"] == 2
+        assert slices[0]["spec"]["pool"]["resourceSliceCount"] == 1
+
     def test_publishers_do_not_prune_each_other(self):
         """Multiple publishers share one driver name (every node plugin +
         the cluster controller); each must only manage its own slices."""
