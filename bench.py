@@ -46,10 +46,10 @@ def pick_config():
     # masters absent) + activations under remat; stay under half of HBM
     # with params+grads.
     if hbm >= 90 << 30:
-        return "8b", 4, 2048, spec.peak_bf16_flops
+        return "8b", 8, 2048, spec.peak_bf16_flops
     if hbm >= 30 << 30:
-        return "3b", 4, 2048, spec.peak_bf16_flops
-    return "1b", 4, 2048, spec.peak_bf16_flops
+        return "3b", 8, 2048, spec.peak_bf16_flops
+    return "1b", 8, 2048, spec.peak_bf16_flops
 
 
 def run_bench(preset, batch, seq, peak_flops):
@@ -124,12 +124,25 @@ def run_bench(preset, batch, seq, peak_flops):
 
 
 def main() -> int:
+    import os
+
     from k8s_dra_driver_tpu.ops.attention import set_attention_impl
 
     preset, batch, seq, peak_flops = pick_config()
+    # Experiment overrides (bench sweeps).
+    preset = os.environ.get("TPU_DRA_BENCH_PRESET", preset)
+    batch = int(os.environ.get("TPU_DRA_BENCH_BATCH", batch))
+    seq = int(os.environ.get("TPU_DRA_BENCH_SEQ", seq))
+    def attn_label():
+        # What flash_attention actually dispatched, not what we hoped for.
+        from k8s_dra_driver_tpu.ops import attention as attn_mod
+
+        on_tpu = jax.default_backend() == "tpu"
+        return "pallas" if on_tpu and attn_mod._ATTN_IMPL != "xla" else "xla"
+
     try:
         result = run_bench(preset, batch, seq, peak_flops)
-        result["detail"]["attn"] = "pallas"
+        result["detail"]["attn"] = attn_label()
     except Exception as e:
         # Pallas may be unavailable on this backend/runtime combination;
         # the XLA attention path is the portable fallback.

@@ -254,9 +254,10 @@ class IciSliceManager:
                 offset = self.offsets.add(key)
             except RuntimeError:
                 logger.error(
-                    "cannot admit ICI domain %s: channel capacity exhausted "
-                    "(%d domains of %d channels)",
-                    key.pool_name, CHANNELS_PER_DRIVER // CHANNELS_PER_POOL,
+                    "cannot admit ICI domain %s: all %d channels are "
+                    "assigned (%d domains × %d channels/pool)",
+                    key.pool_name, CHANNELS_PER_DRIVER,
+                    CHANNELS_PER_DRIVER // CHANNELS_PER_POOL,
                     CHANNELS_PER_POOL,
                 )
                 return False

@@ -1,0 +1,264 @@
+"""Reference DRA allocator: scheduler-sim for tests and dev clusters.
+
+The real allocation happens in the Kubernetes scheduler's structured-
+parameters allocator (SURVEY.md §3.5 — the layer deliberately NOT in the
+reference repo). This module re-implements the subset this driver's
+published attributes exercise, so the full claim lifecycle can be simulated
+hermetically: DeviceClass → device-type mapping, request counts, attribute
+selectors, and cross-request ``matchAttribute`` constraints (the gang /
+same-parent mechanism of tpu-test4/6).
+
+Not a CEL engine: selectors are (attribute, op, value) triples covering what
+the demo specs express. The production path still uses the real scheduler.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Callable, Optional
+
+from .client import RESOURCE_SLICES, KubeClient
+
+# DeviceClass name → the `type` attribute the node plugin publishes.
+DEVICE_CLASS_TYPES = {
+    "tpu.google.com": "chip",
+    "tensorcore.tpu.google.com": "tensorcore",
+    "ici.tpu.google.com": "ici",
+}
+
+
+class AllocationError(RuntimeError):
+    pass
+
+
+@dataclasses.dataclass
+class Selector:
+    """Attribute predicate: op ∈ {eq, ne, lt, le, gt, ge, in}."""
+
+    attribute: str
+    op: str
+    value: Any
+
+    def matches(self, attrs: dict) -> bool:
+        raw = attrs.get(self.attribute)
+        if raw is None:
+            return False
+        val = next(iter(raw.values())) if isinstance(raw, dict) else raw
+        if self.op == "eq":
+            return val == self.value
+        if self.op == "ne":
+            return val != self.value
+        if self.op == "lt":
+            return val < self.value
+        if self.op == "le":
+            return val <= self.value
+        if self.op == "gt":
+            return val > self.value
+        if self.op == "ge":
+            return val >= self.value
+        if self.op == "in":
+            return val in self.value
+        raise ValueError(f"unknown op {self.op!r}")
+
+
+def _attr_value(attrs: dict, name: str):
+    raw = attrs.get(name)
+    if raw is None:
+        return None
+    return next(iter(raw.values())) if isinstance(raw, dict) else raw
+
+
+class ReferenceAllocator:
+    """Allocates claims against published ResourceSlices."""
+
+    def __init__(self, client: KubeClient, driver_name: str = "tpu.google.com"):
+        self.client = client
+        self.driver_name = driver_name
+        self._lock = threading.Lock()
+        # (pool, device) -> claim uid holding it
+        self._reservations: dict[tuple[str, str], str] = {}
+
+    # -- inventory ---------------------------------------------------------
+
+    def _devices(self) -> list[dict]:
+        """Flattened (pool, node, device) inventory from current slices,
+        highest pool generation only."""
+        slices = [
+            s
+            for s in self.client.list(RESOURCE_SLICES)
+            if s["spec"].get("driver") == self.driver_name
+        ]
+        max_gen: dict[str, int] = {}
+        for s in slices:
+            pool = s["spec"]["pool"]
+            max_gen[pool["name"]] = max(
+                max_gen.get(pool["name"], 0), pool["generation"]
+            )
+        out = []
+        for s in slices:
+            pool = s["spec"]["pool"]
+            if pool["generation"] != max_gen[pool["name"]]:
+                continue
+            for dev in s["spec"].get("devices", []):
+                out.append(
+                    {
+                        "pool": pool["name"],
+                        "node": s["spec"].get("nodeName", ""),
+                        "node_selector": s["spec"].get("nodeSelector"),
+                        "name": dev["name"],
+                        "attributes": dev.get("basic", {}).get("attributes", {}),
+                    }
+                )
+        return out
+
+    # -- allocation --------------------------------------------------------
+
+    def allocate(
+        self,
+        claim: dict,
+        node_name: Optional[str] = None,
+        selectors: Optional[dict[str, list[Selector]]] = None,
+    ) -> dict:
+        """Fill claim.status.allocation; returns the claim (mutated).
+
+        ``selectors`` maps request name → extra Selector predicates (the
+        CEL-lite substitute). ``node_name`` restricts node-local pools.
+        """
+        spec = claim.get("spec", {}).get("devices", {})
+        requests = spec.get("requests", [])
+        constraints = spec.get("constraints", [])
+        selectors = selectors or {}
+        with self._lock:
+            inventory = [
+                d
+                for d in self._devices()
+                if (d["pool"], d["name"]) not in self._reservations
+                and (not node_name or not d["node"] or d["node"] == node_name)
+            ]
+            results = self._solve(requests, constraints, selectors, inventory)
+            uid = claim["metadata"]["uid"]
+            for r in results:
+                self._reservations[(r["pool"], r["device"])] = uid
+        claim.setdefault("status", {})["allocation"] = {
+            "devices": {
+                "results": results,
+                "config": self._carry_config(spec),
+            }
+        }
+        return claim
+
+    def _carry_config(self, spec: dict) -> list[dict]:
+        """Claim-spec configs become FromClaim allocation configs (the
+        scheduler does this verbatim copy)."""
+        out = []
+        for cfg in spec.get("config", []):
+            entry = dict(cfg)
+            entry["source"] = "FromClaim"
+            out.append(entry)
+        return out
+
+    def _solve(self, requests, constraints, selectors, inventory) -> list[dict]:
+        """Greedy backtracking over requests with matchAttribute checks."""
+        match_groups = [
+            (set(c.get("requests", [])), c["matchAttribute"].split("/")[-1])
+            for c in constraints
+            if "matchAttribute" in c
+        ]
+
+        def candidates(req):
+            dtype = DEVICE_CLASS_TYPES.get(req.get("deviceClassName", ""))
+            if dtype is None:
+                raise AllocationError(
+                    f"unknown device class {req.get('deviceClassName')!r}"
+                )
+            out = []
+            for d in inventory:
+                if _attr_value(d["attributes"], "type") != dtype:
+                    continue
+                if not all(
+                    s.matches(d["attributes"])
+                    for s in selectors.get(req["name"], [])
+                ):
+                    continue
+                out.append(d)
+            return out
+
+        picked: list[tuple[str, dict]] = []  # (request name, device)
+
+        def consistent(req_name, dev) -> bool:
+            for group, attr in match_groups:
+                if req_name not in group:
+                    continue
+                want = _attr_value(dev["attributes"], attr)
+                for other_name, other in picked:
+                    if other_name in group:
+                        if _attr_value(other["attributes"], attr) != want:
+                            return False
+            return True
+
+        def backtrack(ri: int) -> bool:
+            if ri == len(requests):
+                return True
+            req = requests[ri]
+            count = req.get("count", 1)
+            cands = [
+                d for d in candidates(req)
+                if not any(d is p for _, p in picked)
+            ]
+
+            def pick_n(chosen: list) -> bool:
+                if len(chosen) == count:
+                    for d in chosen:
+                        picked.append((req["name"], d))
+                    if backtrack(ri + 1):
+                        return True
+                    for _ in chosen:
+                        picked.pop()
+                    return False
+                start = cands.index(chosen[-1]) + 1 if chosen else 0
+                for d in cands[start:]:
+                    if any(d is p for _, p in picked) or d in chosen:
+                        continue
+                    if not consistent(req["name"], d):
+                        continue
+                    chosen.append(d)
+                    # Intra-request matchAttribute consistency.
+                    if self._group_ok(
+                        req["name"], chosen, match_groups
+                    ) and pick_n(chosen):
+                        return True
+                    chosen.pop()
+                return False
+
+            return pick_n([])
+
+        if not backtrack(0):
+            raise AllocationError("no satisfying allocation found")
+        return [
+            {
+                "request": name,
+                "driver": self.driver_name,
+                "pool": dev["pool"],
+                "device": dev["name"],
+            }
+            for name, dev in picked
+        ]
+
+    @staticmethod
+    def _group_ok(req_name, chosen, match_groups) -> bool:
+        for group, attr in match_groups:
+            if req_name not in group:
+                continue
+            vals = {_attr_value(d["attributes"], attr) for d in chosen}
+            if len(vals) > 1:
+                return False
+        return True
+
+    # -- release -----------------------------------------------------------
+
+    def deallocate(self, claim_uid: str) -> None:
+        with self._lock:
+            self._reservations = {
+                k: v for k, v in self._reservations.items() if v != claim_uid
+            }

@@ -29,3 +29,16 @@ __all__ = [
     "make_train_step",
     "make_eval_step",
 ]
+
+from .checkpoint import latest_step, restore_checkpoint, save_checkpoint
+from .decode import KVCache, decode_step, generate, prefill
+
+__all__ += [
+    "KVCache",
+    "prefill",
+    "decode_step",
+    "generate",
+    "save_checkpoint",
+    "restore_checkpoint",
+    "latest_step",
+]

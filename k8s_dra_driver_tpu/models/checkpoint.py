@@ -1,0 +1,76 @@
+"""Model/train-state checkpointing via orbax.
+
+The workload-side counterpart of the driver's claim checkpoint
+(plugin/checkpoint.py): a DRA-scheduled training pod that gets preempted or
+rescheduled onto a different slice resumes from the latest step. Orbax
+handles sharded arrays natively — each host writes its shards, and restore
+re-shards onto whatever mesh the new allocation provides.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Optional
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+def _manager(directory: str, max_to_keep: int = 3):
+    import orbax.checkpoint as ocp
+
+    return ocp.CheckpointManager(
+        directory,
+        options=ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep, create=True
+        ),
+    )
+
+
+def save_checkpoint(
+    directory: str,
+    state: Any,
+    step: int,
+    max_to_keep: int = 3,
+    wait: bool = True,
+) -> None:
+    """Save a (possibly sharded) TrainState pytree at ``step``."""
+    import orbax.checkpoint as ocp
+
+    mgr = _manager(os.path.abspath(directory), max_to_keep)
+    mgr.save(step, args=ocp.args.StandardSave(state))
+    if wait:
+        mgr.wait_until_finished()
+    mgr.close()
+
+
+def latest_step(directory: str) -> Optional[int]:
+    import orbax.checkpoint as ocp
+
+    directory = os.path.abspath(directory)
+    if not os.path.isdir(directory):
+        return None
+    mgr = _manager(directory)
+    step = mgr.latest_step()
+    mgr.close()
+    return step
+
+
+def restore_checkpoint(
+    directory: str,
+    template: Any,
+    step: Optional[int] = None,
+) -> Any:
+    """Restore into the shardings/structure of ``template`` (an abstract or
+    concrete TrainState — restoring onto a different mesh re-shards)."""
+    import orbax.checkpoint as ocp
+
+    mgr = _manager(os.path.abspath(directory))
+    step = step if step is not None else mgr.latest_step()
+    if step is None:
+        raise FileNotFoundError(f"no checkpoint found under {directory}")
+    out = mgr.restore(step, args=ocp.args.StandardRestore(template))
+    mgr.close()
+    return out

@@ -1,0 +1,186 @@
+"""Inference: KV-cache prefill + autoregressive decode.
+
+tpu-first decode design: static cache shapes (no dynamic growth — XLA traces
+once), `lax.scan` over layers with stacked per-layer caches, masked
+attention against the preallocated cache, and greedy generation under
+`lax.while_loop` so the whole generate loop compiles to one program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.norms import rmsnorm
+from ..ops.rotary import rope_frequencies
+from .llama import LlamaConfig, _mlp_block, attn_out, project_qkv
+
+NEG_INF = -1e30
+
+
+@dataclasses.dataclass
+class KVCache:
+    """Per-layer stacked cache: k,v [L, B, H_kv, S_max, D]."""
+
+    k: jax.Array
+    v: jax.Array
+    length: jax.Array  # [] int32: filled positions
+
+    @classmethod
+    def init(cls, config: LlamaConfig, batch: int, max_len: int) -> "KVCache":
+        shape = (
+            config.n_layers, batch, config.n_kv_heads, max_len, config.head_dim,
+        )
+        return cls(
+            k=jnp.zeros(shape, config.dtype),
+            v=jnp.zeros(shape, config.dtype),
+            length=jnp.zeros((), jnp.int32),
+        )
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[3]
+
+
+jax.tree_util.register_dataclass(
+    KVCache, data_fields=["k", "v", "length"], meta_fields=[]
+)
+
+
+def _cached_attention(q, k_cache, v_cache, valid_len, scale):
+    """q: [B, H, T, D]; caches: [B, H_kv, S_max, D]; positions >= valid_len
+    masked. T is the new-token count (prompt at prefill, 1 at decode)."""
+    hq, hkv = q.shape[1], k_cache.shape[1]
+    if hq != hkv:
+        reps = hq // hkv
+        k_cache = jnp.repeat(k_cache, reps, axis=1)
+        v_cache = jnp.repeat(v_cache, reps, axis=1)
+    s = jnp.einsum(
+        "bhtd,bhsd->bhts", q, k_cache, preferred_element_type=jnp.float32
+    ) * scale
+    t = q.shape[2]
+    s_max = k_cache.shape[2]
+    # Causal within the new tokens + cache-length bound. New token i sits at
+    # absolute position valid_len - t + i.
+    qpos = valid_len - t + jnp.arange(t)[:, None]
+    kpos = jnp.arange(s_max)[None, :]
+    mask = kpos <= qpos
+    s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        "bhts,bhsd->bhtd", p.astype(v_cache.dtype), v_cache
+    )
+
+
+def _forward_with_cache(
+    params: dict,
+    tokens: jax.Array,            # [B, T] new tokens
+    cache: KVCache,
+    config: LlamaConfig,
+    positions: jax.Array,         # [T] absolute positions of the new tokens
+) -> tuple[jax.Array, KVCache]:
+    """Run the stack over new tokens, reading+writing the cache.
+    Returns (logits [B, T, V], updated cache)."""
+    c = config
+    b, t = tokens.shape
+    scale = c.head_dim ** -0.5
+    x = params["embed"][tokens]
+    cos, sin = rope_frequencies(
+        c.head_dim, cache.max_len, c.rope_theta, dtype=jnp.float32
+    )
+    start = cache.length
+    new_len = start + t
+
+    def block(x, layer_and_cache):
+        layer, k_cache, v_cache = layer_and_cache
+        xn = rmsnorm(x, layer["ln_attn"], c.norm_eps)
+        q, k, v = project_qkv(xn, layer, c, cos, sin, positions=positions)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, 0, start, 0)
+        )
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, 0, start, 0)
+        )
+        o = _cached_attention(q, k_cache, v_cache, new_len, scale)
+        x = attn_out(x, o, layer)
+        x = _mlp_block(x, layer, c)
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        block, x, (params["layers"], cache.k, cache.v)
+    )
+    x = rmsnorm(x, params["final_norm"], c.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, KVCache(k=new_k, v=new_v, length=new_len)
+
+
+def prefill(
+    params: dict,
+    tokens: jax.Array,            # [B, S] prompt
+    config: LlamaConfig,
+    max_len: int,
+) -> tuple[jax.Array, KVCache]:
+    """Process the prompt; returns (last-position logits [B, V], cache)."""
+    b, s = tokens.shape
+    cache = KVCache.init(config, b, max_len)
+    positions = jnp.arange(s)
+    logits, cache = _forward_with_cache(
+        params, tokens, cache, config, positions
+    )
+    return logits[:, -1], cache
+
+
+def decode_step(
+    params: dict,
+    token: jax.Array,             # [B] latest token
+    cache: KVCache,
+    config: LlamaConfig,
+) -> tuple[jax.Array, KVCache]:
+    """One autoregressive step; returns (next-token logits [B, V], cache)."""
+    positions = cache.length[None]
+    logits, cache = _forward_with_cache(
+        params, token[:, None], cache, config, positions
+    )
+    return logits[:, 0], cache
+
+
+def generate(
+    params: dict,
+    prompt: jax.Array,            # [B, S]
+    config: LlamaConfig,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Greedy (or sampled) generation, fully jitted: returns [B, S + N]."""
+    b, s = prompt.shape
+    max_len = s + max_new_tokens
+    logits, cache = prefill(params, prompt, config, max_len)
+    out = jnp.zeros((b, max_new_tokens), jnp.int32)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    def sample(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(key, logits / temperature).astype(
+            jnp.int32
+        )
+
+    def body(carry):
+        i, logits, cache, out, key = carry
+        key, sub = jax.random.split(key)
+        tok = sample(logits, sub)
+        out = out.at[:, i].set(tok)
+        logits, cache = decode_step(params, tok, cache, config)
+        return i + 1, logits, cache, out, key
+
+    def cond(carry):
+        return carry[0] < max_new_tokens
+
+    _, _, _, out, _ = jax.lax.while_loop(
+        cond, body, (jnp.zeros((), jnp.int32), logits, cache, out, rng)
+    )
+    return jnp.concatenate([prompt, out], axis=1)

@@ -158,24 +158,41 @@ def param_specs(config: LlamaConfig) -> dict:
 # ---------------------------------------------------------------------------
 
 
-def _attention_block(x, layer, config: LlamaConfig, cos, sin, mesh, use_ring):
+def project_qkv(
+    xn: jax.Array,                # [B, T, H] (normed input)
+    layer: dict,
+    config: LlamaConfig,
+    cos, sin,
+    positions=None,
+):
+    """QKV projection + head split + rope. Shared by the training forward
+    and the KV-cache decode path (models/decode.py) so dtype/rope policy
+    cannot drift between them. Returns q [B,Hq,T,D], k,v [B,Hkv,T,D]."""
     c = config
-    b, s, _ = x.shape
-    xn = rmsnorm(x, layer["ln_attn"], c.norm_eps)
-    q = (xn @ layer["wq"]).reshape(b, s, c.n_heads, c.head_dim)
-    k = (xn @ layer["wk"]).reshape(b, s, c.n_kv_heads, c.head_dim)
-    v = (xn @ layer["wv"]).reshape(b, s, c.n_kv_heads, c.head_dim)
-    q = q.transpose(0, 2, 1, 3)  # [B, H, S, D]
-    k = k.transpose(0, 2, 1, 3)
-    v = v.transpose(0, 2, 1, 3)
-    q = apply_rope(q, cos, sin)
-    k = apply_rope(k, cos, sin)
+    b, t, _ = xn.shape
+    q = (xn @ layer["wq"]).reshape(b, t, c.n_heads, c.head_dim)
+    k = (xn @ layer["wk"]).reshape(b, t, c.n_kv_heads, c.head_dim)
+    v = (xn @ layer["wv"]).reshape(b, t, c.n_kv_heads, c.head_dim)
+    q = apply_rope(q.transpose(0, 2, 1, 3), cos, sin, positions=positions)
+    k = apply_rope(k.transpose(0, 2, 1, 3), cos, sin, positions=positions)
+    return q, k, v.transpose(0, 2, 1, 3)
+
+
+def attn_out(x: jax.Array, o: jax.Array, layer: dict) -> jax.Array:
+    """Output projection + residual. o: [B, H, T, D] attention result."""
+    b, _, t, _ = o.shape
+    flat = o.transpose(0, 2, 1, 3).reshape(b, t, -1)
+    return x + (flat.astype(x.dtype) @ layer["wo"]).astype(x.dtype)
+
+
+def _attention_block(x, layer, config: LlamaConfig, cos, sin, mesh, use_ring):
+    xn = rmsnorm(x, layer["ln_attn"], config.norm_eps)
+    q, k, v = project_qkv(xn, layer, config, cos, sin)
     if use_ring and mesh is not None:
         o = ring_attention(q, k, v, mesh, causal=True)
     else:
         o = flash_attention(q, k, v, causal=True)
-    o = o.transpose(0, 2, 1, 3).reshape(b, s, c.n_heads * c.head_dim)
-    return x + (o @ layer["wo"]).astype(x.dtype)
+    return attn_out(x, o, layer)
 
 
 def _mlp_block(x, layer, config: LlamaConfig):
@@ -192,8 +209,11 @@ def forward(
     mesh: Optional[Mesh] = None,
     use_ring: bool = False,
     remat: bool = False,
+    return_hidden: bool = False,
 ) -> jax.Array:
-    """Causal LM forward → logits [B, S, V] (f32)."""
+    """Causal LM forward → logits [B, S, V] (f32), or the final hidden
+    states [B, S, H] when ``return_hidden`` (the loss path projects to vocab
+    chunkwise instead)."""
     c = config
     s = tokens.shape[1]
     x = params["embed"][tokens]          # [B, S, H]
@@ -208,7 +228,43 @@ def forward(
         block = jax.checkpoint(block, prevent_cse=False)
     x, _ = jax.lax.scan(block, x, params["layers"])
     x = rmsnorm(x, params["final_norm"], c.norm_eps)
+    if return_hidden:
+        return x
     return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def chunked_cross_entropy(
+    hidden: jax.Array,                   # [B, S, H]
+    lm_head: jax.Array,                  # [H, V]
+    targets: jax.Array,                  # [B, S]
+    chunk: int = 256,
+) -> jax.Array:
+    """Next-token CE without materializing [B, S, V] logits.
+
+    The f32 logits of a 128k vocab dominate HBM at batch (b8 s2048 ≈ 8.4 GB)
+    — far more than the model. Projecting sequence chunks inside a
+    checkpointed scan keeps one [B, chunk, V] slab live in fwd AND bwd
+    (recomputed), trading a second lm_head matmul for gigabytes.
+    """
+    b, s, h = hidden.shape
+    if s % chunk:
+        # Largest divisor of s not exceeding the requested chunk, so the
+        # no-[B,S,V]-materialization guarantee holds for any seq length.
+        chunk = next(c for c in range(min(chunk, s), 0, -1) if s % c == 0)
+    n = s // chunk
+    xc = hidden.reshape(b, n, chunk, h).swapaxes(0, 1)   # [n, B, chunk, H]
+    tc = targets.reshape(b, n, chunk).swapaxes(0, 1)     # [n, B, chunk]
+
+    @jax.checkpoint
+    def one_chunk(carry, xt):
+        x, t = xt
+        logits = (x @ lm_head).astype(jnp.float32)       # [B, chunk, V]
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, t[..., None], axis=-1)[..., 0]
+        return carry + jnp.sum(logz - gold), None
+
+    total, _ = jax.lax.scan(one_chunk, jnp.zeros((), jnp.float32), (xc, tc))
+    return total / (b * s)
 
 
 def loss_fn(
@@ -222,7 +278,7 @@ def loss_fn(
     """Next-token cross-entropy (mean over tokens)."""
     inputs = tokens[:, :-1]
     targets = tokens[:, 1:]
-    logits = forward(params, inputs, config, mesh, use_ring, remat)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(logz - gold)
+    hidden = forward(
+        params, inputs, config, mesh, use_ring, remat, return_hidden=True
+    )
+    return chunked_cross_entropy(hidden, params["lm_head"], targets)

@@ -52,7 +52,7 @@ def attention_reference(
 
 def _flash_kernel(
     q_ref, k_ref, v_ref,  # inputs
-    o_ref,                # output
+    o_ref, lse_ref,       # outputs (lse: per-row logsumexp for the backward)
     m_ref, l_ref, acc_ref,  # VMEM scratch (persist across kv grid steps)
     *, scale: float, causal: bool, block_q: int, block_k: int,
 ):
@@ -105,6 +105,8 @@ def _flash_kernel(
     def _finish():
         l = jnp.maximum(l_ref[:], 1e-30)
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        # logsumexp of each score row: softmax = exp(s*scale - lse).
+        lse_ref[0] = m_ref[:] + jnp.log(l)
 
 
 def _flash_attention_pallas(
@@ -116,7 +118,8 @@ def _flash_attention_pallas(
     block_q: int = 512,
     block_k: int = 512,
     interpret: bool = False,
-) -> jax.Array:
+    return_lse: bool = False,
+):
     b, h, s, d = q.shape
     block_q = min(block_q, s)
     block_k = min(block_k, s)
@@ -135,7 +138,7 @@ def _flash_attention_pallas(
         block_q=block_q,
         block_k=block_k,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -143,8 +146,16 @@ def _flash_attention_pallas(
             pl.BlockSpec((1, block_k, d), lambda bh_, iq, ik: (bh_, ik, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh_, iq, ik: (bh_, ik, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh_, iq, ik: (bh_, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh_, iq, ik: (bh_, iq, 0)),
+            # Trailing unit dim keeps the block Mosaic-tileable (last dim
+            # equal to the array dim satisfies the (8, 128) rule).
+            pl.BlockSpec((1, block_q, 1), lambda bh_, iq, ik: (bh_, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),   # running max m
             pltpu.VMEM((block_q, 1), jnp.float32),   # running denom l
@@ -152,30 +163,230 @@ def _flash_attention_pallas(
         ],
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(b, h, s, d)
+    out = out.reshape(b, h, s, d)
+    if return_lse:
+        return out, lse.reshape(b, h, s)  # trailing unit dim dropped
+    return out
 
 
-# Differentiable wrapper: pallas forward, XLA-recompute backward. The pallas
-# kernel has no automatic VJP; the backward pass re-derives grads through the
-# reference implementation (flash-style recomputation — no residuals besides
-# q,k,v are saved, so memory matches remat'd training).
+# ---------------------------------------------------------------------------
+# Backward kernels (FlashAttention-2 style): recompute p from the saved lse
+# blockwise — no [S, S] materialization in memory, matching the forward.
+# ---------------------------------------------------------------------------
+
+
+def _flash_bwd_dkdv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,   # inputs
+    dk_ref, dv_ref,                                    # outputs
+    dk_acc, dv_acc,                                    # VMEM scratch
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+):
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = True
+    if causal:
+        # q block must end at or after the kv block start.
+        run = (iq + 1) * block_q - 1 >= ik * block_k
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)          # [BQ, D]
+        k = k_ref[0].astype(jnp.float32)          # [BK, D]
+        v = v_ref[0].astype(jnp.float32)          # [BK, D]
+        do = do_ref[0].astype(jnp.float32)        # [BQ, D]
+        lse = lse_ref[0]                          # [BQ, 1]
+        delta = delta_ref[0]                      # [BQ, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                  # [BQ, BK]
+        p = jnp.exp(s - lse)
+        if causal:
+            qpos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            p = jnp.where(kpos <= qpos, p, 0.0)
+        # dV += P^T dO
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # dS = P ⊙ (dO V^T - delta); dK += dS^T Q * scale
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(iq == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,   # inputs
+    dq_ref,                                            # output
+    dq_acc,                                            # VMEM scratch
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = True
+    if causal:
+        run = ik * block_k <= iq * block_q + (block_q - 1)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        p = jnp.exp(s - lse)
+        if causal:
+            qpos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            p = jnp.where(kpos <= qpos, p, 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_attention_bwd_pallas(
+    q, k, v, out, lse, do, causal, scale,
+    block_q: int = 512, block_k: int = 512, interpret: bool = False,
+):
+    b, h, s, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    bh = b * h
+    qr, kr, vr = (x.reshape(bh, s, d) for x in (q, k, v))
+    outr = out.reshape(bh, s, d)
+    dor = do.reshape(bh, s, d)
+    lser = lse.reshape(bh, s, 1)
+    # delta_i = rowsum(dO_i ⊙ O_i) — cheap, fused by XLA.
+    delta = jnp.sum(
+        dor.astype(jnp.float32) * outr.astype(jnp.float32),
+        axis=-1, keepdims=True,
+    )
+
+    dkdv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkdv_kernel,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        ),
+        grid=(bh, s // block_k, s // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh_, ik, iq: (bh_, iq, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, ik, iq: (bh_, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, ik, iq: (bh_, ik, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh_, ik, iq: (bh_, iq, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh_, ik, iq: (bh_, iq, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh_, ik, iq: (bh_, iq, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh_, ik, iq: (bh_, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, ik, iq: (bh_, ik, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )
+    dk, dv = dkdv(qr, kr, vr, dor, lser, delta)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        ),
+        grid=(bh, s // block_q, s // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh_, iq, ik: (bh_, iq, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, iq, ik: (bh_, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, iq, ik: (bh_, ik, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh_, iq, ik: (bh_, iq, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh_, iq, ik: (bh_, iq, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh_, iq, ik: (bh_, iq, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, d), lambda bh_, iq, ik: (bh_, iq, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, delta)
+
+    reshape = lambda x: x.reshape(b, h, s, d)
+    return reshape(dq), reshape(dk), reshape(dv)
+
+
+# Differentiable wrapper: pallas forward AND backward (pallas_call has no
+# automatic VJP). The forward saves only q, k, v, out and the per-row
+# logsumexp; the backward recomputes score blocks from lse — flash-style, no
+# [S, S] materialization in either direction.
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash_diff(q, k, v, causal, scale, interpret=False):
     return _flash_attention_pallas(q, k, v, causal, scale, interpret=interpret)
 
 
 def _flash_diff_fwd(q, k, v, causal, scale, interpret=False):
-    out = _flash_attention_pallas(q, k, v, causal, scale, interpret=interpret)
-    return out, (q, k, v)
+    out, lse = _flash_attention_pallas(
+        q, k, v, causal, scale, interpret=interpret, return_lse=True
+    )
+    return out, (q, k, v, out, lse)
 
 
 def _flash_diff_bwd(causal, scale, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: attention_reference(q_, k_, v_, causal, scale),
-        q, k, v,
+    q, k, v, out, lse = res
+    return _flash_attention_bwd_pallas(
+        q, k, v, out, lse, g, causal, scale, interpret=interpret
     )
-    return vjp(g)
 
 
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)

@@ -1,0 +1,133 @@
+"""Orphan cleanup: reclaim state left behind by crashed prepares.
+
+The reference acknowledges this gap as TODOs (lengrongfu/k8s-dra-driver,
+cmd/nvidia-dra-plugin/driver.go:154-166: "TODO: implement loop to remove CDI
+files for claims that no longer exist", and the MPS equivalent). Here it is
+implemented: a periodic pass removes
+
+- transient CDI claim spec files whose claim is not in the checkpoint,
+- process-share session dirs with no owning claim,
+- sharing-state entries for claims the checkpoint no longer knows
+
+and, when a kube client is available, unprepares checkpointed claims whose
+ResourceClaim was deleted from the API server without kubelet calling
+NodeUnprepareResources (node reboot races, force-deleted pods).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Optional
+
+from ..kube.client import RESOURCE_CLAIMS, KubeClient
+from ..kube.errors import NotFoundError
+from .device_state import DeviceState
+
+logger = logging.getLogger(__name__)
+
+
+class OrphanCleaner:
+    def __init__(
+        self,
+        state: DeviceState,
+        kube_client: Optional[KubeClient] = None,
+        interval_seconds: float = 600.0,
+    ):
+        self.state = state
+        self.kube_client = kube_client
+        self.interval = interval_seconds
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.passes = 0
+        self.removed_cdi = 0
+        self.removed_share_dirs = 0
+        self.unprepared_deleted = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="orphan-cleaner"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(timeout=self.interval):
+            try:
+                self.clean_once()
+            except Exception:
+                logger.exception("orphan cleanup pass failed")
+
+    # -- one pass ----------------------------------------------------------
+
+    def clean_once(self) -> None:
+        self.passes += 1
+        # File cleanup runs under the DeviceState lock so a prepare that
+        # lands between "read checkpoint" and "list files" cannot have its
+        # fresh CDI spec / share dir misclassified as orphaned.
+        with self.state._lock:
+            prepared = self.state.checkpoint.read()
+            self._clean_cdi_files(prepared)
+            self._clean_share_dirs(prepared)
+        if self.kube_client is not None:
+            # Outside the lock: unprepare() takes it itself, and re-checks
+            # the checkpoint, so a stale snapshot here is harmless.
+            self._unprepare_deleted_claims(prepared)
+
+    def _clean_cdi_files(self, prepared: dict) -> None:
+        for uid in self.state.cdi.list_claim_spec_uids():
+            if uid not in prepared:
+                logger.info("removing orphaned CDI spec for claim %s", uid)
+                self.state.cdi.delete_claim_spec_file(uid)
+                self.removed_cdi += 1
+
+    def _clean_share_dirs(self, prepared: dict) -> None:
+        run_dir = self.state.ps_manager.run_dir
+        try:
+            entries = os.listdir(run_dir)
+        except FileNotFoundError:
+            return
+        for entry in entries:
+            # Session dirs are "<claim_uid>-<5 hex digest>" (sharing.py).
+            claim_uid = entry.rsplit("-", 1)[0]
+            if claim_uid not in prepared:
+                logger.info("removing orphaned share dir %s", entry)
+                import shutil
+
+                shutil.rmtree(os.path.join(run_dir, entry), ignore_errors=True)
+                self.removed_share_dirs += 1
+
+    def _unprepare_deleted_claims(self, prepared: dict) -> None:
+        from .prepared import PreparedClaim
+
+        for uid, rec in list(prepared.items()):
+            pc = PreparedClaim.from_dict(rec)
+            if not pc.namespace or not pc.name:
+                continue
+            try:
+                obj = self.kube_client.get(
+                    RESOURCE_CLAIMS, pc.name, namespace=pc.namespace
+                )
+                if obj["metadata"].get("uid", "") == uid:
+                    continue  # still live
+            except NotFoundError:
+                pass
+            except Exception:
+                logger.exception(
+                    "could not verify claim %s/%s; skipping", pc.namespace, pc.name
+                )
+                continue
+            logger.info(
+                "unpreparing claim %s (%s/%s): deleted from API server",
+                uid, pc.namespace, pc.name,
+            )
+            self.state.unprepare(uid)
+            self.unprepared_deleted += 1

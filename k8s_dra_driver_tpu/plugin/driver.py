@@ -45,6 +45,7 @@ class DriverConfig:
     state_root: str = "/var/lib/tpu-dra"
     device_classes: frozenset = frozenset({"chip", "tensorcore", "ici"})
     node_uid: str = ""
+    cleanup_interval_seconds: float = 600.0  # 0 disables the orphan cleaner
 
     @property
     def plugin_socket(self) -> str:
@@ -100,8 +101,20 @@ class Driver(NodeServicer):
         self.plugin.start()
         if self.config.kube_client is not None:
             self.publish_resources()
+        # Orphan cleanup (the reference's acknowledged TODO, driver.go:154-166).
+        from .cleanup import OrphanCleaner
+
+        self.cleaner = OrphanCleaner(
+            self.state,
+            self.config.kube_client,
+            interval_seconds=self.config.cleanup_interval_seconds,
+        )
+        if self.config.cleanup_interval_seconds > 0:
+            self.cleaner.start()
 
     def shutdown(self) -> None:
+        if getattr(self, "cleaner", None) is not None:
+            self.cleaner.stop()
         self.plugin.stop()
         self.state.chiplib.shutdown()
 

@@ -1,0 +1,80 @@
+"""Orphan cleanup tests (the reference's TODO, implemented + covered)."""
+
+import os
+
+from k8s_dra_driver_tpu.kube import RESOURCE_CLAIMS, FakeKubeClient
+from k8s_dra_driver_tpu.plugin.cleanup import OrphanCleaner
+from tests.test_device_state import make_claim, make_state, opaque
+
+PS = {
+    "apiVersion": "tpu.google.com/v1alpha1",
+    "kind": "TpuChipConfig",
+    "sharing": {"strategy": "ProcessShared"},
+}
+
+
+class TestOrphanCleanup:
+    def test_orphan_cdi_file_removed(self, tmp_path):
+        state, _ = make_state(tmp_path)
+        state.prepare(make_claim("uid-live", ["tpu-0"]))
+        # Simulate a crashed prepare: CDI file exists, checkpoint doesn't
+        # know the claim.
+        state.cdi.create_claim_spec_file("uid-ghost", {}, {})
+        assert set(state.cdi.list_claim_spec_uids()) == {"uid-ghost", "uid-live"}
+        cleaner = OrphanCleaner(state)
+        cleaner.clean_once()
+        assert state.cdi.list_claim_spec_uids() == ["uid-live"]
+        assert cleaner.removed_cdi == 1
+
+    def test_orphan_share_dir_removed(self, tmp_path):
+        state, _ = make_state(tmp_path)
+        state.prepare(make_claim("uid-live", ["tpu-0"], configs=[opaque(PS)]))
+        ghost = os.path.join(state.ps_manager.run_dir, "uid-ghost-abcde")
+        os.makedirs(ghost)
+        OrphanCleaner(state).clean_once()
+        assert not os.path.exists(ghost)
+        # Live session dir untouched.
+        live_dirs = os.listdir(state.ps_manager.run_dir)
+        assert any(d.startswith("uid-live") for d in live_dirs)
+
+    def test_deleted_claim_gets_unprepared(self, tmp_path):
+        state, lib = make_state(tmp_path)
+        client = FakeKubeClient()
+        claim = make_claim("uid-1", ["tpu-0"], name="c1", namespace="ns")
+        client.create(RESOURCE_CLAIMS, claim, namespace="ns")
+        state.prepare(claim)
+        cleaner = OrphanCleaner(state, kube_client=client)
+        # Claim still exists: nothing happens.
+        cleaner.clean_once()
+        assert "uid-1" in state.checkpoint.read()
+        # Claim deleted from API server: cleanup unprepares it.
+        client.delete(RESOURCE_CLAIMS, "c1", namespace="ns")
+        cleaner.clean_once()
+        assert state.checkpoint.read() == {}
+        assert cleaner.unprepared_deleted == 1
+
+    def test_recreated_claim_with_new_uid_unprepares_old(self, tmp_path):
+        state, _ = make_state(tmp_path)
+        client = FakeKubeClient()
+        old = make_claim("uid-old", ["tpu-0"], name="c1", namespace="ns")
+        client.create(RESOURCE_CLAIMS, old, namespace="ns")
+        state.prepare(old)
+        client.delete(RESOURCE_CLAIMS, "c1", namespace="ns")
+        client.create(
+            RESOURCE_CLAIMS,
+            make_claim("uid-new", ["tpu-1"], name="c1", namespace="ns"),
+            namespace="ns",
+        )
+        cleaner = OrphanCleaner(state, kube_client=client)
+        cleaner.clean_once()
+        assert "uid-old" not in state.checkpoint.read()
+
+    def test_start_stop(self, tmp_path):
+        state, _ = make_state(tmp_path)
+        cleaner = OrphanCleaner(state, interval_seconds=0.05)
+        cleaner.start()
+        import time
+
+        time.sleep(0.2)
+        cleaner.stop()
+        assert cleaner.passes >= 1

@@ -1,0 +1,267 @@
+"""Cluster simulation: the whole driver stack against one fake API server.
+
+Two "nodes" of a 2-host v5p slice run full Driver instances (gRPC over real
+unix sockets), the cluster controller publishes the slice's ICI channel
+pool, and a reference allocator plays the scheduler. This is the e2e story
+the reference could only perform manually on hardware (SURVEY.md §4).
+"""
+
+import json
+import os
+import time
+
+import grpc
+import pytest
+
+from k8s_dra_driver_tpu.controller.slice_manager import (
+    SLICE_LABEL,
+    IciSliceManager,
+)
+from k8s_dra_driver_tpu.kube import NODES, RESOURCE_CLAIMS, RESOURCE_SLICES, FakeKubeClient
+from k8s_dra_driver_tpu.kube.allocator import (
+    AllocationError,
+    ReferenceAllocator,
+    Selector,
+)
+from k8s_dra_driver_tpu.kube.protos import dra_v1alpha4_pb2 as drapb
+from k8s_dra_driver_tpu.plugin.driver import Driver, DriverConfig
+from k8s_dra_driver_tpu.plugin.grpc_services import NodeStub
+from k8s_dra_driver_tpu.tpulib import FakeChipLib
+
+DRIVER = "tpu.google.com"
+
+
+def wait_for(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    """API server + controller + two node plugins on one v5p 4x2 slice."""
+    client = FakeKubeClient()
+    drivers = {}
+    for h, name in enumerate(["node-a", "node-b"]):
+        client.create(
+            NODES,
+            {
+                "metadata": {
+                    "name": name,
+                    "uid": f"uid-{name}",
+                    "labels": {SLICE_LABEL: "slice-1"},
+                }
+            },
+        )
+        cfg = DriverConfig(
+            node_name=name,
+            chiplib=FakeChipLib(
+                generation="v5p",
+                topology="4x2x1",
+                host_id=h,
+                hosts_per_slice=2,
+                slice_id="slice-1",
+            ),
+            kube_client=client,
+            cdi_root=str(tmp_path / name / "cdi"),
+            plugin_root=str(tmp_path / name / "plugin"),
+            registrar_root=str(tmp_path / name / "reg"),
+            state_root=str(tmp_path / name / "state"),
+            node_uid=f"uid-{name}",
+            cleanup_interval_seconds=0,
+        )
+        d = Driver(cfg)
+        d.start()
+        drivers[name] = d
+    mgr = IciSliceManager(client)
+    mgr.start()
+    assert wait_for(
+        lambda: len(client.list(RESOURCE_SLICES)) >= 3
+    ), "expected 2 node pools + 1 ici pool"
+    yield client, drivers, mgr
+    mgr.stop(cleanup=False)
+    for d in drivers.values():
+        d.shutdown()
+
+
+def grpc_prepare(driver, claim):
+    with grpc.insecure_channel(f"unix://{driver.config.plugin_socket}") as ch:
+        stub = NodeStub(ch)
+        resp = stub.NodePrepareResources(
+            drapb.NodePrepareResourcesRequest(
+                claims=[
+                    drapb.Claim(
+                        uid=claim["metadata"]["uid"],
+                        name=claim["metadata"]["name"],
+                        namespace=claim["metadata"]["namespace"],
+                    )
+                ]
+            )
+        )
+    return resp.claims[claim["metadata"]["uid"]]
+
+
+def grpc_unprepare(driver, claim):
+    with grpc.insecure_channel(f"unix://{driver.config.plugin_socket}") as ch:
+        stub = NodeStub(ch)
+        return stub.NodeUnprepareResources(
+            drapb.NodeUnprepareResourcesRequest(
+                claims=[
+                    drapb.Claim(
+                        uid=claim["metadata"]["uid"],
+                        name=claim["metadata"]["name"],
+                        namespace=claim["metadata"]["namespace"],
+                    )
+                ]
+            )
+        ).claims[claim["metadata"]["uid"]]
+
+
+def make_claim_obj(uid, name, requests, constraints=None, config=None):
+    return {
+        "metadata": {"name": name, "namespace": "sim", "uid": uid},
+        "spec": {
+            "devices": {
+                "requests": requests,
+                **({"constraints": constraints} if constraints else {}),
+                **({"config": config} if config else {}),
+            }
+        },
+    }
+
+
+class TestClusterSim:
+    def test_slice_inventory(self, cluster):
+        client, drivers, mgr = cluster
+        slices = client.list(RESOURCE_SLICES)
+        by_node = {
+            s["spec"].get("nodeName"): s for s in slices if "nodeName" in s["spec"]
+        }
+        assert set(by_node) == {"node-a", "node-b"}
+        # 4 chips + 8 cores per host.
+        assert len(by_node["node-a"]["spec"]["devices"]) == 12
+        ici = [s for s in slices if "nodeSelector" in s["spec"]]
+        assert len(ici) == 1
+        assert len(ici[0]["spec"]["devices"]) == 128
+
+    def test_full_pod_lifecycle_single_chip(self, cluster, tmp_path):
+        client, drivers, mgr = cluster
+        alloc = ReferenceAllocator(client)
+        claim = make_claim_obj(
+            "sim-uid-1", "one-chip",
+            [{"name": "chip", "deviceClassName": "tpu.google.com"}],
+        )
+        alloc.allocate(claim, node_name="node-a")
+        client.create(RESOURCE_CLAIMS, claim, namespace="sim")
+        result = grpc_prepare(drivers["node-a"], claim)
+        assert result.error == ""
+        assert len(result.devices) == 1
+        # CDI spec on node-a carries chip visibility env.
+        cdi_dir = drivers["node-a"].config.cdi_root
+        spec = json.load(
+            open(os.path.join(cdi_dir, "k8s.tpu.google.com-claim_sim-uid-1.json"))
+        )
+        env = spec["containerEdits"]["env"]
+        assert any(e.startswith("TPU_VISIBLE_CHIPS=") for e in env)
+        assert "TPU_SLICE_ID=slice-1" in env
+        assert grpc_unprepare(drivers["node-a"], claim).error == ""
+        alloc.deallocate("sim-uid-1")
+
+    def test_gang_submesh_with_ici_channel(self, cluster):
+        """4-chip sub-mesh on one host + an ICI channel from the slice pool
+        (tpu-test6 + tpu-test-ici combined)."""
+        client, drivers, mgr = cluster
+        alloc = ReferenceAllocator(client)
+        claim = make_claim_obj(
+            "sim-uid-2", "gang",
+            [
+                {"name": "mesh", "deviceClassName": "tpu.google.com", "count": 4},
+                {"name": "chan", "deviceClassName": "ici.tpu.google.com"},
+            ],
+            constraints=[{"requests": ["mesh"], "matchAttribute":
+                          "tpu.google.com/hostId"}],
+        )
+        alloc.allocate(claim)
+        results = claim["status"]["allocation"]["devices"]["results"]
+        mesh_devs = [r for r in results if r["request"] == "mesh"]
+        chan_devs = [r for r in results if r["request"] == "chan"]
+        assert len(mesh_devs) == 4 and len(chan_devs) == 1
+        # All chips from one host's pool (matchAttribute hostId).
+        pools = {r["pool"] for r in mesh_devs}
+        assert len(pools) == 1
+        node = pools.pop()
+        client.create(RESOURCE_CLAIMS, claim, namespace="sim")
+        result = grpc_prepare(drivers[node], claim)
+        assert result.error == ""
+        assert len(result.devices) == 5
+        # Channel device node materialised by the fake chiplib.
+        assert drivers[node].state.chiplib.created_channels
+
+    def test_selector_picks_generation_and_coord(self, cluster):
+        client, drivers, mgr = cluster
+        alloc = ReferenceAllocator(client)
+        claim = make_claim_obj(
+            "sim-uid-3", "origin-chip",
+            [{"name": "chip", "deviceClassName": "tpu.google.com"}],
+        )
+        alloc.allocate(
+            claim,
+            selectors={
+                "chip": [
+                    Selector("generation", "eq", "v5p"),
+                    Selector("coord", "eq", "0,1,0"),
+                ]
+            },
+        )
+        r = claim["status"]["allocation"]["devices"]["results"][0]
+        assert r["pool"] == "node-a"  # coords 0,* live on host 0
+
+    def test_double_booking_prevented(self, cluster):
+        client, drivers, mgr = cluster
+        alloc = ReferenceAllocator(client)
+        sel = {"chip": [Selector("coord", "eq", "0,0,0")]}
+        c1 = make_claim_obj(
+            "sim-uid-4", "c1",
+            [{"name": "chip", "deviceClassName": "tpu.google.com"}],
+        )
+        alloc.allocate(c1, selectors=sel)
+        c2 = make_claim_obj(
+            "sim-uid-5", "c2",
+            [{"name": "chip", "deviceClassName": "tpu.google.com"}],
+        )
+        with pytest.raises(AllocationError):
+            alloc.allocate(c2, selectors=sel)
+
+    def test_tensorcore_same_parent_constraint(self, cluster):
+        """tpu-test4: two core partitions forced onto one chip."""
+        client, drivers, mgr = cluster
+        alloc = ReferenceAllocator(client)
+        claim = make_claim_obj(
+            "sim-uid-6", "cores",
+            [
+                {"name": "core-0",
+                 "deviceClassName": "tensorcore.tpu.google.com"},
+                {"name": "core-1",
+                 "deviceClassName": "tensorcore.tpu.google.com"},
+            ],
+            constraints=[{"requests": ["core-0", "core-1"],
+                          "matchAttribute": "tpu.google.com/parentUuid"}],
+        )
+        alloc.allocate(claim, node_name="node-b")
+        results = claim["status"]["allocation"]["devices"]["results"]
+        names = sorted(r["device"] for r in results)
+        # Same parent chip index.
+        parents = {n.split("-core-")[0] for n in names}
+        assert len(parents) == 1
+        client.create(RESOURCE_CLAIMS, claim, namespace="sim")
+        result = grpc_prepare(drivers["node-b"], claim)
+        assert result.error == ""
+        cdi_dir = drivers["node-b"].config.cdi_root
+        spec = json.load(
+            open(os.path.join(cdi_dir, "k8s.tpu.google.com-claim_sim-uid-6.json"))
+        )
+        env = spec["containerEdits"]["env"]
+        assert any(e.startswith("TPU_VISIBLE_CORES=") for e in env)

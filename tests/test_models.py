@@ -72,6 +72,41 @@ class TestForward:
         l2 = forward(params, t, TINY, remat=True)
         np.testing.assert_allclose(l1, l2, atol=1e-6)
 
+    def test_chunked_ce_matches_naive(self):
+        from k8s_dra_driver_tpu.models.llama import chunked_cross_entropy
+
+        params = init_params(TINY, jax.random.PRNGKey(0))
+        t = tokens(2, 33)
+        inputs, targets = t[:, :-1], t[:, 1:]
+        hidden = forward(params, inputs, TINY, return_hidden=True)
+        logits = forward(params, inputs, TINY)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+        naive = jnp.mean(logz - gold)
+        chunked = chunked_cross_entropy(
+            hidden, params["lm_head"], targets, chunk=8
+        )
+        np.testing.assert_allclose(float(chunked), float(naive), rtol=1e-6)
+        # Grads agree too.
+        g1 = jax.grad(
+            lambda p: chunked_cross_entropy(
+                forward(p, inputs, TINY, return_hidden=True),
+                p["lm_head"], targets, chunk=8,
+            )
+        )(params)
+        g2 = jax.grad(
+            lambda p: jnp.mean(
+                jax.nn.logsumexp(forward(p, inputs, TINY), axis=-1)
+                - jnp.take_along_axis(
+                    forward(p, inputs, TINY), targets[..., None], axis=-1
+                )[..., 0]
+            )
+        )(params)
+        np.testing.assert_allclose(
+            np.array(g1["lm_head"]), np.array(g2["lm_head"]),
+            atol=1e-6, rtol=1e-4,
+        )
+
     def test_loss_finite_and_near_uniform_at_init(self):
         params = init_params(TINY, jax.random.PRNGKey(0))
         loss = loss_fn(params, tokens(2, 33), TINY, remat=False)
