@@ -218,10 +218,17 @@ def chip_visibility_env(chips: list[ChipInfo]) -> dict[str, str]:
         f"{max(xs) - min(xs) + 1},{max(ys) - min(ys) + 1},{max(zs) - min(zs) + 1}"
     )
     first = chips[0]
+    # Accelerator-type strings count TensorCores, not chips: 4 chips of v5p
+    # is "v5p-8" (cores_per_chip=2), while lite generations (v5e/v6e,
+    # cores_per_chip=1) count chips. libtpu derives topology from this.
+    from ..tpulib.topology import GENERATIONS
+
+    spec = GENERATIONS.get(first.generation)
+    n_cores = len(chips) * (spec.cores_per_chip if spec else 1)
     env = {
         "TPU_VISIBLE_CHIPS": indices,
         "TPU_CHIPS_PER_HOST_BOUNDS": bounds,
-        "TPU_ACCELERATOR_TYPE": f"{first.generation}-{len(chips)}",
+        "TPU_ACCELERATOR_TYPE": f"{first.generation}-{n_cores}",
         "TPU_SLICE_ID": first.slice_id,
         "TPU_TOPOLOGY": str(first.slice_topology),
         "TPU_WORKER_ID": str(first.host_id),

@@ -17,7 +17,8 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-import tempfile
+
+from ..utils.fs import atomic_write_json
 
 CHECKPOINT_VERSION = "v1"
 
@@ -70,16 +71,4 @@ class CheckpointManager:
             "checksum": "",
         }
         payload["checksum"] = _checksum(payload)
-        d = os.path.dirname(self.path) or "."
-        os.makedirs(d, exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=d, suffix=".ckpt.tmp")
-        try:
-            with os.fdopen(fd, "w") as f:
-                json.dump(payload, f, indent=1)
-            os.rename(tmp, self.path)
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+        atomic_write_json(self.path, payload, indent=1)

@@ -43,6 +43,7 @@ class OrphanCleaner:
         self.passes = 0
         self.removed_cdi = 0
         self.removed_share_dirs = 0
+        self.removed_share_claims = 0
         self.unprepared_deleted = 0
 
     # -- lifecycle ---------------------------------------------------------
@@ -77,6 +78,7 @@ class OrphanCleaner:
             prepared = self.state.checkpoint.read()
             self._clean_cdi_files(prepared)
             self._clean_share_dirs(prepared)
+            self._clean_share_state(prepared)
         if self.kube_client is not None:
             # Outside the lock: unprepare() takes it itself, and re-checks
             # the checkpoint, so a stale snapshot here is harmless.
@@ -104,6 +106,43 @@ class OrphanCleaner:
 
                 shutil.rmtree(os.path.join(run_dir, entry), ignore_errors=True)
                 self.removed_share_dirs += 1
+
+    def _clean_share_state(self, prepared: dict) -> None:
+        """Release share-state claim entries the checkpoint no longer knows.
+
+        A crash between SharingStateStore.acquire and checkpoint.write leaves
+        phantom claim entries that pin chips in a sharing mode; unprepare is a
+        no-op for claims not in the checkpoint, so without this pass later
+        claims would fail with ModeConflictError forever.
+        """
+        from ..tpulib.chiplib import SHARING_EXCLUSIVE
+        from .sharing import CorruptShareStateError
+
+        store = self.state.share_state
+        try:
+            entries = os.listdir(store.state_dir)
+        except FileNotFoundError:
+            return
+        freed: list[str] = []
+        for entry in entries:
+            if not entry.endswith(".share.json"):
+                continue
+            uuid = entry[: -len(".share.json")]
+            try:
+                st = store.get(uuid)
+            except CorruptShareStateError:
+                logger.exception("share state for chip %s unreadable; skipping", uuid)
+                continue
+            for claim_uid in [c for c in st.claims if c not in prepared]:
+                logger.info(
+                    "releasing phantom share-state entry: claim %s on chip %s",
+                    claim_uid, uuid,
+                )
+                if store.release(uuid, claim_uid):
+                    freed.append(uuid)
+                self.removed_share_claims += 1
+        if freed:
+            self.state.chiplib.set_sharing_mode(freed, SHARING_EXCLUSIVE)
 
     def _unprepare_deleted_claims(self, prepared: dict) -> None:
         from .prepared import PreparedClaim

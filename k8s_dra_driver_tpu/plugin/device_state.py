@@ -239,6 +239,18 @@ class DeviceState:
                 groups.append(
                     PreparedDeviceGroup(devices=prepared_devices, config=config.to_dict())
                 )
+
+            # Visibility env over the WHOLE claim (all groups), so multi-group
+            # allocations present every chip to libtpu. Inside the try block:
+            # if the claim-spec write fails (e.g. disk full) the sharing
+            # acquisitions above must be rolled back too, or they leak —
+            # the claim is never checkpointed, so unprepare would no-op.
+            all_devices = [d for _, (_, ms) in grouped.items() for _, d in ms]
+            common_env = claim_visibility_env(
+                [d.chip for d in all_devices if d.chip is not None],
+                [d.tensorcore for d in all_devices if d.tensorcore is not None],
+            )
+            self.cdi.create_claim_spec_file(claim_uid, claim_device_edits, common_env)
         except BaseException:
             # Roll back acquisitions from already-applied groups; otherwise a
             # half-prepared claim that kubelet never retries (pod deleted)
@@ -252,15 +264,6 @@ class DeviceState:
                     )
             raise
 
-        # Visibility env over the WHOLE claim (all groups), so multi-group
-        # allocations present every chip to libtpu.
-        all_devices = [d for _, (_, ms) in grouped.items() for _, d in ms]
-        common_env = claim_visibility_env(
-            [d.chip for d in all_devices if d.chip is not None],
-            [d.tensorcore for d in all_devices if d.tensorcore is not None],
-        )
-
-        self.cdi.create_claim_spec_file(claim_uid, claim_device_edits, common_env)
         return PreparedClaim(
             claim_uid=claim_uid,
             namespace=claim["metadata"].get("namespace", ""),

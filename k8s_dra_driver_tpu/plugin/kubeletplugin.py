@@ -36,7 +36,12 @@ from .grpc_services import (
 
 logger = logging.getLogger(__name__)
 
-DRA_API_VERSION = "v1alpha4"
+# Version string advertised on the registration socket. Kubelet semver-parses
+# this (it is a plugin-API version, not the gRPC service name); the reference
+# framework advertises "1.0.0" (vendor kubeletplugin/noderegistrar.go:40).
+# The DRA service kubelet actually calls is selected by the gRPC service name
+# (grpc_services.DRA_SERVICE_NAME), independent of this string.
+REGISTRATION_VERSION = "1.0.0"
 
 
 def _serve_uds(path: str, register) -> grpc.Server:
@@ -63,7 +68,7 @@ class _RegistrationService(RegistrationServicer):
             type="DRAPlugin",
             name=self.plugin.driver_name,
             endpoint=self.plugin.plugin_socket,
-            supported_versions=[DRA_API_VERSION],
+            supported_versions=[REGISTRATION_VERSION],
         )
 
     def NotifyRegistrationStatus(self, request, context):

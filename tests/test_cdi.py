@@ -89,7 +89,8 @@ class TestVisibilityEnv:
         env = chip_visibility_env(chips)
         assert env["TPU_VISIBLE_CHIPS"] == "0,1,2,3"
         assert env["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,2,1"
-        assert env["TPU_ACCELERATOR_TYPE"] == "v5p-4"
+        # v5p counts TensorCores (2/chip): 4 chips -> v5p-8.
+        assert env["TPU_ACCELERATOR_TYPE"] == "v5p-8"
         assert env["TPU_SLICE_ID"] == "s9"
         assert env["TPU_TOPOLOGY"] == "2x2x1"
         assert env["TPU_SKIP_MDS_QUERY"] == "true"

@@ -69,6 +69,53 @@ class TestOrphanCleanup:
         cleaner.clean_once()
         assert "uid-old" not in state.checkpoint.read()
 
+    def test_phantom_share_state_released(self, tmp_path):
+        """A crash between SharingStateStore.acquire and checkpoint.write
+        leaves a claim entry that pins the chip's sharing mode; the cleaner
+        must release it (or later claims ModeConflictError forever)."""
+        import pytest
+
+        from k8s_dra_driver_tpu.plugin.sharing import ModeConflictError
+        from k8s_dra_driver_tpu.tpulib.chiplib import (
+            SHARING_EXCLUSIVE,
+            SHARING_PROCESS_SHARED,
+            SHARING_TIME_SHARED,
+        )
+
+        state, lib = make_state(tmp_path)
+        uuid = lib.enumerate_chips()[0].uuid
+        # Simulate the crash: acquire without ever checkpointing the claim.
+        state.share_state.acquire(uuid, "uid-ghost", SHARING_TIME_SHARED)
+        lib.set_sharing_mode([uuid], SHARING_TIME_SHARED)
+        with pytest.raises(ModeConflictError):
+            state.share_state.acquire(uuid, "uid-new", SHARING_PROCESS_SHARED)
+        cleaner = OrphanCleaner(state)
+        cleaner.clean_once()
+        assert cleaner.removed_share_claims == 1
+        # Chip is free again, in exclusive mode, and claimable in any mode.
+        assert lib.sharing_modes[uuid] == SHARING_EXCLUSIVE
+        state.share_state.acquire(uuid, "uid-new", SHARING_PROCESS_SHARED)
+
+    def test_phantom_entry_does_not_touch_live_claims(self, tmp_path):
+        """Pruning only drops entries absent from the checkpoint; live
+        claims on the same chip keep the mode."""
+        from k8s_dra_driver_tpu.tpulib.chiplib import SHARING_TIME_SHARED
+
+        TS = {
+            "apiVersion": "tpu.google.com/v1alpha1",
+            "kind": "TpuChipConfig",
+            "sharing": {"strategy": "TimeShared"},
+        }
+        state, lib = make_state(tmp_path)
+        state.prepare(make_claim("uid-live", ["tpu-0"], configs=[opaque(TS)]))
+        uuid = lib.enumerate_chips()[0].uuid
+        state.share_state.acquire(uuid, "uid-ghost", SHARING_TIME_SHARED)
+        OrphanCleaner(state).clean_once()
+        st = state.share_state.get(uuid)
+        assert set(st.claims) == {"uid-live"}
+        assert st.mode == SHARING_TIME_SHARED
+        assert lib.sharing_modes[uuid] == SHARING_TIME_SHARED
+
     def test_start_stop(self, tmp_path):
         state, _ = make_state(tmp_path)
         cleaner = OrphanCleaner(state, interval_seconds=0.05)

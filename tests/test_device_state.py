@@ -207,6 +207,28 @@ class TestSharingConfigs:
         # Same mode is compatible.
         state.prepare(make_claim("uid-c", ["tpu-0"], configs=[opaque(ts)]))
 
+    def test_claim_spec_write_failure_rolls_back_sharing(self, tmp_path):
+        """If the per-claim CDI spec write fails (disk full), sharing
+        acquisitions must be rolled back — the claim is never checkpointed,
+        so unprepare would no-op and leak share-state entries."""
+        state, _ = make_state(tmp_path)
+        ts = {
+            "apiVersion": "tpu.google.com/v1alpha1",
+            "kind": "TpuChipConfig",
+            "sharing": {"strategy": "TimeShared"},
+        }
+
+        def boom(*a, **k):
+            raise OSError(28, "No space left on device")
+
+        state.cdi.create_claim_spec_file = boom
+        with pytest.raises(OSError):
+            state.prepare(make_claim("uid-x", ["tpu-0"], configs=[opaque(ts)]))
+        assert "uid-x" not in state.checkpoint.read()
+        # The chip must be fully released: an exclusive claim now succeeds.
+        del state.cdi.create_claim_spec_file
+        state.prepare(make_claim("uid-y", ["tpu-0"]))
+
     def test_class_claim_precedence(self, tmp_path):
         state, _ = make_state(tmp_path)
         class_cfg = opaque(

@@ -68,7 +68,9 @@ class TestRegistration:
             assert info.type == "DRAPlugin"
             assert info.name == DRIVER
             assert info.endpoint == config.plugin_socket
-            assert list(info.supported_versions) == ["v1alpha4"]
+            # Registration advertises the plugin-API version kubelet
+            # semver-parses, not the DRA gRPC service version.
+            assert list(info.supported_versions) == ["1.0.0"]
             stub.NotifyRegistrationStatus(
                 regpb.RegistrationStatus(plugin_registered=True)
             )
