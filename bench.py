@@ -52,7 +52,7 @@ def pick_config():
     return "1b", 8, 2048, spec.peak_bf16_flops
 
 
-def run_bench(preset, batch, seq, peak_flops):
+def run_bench(preset, batch, seq, peak_flops, remat_policy="flash"):
     from k8s_dra_driver_tpu.models.llama import PRESETS, init_params, loss_fn
     config = PRESETS[preset]
     if config.max_seq_len < seq + 1:
@@ -67,7 +67,9 @@ def run_bench(preset, batch, seq, peak_flops):
 
     grad_fn = jax.jit(
         jax.value_and_grad(
-            lambda p, t: loss_fn(p, t, config, remat=True)
+            lambda p, t: loss_fn(
+                p, t, config, remat=True, remat_policy=remat_policy
+            )
         ),
         donate_argnums=(),
     )
@@ -126,31 +128,35 @@ def run_bench(preset, batch, seq, peak_flops):
 def main() -> int:
     import os
 
-    from k8s_dra_driver_tpu.ops.attention import set_attention_impl
+    from k8s_dra_driver_tpu.models.llama import REMAT_POLICIES
+    from k8s_dra_driver_tpu.ops.attention import (
+        attention_impl_label,
+        set_attention_impl,
+    )
 
     preset, batch, seq, peak_flops = pick_config()
     # Experiment overrides (bench sweeps).
     preset = os.environ.get("TPU_DRA_BENCH_PRESET", preset)
     batch = int(os.environ.get("TPU_DRA_BENCH_BATCH", batch))
     seq = int(os.environ.get("TPU_DRA_BENCH_SEQ", seq))
-    def attn_label():
-        # What flash_attention actually dispatched, not what we hoped for.
-        from k8s_dra_driver_tpu.ops import attention as attn_mod
-
-        on_tpu = jax.default_backend() == "tpu"
-        return "pallas" if on_tpu and attn_mod._ATTN_IMPL != "xla" else "xla"
+    remat_policy = os.environ.get("TPU_DRA_BENCH_REMAT", "flash")
+    if remat_policy != "none" and remat_policy not in REMAT_POLICIES:
+        print(f"unknown TPU_DRA_BENCH_REMAT {remat_policy!r}; valid: "
+              f"{['none', *REMAT_POLICIES]}", file=sys.stderr)
+        return 2
 
     try:
-        result = run_bench(preset, batch, seq, peak_flops)
-        result["detail"]["attn"] = attn_label()
+        result = run_bench(preset, batch, seq, peak_flops, remat_policy)
+        result["detail"]["attn"] = attention_impl_label()
     except Exception as e:
         # Pallas may be unavailable on this backend/runtime combination;
         # the XLA attention path is the portable fallback.
         print(f"pallas path failed ({type(e).__name__}); retrying with XLA "
               f"attention", file=sys.stderr)
         set_attention_impl("xla")
-        result = run_bench(preset, batch, seq, peak_flops)
+        result = run_bench(preset, batch, seq, peak_flops, remat_policy)
         result["detail"]["attn"] = "xla"
+    result["detail"]["remat"] = remat_policy
     print(json.dumps(result))
     return 0
 

@@ -8,8 +8,10 @@ hermetically: DeviceClass → device-type mapping, request counts, attribute
 selectors, and cross-request ``matchAttribute`` constraints (the gang /
 same-parent mechanism of tpu-test4/6).
 
-Not a CEL engine: selectors are (attribute, op, value) triples covering what
-the demo specs express. The production path still uses the real scheduler.
+Selectors come in two forms: programmatic (attribute, op, value) triples,
+and real CEL expressions from DeviceClass specs / request ``selectors``
+(evaluated by the cel module's subset engine, so the demo specs run through
+the sim verbatim). The production path still uses the real scheduler.
 """
 
 from __future__ import annotations
@@ -18,6 +20,7 @@ import dataclasses
 import threading
 from typing import Any, Callable, Optional
 
+from .cel import CelError, evaluate as cel_evaluate
 from .client import RESOURCE_SLICES, KubeClient
 
 # DeviceClass name → the `type` attribute the node plugin publishes.
@@ -69,21 +72,72 @@ def _attr_value(attrs: dict, name: str):
     return next(iter(raw.values())) if isinstance(raw, dict) else raw
 
 
+def _consumption_entries(dev: dict):
+    """(pool, counter set, counter, amount) for each counter a device
+    consumes."""
+    for cc in dev.get("consumes", []):
+        for cname, cval in cc.get("counters", {}).items():
+            yield dev["pool"], cc["counterSet"], cname, int(cval["value"])
+
+
+def _gang_contiguous(chosen: list[dict]) -> bool:
+    """A multi-chip request is a gang: its chips must be one contiguous
+    ICI sub-mesh within a single slice (SURVEY.md §7 hard part (a); the
+    reference's analog is same-parent MIG constraints,
+    demo/specs/quickstart/gpu-test4.yaml:42-44). XLA's collective
+    performance model assumes mesh neighbours, so a fragmented pick is
+    useless to the workload and must be rejected, not granted.
+    """
+    chips = [
+        d for d in chosen
+        if _attr_value(d["attributes"], "type") == "chip"
+    ]
+    if len(chips) < 2:
+        return True
+    from ..tpulib.topology import Coord, is_contiguous_submesh
+
+    if len({_attr_value(d["attributes"], "sliceId") for d in chips}) > 1:
+        return False
+    coords = []
+    for d in chips:
+        c = _attr_value(d["attributes"], "coord")
+        if c is None:
+            return False
+        coords.append(Coord.parse(c))
+    return is_contiguous_submesh(coords)
+
+
 class ReferenceAllocator:
     """Allocates claims against published ResourceSlices."""
 
-    def __init__(self, client: KubeClient, driver_name: str = "tpu.google.com"):
+    def __init__(
+        self,
+        client: KubeClient,
+        driver_name: str = "tpu.google.com",
+        device_classes: Optional[dict[str, list[str]]] = None,
+    ):
+        """``device_classes`` maps DeviceClass name → CEL selector
+        expressions (from the class spec). When given, class membership is
+        decided by evaluating those (the production mechanism); otherwise
+        the built-in DEVICE_CLASS_TYPES name → type mapping applies.
+        """
         self.client = client
         self.driver_name = driver_name
+        self.device_classes = device_classes
         self._lock = threading.Lock()
         # (pool, device) -> claim uid holding it
         self._reservations: dict[tuple[str, str], str] = {}
+        # (pool, counter set, counter) -> amount consumed by reservations.
+        self._consumed: dict[tuple[str, str, str], int] = {}
+        # claim uid -> [(pool, counter set, counter, amount)] for release.
+        self._claim_consumption: dict[str, list[tuple[str, str, str, int]]] = {}
 
     # -- inventory ---------------------------------------------------------
 
-    def _devices(self) -> list[dict]:
-        """Flattened (pool, node, device) inventory from current slices,
-        highest pool generation only."""
+    def _inventory(self) -> tuple[list[dict], dict[tuple[str, str, str], int]]:
+        """One pass over the current slices (highest pool generation only):
+        flattened (pool, node, device) inventory + shared-counter
+        capacities keyed (pool, counter set, counter)."""
         slices = [
             s
             for s in self.client.list(RESOURCE_SLICES)
@@ -95,22 +149,32 @@ class ReferenceAllocator:
             max_gen[pool["name"]] = max(
                 max_gen.get(pool["name"], 0), pool["generation"]
             )
-        out = []
+        devices = []
+        capacity: dict[tuple[str, str, str], int] = {}
         for s in slices:
             pool = s["spec"]["pool"]
             if pool["generation"] != max_gen[pool["name"]]:
                 continue
             for dev in s["spec"].get("devices", []):
-                out.append(
+                devices.append(
                     {
                         "pool": pool["name"],
                         "node": s["spec"].get("nodeName", ""),
                         "node_selector": s["spec"].get("nodeSelector"),
                         "name": dev["name"],
                         "attributes": dev.get("basic", {}).get("attributes", {}),
+                        "capacity": dev.get("basic", {}).get("capacity", {}),
+                        "consumes": dev.get("basic", {}).get(
+                            "consumesCounters", []
+                        ),
                     }
                 )
-        return out
+            for cs in s["spec"].get("sharedCounters", []):
+                for cname, cval in cs.get("counters", {}).items():
+                    capacity[(pool["name"], cs["name"], cname)] = int(
+                        cval["value"]
+                    )
+        return devices, capacity
 
     # -- allocation --------------------------------------------------------
 
@@ -130,16 +194,27 @@ class ReferenceAllocator:
         constraints = spec.get("constraints", [])
         selectors = selectors or {}
         with self._lock:
+            devices, capacity = self._inventory()
             inventory = [
                 d
-                for d in self._devices()
+                for d in devices
                 if (d["pool"], d["name"]) not in self._reservations
                 and (not node_name or not d["node"] or d["node"] == node_name)
             ]
-            results = self._solve(requests, constraints, selectors, inventory)
+            results, picked_devs = self._solve(
+                requests, constraints, selectors, inventory, capacity
+            )
             uid = claim["metadata"]["uid"]
             for r in results:
                 self._reservations[(r["pool"], r["device"])] = uid
+            for d in picked_devs:
+                for pool, cset, cname, amount in _consumption_entries(d):
+                    self._consumed[(pool, cset, cname)] = (
+                        self._consumed.get((pool, cset, cname), 0) + amount
+                    )
+                    self._claim_consumption.setdefault(uid, []).append(
+                        (pool, cset, cname, amount)
+                    )
         claim.setdefault("status", {})["allocation"] = {
             "devices": {
                 "results": results,
@@ -158,28 +233,81 @@ class ReferenceAllocator:
             out.append(entry)
         return out
 
-    def _solve(self, requests, constraints, selectors, inventory) -> list[dict]:
-        """Greedy backtracking over requests with matchAttribute checks."""
+    def _solve(self, requests, constraints, selectors, inventory, capacity):
+        """Greedy backtracking over requests with matchAttribute checks,
+        shared-counter budgets, and ICI contiguity for multi-chip gangs.
+
+        Returns (allocation results, picked device dicts).
+        """
         match_groups = [
             (set(c.get("requests", [])), c["matchAttribute"].split("/")[-1])
             for c in constraints
             if "matchAttribute" in c
         ]
+        # Counters consumed by the in-progress solution, on top of the
+        # amounts already reserved by other claims.
+        tentative: dict[tuple[str, str, str], int] = {}
+
+        def counters_fit(dev) -> bool:
+            for pool, cset, cname, amount in _consumption_entries(dev):
+                key = (pool, cset, cname)
+                cap = capacity.get(key)
+                if cap is None:
+                    continue  # undeclared counter: unconstrained
+                used = self._consumed.get(key, 0) + tentative.get(key, 0)
+                if used + amount > cap:
+                    return False
+            return True
+
+        def consume(dev) -> None:
+            for pool, cset, cname, amount in _consumption_entries(dev):
+                key = (pool, cset, cname)
+                tentative[key] = tentative.get(key, 0) + amount
+
+        def unconsume(dev) -> None:
+            for pool, cset, cname, amount in _consumption_entries(dev):
+                key = (pool, cset, cname)
+                tentative[key] -= amount
+
+        def cel_matches(expr: str, d: dict) -> bool:
+            try:
+                return cel_evaluate(
+                    expr, self.driver_name, d["attributes"], d.get("capacity")
+                )
+            except CelError as e:
+                # Bad expressions make the claim unallocatable, matching the
+                # solver's error contract for malformed specs.
+                raise AllocationError(f"invalid CEL selector: {e}") from e
+
+        def class_matches(class_name: str, d: dict) -> bool:
+            if self.device_classes is not None:
+                exprs = self.device_classes.get(class_name)
+                if exprs is None:
+                    raise AllocationError(
+                        f"unknown device class {class_name!r}"
+                    )
+                return all(cel_matches(e, d) for e in exprs)
+            dtype = DEVICE_CLASS_TYPES.get(class_name)
+            if dtype is None:
+                raise AllocationError(f"unknown device class {class_name!r}")
+            return _attr_value(d["attributes"], "type") == dtype
 
         def candidates(req):
-            dtype = DEVICE_CLASS_TYPES.get(req.get("deviceClassName", ""))
-            if dtype is None:
-                raise AllocationError(
-                    f"unknown device class {req.get('deviceClassName')!r}"
-                )
+            cel_selectors = [
+                s["cel"]["expression"]
+                for s in req.get("selectors", [])
+                if "cel" in s
+            ]
             out = []
             for d in inventory:
-                if _attr_value(d["attributes"], "type") != dtype:
+                if not class_matches(req.get("deviceClassName", ""), d):
                     continue
                 if not all(
                     s.matches(d["attributes"])
                     for s in selectors.get(req["name"], [])
                 ):
+                    continue
+                if not all(cel_matches(e, d) for e in cel_selectors):
                     continue
                 out.append(d)
             return out
@@ -209,6 +337,8 @@ class ReferenceAllocator:
 
             def pick_n(chosen: list) -> bool:
                 if len(chosen) == count:
+                    if not _gang_contiguous(chosen):
+                        return False
                     for d in chosen:
                         picked.append((req["name"], d))
                     if backtrack(ri + 1):
@@ -222,12 +352,16 @@ class ReferenceAllocator:
                         continue
                     if not consistent(req["name"], d):
                         continue
+                    if not counters_fit(d):
+                        continue
                     chosen.append(d)
+                    consume(d)
                     # Intra-request matchAttribute consistency.
                     if self._group_ok(
                         req["name"], chosen, match_groups
                     ) and pick_n(chosen):
                         return True
+                    unconsume(d)
                     chosen.pop()
                 return False
 
@@ -243,7 +377,7 @@ class ReferenceAllocator:
                 "device": dev["name"],
             }
             for name, dev in picked
-        ]
+        ], [dev for _, dev in picked]
 
     @staticmethod
     def _group_ok(req_name, chosen, match_groups) -> bool:
@@ -262,3 +396,7 @@ class ReferenceAllocator:
             self._reservations = {
                 k: v for k, v in self._reservations.items() if v != claim_uid
             }
+            for pool, cset, cname, amount in self._claim_consumption.pop(
+                claim_uid, []
+            ):
+                self._consumed[(pool, cset, cname)] -= amount

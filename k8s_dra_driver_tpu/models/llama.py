@@ -22,6 +22,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.attention import flash_attention
@@ -99,12 +100,25 @@ PRESETS: dict[str, LlamaConfig] = {
 
 
 def init_params(config: LlamaConfig, key: jax.Array) -> dict:
-    """Initialize the parameter pytree (layers stacked on axis 0)."""
+    """Initialize the parameter pytree (layers stacked on axis 0).
+
+    QKV and gate/up are stored FUSED so each is one MXU matmul per layer
+    (HBM reads the normed activations once, not three times):
+
+    - ``wqkv``: [L, H, n_kv_heads, group+2, head_dim] where
+      group = n_heads // n_kv_heads. Per kv head the out dim packs that
+      head's ``group`` q heads, then its k head, then its v head. Grouping
+      by kv head (rather than a flat [q|k|v] concat) keeps tensor-parallel
+      sharding clean: the kv-head axis shards evenly and every shard slices
+      q/k/v locally. Head order is therefore "grouped by kv head" — a fixed
+      permutation of the conventional layout (internal checkpoints only).
+    - ``w_gateup``: [L, H, 2, M]; index 0 = gate, 1 = up, sharded on M.
+    """
     c = config
     keys = jax.random.split(key, 10)
     h, m, v, l = c.hidden, c.mlp_hidden, c.vocab_size, c.n_layers
     hq = c.n_heads * c.head_dim
-    hkv = c.n_kv_heads * c.head_dim
+    g = c.n_heads // c.n_kv_heads
 
     def norm_init(k, *shape, fan_in):
         scale = 1.0 / math.sqrt(fan_in)
@@ -113,12 +127,11 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
     return {
         "embed": norm_init(keys[0], v, h, fan_in=h),
         "layers": {
-            "wq": norm_init(keys[1], l, h, hq, fan_in=h),
-            "wk": norm_init(keys[2], l, h, hkv, fan_in=h),
-            "wv": norm_init(keys[3], l, h, hkv, fan_in=h),
+            "wqkv": norm_init(
+                keys[1], l, h, c.n_kv_heads, g + 2, c.head_dim, fan_in=h
+            ),
             "wo": norm_init(keys[4], l, hq, h, fan_in=hq),
-            "w_gate": norm_init(keys[5], l, h, m, fan_in=h),
-            "w_up": norm_init(keys[6], l, h, m, fan_in=h),
+            "w_gateup": norm_init(keys[5], l, h, 2, m, fan_in=h),
             "w_down": norm_init(keys[7], l, m, h, fan_in=m),
             "ln_attn": jnp.ones((l, h), c.dtype),
             "ln_mlp": jnp.ones((l, h), c.dtype),
@@ -131,20 +144,17 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
 def param_specs(config: LlamaConfig) -> dict:
     """PartitionSpecs per param (Megatron TP + fsdp on the other dim).
 
-    Layer stacks carry a leading None for the scan dim.
+    Layer stacks carry a leading None for the scan dim. The fused wqkv
+    shards its kv-head axis on "tensor" (each shard holds whole kv groups);
+    w_gateup shards the M axis.
     """
-    col = P(None, "fsdp", "tensor")     # column-parallel: out dim sharded
-    row = P(None, "tensor", "fsdp")     # row-parallel: in dim sharded
     return {
         "embed": P("tensor", "fsdp"),
         "layers": {
-            "wq": col,
-            "wk": col,
-            "wv": col,
-            "wo": row,
-            "w_gate": col,
-            "w_up": col,
-            "w_down": row,
+            "wqkv": P(None, "fsdp", "tensor", None, None),
+            "wo": P(None, "tensor", "fsdp"),
+            "w_gateup": P(None, "fsdp", None, "tensor"),
+            "w_down": P(None, "tensor", "fsdp"),
             "ln_attn": P(None, None),
             "ln_mlp": P(None, None),
         },
@@ -170,9 +180,12 @@ def project_qkv(
     cannot drift between them. Returns q [B,Hq,T,D], k,v [B,Hkv,T,D]."""
     c = config
     b, t, _ = xn.shape
-    q = (xn @ layer["wq"]).reshape(b, t, c.n_heads, c.head_dim)
-    k = (xn @ layer["wk"]).reshape(b, t, c.n_kv_heads, c.head_dim)
-    v = (xn @ layer["wv"]).reshape(b, t, c.n_kv_heads, c.head_dim)
+    g = c.n_heads // c.n_kv_heads
+    # One fused matmul: [B,T,H] @ [H, KV, G+2, D] -> [B, T, KV, G+2, D].
+    qkv = jnp.einsum("bth,hkgd->btkgd", xn, layer["wqkv"])
+    q = qkv[..., :g, :].reshape(b, t, c.n_heads, c.head_dim)
+    k = qkv[..., g, :]                                  # [B, T, KV, D]
+    v = qkv[..., g + 1, :]
     q = apply_rope(q.transpose(0, 2, 1, 3), cos, sin, positions=positions)
     k = apply_rope(k.transpose(0, 2, 1, 3), cos, sin, positions=positions)
     return q, k, v.transpose(0, 2, 1, 3)
@@ -192,14 +205,50 @@ def _attention_block(x, layer, config: LlamaConfig, cos, sin, mesh, use_ring):
         o = ring_attention(q, k, v, mesh, causal=True)
     else:
         o = flash_attention(q, k, v, causal=True)
+    o = checkpoint_name(o, "attn_o")
     return attn_out(x, o, layer)
 
 
 def _mlp_block(x, layer, config: LlamaConfig):
     xn = rmsnorm(x, layer["ln_mlp"], config.norm_eps)
-    gate = jax.nn.silu((xn @ layer["w_gate"]).astype(jnp.float32))
-    up = (xn @ layer["w_up"]).astype(jnp.float32)
-    return x + ((gate * up).astype(x.dtype) @ layer["w_down"]).astype(x.dtype)
+    # One fused matmul: [B,T,H] @ [H, 2, M] -> [B, T, 2, M].
+    gu = jnp.einsum("bth,hcm->btcm", xn, layer["w_gateup"])
+    gate = jax.nn.silu(gu[..., 0, :].astype(jnp.float32))
+    up = gu[..., 1, :].astype(jnp.float32)
+    prod = checkpoint_name((gate * up).astype(x.dtype), "mlp_prod")
+    return x + (prod @ layer["w_down"]).astype(x.dtype)
+
+
+# Remat policies, cheapest-memory first. "full" recomputes the whole block
+# in the backward (~25% extra FLOPs). "flash" saves the attention kernel's
+# out+lse residuals (small) so the flash kernel never re-runs; the QKV dot
+# is still recomputed to rebuild q/k/v. "flash_qkv" saves q/k/v too (large:
+# full head count after GQA repeat) skipping the QKV recompute. "flash_mlp"
+# additionally saves the silu(gate)*up product. The gate/up matmul outputs
+# themselves ([B,S,2M]) are never saved — too large at any batch.
+# ``remat_policy="none"`` (or remat=False) disables remat entirely.
+REMAT_POLICIES = {
+    "full": None,
+    "flash": ("flash_out", "attn_o"),
+    "flash_qkv": ("flash_out", "flash_qkv", "attn_o"),
+    "flash_mlp": ("flash_out", "attn_o", "mlp_prod"),
+}
+
+
+def _remat_transform(remat, remat_policy):
+    if not remat or remat_policy == "none":
+        return lambda f: f
+    if remat_policy not in REMAT_POLICIES:
+        raise ValueError(
+            f"unknown remat_policy {remat_policy!r}; valid: "
+            f"{['none', *REMAT_POLICIES]}"
+        )
+    names = REMAT_POLICIES[remat_policy]
+    policy = (
+        jax.checkpoint_policies.save_only_these_names(*names)
+        if names else None
+    )
+    return lambda f: jax.checkpoint(f, prevent_cse=False, policy=policy)
 
 
 def forward(
@@ -210,6 +259,7 @@ def forward(
     use_ring: bool = False,
     remat: bool = False,
     return_hidden: bool = False,
+    remat_policy: str = "full",
 ) -> jax.Array:
     """Causal LM forward → logits [B, S, V] (f32), or the final hidden
     states [B, S, H] when ``return_hidden`` (the loss path projects to vocab
@@ -224,8 +274,7 @@ def forward(
         x = _mlp_block(x, layer, c)
         return x, None
 
-    if remat:
-        block = jax.checkpoint(block, prevent_cse=False)
+    block = _remat_transform(remat, remat_policy)(block)
     x, _ = jax.lax.scan(block, x, params["layers"])
     x = rmsnorm(x, params["final_norm"], c.norm_eps)
     if return_hidden:
@@ -274,11 +323,13 @@ def loss_fn(
     mesh: Optional[Mesh] = None,
     use_ring: bool = False,
     remat: bool = True,
+    remat_policy: str = "full",
 ) -> jax.Array:
     """Next-token cross-entropy (mean over tokens)."""
     inputs = tokens[:, :-1]
     targets = tokens[:, 1:]
     hidden = forward(
-        params, inputs, config, mesh, use_ring, remat, return_hidden=True
+        params, inputs, config, mesh, use_ring, remat, return_hidden=True,
+        remat_policy=remat_policy,
     )
     return chunked_cross_entropy(hidden, params["lm_head"], targets)

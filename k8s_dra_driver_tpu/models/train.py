@@ -58,6 +58,15 @@ def init_train_state(
     """Initialize params + opt state directly sharded on the mesh (no
     host-memory staging of the full model: init is jitted with sharded
     outputs)."""
+    tensor = mesh.shape.get("tensor", 1)
+    if tensor > 1 and config.n_kv_heads % tensor != 0:
+        # The fused wqkv shards its kv-head axis on "tensor"; TP beyond
+        # n_kv_heads would require kv-head duplication, which this layout
+        # does not implement.
+        raise ValueError(
+            f"tensor parallel degree {tensor} must divide n_kv_heads "
+            f"({config.n_kv_heads}); use tensor <= n_kv_heads"
+        )
     pspecs = param_specs(config)
     param_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs)
 

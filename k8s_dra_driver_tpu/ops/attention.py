@@ -120,7 +120,15 @@ def _flash_attention_pallas(
     interpret: bool = False,
     return_lse: bool = False,
 ):
+    """GQA-native: k/v may have fewer heads than q (q head i reads kv head
+    i // group) — no repeat materialization, kv blocks are simply mapped to
+    the right head by the BlockSpec index map."""
     b, h, s, d = q.shape
+    hkv = k.shape[1]
+    assert h % hkv == 0, (
+        f"q heads ({h}) must be a multiple of kv heads ({hkv})"
+    )
+    g = h // hkv
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     assert s % block_q == 0 and s % block_k == 0, (
@@ -128,8 +136,8 @@ def _flash_attention_pallas(
     )
     bh = b * h
     qr = q.reshape(bh, s, d)
-    kr = k.reshape(bh, s, d)
-    vr = v.reshape(bh, s, d)
+    kr = k.reshape(b * hkv, s, d)
+    vr = v.reshape(b * hkv, s, d)
     grid = (bh, s // block_q, s // block_k)
     kernel = functools.partial(
         _flash_kernel,
@@ -143,8 +151,12 @@ def _flash_attention_pallas(
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh_, iq, ik: (bh_, iq, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh_, iq, ik: (bh_, ik, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh_, iq, ik: (bh_, ik, 0)),
+            pl.BlockSpec(
+                (1, block_k, d), lambda bh_, iq, ik: (bh_ // g, ik, 0)
+            ),
+            pl.BlockSpec(
+                (1, block_k, d), lambda bh_, iq, ik: (bh_ // g, ik, 0)
+            ),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh_, iq, ik: (bh_, iq, 0)),
@@ -179,13 +191,18 @@ def _flash_bwd_dkdv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,   # inputs
     dk_ref, dv_ref,                                    # outputs
     dk_acc, dv_acc,                                    # VMEM scratch
-    *, scale: float, causal: bool, block_q: int, block_k: int,
+    *, scale: float, causal: bool, block_q: int, block_k: int, nq: int,
 ):
+    """Grid dim 0 walks KV heads; the innermost dim flattens (q head in
+    group, q block) so dk/dv accumulate over every q head sharing this kv
+    head — GQA without materializing repeated k/v or summing dk over
+    groups afterwards."""
     ik = pl.program_id(1)
-    iq = pl.program_id(2)
-    nq = pl.num_programs(2)
+    pid2 = pl.program_id(2)
+    n2 = pl.num_programs(2)
+    iq = pid2 % nq
 
-    @pl.when(iq == 0)
+    @pl.when(pid2 == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -232,7 +249,7 @@ def _flash_bwd_dkdv_kernel(
             preferred_element_type=jnp.float32,
         ) * scale
 
-    @pl.when(iq == nq - 1)
+    @pl.when(pid2 == n2 - 1)
     def _finish():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
@@ -297,10 +314,16 @@ def _flash_attention_bwd_pallas(
     block_q: int = 512, block_k: int = 512, interpret: bool = False,
 ):
     b, h, s, d = q.shape
+    hkv = k.shape[1]
+    g = h // hkv
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     bh = b * h
-    qr, kr, vr = (x.reshape(bh, s, d) for x in (q, k, v))
+    bhkv = b * hkv
+    nq = s // block_q
+    qr = q.reshape(bh, s, d)
+    kr = k.reshape(bhkv, s, d)
+    vr = v.reshape(bhkv, s, d)
     outr = out.reshape(bh, s, d)
     dor = do.reshape(bh, s, d)
     lser = lse.reshape(bh, s, 1)
@@ -310,27 +333,32 @@ def _flash_attention_bwd_pallas(
         axis=-1, keepdims=True,
     )
 
+    # dk/dv: grid dim 0 = kv head; innermost flattens (q head in group,
+    # q block) so accumulation covers the whole group — dk/dv come out at
+    # kv-head count directly.
+    q_map = lambda bh_, ik, p2: (bh_ * g + p2 // nq, p2 % nq, 0)
     dkdv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkdv_kernel,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            nq=nq,
         ),
-        grid=(bh, s // block_k, s // block_q),
+        grid=(bhkv, s // block_k, g * nq),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh_, ik, iq: (bh_, iq, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh_, ik, iq: (bh_, ik, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh_, ik, iq: (bh_, ik, 0)),
-            pl.BlockSpec((1, block_q, d), lambda bh_, ik, iq: (bh_, iq, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bh_, ik, iq: (bh_, iq, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bh_, ik, iq: (bh_, iq, 0)),
+            pl.BlockSpec((1, block_q, d), q_map),
+            pl.BlockSpec((1, block_k, d), lambda bh_, ik, p2: (bh_, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, ik, p2: (bh_, ik, 0)),
+            pl.BlockSpec((1, block_q, d), q_map),
+            pl.BlockSpec((1, block_q, 1), q_map),
+            pl.BlockSpec((1, block_q, 1), q_map),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda bh_, ik, iq: (bh_, ik, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh_, ik, iq: (bh_, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, ik, p2: (bh_, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, ik, p2: (bh_, ik, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bhkv, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bhkv, s, d), q.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -348,8 +376,12 @@ def _flash_attention_bwd_pallas(
         grid=(bh, s // block_q, s // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh_, iq, ik: (bh_, iq, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh_, iq, ik: (bh_, ik, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh_, iq, ik: (bh_, ik, 0)),
+            pl.BlockSpec(
+                (1, block_k, d), lambda bh_, iq, ik: (bh_ // g, ik, 0)
+            ),
+            pl.BlockSpec(
+                (1, block_k, d), lambda bh_, iq, ik: (bh_ // g, ik, 0)
+            ),
             pl.BlockSpec((1, block_q, d), lambda bh_, iq, ik: (bh_, iq, 0)),
             pl.BlockSpec((1, block_q, 1), lambda bh_, iq, ik: (bh_, iq, 0)),
             pl.BlockSpec((1, block_q, 1), lambda bh_, iq, ik: (bh_, iq, 0)),
@@ -362,30 +394,55 @@ def _flash_attention_bwd_pallas(
         interpret=interpret,
     )(qr, kr, vr, dor, lser, delta)
 
-    reshape = lambda x: x.reshape(b, h, s, d)
-    return reshape(dq), reshape(dk), reshape(dv)
+    return (
+        dq.reshape(b, h, s, d),
+        dk.reshape(b, hkv, s, d),
+        dv.reshape(b, hkv, s, d),
+    )
 
 
 # Differentiable wrapper: pallas forward AND backward (pallas_call has no
 # automatic VJP). The forward saves only q, k, v, out and the per-row
 # logsumexp; the backward recomputes score blocks from lse — flash-style, no
 # [S, S] materialization in either direction.
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_diff(q, k, v, causal, scale, interpret=False):
-    return _flash_attention_pallas(q, k, v, causal, scale, interpret=interpret)
-
-
-def _flash_diff_fwd(q, k, v, causal, scale, interpret=False):
-    out, lse = _flash_attention_pallas(
-        q, k, v, causal, scale, interpret=interpret, return_lse=True
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_diff(q, k, v, causal, scale, interpret=False,
+                block_q=512, block_k=512):
+    return _flash_attention_pallas(
+        q, k, v, causal, scale, block_q=block_q, block_k=block_k,
+        interpret=interpret,
     )
-    return out, (q, k, v, out, lse)
 
 
-def _flash_diff_bwd(causal, scale, interpret, res, g):
+def _flash_diff_fwd(q, k, v, causal, scale, interpret=False,
+                    block_q=512, block_k=512):
+    out, lse = _flash_attention_pallas(
+        q, k, v, causal, scale, block_q=block_q, block_k=block_k,
+        interpret=interpret, return_lse=True,
+    )
+    # Name the residuals so a remat policy (save_only_these_names) can keep
+    # them: without this, jax.checkpoint around a transformer block re-runs
+    # the QKV projection AND this kernel in the backward just to rebuild
+    # (q, k, v, lse). Two tiers: "flash_out" (out + lse, small — skips the
+    # kernel re-run but recomputes the QKV dot) and "flash_qkv" (q/k/v —
+    # large at full head count after GQA repeat, skips the QKV dot too).
+    from jax.ad_checkpoint import checkpoint_name
+
+    res = (
+        checkpoint_name(q, "flash_qkv"),
+        checkpoint_name(k, "flash_qkv"),
+        checkpoint_name(v, "flash_qkv"),
+        checkpoint_name(out, "flash_out"),
+        checkpoint_name(lse, "flash_out"),
+    )
+    return out, res
+
+
+def _flash_diff_bwd(causal, scale, interpret, block_q, block_k, res, g):
     q, k, v, out, lse = res
     return _flash_attention_bwd_pallas(
-        q, k, v, out, lse, g, causal, scale, interpret=interpret
+        q, k, v, out, lse, g, causal, scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
     )
 
 
@@ -394,12 +451,30 @@ _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 # Attention implementation override: "auto" (pallas on TPU), "pallas", "xla".
 _ATTN_IMPL = os.environ.get("TPU_DRA_ATTN_IMPL", "auto")
 
+# Kernel block sizes, sweepable per generation (VMEM budget differs between
+# v5e and v5p). Defaults chosen by the v5e sweep in BENCH history.
+_BLOCK_Q = int(os.environ.get("TPU_DRA_ATTN_BLOCK_Q", "512"))
+_BLOCK_K = int(os.environ.get("TPU_DRA_ATTN_BLOCK_K", "512"))
+
 
 def set_attention_impl(impl: str) -> None:
     """Select the attention backend: "auto" | "pallas" | "xla"."""
     global _ATTN_IMPL
     assert impl in ("auto", "pallas", "xla"), impl
     _ATTN_IMPL = impl
+
+
+def set_attention_blocks(block_q: int, block_k: int) -> None:
+    """Override the Pallas kernel block sizes (must divide the seq len)."""
+    global _BLOCK_Q, _BLOCK_K
+    _BLOCK_Q, _BLOCK_K = block_q, block_k
+
+
+def attention_impl_label() -> str:
+    """What ``flash_attention`` will actually dispatch on this backend —
+    public so benchmarks don't reach into module privates."""
+    on_tpu = jax.default_backend() == "tpu"
+    return "pallas" if on_tpu and _ATTN_IMPL != "xla" else "xla"
 
 
 def flash_attention(
@@ -413,18 +488,21 @@ def flash_attention(
 ) -> jax.Array:
     """Multi-head attention, q/k/v: [B, H, S, D].
 
-    GQA (fewer kv heads) is handled by repeating kv heads before dispatch.
+    GQA (fewer kv heads): the Pallas kernel maps q head i onto kv head
+    i // group natively — no repeated k/v in memory; the XLA reference
+    repeats heads before dispatch.
     """
     d = q.shape[-1]
     scale = scale if scale is not None else d ** -0.5
-    if k.shape[1] != q.shape[1]:
-        reps = q.shape[1] // k.shape[1]
-        k = jnp.repeat(k, reps, axis=1)
-        v = jnp.repeat(v, reps, axis=1)
     on_tpu = jax.default_backend() == "tpu"
     use_pallas = force_pallas or (on_tpu and _ATTN_IMPL != "xla")
     if use_pallas:
         return _flash_diff(
-            q, k, v, causal, scale, interpret or not on_tpu
+            q, k, v, causal, scale, interpret or not on_tpu,
+            _BLOCK_Q, _BLOCK_K,
         )
+    if k.shape[1] != q.shape[1]:
+        reps = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, reps, axis=1)
+        v = jnp.repeat(v, reps, axis=1)
     return attention_reference(q, k, v, causal, scale)

@@ -75,11 +75,28 @@ class ChipInfo:
     def uuids(self) -> list[str]:
         return [self.uuid]
 
+    def submesh_tile_id(self, tx: int, ty: int, tz: int = 1) -> str:
+        """Identity of the axis-aligned (tx, ty, tz) tile this chip's
+        coordinate falls in, scoped to the slice.
+
+        Published as an attribute so a stock scheduler can enforce ICI
+        contiguity with nothing but ``matchAttribute``: every chip with the
+        same tile id is, by construction, part of one contiguous sub-mesh
+        (the TPU analog of MIG placement constraints,
+        demo/specs/quickstart/gpu-test4.yaml:42-44). Aligned tiles partition
+        the slice, so tile-equality claims can never straddle a gap.
+        """
+        c = self.coord
+        return (
+            f"{self.slice_id}:{tx}x{ty}x{tz}:"
+            f"{c.x // tx}-{c.y // ty}-{c.z // tz}"
+        )
+
     def get_device(self) -> dict[str, Any]:
         """Render as a resource.k8s.io Device (deviceinfo.go:98-140 analog)."""
         spec = GENERATIONS.get(self.generation)
         peak_flops = int(spec.peak_bf16_flops) if spec else 0
-        return {
+        dev = {
             "name": self.canonical_name(),
             "basic": {
                 "attributes": {
@@ -96,6 +113,8 @@ class ChipInfo:
                     "sliceTopology": _attr(str(self.slice_topology)),
                     "hostId": _attr(self.host_id),
                     "hostsPerSlice": _attr(self.hosts_per_slice),
+                    "submesh2x2Id": _attr(self.submesh_tile_id(2, 2, 1)),
+                    "submesh4x4Id": _attr(self.submesh_tile_id(4, 4, 1)),
                     "pcieAddress": _attr(self.pci_address),
                     "numaNode": _attr(self.numa_node),
                     "driverVersion": _version_attr(self.driver_version),
@@ -108,6 +127,22 @@ class ChipInfo:
                 },
             },
         }
+        if self.cores >= 2:
+            # A whole-chip claim drains the chip's counter set, so the
+            # scheduler cannot also hand out this chip's TensorCore
+            # partitions (and vice versa). The reference encodes the same
+            # exclusivity via MIG memory-slice capacities
+            # (deviceinfo.go:184-198).
+            dev["basic"]["consumesCounters"] = [
+                {
+                    "counterSet": f"chip-{self.index}-counters",
+                    "counters": {
+                        "cores": {"value": str(self.cores)},
+                        "hbm": {"value": str(self.hbm_bytes)},
+                    },
+                }
+            ]
+        return dev
 
 
 @dataclasses.dataclass

@@ -1,0 +1,266 @@
+"""CEL-subset evaluator tests + demo specs executed through the sim.
+
+The reference's CEL selectors are evaluated only by the real scheduler
+(gpu-test6.yaml:22-31); here the demo specs' selectors run against
+published slices hermetically.
+"""
+
+import glob
+import os
+
+import pytest
+import yaml
+
+from k8s_dra_driver_tpu.kube import RESOURCE_SLICES, FakeKubeClient
+from k8s_dra_driver_tpu.kube.allocator import (
+    AllocationError,
+    ReferenceAllocator,
+)
+from k8s_dra_driver_tpu.kube.cel import CelError, evaluate
+from k8s_dra_driver_tpu.kube.resourceslice import (
+    DriverResources,
+    Pool,
+    ResourceSliceController,
+)
+from k8s_dra_driver_tpu.tpulib import FakeChipLib
+from k8s_dra_driver_tpu.tpulib.deviceinfo import counter_sets
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DRIVER = "tpu.google.com"
+
+ATTRS = {
+    "type": {"string": "chip"},
+    "generation": {"string": "v5p"},
+    "coord": {"string": "0,1,0"},
+    "iciX": {"int": 0},
+    "iciY": {"int": 1},
+    "iciZ": {"int": 0},
+    "cores": {"int": 2},
+}
+
+
+def ev(expr, attrs=None, capacity=None):
+    return evaluate(expr, DRIVER, attrs or ATTRS, capacity)
+
+
+class TestCelEvaluator:
+    def test_string_eq(self):
+        assert ev("device.attributes['tpu.google.com'].generation == 'v5p'")
+        assert not ev("device.attributes['tpu.google.com'].generation == 'v4'")
+
+    def test_driver_member(self):
+        assert ev("device.driver == 'tpu.google.com'")
+        assert not ev("device.driver == 'gpu.nvidia.com'")
+
+    def test_int_comparisons(self):
+        assert ev("device.attributes['tpu.google.com'].iciX < 2")
+        assert ev("device.attributes['tpu.google.com'].iciY <= 1")
+        assert ev("device.attributes['tpu.google.com'].cores >= 2")
+        assert not ev("device.attributes['tpu.google.com'].iciY > 1")
+        assert ev("device.attributes['tpu.google.com'].iciZ != 1")
+
+    def test_conjunction_disjunction_negation(self):
+        assert ev(
+            "device.attributes['tpu.google.com'].generation == 'v5p' && "
+            "device.attributes['tpu.google.com'].coord == '0,1,0'"
+        )
+        assert ev(
+            "device.attributes['tpu.google.com'].generation == 'v4' || "
+            "device.attributes['tpu.google.com'].iciX == 0"
+        )
+        assert ev("!(device.attributes['tpu.google.com'].iciX == 3)")
+
+    def test_in_operator(self):
+        assert ev(
+            "device.attributes['tpu.google.com'].generation in ['v4', 'v5p']"
+        )
+        assert not ev(
+            "device.attributes['tpu.google.com'].generation in ['v4', 'v5e']"
+        )
+
+    def test_parentheses_precedence(self):
+        # && binds tighter than ||.
+        assert ev(
+            "device.attributes['tpu.google.com'].iciX == 1 && "
+            "device.attributes['tpu.google.com'].iciY == 9 || "
+            "device.attributes['tpu.google.com'].iciZ == 0"
+        )
+        assert not ev(
+            "device.attributes['tpu.google.com'].iciX == 1 && ("
+            "device.attributes['tpu.google.com'].iciY == 9 || "
+            "device.attributes['tpu.google.com'].iciZ == 0)"
+        )
+
+    def test_missing_attribute_no_match(self):
+        assert not ev("device.attributes['tpu.google.com'].nosuch == 1")
+
+    def test_missing_absorbed_by_or_true(self):
+        # CEL's commutative ||: a true operand absorbs the other side's
+        # missing-attribute error.
+        assert ev(
+            "device.attributes['tpu.google.com'].nosuch == 1 || "
+            "device.attributes['tpu.google.com'].iciX == 0"
+        )
+
+    def test_missing_absorbed_by_and_false(self):
+        assert not ev(
+            "device.attributes['tpu.google.com'].nosuch == 1 && "
+            "device.attributes['tpu.google.com'].iciX == 3"
+        )
+
+    def test_foreign_domain_is_missing(self):
+        assert not ev("device.attributes['gpu.nvidia.com'].type == 'chip'")
+
+    def test_capacity_access(self):
+        cap = {"hbm": {"value": "1024"}}
+        assert ev(
+            "device.capacity['tpu.google.com'].hbm == '1024'", capacity=cap
+        )
+
+    def test_bad_syntax_raises(self):
+        with pytest.raises(CelError):
+            ev("device.attributes[")
+        with pytest.raises(CelError):
+            ev("frobnicate == 1")
+
+
+def load_device_classes():
+    """DeviceClass name -> CEL expressions from the shipped manifests."""
+    out = {}
+    path = os.path.join(REPO, "deployments/manifests/deviceclasses.yaml")
+    with open(path) as f:
+        for doc in yaml.safe_load_all(f):
+            if doc and doc.get("kind") == "DeviceClass":
+                out[doc["metadata"]["name"]] = [
+                    s["cel"]["expression"]
+                    for s in doc["spec"].get("selectors", [])
+                ]
+    return out
+
+
+def spec_requests(path):
+    """All (requests, constraints) device specs from a demo YAML."""
+    out = []
+    with open(os.path.join(REPO, path)) as f:
+        for doc in yaml.safe_load_all(f):
+            if not doc:
+                continue
+            if doc.get("kind") == "ResourceClaimTemplate":
+                out.append(doc["spec"]["spec"]["devices"])
+            elif doc.get("kind") == "ResourceClaim":
+                out.append(doc["spec"]["devices"])
+    return out
+
+
+@pytest.fixture
+def published(tmp_path):
+    """A 4x4 v5p node's slices published through the real controller."""
+    client = FakeKubeClient()
+    lib = FakeChipLib(generation="v5p", topology="4x4x1", slice_id="s1")
+    lib.init()
+    devices = lib.enumerate_all_possible_devices({"chip", "tensorcore"})
+    ctrl = ResourceSliceController(
+        client,
+        driver_name=DRIVER,
+        scope="node-a",
+        owner={"kind": "Node", "name": "node-a", "uid": "u1"},
+    )
+    ctrl.update(
+        DriverResources(
+            pools={
+                "node-a": Pool(
+                    node_name="node-a",
+                    devices=[d.get_device() for d in devices.values()],
+                    shared_counters=counter_sets(devices),
+                )
+            }
+        )
+    )
+    ctrl.sync_once()
+    assert client.list(RESOURCE_SLICES)
+    return client
+
+
+class TestDemoSpecsExecute:
+    """The CEL specs run THROUGH the allocator, not parse-only."""
+
+    def test_tpu_test6_origin_pin(self, published):
+        """First tpu-test6 claim: CEL pins coord 0,0,0 — re-claiming the
+        same spec must fail because exactly one device satisfies it."""
+        alloc = ReferenceAllocator(
+            published, device_classes=load_device_classes()
+        )
+        origin = spec_requests("demo/specs/quickstart/tpu-test6.yaml")[0]
+        claim = {
+            "metadata": {"name": "t6-0", "namespace": "d", "uid": "t6-0"},
+            "spec": {"devices": origin},
+        }
+        alloc.allocate(claim)
+        assert len(claim["status"]["allocation"]["devices"]["results"]) == 1
+        with pytest.raises(AllocationError):
+            alloc.allocate(
+                {
+                    "metadata": {"name": "again", "namespace": "d",
+                                 "uid": "again"},
+                    "spec": {"devices": origin},
+                }
+            )
+
+    def test_tpu_test6_quadrant_is_enforced(self, published):
+        """Second tpu-test6 claim (count=4, iciX<2 && iciY<2) takes exactly
+        the 2x2 origin quadrant; a second gang cannot be satisfied even
+        though 12 chips remain outside it."""
+        alloc = ReferenceAllocator(
+            published, device_classes=load_device_classes()
+        )
+        quadrant = spec_requests("demo/specs/quickstart/tpu-test6.yaml")[1]
+        claim = {
+            "metadata": {"name": "q-0", "namespace": "d", "uid": "q-0"},
+            "spec": {"devices": quadrant},
+        }
+        alloc.allocate(claim)
+        results = claim["status"]["allocation"]["devices"]["results"]
+        assert len(results) == 4
+        # Every pick obeys the CEL quadrant bound.
+        for s in published.list(RESOURCE_SLICES):
+            for d in s["spec"].get("devices", []):
+                if any(d["name"] == r["device"] for r in results):
+                    attrs = d["basic"]["attributes"]
+                    assert attrs["iciX"]["int"] < 2
+                    assert attrs["iciY"]["int"] < 2
+        with pytest.raises(AllocationError):
+            alloc.allocate(
+                {
+                    "metadata": {"name": "q-1", "namespace": "d",
+                                 "uid": "q-1"},
+                    "spec": {"devices": quadrant},
+                }
+            )
+
+    def test_tpu_test7_gang_contiguous(self, published):
+        alloc = ReferenceAllocator(
+            published, device_classes=load_device_classes()
+        )
+        spec = spec_requests("demo/specs/quickstart/tpu-test7.yaml")[0]
+        claim = {
+            "metadata": {"name": "t7", "namespace": "d", "uid": "t7"},
+            "spec": {"devices": spec},
+        }
+        alloc.allocate(claim)
+        assert len(claim["status"]["allocation"]["devices"]["results"]) == 4
+
+    def test_deviceclass_cel_distinguishes_types(self, published):
+        """With real DeviceClass CEL, a tensorcore claim never receives a
+        whole chip and vice versa."""
+        alloc = ReferenceAllocator(
+            published, device_classes=load_device_classes()
+        )
+        claim = {
+            "metadata": {"name": "c", "namespace": "d", "uid": "c"},
+            "spec": {"devices": {"requests": [
+                {"name": "r", "deviceClassName": "tensorcore.tpu.google.com"},
+            ]}},
+        }
+        alloc.allocate(claim)
+        dev = claim["status"]["allocation"]["devices"]["results"][0]["device"]
+        assert "-core-" in dev

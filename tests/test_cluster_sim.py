@@ -235,6 +235,111 @@ class TestClusterSim:
         with pytest.raises(AllocationError):
             alloc.allocate(c2, selectors=sel)
 
+    def test_counter_sets_block_chip_core_double_booking(self, cluster):
+        """tpu-test4's promise made true: a whole-chip claim drains the
+        chip's counter set, so that chip's TensorCore partitions cannot also
+        be granted — and vice versa — until deallocation."""
+        client, drivers, mgr = cluster
+        alloc = ReferenceAllocator(client)
+        whole = make_claim_obj(
+            "cnt-uid-1", "whole",
+            [{"name": "chip", "deviceClassName": "tpu.google.com"}],
+        )
+        alloc.allocate(
+            whole, selectors={"chip": [Selector("coord", "eq", "0,0,0")]}
+        )
+        idx = None
+        for s in client.list(RESOURCE_SLICES):
+            for d in s["spec"].get("devices", []):
+                if d["name"] == whole["status"]["allocation"]["devices"][
+                    "results"
+                ][0]["device"]:
+                    idx = d["basic"]["attributes"]["index"]["int"]
+        assert idx is not None
+        core = make_claim_obj(
+            "cnt-uid-2", "core",
+            [{"name": "core",
+              "deviceClassName": "tensorcore.tpu.google.com"}],
+        )
+        pin = {"core": [Selector("parentIndex", "eq", idx)]}
+        with pytest.raises(AllocationError):
+            alloc.allocate(core, selectors=pin, node_name="node-a")
+        # Freeing the whole-chip claim releases the counters.
+        alloc.deallocate("cnt-uid-1")
+        alloc.allocate(core, selectors=pin, node_name="node-a")
+
+        # Reverse direction: one core held -> whole chip blocked.
+        whole2 = make_claim_obj(
+            "cnt-uid-3", "whole2",
+            [{"name": "chip", "deviceClassName": "tpu.google.com"}],
+        )
+        with pytest.raises(AllocationError):
+            alloc.allocate(
+                whole2,
+                selectors={"chip": [Selector("index", "eq", idx)]},
+                node_name="node-a",
+            )
+
+    def test_gang_must_be_contiguous_submesh(self, cluster):
+        """A fragmented multi-chip pick is rejected: chips (0,0) and (2,0)
+        are not ICI neighbours, (0,0)+(1,0) are."""
+        client, drivers, mgr = cluster
+        alloc = ReferenceAllocator(client)
+        frag = make_claim_obj(
+            "gang-uid-1", "fragmented",
+            [{"name": "gang", "deviceClassName": "tpu.google.com",
+              "count": 2}],
+        )
+        with pytest.raises(AllocationError):
+            alloc.allocate(
+                frag,
+                selectors={"gang": [
+                    Selector("coord", "in", ["0,0,0", "2,0,0"])
+                ]},
+            )
+        ok = make_claim_obj(
+            "gang-uid-2", "adjacent",
+            [{"name": "gang", "deviceClassName": "tpu.google.com",
+              "count": 2}],
+        )
+        alloc.allocate(
+            ok,
+            selectors={"gang": [
+                Selector("coord", "in", ["0,0,0", "1,0,0"])
+            ]},
+        )
+        assert len(ok["status"]["allocation"]["devices"]["results"]) == 2
+
+    def test_submesh_tile_attribute_gangs_2x2(self, cluster):
+        """matchAttribute on the published submesh2x2Id yields a contiguous
+        2x2 gang — the mechanism a stock scheduler can use."""
+        client, drivers, mgr = cluster
+        alloc = ReferenceAllocator(client)
+        claim = make_claim_obj(
+            "gang-uid-3", "tile",
+            [{"name": "gang", "deviceClassName": "tpu.google.com",
+              "count": 4}],
+            constraints=[{"requests": ["gang"],
+                          "matchAttribute": "tpu.google.com/submesh2x2Id"}],
+        )
+        alloc.allocate(claim)
+        results = claim["status"]["allocation"]["devices"]["results"]
+        assert len(results) == 4
+        # All four in one tile -> one contiguous 2x2 (spans both hosts'
+        # pools on this 4x2 slice or sits in one, either is contiguous).
+        devs = []
+        for s in client.list(RESOURCE_SLICES):
+            for d in s["spec"].get("devices", []):
+                for r in results:
+                    if d["name"] == r["device"] and s["spec"].get(
+                        "pool", {}
+                    ).get("name") == r["pool"]:
+                        devs.append(d)
+        tiles = {
+            d["basic"]["attributes"]["submesh2x2Id"]["string"] for d in devs
+        }
+        assert len(tiles) == 1, tiles
+
     def test_tensorcore_same_parent_constraint(self, cluster):
         """tpu-test4: two core partitions forced onto one chip."""
         client, drivers, mgr = cluster

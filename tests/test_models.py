@@ -72,6 +72,30 @@ class TestForward:
         l2 = forward(params, t, TINY, remat=True)
         np.testing.assert_allclose(l1, l2, atol=1e-6)
 
+    def test_remat_policies_same_grads(self):
+        """Every remat policy is a pure memory/compute tradeoff: loss and
+        grads must be bit-comparable to the unremat'd forward."""
+        from k8s_dra_driver_tpu.models.llama import loss_fn
+
+        params = init_params(TINY, jax.random.PRNGKey(0))
+        t = tokens(2, 33)
+        ref_l, ref_g = jax.value_and_grad(
+            lambda p: loss_fn(p, t, TINY, remat=False)
+        )(params)
+        for policy in ("full", "flash", "flash_qkv", "flash_mlp"):
+            l, g = jax.value_and_grad(
+                lambda p: loss_fn(p, t, TINY, remat=True, remat_policy=policy)
+            )(params)
+            np.testing.assert_allclose(float(l), float(ref_l), rtol=1e-6)
+            for (ka, a), (kb, b) in zip(
+                jax.tree_util.tree_leaves_with_path(ref_g),
+                jax.tree_util.tree_leaves_with_path(g),
+            ):
+                np.testing.assert_allclose(
+                    np.array(a), np.array(b), atol=1e-6, rtol=1e-4,
+                    err_msg=f"{policy}: {ka}",
+                )
+
     def test_chunked_ce_matches_naive(self):
         from k8s_dra_driver_tpu.models.llama import chunked_cross_entropy
 
@@ -135,11 +159,14 @@ class TestShardedTraining:
     def test_params_actually_sharded(self, mesh):
         opt = make_optimizer()
         state = init_train_state(TINY, mesh, opt)
-        wq = state.params["layers"]["wq"]
-        shards = wq.sharding.device_set
+        wqkv = state.params["layers"]["wqkv"]
+        shards = wqkv.sharding.device_set
         assert len(shards) == 8  # placed across the whole mesh
-        # tensor axis shards the last dim: local shard smaller than global.
-        assert wq.addressable_shards[0].data.shape[-1] == wq.shape[-1] // 2
+        # tensor axis shards the kv-head dim (axis 2) of the fused weight:
+        # local shard smaller than global.
+        assert (
+            wqkv.addressable_shards[0].data.shape[2] == wqkv.shape[2] // 2
+        )
 
     def test_eval_step(self, mesh):
         opt = make_optimizer()

@@ -68,6 +68,34 @@ class TestFlashAttention:
 
 
 class TestFlashAttentionGrad:
+    def test_gqa_grads_match_reference(self):
+        """GQA-native dk/dv accumulate across the q-head group inside the
+        kernel; must equal AD through repeat+reference (which sums dk over
+        the group)."""
+        b, hq, hkv, s, d = 1, 4, 2, 128, 32
+        q = rand(b, hq, s, d, seed=0)
+        k = rand(b, hkv, s, d, seed=1)
+        v = rand(b, hkv, s, d, seed=2)
+
+        def loss_flash(q, k, v):
+            out = flash_attention(
+                q, k, v, causal=True, force_pallas=True, interpret=True
+            )
+            return jnp.sum(out * out)
+
+        def loss_ref(q, k, v):
+            g = hq // hkv
+            out = attention_reference(
+                q, jnp.repeat(k, g, axis=1), jnp.repeat(v, g, axis=1),
+                causal=True,
+            )
+            return jnp.sum(out * out)
+
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g1, g2):
+            np.testing.assert_allclose(a, b_, atol=5e-4, rtol=5e-4)
+
     def test_grads_match_reference(self):
         """custom_vjp backward must match AD through the reference."""
         b, h, s, d = 1, 2, 128, 32
