@@ -1,0 +1,22 @@
+# Build/test entrypoints (reference: Makefile:58-102).
+IMAGE ?= tpu-dra-driver
+TAG ?= latest
+
+.PHONY: all native test image lint clean
+
+all: native
+
+native:
+	$(MAKE) -C k8s_dra_driver_tpu/native
+
+test: native
+	python -m pytest tests/ -q
+
+lint:
+	python -m compileall -q k8s_dra_driver_tpu tests bench.py __graft_entry__.py
+
+image:
+	docker build -t $(IMAGE):$(TAG) -f deployments/container/Dockerfile .
+
+clean:
+	$(MAKE) -C k8s_dra_driver_tpu/native clean

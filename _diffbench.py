@@ -1,5 +1,5 @@
-"""Differential timing: where does the step time go?"""
-import time, sys
+"""Differential timing: where does the step time go? (params passed as args)"""
+import time
 import jax, jax.numpy as jnp
 from k8s_dra_driver_tpu.models.llama import (
     PRESETS, init_params, loss_fn, forward, chunked_cross_entropy)
@@ -13,31 +13,26 @@ toks = [jax.random.randint(jax.random.PRNGKey(100+i), (batch, seq+1), 0, config.
 jax.block_until_ready(toks)
 
 def timeit(name, fn):
-    r = fn(toks[0]); jax.block_until_ready(r)   # compile
+    r = fn(params, toks[0]); jax.block_until_ready(r)   # compile
     t0 = time.perf_counter()
-    outs = []
     for t in toks[1:4]:
-        r = fn(t)
-        outs.append(float(jax.tree_util.tree_leaves(r)[0].ravel()[0]))
+        r = fn(params, t)
+        float(jax.tree_util.tree_leaves(r)[0].ravel()[0])
     dt = (time.perf_counter() - t0) / 3
     print(f"{name}: {dt*1e3:.1f} ms", flush=True)
-    return dt
 
-# 1. Full grad step (flash policy) — the bench number.
 grad_fn = jax.jit(jax.value_and_grad(
     lambda p, t: loss_fn(p, t, config, remat=True, remat_policy="flash")))
-timeit("grad_full", lambda t: grad_fn(params, t))
+timeit("grad_full", grad_fn)
 
-# 2. Forward-only (hidden states, no CE).
-fwd = jax.jit(lambda t: forward(params, t[:, :-1], config, return_hidden=True))
+fwd = jax.jit(lambda p, t: forward(p, t[:, :-1], config, return_hidden=True))
 timeit("fwd_hidden", fwd)
 
-# 3. Forward + chunked CE (no grad).
-fl = jax.jit(lambda t: loss_fn(params, t, config, remat=False))
+fl = jax.jit(lambda p, t: loss_fn(p, t, config, remat=False))
 timeit("fwd_loss", fl)
 
-# 4. CE grad alone (hidden fixed).
-hidden = fwd(toks[0]); jax.block_until_ready(hidden)
 ce = jax.jit(jax.grad(
-    lambda h, t: chunked_cross_entropy(h, params["lm_head"], t[:, 1:])))
-timeit("ce_grad", lambda t: ce(hidden, t))
+    lambda p, t, h: chunked_cross_entropy(h, p["lm_head"], t[:, 1:]),
+    argnums=2))
+hidden = fwd(params, toks[0]); jax.block_until_ready(hidden)
+timeit("ce_grad_wrt_hidden", lambda p, t: ce(p, t, hidden))

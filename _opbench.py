@@ -1,0 +1,48 @@
+"""Per-op efficiency on the chip: isolate matmul vs flash kernel."""
+import time
+import jax, jax.numpy as jnp
+from k8s_dra_driver_tpu.ops.attention import flash_attention, set_attention_blocks
+
+PEAK = 197e12
+
+def timeit(fn, args, flops, name, n=6):
+    outs = fn(*args); jax.block_until_ready(outs)
+    t0 = time.perf_counter()
+    for i in range(n):
+        outs = fn(*args)
+        jax.block_until_ready(outs)
+    dt = (time.perf_counter() - t0) / n
+    print(f"{name}: {dt*1e3:.2f} ms  {flops/dt/1e12:.1f} TF/s  "
+          f"{flops/dt/PEAK*100:.1f}% peak", flush=True)
+
+k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+
+# Big matmul like gate/up: [16384, 2048] x [2048, 16384]
+a = jax.random.normal(k1, (16384, 2048), jnp.bfloat16)
+b = jax.random.normal(k2, (2048, 16384), jnp.bfloat16)
+mm = jax.jit(lambda a, b: a @ b)
+timeit(mm, (a, b), 2*16384*2048*16384, "matmul_16k_2k_16k")
+
+# matmul with 64-wide output (qkv-head-dim shape): [16384,2048]x[2048,64]
+b64 = jax.random.normal(k2, (2048, 64), jnp.bfloat16)
+mm64 = jax.jit(lambda a, b: a @ b)
+timeit(mm64, (a, b64), 2*16384*2048*64, "matmul_N64")
+
+# einsum like fused qkv: bth,hkgd->btkgd
+w = jax.random.normal(k2, (2048, 8, 6, 64), jnp.bfloat16)
+x = jax.random.normal(k1, (8, 2048, 2048), jnp.bfloat16)
+qkv = jax.jit(lambda x, w: jnp.einsum("bth,hkgd->btkgd", x, w))
+timeit(qkv, (x, w), 2*8*2048*2048*8*6*64, "einsum_qkv")
+
+# flash attention fwd (b8 h32 s2048 d64, causal), pallas
+set_attention_blocks(512, 2048)
+q = jax.random.normal(k1, (8, 32, 2048, 64), jnp.bfloat16)
+kk = jax.random.normal(k2, (8, 8, 2048, 64), jnp.bfloat16)
+vv = jax.random.normal(k3, (8, 8, 2048, 64), jnp.bfloat16)
+fa = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True, force_pallas=True))
+attn_flops = 2 * 2 * 8 * 32 * 2048 * 2048 * 64 * 0.5
+timeit(fa, (q, kk, vv), attn_flops, "flash_fwd_pallas")
+
+# flash fwd+bwd
+fab = jax.jit(jax.grad(lambda q, k, v: flash_attention(q, k, v, causal=True, force_pallas=True).astype(jnp.float32).sum(), argnums=(0,1,2)))
+timeit(fab, (q, kk, vv), attn_flops*3.5, "flash_fwd_bwd_pallas")

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Create a kind cluster ready for the TPU DRA driver
+# (reference: demo/clusters/kind/create-cluster.sh).
+set -euo pipefail
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+
+CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra}"
+
+kind create cluster \
+  --name "${CLUSTER_NAME}" \
+  --config "${SCRIPT_DIR}/kind-cluster-config.yaml"
+
+kubectl cluster-info --context "kind-${CLUSTER_NAME}"
+echo "cluster ${CLUSTER_NAME} ready; next: ./install-dra-driver.sh"

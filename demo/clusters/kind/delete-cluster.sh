@@ -1,0 +1,4 @@
+#!/usr/bin/env bash
+set -euo pipefail
+CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra}"
+kind delete cluster --name "${CLUSTER_NAME}"

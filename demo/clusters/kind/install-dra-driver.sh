@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Build the driver image, load it into kind, and install the chart with a
+# fake 2x2 topology so the full DRA path (ResourceSlices -> scheduler ->
+# NodePrepareResources -> CDI) runs without TPU hardware
+# (reference: demo/clusters/kind/install-dra-driver.sh).
+set -euo pipefail
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+REPO_ROOT="$(cd "${SCRIPT_DIR}/../../.." && pwd)"
+
+CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra}"
+IMAGE="${IMAGE:-tpu-dra-driver}"
+TAG="${TAG:-latest}"
+FAKE_TOPOLOGY="${FAKE_TOPOLOGY:-2x2x1}"
+
+docker build -t "${IMAGE}:${TAG}" \
+  -f "${REPO_ROOT}/deployments/container/Dockerfile" "${REPO_ROOT}"
+kind load docker-image --name "${CLUSTER_NAME}" "${IMAGE}:${TAG}"
+
+if command -v helm >/dev/null; then
+  helm upgrade --install tpu-dra-driver \
+    "${REPO_ROOT}/deployments/helm/tpu-dra-driver" \
+    --set image.repository="${IMAGE}" \
+    --set image.tag="${TAG}" \
+    --set plugin.fakeTopology="${FAKE_TOPOLOGY}"
+else
+  # Raw-manifest fallback: same objects, fixed values.
+  kubectl create namespace tpu-dra --dry-run=client -o yaml | kubectl apply -f -
+  kubectl apply -f "${REPO_ROOT}/deployments/manifests/"
+fi
+
+kubectl -n tpu-dra rollout status daemonset/tpu-dra-plugin --timeout=180s
+echo "driver installed; chips published:"
+kubectl get resourceslices -o wide

@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# End-to-end demo: claim one fake chip via a ResourceClaim and verify the
+# pod sees the driver-injected TPU environment (tpu-test1, gpu-test1
+# analog). One command from installed driver to asserted env.
+set -euo pipefail
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+REPO_ROOT="$(cd "${SCRIPT_DIR}/../../.." && pwd)"
+
+kubectl apply -f "${REPO_ROOT}/demo/specs/quickstart/tpu-test1.yaml"
+kubectl -n tpu-test1 wait pod --all --for=condition=Ready --timeout=180s || true
+kubectl -n tpu-test1 wait pod --all \
+  --for=jsonpath='{.status.phase}'=Succeeded --timeout=180s
+
+echo "--- pod log ---"
+kubectl -n tpu-test1 logs --tail=20 -l app=tpu-test1 --ignore-errors=true || \
+  kubectl -n tpu-test1 logs "$(kubectl -n tpu-test1 get pod -o name | head -1)"
+echo "demo OK: pod ran with a DRA-claimed TPU chip"

@@ -1,0 +1,11 @@
+{{/* Image reference */}}
+{{- define "tpu-dra-driver.image" -}}
+{{ .Values.image.repository }}:{{ .Values.image.tag | default .Chart.AppVersion }}
+{{- end -}}
+
+{{/* Common labels */}}
+{{- define "tpu-dra-driver.labels" -}}
+app.kubernetes.io/name: tpu-dra-driver
+app.kubernetes.io/version: {{ .Chart.AppVersion | quote }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- end -}}

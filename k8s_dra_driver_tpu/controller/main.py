@@ -25,6 +25,10 @@ def build_parser() -> argparse.ArgumentParser:
         prog="tpu-dra-controller",
         description="TPU DRA cluster controller (ICI channel publisher)",
     )
+    from ..version import version_string
+
+    p.add_argument("--version", action="version",
+                   version=version_string())
     p.add_argument("--driver-name", default=_env("DRIVER_NAME", "tpu.google.com"))
     p.add_argument("--pod-name", default=_env("POD_NAME", ""),
                    help="controller pod name, for slice ownerReferences [POD_NAME]")

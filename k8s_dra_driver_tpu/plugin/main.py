@@ -32,6 +32,10 @@ def build_parser() -> argparse.ArgumentParser:
         prog="tpu-dra-plugin",
         description="TPU DRA kubelet plugin (node agent)",
     )
+    from ..version import version_string
+
+    p.add_argument("--version", action="version",
+                   version=version_string())
     p.add_argument("--node-name", default=_env("NODE_NAME"),
                    help="name of the node this plugin runs on [NODE_NAME]")
     p.add_argument("--driver-name", default=_env("DRIVER_NAME", "tpu.google.com"),

@@ -139,9 +139,56 @@ class Registry:
         return "\n".join(lines) + "\n"
 
 
+def _dump_stacks() -> str:
+    """All thread stacks (pprof goroutine-profile analog)."""
+    import sys
+    import traceback
+
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in sys._current_frames().items():
+        out.append(f"--- thread {ident} ({names.get(ident, '?')}) ---")
+        out.extend(line.rstrip() for line in traceback.format_stack(frame))
+    return "\n".join(out) + "\n"
+
+
+def _sample_profile(seconds: float, hz: float = 100.0) -> str:
+    """Statistical CPU profile: sample every thread's stack for `seconds`,
+    report the hottest aggregated stacks (pprof CPU-profile analog —
+    cProfile only sees its own thread, so sampling is the stdlib way to
+    profile a multithreaded server in place)."""
+    import sys
+    import traceback
+    from collections import Counter as _Counter
+
+    period = 1.0 / hz
+    counts: _Counter = _Counter()
+    deadline = time.monotonic() + min(seconds, 60.0)
+    n = 0
+    me = threading.get_ident()
+    while time.monotonic() < deadline:
+        for ident, frame in sys._current_frames().items():
+            if ident == me:
+                continue
+            stack = tuple(
+                f"{fs.filename.rsplit('/', 1)[-1]}:{fs.lineno}:{fs.name}"
+                for fs in traceback.extract_stack(frame)[-8:]
+            )
+            counts[stack] += 1
+        n += 1
+        time.sleep(period)
+    out = [f"# {n} samples at {hz:g} Hz over {seconds:g}s", ""]
+    for stack, c in counts.most_common(30):
+        out.append(f"{c} samples ({100.0 * c / max(n, 1):.1f}%):")
+        out.extend(f"    {line}" for line in stack)
+        out.append("")
+    return "\n".join(out) + "\n"
+
+
 class MetricsServer:
-    """/metrics + /healthz on a background HTTP server
-    (SetupHTTPEndpoint analog, main.go:194-241)."""
+    """/metrics + /healthz + /version + /debug/{stacks,profile} on a
+    background HTTP server (SetupHTTPEndpoint analog, main.go:194-241,
+    incl. the pprof mux at main.go:216-224)."""
 
     def __init__(self, registry: Registry, host: str = "0.0.0.0", port: int = 0):
         self.registry = registry
@@ -150,19 +197,35 @@ class MetricsServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):
+                status = 200
                 if self.path == "/metrics":
                     body = registry_ref.render().encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type",
-                                     "text/plain; version=0.0.4")
+                    ctype = "text/plain; version=0.0.4"
                 elif self.path == "/healthz":
                     body = (b"ok" if health["ok"] else b"unhealthy")
-                    self.send_response(200 if health["ok"] else 503)
-                    self.send_header("Content-Type", "text/plain")
+                    status = 200 if health["ok"] else 503
+                    ctype = "text/plain"
+                elif self.path == "/version":
+                    from ..version import version_string
+
+                    body = (version_string() + "\n").encode()
+                    ctype = "text/plain"
+                elif self.path == "/debug/stacks":
+                    body = _dump_stacks().encode()
+                    ctype = "text/plain"
+                elif self.path.startswith("/debug/profile"):
+                    from urllib.parse import parse_qs, urlparse
+
+                    q = parse_qs(urlparse(self.path).query)
+                    secs = float(q.get("seconds", ["2"])[0])
+                    body = _sample_profile(secs).encode()
+                    ctype = "text/plain"
                 else:
                     body = b"not found"
-                    self.send_response(404)
-                    self.send_header("Content-Type", "text/plain")
+                    status = 404
+                    ctype = "text/plain"
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

@@ -95,6 +95,62 @@ class TestDemoSpecs:
                     assert claim["name"] in declared, (path, claim)
 
 
+class TestPackaging:
+    """Image + chart + kind scripts exist and are internally consistent
+    (round-1 gap: manifests referenced an unbuildable image)."""
+
+    def test_dockerfile_builds_both_entrypoints(self):
+        df = open(os.path.join(
+            REPO, "deployments/container/Dockerfile")).read()
+        assert "tpu-dra-plugin" in df
+        assert "libtpudiscovery.so" in df
+        assert "k8s_dra_driver_tpu/native" in df
+
+    def test_helm_chart_structure(self):
+        chart_dir = os.path.join(REPO, "deployments/helm/tpu-dra-driver")
+        chart = yaml.safe_load(open(os.path.join(chart_dir, "Chart.yaml")))
+        assert chart["name"] == "tpu-dra-driver"
+        values = yaml.safe_load(open(os.path.join(chart_dir, "values.yaml")))
+        assert set(values["deviceClasses"]) <= {"chip", "tensorcore", "ici"}
+        # The flags the templates pass must exist on the plugin CLI.
+        from k8s_dra_driver_tpu.plugin.main import build_parser
+
+        opts = {
+            o for a in build_parser()._actions for o in a.option_strings
+        }
+        tpl = open(os.path.join(
+            chart_dir, "templates/kubeletplugin.yaml")).read()
+        import re
+
+        for flag in re.findall(r"--[a-z][a-z-]+", tpl):
+            assert flag in opts, f"template passes unknown flag {flag}"
+        for tmpl in ("kubeletplugin.yaml", "controller.yaml",
+                     "deviceclasses.yaml", "validation.yaml"):
+            assert os.path.exists(os.path.join(chart_dir, "templates", tmpl))
+
+    def test_kind_scripts_valid_bash(self):
+        import subprocess
+
+        d = os.path.join(REPO, "demo/clusters/kind")
+        scripts = glob.glob(os.path.join(d, "*.sh"))
+        assert len(scripts) >= 4
+        for s in scripts:
+            assert os.access(s, os.X_OK), f"{s} not executable"
+            subprocess.run(["bash", "-n", s], check=True)
+        yaml.safe_load(open(os.path.join(d, "kind-cluster-config.yaml")))
+
+    def test_ci_workflow_parses(self):
+        wf = yaml.safe_load(open(os.path.join(
+            REPO, ".github/workflows/ci.yaml")))
+        assert "test" in wf["jobs"]
+        assert "kind-e2e" in wf["jobs"]
+
+    def test_version_module(self):
+        from k8s_dra_driver_tpu.version import VERSION, version_string
+
+        assert version_string().startswith(VERSION)
+
+
 class TestDeploymentManifests:
     def test_manifests_parse_and_have_rbac(self):
         kinds = [

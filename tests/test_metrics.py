@@ -64,3 +64,24 @@ class TestServer:
             assert urllib.request.urlopen(f"{base}/healthz").read() == b"ok"
         finally:
             srv.stop()
+
+    def test_version_and_debug_endpoints(self):
+        """pprof-analog endpoints (reference: main.go:216-224) + version."""
+        from k8s_dra_driver_tpu.version import version_string
+
+        r = Registry()
+        srv = MetricsServer(r, host="127.0.0.1", port=0)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            v = urllib.request.urlopen(f"{base}/version").read().decode()
+            assert v.strip() == version_string()
+            stacks = urllib.request.urlopen(
+                f"{base}/debug/stacks").read().decode()
+            # Our own serve_forever thread must show up.
+            assert "--- thread" in stacks and "serve_forever" in stacks
+            prof = urllib.request.urlopen(
+                f"{base}/debug/profile?seconds=0.2").read().decode()
+            assert "samples at" in prof
+        finally:
+            srv.stop()

@@ -1,0 +1,259 @@
+"""RealKubeClient against a stub HTTP API server.
+
+Round-1 gap: the REST client that actually runs in production had zero
+coverage (kube/client.py:394-526). The stub replays real API-server
+semantics: JSON wire format, 404, 409 with Status reason AlreadyExists vs
+Conflict, resourceVersion bumps, labelSelector filtering — so the error
+mapping and the poll-based watch are exercised over real HTTP.
+"""
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from k8s_dra_driver_tpu.kube.client import (
+    RESOURCE_SLICES,
+    RealKubeClient,
+    RestConfig,
+)
+from k8s_dra_driver_tpu.kube.errors import (
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+)
+
+
+class StubApiServer:
+    """Minimal resource.k8s.io API server over http.server."""
+
+    def __init__(self):
+        self.objects: dict[str, dict] = {}  # name -> obj (cluster-scoped)
+        self.rv = 0
+        self.requests: list[tuple[str, str]] = []  # (method, path)
+        self.auth_headers: list[str] = []
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            prefix = "/apis/resource.k8s.io/v1alpha3/resourceslices"
+
+            def _send(self, code: int, obj: dict):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _status(self, code: int, reason: str, msg: str = ""):
+                self._send(code, {
+                    "kind": "Status", "apiVersion": "v1", "status": "Failure",
+                    "reason": reason, "message": msg or reason, "code": code,
+                })
+
+            def _record(self):
+                stub.requests.append((self.command, self.path))
+                stub.auth_headers.append(self.headers.get("Authorization", ""))
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                return json.loads(self.rfile.read(n)) if n else {}
+
+            def do_GET(self):
+                self._record()
+                url = urllib.parse.urlparse(self.path)
+                if not url.path.startswith(self.prefix):
+                    return self._status(404, "NotFound", self.path)
+                rest = url.path[len(self.prefix):].strip("/")
+                if rest:
+                    obj = stub.objects.get(rest)
+                    if obj is None:
+                        return self._status(404, "NotFound", rest)
+                    return self._send(200, obj)
+                items = list(stub.objects.values())
+                q = urllib.parse.parse_qs(url.query)
+                sel = q.get("labelSelector", [""])[0]
+                if sel:
+                    k, _, v = sel.partition("=")
+                    items = [
+                        o for o in items
+                        if o["metadata"].get("labels", {}).get(k) == v
+                    ]
+                return self._send(200, {"kind": "ResourceSliceList",
+                                        "items": items})
+
+            def do_POST(self):
+                self._record()
+                obj = self._body()
+                name = obj["metadata"]["name"]
+                if name in stub.objects:
+                    return self._status(
+                        409, "AlreadyExists",
+                        f'resourceslices "{name}" already exists')
+                stub.rv += 1
+                obj["metadata"]["resourceVersion"] = str(stub.rv)
+                stub.objects[name] = obj
+                self._send(201, obj)
+
+            def do_PUT(self):
+                self._record()
+                obj = self._body()
+                name = obj["metadata"]["name"]
+                cur = stub.objects.get(name)
+                if cur is None:
+                    return self._status(404, "NotFound", name)
+                sent_rv = obj["metadata"].get("resourceVersion", "")
+                if sent_rv and sent_rv != cur["metadata"]["resourceVersion"]:
+                    return self._status(
+                        409, "Conflict",
+                        "the object has been modified")
+                stub.rv += 1
+                obj["metadata"]["resourceVersion"] = str(stub.rv)
+                stub.objects[name] = obj
+                self._send(200, obj)
+
+            def do_DELETE(self):
+                self._record()
+                name = self.path[len(self.prefix):].strip("/")
+                if name not in stub.objects:
+                    return self._status(404, "NotFound", name)
+                del stub.objects[name]
+                self._send(200, {"kind": "Status", "status": "Success"})
+
+            def log_message(self, *args):
+                pass
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._server.server_address[1]
+
+    def start(self):
+        threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        ).start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+@pytest.fixture
+def api():
+    stub = StubApiServer()
+    stub.start()
+    client = RealKubeClient(
+        RestConfig(host=f"http://127.0.0.1:{stub.port}", token="tok-123"),
+        poll_interval=0.05,
+    )
+    yield stub, client
+    stub.stop()
+
+
+def mkslice(name, labels=None):
+    return {
+        "apiVersion": "resource.k8s.io/v1alpha3",
+        "kind": "ResourceSlice",
+        "metadata": {"name": name, **({"labels": labels} if labels else {})},
+        "spec": {"driver": "tpu.google.com",
+                 "pool": {"name": "p", "generation": 1}},
+    }
+
+
+class TestRealClientCrud:
+    def test_create_get_list_delete(self, api):
+        stub, client = api
+        created = client.create(RESOURCE_SLICES, mkslice("s1"))
+        assert created["metadata"]["resourceVersion"] == "1"
+        got = client.get(RESOURCE_SLICES, "s1")
+        assert got["spec"]["driver"] == "tpu.google.com"
+        assert [o["metadata"]["name"]
+                for o in client.list(RESOURCE_SLICES)] == ["s1"]
+        client.delete(RESOURCE_SLICES, "s1")
+        with pytest.raises(NotFoundError):
+            client.get(RESOURCE_SLICES, "s1")
+
+    def test_bearer_token_sent(self, api):
+        stub, client = api
+        client.list(RESOURCE_SLICES)
+        assert stub.auth_headers[-1] == "Bearer tok-123"
+
+    def test_label_selector_passed_and_filtered(self, api):
+        stub, client = api
+        client.create(RESOURCE_SLICES, mkslice("a", {"scope": "x"}))
+        client.create(RESOURCE_SLICES, mkslice("b", {"scope": "y"}))
+        names = [o["metadata"]["name"]
+                 for o in client.list(RESOURCE_SLICES,
+                                      label_selector="scope=x")]
+        assert names == ["a"]
+
+    def test_409_already_exists_vs_conflict(self, api):
+        """The API server uses 409 for both duplicate creates and stale
+        updates; the client must map them to different exceptions."""
+        stub, client = api
+        client.create(RESOURCE_SLICES, mkslice("s1"))
+        with pytest.raises(AlreadyExistsError):
+            client.create(RESOURCE_SLICES, mkslice("s1"))
+        obj = client.get(RESOURCE_SLICES, "s1")
+        obj["metadata"]["resourceVersion"] = "999"
+        with pytest.raises(ConflictError):
+            client.update(RESOURCE_SLICES, obj)
+
+    def test_update_bumps_resource_version(self, api):
+        stub, client = api
+        client.create(RESOURCE_SLICES, mkslice("s1"))
+        obj = client.get(RESOURCE_SLICES, "s1")
+        out = client.update(RESOURCE_SLICES, obj)
+        assert int(out["metadata"]["resourceVersion"]) > 1
+
+    def test_update_missing_raises_not_found(self, api):
+        stub, client = api
+        with pytest.raises(NotFoundError):
+            client.update(RESOURCE_SLICES, mkslice("ghost"))
+
+
+class TestRealClientWatch:
+    def test_poll_watch_added_modified_deleted(self, api):
+        import time
+
+        stub, client = api
+        client.create(RESOURCE_SLICES, mkslice("s1"))
+        w = client.watch(RESOURCE_SLICES)
+        events = []
+        done = threading.Event()
+
+        def consume():
+            for ev in w.events():
+                events.append((ev.type, ev.object["metadata"]["name"]))
+                if len(events) >= 3:
+                    done.set()
+                    return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 5
+        while not events and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert ("ADDED", "s1") in events
+        obj = client.get(RESOURCE_SLICES, "s1")
+        obj["spec"]["pool"]["generation"] = 2
+        client.update(RESOURCE_SLICES, obj)
+        client.delete(RESOURCE_SLICES, "s1")
+        assert done.wait(5), events
+        w.stop()
+        assert ("MODIFIED", "s1") in events
+        assert ("DELETED", "s1") in events
+
+    def test_watch_survives_server_errors(self, api):
+        """Transient API failures must not kill the poll loop."""
+        import time
+
+        stub, client = api
+        w = client.watch(RESOURCE_SLICES)
+        time.sleep(0.1)
+        stub.stop()  # poll now fails
+        time.sleep(0.15)
+        # Restart on the same port is racy; instead just assert the thread
+        # is still alive and the watch is not stopped.
+        assert not w.stopped
+        w.stop()
