@@ -130,6 +130,7 @@ def main() -> int:
 
     from k8s_dra_driver_tpu.models.llama import REMAT_POLICIES
     from k8s_dra_driver_tpu.ops.attention import (
+        attention_blocks,
         attention_impl_label,
         set_attention_impl,
     )
@@ -157,6 +158,7 @@ def main() -> int:
         result = run_bench(preset, batch, seq, peak_flops, remat_policy)
         result["detail"]["attn"] = "xla"
     result["detail"]["remat"] = remat_policy
+    result["detail"]["blocks"] = "x".join(map(str, attention_blocks()))
     print(json.dumps(result))
     return 0
 

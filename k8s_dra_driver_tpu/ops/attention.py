@@ -315,9 +315,15 @@ def _flash_attention_bwd_pallas(
 ):
     b, h, s, d = q.shape
     hkv = k.shape[1]
+    assert h % hkv == 0, (
+        f"q heads ({h}) must be a multiple of kv heads ({hkv})"
+    )
     g = h // hkv
     block_q = min(block_q, s)
     block_k = min(block_k, s)
+    assert s % block_q == 0 and s % block_k == 0, (
+        f"seq len {s} must be a multiple of block sizes {block_q}/{block_k}"
+    )
     bh = b * h
     bhkv = b * hkv
     nq = s // block_q
@@ -452,9 +458,11 @@ _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 _ATTN_IMPL = os.environ.get("TPU_DRA_ATTN_IMPL", "auto")
 
 # Kernel block sizes, sweepable per generation (VMEM budget differs between
-# v5e and v5p). Defaults chosen by the v5e sweep in BENCH history.
+# v5e and v5p). Defaults are the v5e sweep winner (512x2048 at s2048; blocks
+# clamp to the seq len for shorter sequences, so the wide-K default is safe
+# everywhere S % 512 == 0).
 _BLOCK_Q = int(os.environ.get("TPU_DRA_ATTN_BLOCK_Q", "512"))
-_BLOCK_K = int(os.environ.get("TPU_DRA_ATTN_BLOCK_K", "512"))
+_BLOCK_K = int(os.environ.get("TPU_DRA_ATTN_BLOCK_K", "2048"))
 
 
 def set_attention_impl(impl: str) -> None:
@@ -475,6 +483,12 @@ def attention_impl_label() -> str:
     public so benchmarks don't reach into module privates."""
     on_tpu = jax.default_backend() == "tpu"
     return "pallas" if on_tpu and _ATTN_IMPL != "xla" else "xla"
+
+
+def attention_blocks() -> tuple[int, int]:
+    """The (block_q, block_k) the kernel will use (before seq-len clamping)
+    — public so benchmarks can record the config they actually measured."""
+    return _BLOCK_Q, _BLOCK_K
 
 
 def flash_attention(

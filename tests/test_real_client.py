@@ -147,6 +147,9 @@ def api():
         poll_interval=0.05,
     )
     yield stub, client
+    # Close the client FIRST: orphaned poll threads outliving the stub
+    # server spam connection-refused warnings through the rest of the suite.
+    client.close()
     stub.stop()
 
 
@@ -214,35 +217,42 @@ class TestRealClientCrud:
 
 class TestRealClientWatch:
     def test_poll_watch_added_modified_deleted(self, api):
+        """Each mutation waits for its event before the next one: the poll
+        watch diffs list snapshots, so an update+delete landing inside one
+        poll window legitimately coalesces to DELETED only — the sequence
+        is only observable when mutations land in separate poll cycles."""
         import time
 
         stub, client = api
         client.create(RESOURCE_SLICES, mkslice("s1"))
         w = client.watch(RESOURCE_SLICES)
         events = []
-        done = threading.Event()
 
         def consume():
             for ev in w.events():
                 events.append((ev.type, ev.object["metadata"]["name"]))
-                if len(events) >= 3:
-                    done.set()
-                    return
 
         t = threading.Thread(target=consume, daemon=True)
         t.start()
-        deadline = time.monotonic() + 5
-        while not events and time.monotonic() < deadline:
-            time.sleep(0.02)
-        assert ("ADDED", "s1") in events
-        obj = client.get(RESOURCE_SLICES, "s1")
-        obj["spec"]["pool"]["generation"] = 2
-        client.update(RESOURCE_SLICES, obj)
-        client.delete(RESOURCE_SLICES, "s1")
-        assert done.wait(5), events
-        w.stop()
-        assert ("MODIFIED", "s1") in events
-        assert ("DELETED", "s1") in events
+
+        def wait_for(ev, deadline_s=5.0):
+            deadline = time.monotonic() + deadline_s
+            while ev not in events and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert ev in events, events
+
+        try:
+            wait_for(("ADDED", "s1"))
+            obj = client.get(RESOURCE_SLICES, "s1")
+            obj["spec"]["pool"]["generation"] = 2
+            client.update(RESOURCE_SLICES, obj)
+            wait_for(("MODIFIED", "s1"))
+            client.delete(RESOURCE_SLICES, "s1")
+            wait_for(("DELETED", "s1"))
+        finally:
+            w.stop()
+        t.join(timeout=5)
+        assert not t.is_alive()
 
     def test_watch_survives_server_errors(self, api):
         """Transient API failures must not kill the poll loop."""
