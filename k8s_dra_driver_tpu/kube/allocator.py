@@ -17,11 +17,14 @@ the sim verbatim). The production path still uses the real scheduler.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
 from typing import Any, Callable, Optional
 
 from .cel import CelError, evaluate as cel_evaluate
 from .client import RESOURCE_SLICES, KubeClient
+
+logger = logging.getLogger(__name__)
 
 # DeviceClass name → the `type` attribute the node plugin publishes.
 DEVICE_CLASS_TYPES = {
@@ -131,6 +134,9 @@ class ReferenceAllocator:
         self._consumed: dict[tuple[str, str, str], int] = {}
         # claim uid -> [(pool, counter set, counter, amount)] for release.
         self._claim_consumption: dict[str, list[tuple[str, str, str, int]]] = {}
+        # (pool, device) pairs already warned about misconfigured counters,
+        # so a static slice defect is diagnosed once, not per allocate().
+        self._warned_invalid: set[tuple[str, str]] = set()
 
     # -- inventory ---------------------------------------------------------
 
@@ -173,6 +179,26 @@ class ReferenceAllocator:
                 for cname, cval in cs.get("counters", {}).items():
                     capacity[(pool["name"], cs["name"], cname)] = int(
                         cval["value"]
+                    )
+        # A device consuming a counter its slice never declared is a
+        # misconfigured slice; the upstream DRA allocator treats such a
+        # device as invalid. Flag it ONCE here — not in the solver's
+        # backtracking hot path, which would re-diagnose (and re-log) the
+        # same static defect per candidate probe.
+        for dev in devices:
+            missing = [
+                (cset, cname)
+                for _, cset, cname, _ in _consumption_entries(dev)
+                if (dev["pool"], cset, cname) not in capacity
+            ]
+            if missing:
+                dev["invalid"] = True
+                if (dev["pool"], dev["name"]) not in self._warned_invalid:
+                    self._warned_invalid.add((dev["pool"], dev["name"]))
+                    logger.warning(
+                        "device %r in pool %r consumes undeclared counters "
+                        "%s; treating device as unallocatable",
+                        dev["name"], dev["pool"], missing,
                     )
         return devices, capacity
 
@@ -249,11 +275,13 @@ class ReferenceAllocator:
         tentative: dict[tuple[str, str, str], int] = {}
 
         def counters_fit(dev) -> bool:
+            if dev.get("invalid"):
+                return False  # flagged (and logged) once by _inventory
             for pool, cset, cname, amount in _consumption_entries(dev):
                 key = (pool, cset, cname)
                 cap = capacity.get(key)
                 if cap is None:
-                    continue  # undeclared counter: unconstrained
+                    return False  # unreachable: _inventory flags these
                 used = self._consumed.get(key, 0) + tentative.get(key, 0)
                 if used + amount > cap:
                     return False

@@ -29,13 +29,29 @@ from __future__ import annotations
 import re
 from typing import Any, Callable
 
+from ..api.v1alpha1.quantity import InvalidQuantityError, parse_quantity
+
 
 class CelError(ValueError):
     pass
 
 
-class _Missing(Exception):
+class _EvalError(Exception):
+    """A runtime evaluation error (absent attribute, type mismatch).
+
+    CEL's commutative ``&&``/``||`` absorb these when the other operand
+    decides the result; one surviving to the top makes the device not
+    match. Python exceptions (e.g. TypeError from ``'str' >= int``) must
+    never escape ``evaluate`` — the round-2 advisor found exactly that
+    killing the allocator loop."""
+
+
+class _Missing(_EvalError):
     """An attribute referenced by the expression is absent on the device."""
+
+
+class _TypeMismatch(_EvalError):
+    """Operands of incompatible types reached a comparison operator."""
 
 
 _TOKEN_RE = re.compile(
@@ -73,11 +89,17 @@ def _tokenize(src: str) -> list[tuple[str, str]]:
 
 class _AttrMap:
     """``device.attributes['domain']`` — resolves unqualified attribute
-    names published by this driver, unwrapping the DRA value union."""
+    names published by this driver, unwrapping the DRA value union.
 
-    def __init__(self, attrs: dict, domain: str, want_domain: str):
+    Capacity maps additionally parse their quantity-string values to
+    integer bytes/counts, so ``device.capacity['d'].hbm >= 17179869184``
+    compares numerically the way real CEL compares Quantity values."""
+
+    def __init__(self, attrs: dict, domain: str, want_domain: str,
+                 is_capacity: bool = False):
         self._attrs = attrs
         self._match = domain == want_domain
+        self._is_capacity = is_capacity
 
     def get(self, name: str):
         if not self._match:
@@ -86,7 +108,14 @@ class _AttrMap:
         if raw is None:
             raise _Missing()
         if isinstance(raw, dict):
-            return next(iter(raw.values()))
+            if not raw:
+                raise _Missing()  # empty value union carries no value
+            raw = next(iter(raw.values()))
+        if self._is_capacity:
+            try:
+                return parse_quantity(raw)
+            except InvalidQuantityError:
+                return raw
         return raw
 
 
@@ -147,7 +176,7 @@ class _Parser:
                 try:
                     if bool(op()):
                         return True  # true absorbs errors (CEL or)
-                except _Missing as e:
+                except _EvalError as e:
                     err = e
             if err is not None:
                 raise err
@@ -169,7 +198,7 @@ class _Parser:
                 try:
                     if not bool(op()):
                         return False  # false absorbs errors (CEL and)
-                except _Missing as e:
+                except _EvalError as e:
                     err = e
             if err is not None:
                 raise err
@@ -194,6 +223,39 @@ class _Parser:
         "in": lambda a, b: a in b,
     }
 
+    @staticmethod
+    def _check_overload(op: str, a: Any, b: Any) -> None:
+        """Modern CEL (the cel-go runtime Kubernetes uses) defines
+        heterogeneous equality — ``1 == '1'`` is simply false, ``!=`` true
+        — so ==/!= fall through to Python semantics. Only the ORDERING
+        operators and ``in`` have no cross-type overloads: those raise an
+        evaluation error the logical operators may absorb."""
+        if op in ("==", "!="):
+            return
+
+        def cat(v: Any) -> str:
+            if isinstance(v, bool):  # before int: bool is an int subclass
+                return "bool"
+            if isinstance(v, (int, float)):
+                return "number"
+            if isinstance(v, str):
+                return "string"
+            if isinstance(v, list):
+                return "list"
+            return type(v).__name__
+
+        if op == "in":
+            if cat(b) != "list":
+                raise _TypeMismatch(
+                    f"'in' requires a list, got {cat(b)}"
+                )
+            return
+        if cat(a) != cat(b):
+            raise _TypeMismatch(
+                f"no matching overload for {op!r} applied to "
+                f"({cat(a)}, {cat(b)})"
+            )
+
     def cmp(self) -> Thunk:
         left = self.primary()
         _, tok = self.peek()
@@ -201,7 +263,22 @@ class _Parser:
             self.next()
             right = self.primary()
             fn = self._OPS[tok]
-            return lambda: fn(left(), right())
+
+            def run():
+                a, b = left(), right()
+                self._check_overload(tok, a, b)
+                try:
+                    return fn(a, b)
+                except TypeError:
+                    # Belt and braces: anything _check_overload missed is
+                    # still an evaluation error the logical operators may
+                    # absorb, never a Python crash.
+                    raise _TypeMismatch(
+                        f"no matching overload for {tok!r} applied to "
+                        f"({type(a).__name__}, {type(b).__name__})"
+                    ) from None
+
+            return run
         return left
 
     def primary(self) -> Thunk:
@@ -266,7 +343,7 @@ class _Parser:
                 if name == "driver":
                     return obj.driver
                 if name in ("attributes", "capacity"):
-                    return ("attrmap", getattr(obj, name))
+                    return ("attrmap", getattr(obj, name), name)
                 raise CelError(f"unknown device member {name!r}")
             if isinstance(obj, _AttrMap):
                 return obj.get(name)
@@ -280,7 +357,8 @@ class _Parser:
         def run():
             obj = v()
             if isinstance(obj, tuple) and obj and obj[0] == "attrmap":
-                return _AttrMap(obj[1], str(idx()), self.driver)
+                return _AttrMap(obj[1], str(idx()), self.driver,
+                                is_capacity=obj[2] == "capacity")
             raise CelError(f"cannot index {type(obj).__name__}")
 
         return run
@@ -299,5 +377,5 @@ def evaluate(
     thunk = _Parser(_tokenize(expression), driver, device).parse()
     try:
         return bool(thunk())
-    except _Missing:
+    except _EvalError:
         return False

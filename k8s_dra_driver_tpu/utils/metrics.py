@@ -217,9 +217,18 @@ class MetricsServer:
                     from urllib.parse import parse_qs, urlparse
 
                     q = parse_qs(urlparse(self.path).query)
-                    secs = float(q.get("seconds", ["2"])[0])
-                    body = _sample_profile(secs).encode()
-                    ctype = "text/plain"
+                    try:
+                        secs = float(q.get("seconds", ["2"])[0])
+                    except ValueError:
+                        body = b"bad seconds parameter\n"
+                        status = 400
+                        ctype = "text/plain"
+                    else:
+                        # NaN fails both bounds checks and lands on 2s.
+                        if not (0.0 <= secs <= 60.0):
+                            secs = min(max(secs, 0.0), 60.0) if secs == secs else 2.0
+                        body = _sample_profile(secs).encode()
+                        ctype = "text/plain"
                 else:
                     body = b"not found"
                     status = 404

@@ -111,10 +111,61 @@ class TestCelEvaluator:
     def test_foreign_domain_is_missing(self):
         assert not ev("device.attributes['gpu.nvidia.com'].type == 'chip'")
 
-    def test_capacity_access(self):
-        cap = {"hbm": {"value": "1024"}}
+    def test_capacity_compares_numerically(self):
+        # Capacity values are k8s Quantities; like real CEL they compare
+        # as numbers, including suffixed forms.
+        cap = {"hbm": {"value": "16Gi"}, "tensorcores": {"value": "2"}}
         assert ev(
-            "device.capacity['tpu.google.com'].hbm == '1024'", capacity=cap
+            "device.capacity['tpu.google.com'].hbm >= 17179869184",
+            capacity=cap,
+        )
+        assert ev(
+            "device.capacity['tpu.google.com'].tensorcores == 2",
+            capacity=cap,
+        )
+        assert not ev(
+            "device.capacity['tpu.google.com'].hbm < 1024", capacity=cap
+        )
+
+    def test_type_mismatch_is_eval_error_not_crash(self):
+        # 'str' >= int must not leak a Python TypeError out of evaluate()
+        # (round-2 advisor: it escaped cel_matches and killed the
+        # allocator loop). It behaves like a CEL no-overload error: the
+        # device simply doesn't match.
+        assert not ev(
+            "device.attributes['tpu.google.com'].generation >= 16"
+        )
+        # ...and the error is absorbed by a deciding || / && operand.
+        assert ev(
+            "device.attributes['tpu.google.com'].generation >= 16 || "
+            "device.attributes['tpu.google.com'].iciX == 0"
+        )
+        assert not ev(
+            "device.attributes['tpu.google.com'].generation >= 16 && "
+            "device.attributes['tpu.google.com'].iciY > 1"
+        )
+        # membership against a non-container is the same class of error
+        assert not ev(
+            "device.attributes['tpu.google.com'].iciX in "
+            "device.attributes['tpu.google.com'].cores"
+        )
+
+    def test_heterogeneous_equality(self):
+        # cel-go (the runtime Kubernetes uses) defines cross-type ==/!=:
+        # values of different types compare unequal, they don't error.
+        assert not ev("device.attributes['tpu.google.com'].cores == '2'")
+        assert ev("device.attributes['tpu.google.com'].cores != '2'")
+
+    def test_empty_value_union_is_missing(self):
+        # An empty DRA value-union dict carries no value: treated like an
+        # absent attribute, not a StopIteration crash.
+        attrs = dict(ATTRS, hollow={})
+        assert not ev(
+            "device.attributes['tpu.google.com'].hollow == 1", attrs=attrs
+        )
+        assert not ev(
+            "device.capacity['tpu.google.com'].hbm >= 1",
+            capacity={"hbm": {}},
         )
 
     def test_bad_syntax_raises(self):

@@ -280,6 +280,67 @@ class TestClusterSim:
                 node_name="node-a",
             )
 
+    def test_undeclared_consumed_counter_disqualifies_device(self):
+        """A device consuming a counter its slice never declared is
+        misconfigured: the upstream DRA allocator treats that device as
+        invalid (round-2 advisor) — but a broken device must not poison
+        allocation from healthy ones (round-3 review)."""
+        def chip(name, idx, consumes):
+            return {
+                "name": name,
+                "basic": {
+                    "attributes": {"type": {"string": "chip"},
+                                   "index": {"int": idx}},
+                    "capacity": {},
+                    "consumesCounters": consumes,
+                },
+            }
+
+        client = FakeKubeClient()
+        client.create(RESOURCE_SLICES, {
+            "apiVersion": "resource.k8s.io/v1beta1",
+            "kind": "ResourceSlice",
+            "metadata": {"name": "mixed-slice"},
+            "spec": {
+                "driver": "tpu.google.com",
+                "nodeName": "node-x",
+                "pool": {"name": "node-x", "generation": 1,
+                         "resourceSliceCount": 1},
+                # Only chip-1's counter set is declared; chip-0 consumes
+                # from a phantom one.
+                "sharedCounters": [{
+                    "name": "chip-1-counters",
+                    "counters": {"cores": {"value": "2"}},
+                }],
+                "devices": [
+                    chip("chip-0", 0, [{
+                        "counterSet": "phantom-counters",
+                        "counters": {"cores": {"value": "2"}},
+                    }]),
+                    chip("chip-1", 1, [{
+                        "counterSet": "chip-1-counters",
+                        "counters": {"cores": {"value": "2"}},
+                    }]),
+                ],
+            },
+        })
+        alloc = ReferenceAllocator(client)
+        claim = make_claim_obj(
+            "bad-uid-1", "c",
+            [{"name": "chip", "deviceClassName": "tpu.google.com"}],
+        )
+        # The healthy device is still allocatable...
+        alloc.allocate(claim)
+        results = claim["status"]["allocation"]["devices"]["results"]
+        assert [r["device"] for r in results] == ["chip-1"]
+        # ...and the misconfigured one never is.
+        claim2 = make_claim_obj(
+            "bad-uid-2", "c2",
+            [{"name": "chip", "deviceClassName": "tpu.google.com"}],
+        )
+        with pytest.raises(AllocationError):
+            alloc.allocate(claim2)
+
     def test_gang_must_be_contiguous_submesh(self, cluster):
         """A fragmented multi-chip pick is rejected: chips (0,0) and (2,0)
         are not ICI neighbours, (0,0)+(1,0) are."""

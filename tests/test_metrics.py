@@ -1,6 +1,9 @@
 """Metrics registry + HTTP endpoint tests."""
 
+import urllib.error
 import urllib.request
+
+import pytest
 
 from k8s_dra_driver_tpu.utils.metrics import (
     Counter,
@@ -82,6 +85,15 @@ class TestServer:
             assert "--- thread" in stacks and "serve_forever" in stacks
             prof = urllib.request.urlopen(
                 f"{base}/debug/profile?seconds=0.2").read().decode()
+            assert "samples at" in prof
+            # Bad inputs get a 400, not a handler-thread traceback; out-of
+            # -range values clamp instead of hanging the server for hours.
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(
+                    f"{base}/debug/profile?seconds=bogus")
+            assert exc_info.value.code == 400
+            prof = urllib.request.urlopen(
+                f"{base}/debug/profile?seconds=-5").read().decode()
             assert "samples at" in prof
         finally:
             srv.stop()
