@@ -12,8 +12,14 @@ native:
 test: native
 	python -m pytest tests/ -q
 
+# ruff when available (CI installs it; .golangci.yaml analog is
+# [tool.ruff] in pyproject.toml), else the first-party AST lint floor.
 lint:
-	python -m compileall -q k8s_dra_driver_tpu tests bench.py __graft_entry__.py
+	@if command -v ruff >/dev/null 2>&1; then \
+		ruff check k8s_dra_driver_tpu tests tools bench.py __graft_entry__.py; \
+	else \
+		python tools/lint.py; \
+	fi
 
 image:
 	docker build -t $(IMAGE):$(TAG) -f deployments/container/Dockerfile .

@@ -19,7 +19,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import threading
-from typing import Any, Callable, Optional
+from typing import Any, Optional
 
 from .cel import CelError, evaluate as cel_evaluate
 from .client import RESOURCE_SLICES, KubeClient

@@ -13,8 +13,6 @@ import logging
 import os
 from typing import Any, Optional
 
-import jax
-
 logger = logging.getLogger(__name__)
 
 

@@ -19,7 +19,6 @@ import threading
 from typing import Any, Optional
 
 from ..api.v1alpha1 import (
-    API_VERSION,
     IciChannelConfig,
     TensorCoreConfig,
     TpuChipConfig,

@@ -20,7 +20,7 @@ from ..kube.client import RESOURCE_CLAIMS, KubeClient
 from ..kube.protos import dra_v1alpha4_pb2 as drapb
 from ..kube.resourceslice import DriverResources, Pool
 from ..tpulib.chiplib import ChipLib
-from ..utils.metrics import Counter, Gauge, Histogram, Registry
+from ..utils.metrics import Counter, Histogram, Registry
 from .checkpoint import CheckpointManager
 from .device_state import DeviceState
 from .grpc_services import NodeServicer

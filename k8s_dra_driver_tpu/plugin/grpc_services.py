@@ -10,13 +10,51 @@ dra/v1alpha4/api.proto and pluginregistration/v1/api.proto).
 
 from __future__ import annotations
 
+import logging
+import time
+
 import grpc
 
 from ..kube.protos import dra_v1alpha4_pb2 as drapb
 from ..kube.protos import pluginregistration_v1_pb2 as regpb
 
+logger = logging.getLogger(__name__)
+
 DRA_SERVICE_NAME = "v1alpha3.Node"
 REGISTRATION_SERVICE_NAME = "pluginregistration.Registration"
+
+
+def _claim_uids(request) -> str:
+    """Claim UIDs carried by a DRA request, for the per-RPC log line."""
+    claims = getattr(request, "claims", None)
+    if not claims:
+        return "-"
+    return ",".join(c.uid for c in claims)
+
+
+def _logged(service: str, method: str, fn):
+    """Per-RPC call logging at debug verbosity: method, claim UIDs, and
+    latency — the signal needed to debug a misbehaving kubelet. The
+    vendored reference framework logs every DRA RPC the same way at
+    verbosity >=4 (vendor/k8s.io/dynamic-resource-allocation/
+    kubeletplugin/draplugin.go:89-94)."""
+
+    def wrapper(request, context):
+        start = time.monotonic()
+        logger.debug("gRPC %s/%s called: claims=%s",
+                     service, method, _claim_uids(request))
+        try:
+            response = fn(request, context)
+        except Exception as e:
+            logger.debug("gRPC %s/%s failed after %.1fms: %s",
+                         service, method,
+                         (time.monotonic() - start) * 1e3, e)
+            raise
+        logger.debug("gRPC %s/%s succeeded in %.1fms",
+                     service, method, (time.monotonic() - start) * 1e3)
+        return response
+
+    return wrapper
 
 
 # ---------------------------------------------------------------------------
@@ -37,12 +75,14 @@ class NodeServicer:
 def add_node_servicer_to_server(servicer: NodeServicer, server: grpc.Server) -> None:
     handlers = {
         "NodePrepareResources": grpc.unary_unary_rpc_method_handler(
-            servicer.NodePrepareResources,
+            _logged(DRA_SERVICE_NAME, "NodePrepareResources",
+                    servicer.NodePrepareResources),
             request_deserializer=drapb.NodePrepareResourcesRequest.FromString,
             response_serializer=drapb.NodePrepareResourcesResponse.SerializeToString,
         ),
         "NodeUnprepareResources": grpc.unary_unary_rpc_method_handler(
-            servicer.NodeUnprepareResources,
+            _logged(DRA_SERVICE_NAME, "NodeUnprepareResources",
+                    servicer.NodeUnprepareResources),
             request_deserializer=drapb.NodeUnprepareResourcesRequest.FromString,
             response_serializer=drapb.NodeUnprepareResourcesResponse.SerializeToString,
         ),
@@ -89,12 +129,13 @@ def add_registration_servicer_to_server(
 ) -> None:
     handlers = {
         "GetInfo": grpc.unary_unary_rpc_method_handler(
-            servicer.GetInfo,
+            _logged(REGISTRATION_SERVICE_NAME, "GetInfo", servicer.GetInfo),
             request_deserializer=regpb.InfoRequest.FromString,
             response_serializer=regpb.PluginInfo.SerializeToString,
         ),
         "NotifyRegistrationStatus": grpc.unary_unary_rpc_method_handler(
-            servicer.NotifyRegistrationStatus,
+            _logged(REGISTRATION_SERVICE_NAME, "NotifyRegistrationStatus",
+                    servicer.NotifyRegistrationStatus),
             request_deserializer=regpb.RegistrationStatus.FromString,
             response_serializer=regpb.RegistrationStatusResponse.SerializeToString,
         ),

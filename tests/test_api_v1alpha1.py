@@ -9,9 +9,6 @@ Normalize/Validate paths it left untested.
 import pytest
 
 from k8s_dra_driver_tpu.api.v1alpha1 import (
-    EXCLUSIVE,
-    PROCESS_SHARED,
-    TIME_SHARED,
     ConfigError,
     ErrInvalidDeviceSelector,
     ErrInvalidLimit,

@@ -5,7 +5,6 @@ The reference's CEL selectors are evaluated only by the real scheduler
 published slices hermetically.
 """
 
-import glob
 import os
 
 import pytest

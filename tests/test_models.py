@@ -7,7 +7,6 @@ import pytest
 
 from k8s_dra_driver_tpu.models.llama import (
     PRESETS,
-    LlamaConfig,
     forward,
     init_params,
     loss_fn,

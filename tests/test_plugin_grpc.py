@@ -111,6 +111,40 @@ class TestPrepareOverGrpc:
             assert uresp.claims["uid-1"].error == ""
         assert driver.state.checkpoint.read() == {}
 
+    def test_rpc_call_logging(self, harness, caplog):
+        """Every DRA RPC emits a debug log line with method, claim UIDs
+        and latency (reference framework behavior: draplugin.go:89-94 at
+        verbosity >=4) — the record needed to debug a misbehaving
+        kubelet."""
+        import logging
+
+        _, client, config = harness
+        add_claim(client, "uid-log", ["tpu-0"], name="logged")
+        with caplog.at_level(
+            logging.DEBUG, logger="k8s_dra_driver_tpu.plugin.grpc_services"
+        ):
+            with grpc.insecure_channel(f"unix://{config.plugin_socket}") as ch:
+                stub = NodeStub(ch)
+                stub.NodePrepareResources(
+                    drapb.NodePrepareResourcesRequest(
+                        claims=[drapb.Claim(
+                            uid="uid-log", name="logged",
+                            namespace="default")]
+                    )
+                )
+                stub.NodeUnprepareResources(
+                    drapb.NodeUnprepareResourcesRequest(
+                        claims=[drapb.Claim(
+                            uid="uid-log", name="logged",
+                            namespace="default")]
+                    )
+                )
+        msgs = [r.getMessage() for r in caplog.records]
+        assert any("NodePrepareResources called: claims=uid-log" in m
+                   for m in msgs), msgs
+        assert any("NodePrepareResources succeeded in" in m for m in msgs)
+        assert any("NodeUnprepareResources succeeded in" in m for m in msgs)
+
     def test_per_claim_error_isolation(self, harness):
         """One bad claim must not fail the RPC or the good claim
         (driver.go:124-138 analog)."""

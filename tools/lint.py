@@ -1,0 +1,208 @@
+#!/usr/bin/env python3
+"""First-party lint floor: the pyflakes-core checks, stdlib-only.
+
+CI runs ruff (installed there; see .github/workflows/ci.yaml and
+[tool.ruff] in pyproject.toml) the way the reference runs golangci-lint
+as a required job (/root/reference/.github/workflows/golang.yaml:28-50).
+Dev machines for this repo cannot install packages, so `make lint` falls
+back to this checker, which approximates ruff's default F-rules:
+
+- F401: imported name never used (module scope)
+- F811: redefinition of a top-level def/class
+- F841: local variable assigned but never used
+- E722: bare ``except:``
+- B006: mutable default argument
+- E711: comparison to None with ==/!=
+- E712: comparison to True/False with ==/!=
+
+Exit status 1 when any finding is emitted, so `make lint` is a gate,
+not a suggestion.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+
+class Finding:
+    def __init__(self, path: Path, line: int, code: str, msg: str):
+        self.path, self.line, self.code, self.msg = path, line, code, msg
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.msg}"
+
+
+def _names_loaded(tree: ast.AST) -> set[str]:
+    """Every identifier read anywhere in the tree (incl. attribute roots),
+    plus names referenced in string annotations and __all__ exports."""
+    loaded: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            loaded.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            root = node
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name):
+                loaded.add(root.id)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            # String annotations / __all__ entries keep imports "used".
+            if node.value.isidentifier():
+                loaded.add(node.value)
+    return loaded
+
+
+def check_unused_imports(tree: ast.Module, path: Path) -> list[Finding]:
+    out = []
+    loaded = _names_loaded(tree)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                name = alias.asname or alias.name.split(".")[0]
+                if name not in loaded:
+                    out.append(Finding(
+                        path, node.lineno, "F401",
+                        f"{alias.name!r} imported but unused"))
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                name = alias.asname or alias.name
+                if name not in loaded:
+                    out.append(Finding(
+                        path, node.lineno, "F401",
+                        f"{alias.name!r} imported but unused"))
+    return out
+
+
+def check_redefinitions(tree: ast.Module, path: Path) -> list[Finding]:
+    out = []
+    seen: dict[str, int] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            # @overload / @property chains and conditional defs are the
+            # legitimate uses; only flag unconditional same-scope dupes
+            # without decorators.
+            if node.decorator_list:
+                continue
+            if node.name in seen:
+                out.append(Finding(
+                    path, node.lineno, "F811",
+                    f"redefinition of {node.name!r} from line "
+                    f"{seen[node.name]}"))
+            seen[node.name] = node.lineno
+    return out
+
+
+def check_function_bodies(tree: ast.Module, path: Path) -> list[Finding]:
+    out = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for default in (fn.args.defaults + fn.args.kw_defaults):
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                out.append(Finding(
+                    path, default.lineno, "B006",
+                    "mutable default argument"))
+        # F841: names assigned in this function's OWN scope, never loaded.
+        # ast.walk can't prune subtrees, so gather assigns with an explicit
+        # stack that stops at nested function/class scopes (a nested class
+        # body is its own scope: `prefix = ...` there is a class attribute,
+        # not a local of the enclosing function).
+        assigned: dict[str, int] = {}
+        stack: list[ast.AST] = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) \
+                            and not tgt.id.startswith("_"):
+                        assigned.setdefault(tgt.id, tgt.lineno)
+            stack.extend(ast.iter_child_nodes(node))
+        loaded = _names_loaded(fn)
+        # Stores count too conservatively: augmented assigns and nested
+        # scopes read names ast.Name/Load won't attribute here; only
+        # report when the name appears exactly once in the whole function.
+        for name, lineno in assigned.items():
+            occurrences = sum(
+                1 for n in ast.walk(fn)
+                if isinstance(n, ast.Name) and n.id == name
+            )
+            if name not in loaded and occurrences == 1:
+                out.append(Finding(
+                    path, lineno, "F841",
+                    f"local variable {name!r} assigned but never used"))
+    return out
+
+
+def check_misc(tree: ast.Module, path: Path) -> list[Finding]:
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            out.append(Finding(path, node.lineno, "E722", "bare except"))
+        elif isinstance(node, ast.Compare):
+            for op, cmp_ in zip(node.ops, node.comparators):
+                if not isinstance(op, (ast.Eq, ast.NotEq)):
+                    continue
+                if isinstance(cmp_, ast.Constant):
+                    if cmp_.value is None:
+                        out.append(Finding(
+                            path, node.lineno, "E711",
+                            "comparison to None should be 'is None'"))
+                    elif cmp_.value is True or cmp_.value is False:
+                        out.append(Finding(
+                            path, node.lineno, "E712",
+                            "comparison to True/False should use 'is'"))
+    return out
+
+
+def lint_file(path: Path) -> list[Finding]:
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 0, "E999", f"syntax error: {e.msg}")]
+    out = []
+    # __init__.py files are re-export surfaces; like ruff's conventional
+    # per-file-ignores (`"__init__.py" = ["F401"]`), unused-import does
+    # not apply there.
+    if path.name != "__init__.py":
+        out += check_unused_imports(tree, path)
+    out += check_redefinitions(tree, path)
+    out += check_function_bodies(tree, path)
+    out += check_misc(tree, path)
+    return out
+
+
+def main(argv: list[str]) -> int:
+    roots = [Path(a) for a in argv] or [
+        Path("k8s_dra_driver_tpu"), Path("tests"), Path("tools"),
+        Path("bench.py"), Path("__graft_entry__.py"),
+    ]
+    files: list[Path] = []
+    for root in roots:
+        if root.is_dir():
+            files += sorted(root.rglob("*.py"))
+        else:
+            files.append(root)
+    findings: list[Finding] = []
+    for f in files:
+        if "_pb2" in f.name:  # generated protobuf descriptor modules
+            continue
+        findings += lint_file(f)
+    for fd in findings:
+        print(fd)
+    print(f"lint: {len(files)} files, {len(findings)} findings",
+          file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
