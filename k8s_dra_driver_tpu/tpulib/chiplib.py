@@ -78,6 +78,29 @@ SHARING_TIME_SHARED = "time-shared"
 SHARING_PROCESS_SHARED = "process-shared"
 
 
+def derive_host_block(
+    topology: MeshShape, n_per_host: int
+) -> Optional[MeshShape]:
+    """Most compact (bx,by,bz) with bx*by*bz == n_per_host tiling the
+    topology: minimal z extent first (real multi-host blocks are flat:
+    v4/v5p hosts own 2x2x1), then most square in x/y. Shared by the real
+    and fake backends so both speak the same coordinate contract."""
+    best = None
+    for bx in range(1, topology.x + 1):
+        if topology.x % bx or n_per_host % bx:
+            continue
+        for by in range(1, topology.y + 1):
+            if topology.y % by or (n_per_host // bx) % by:
+                continue
+            bz = n_per_host // (bx * by)
+            if bz > topology.z or topology.z % bz:
+                continue
+            key = (bz, abs(bx - by), bx + by + bz)
+            if best is None or key < best[0]:
+                best = (key, MeshShape(bx, by, bz))
+    return best[1] if best else None
+
+
 @dataclasses.dataclass
 class ChipLibConfig:
     """Host-side knobs (role of driver-root flags, main.go:73-123)."""
@@ -91,6 +114,11 @@ class ChipLibConfig:
     slice_topology: Optional[str] = None
     host_id: int = 0
     hosts_per_slice: int = 1
+    # Coordinate-grid metadata (TPU_CHIPS_PER_HOST_BOUNDS /
+    # TPU_HOST_BOUNDS mirrors), e.g. "2,2,1". See enumerate_chips for the
+    # mapping contract.
+    chips_per_host_bounds: Optional[str] = None
+    host_bounds: Optional[str] = None
 
 
 class ChipLib(abc.ABC):
@@ -199,13 +227,37 @@ class FakeChipLib(ChipLib):
     def shutdown(self) -> None:
         self.initialized = False
 
-    def enumerate_chips(self) -> list[ChipInfo]:
-        spec = GENERATIONS[self.generation]
+    def _host_coords(self) -> list[Coord]:
+        """This host's chip coordinates under the same block contract the
+        real backend derives from grid metadata (RealChipLib.
+        enumerate_chips): host_id indexes a host grid of compact per-host
+        blocks. Falls back to host-major linear slicing when the chip
+        count doesn't tile the topology (deliberately odd test setups)."""
+        block = derive_host_block(self.topology, self.chips_per_host)
+        if block is not None:
+            host_grid = MeshShape(
+                self.topology.x // block.x,
+                self.topology.y // block.y,
+                self.topology.z // block.z,
+            )
+            if self.host_id < host_grid.num_chips:
+                hc = host_grid.coord_at(self.host_id)
+                return [
+                    Coord(
+                        hc.x * block.x + block.coord_at(i).x,
+                        hc.y * block.y + block.coord_at(i).y,
+                        hc.z * block.z + block.coord_at(i).z,
+                    )
+                    for i in range(self.chips_per_host)
+                ]
         all_coords = list(self.topology.coords())
         lo = self.host_id * self.chips_per_host
-        hi = lo + self.chips_per_host
+        return all_coords[lo:lo + self.chips_per_host]
+
+    def enumerate_chips(self) -> list[ChipInfo]:
+        spec = GENERATIONS[self.generation]
         chips = []
-        for local_idx, coord in enumerate(all_coords[lo:hi]):
+        for local_idx, coord in enumerate(self._host_coords()):
             serial = hashlib.sha256(
                 f"{self.slice_id}/{coord}".encode()
             ).hexdigest()[:12]
@@ -326,14 +378,104 @@ class RealChipLib(ChipLib):
             slice_id = f"{generation}-{topology}-{os.uname().nodename}"
         return slice_id, topology, host_id, hosts
 
+    @staticmethod
+    def _parse_bounds(s: str) -> Optional[MeshShape]:
+        """TPU bounds env format: comma-separated ("2,2,1"); tolerate the
+        x-separated topology form too. Non-positive axes are malformed
+        metadata (they'd divide by zero downstream): treated as absent."""
+        s = s.strip()
+        if not s:
+            return None
+        try:
+            shape = MeshShape.parse(s.replace(",", "x"))
+        except ValueError:
+            return None
+        if shape.x < 1 or shape.y < 1 or shape.z < 1:
+            return None
+        return shape
+
+    def _grid_metadata(
+        self, topology: MeshShape, hosts: int
+    ) -> Optional[tuple[MeshShape, MeshShape, bool]]:
+        """(per-host chip bounds, host grid, grounded) from runtime metadata.
+
+        Sources: ``TPU_CHIPS_PER_HOST_BOUNDS`` and ``TPU_HOST_BOUNDS`` (the
+        variables libtpu itself consumes), overridable via ChipLibConfig.
+        When only one is present the other derives from the slice topology;
+        when neither is, a compact per-host block is derived from topology ÷
+        hosts (the 2x2x1 block of real v4/v5p hosts falls out naturally).
+        ``grounded`` is True only when the mapping needs no guessing — a
+        single-host slice, or explicit bounds metadata; multi-host blocks
+        DERIVED by heuristic stay usable for coordinates but are flagged so
+        contiguity attributes are withheld.
+        Returns None — caller falls back to positional coords — if the
+        metadata is inconsistent (bounds don't tile the topology, or the
+        grids disagree with the host count)."""
+        bounds = (
+            self._parse_bounds(self.config.chips_per_host_bounds or "")
+            or self._parse_bounds(self._env("TPU_CHIPS_PER_HOST_BOUNDS"))
+        )
+        host_grid = (
+            self._parse_bounds(self.config.host_bounds or "")
+            or self._parse_bounds(self._env("TPU_HOST_BOUNDS"))
+        )
+        grounded = hosts == 1 or bounds is not None or host_grid is not None
+        if bounds is None and host_grid is not None:
+            if not host_grid.divides(topology):
+                return None
+            bounds = MeshShape(
+                topology.x // host_grid.x,
+                topology.y // host_grid.y,
+                topology.z // host_grid.z,
+            )
+        if bounds is None:
+            bounds = self._derive_compact_bounds(
+                topology, max(topology.num_chips // max(hosts, 1), 1)
+            )
+            if bounds is None:
+                return None
+        if not bounds.divides(topology):
+            logger.warning(
+                "chip bounds %s do not tile slice topology %s; "
+                "falling back to positional coordinates", bounds, topology,
+            )
+            return None
+        derived_grid = MeshShape(
+            topology.x // bounds.x, topology.y // bounds.y,
+            topology.z // bounds.z,
+        )
+        if host_grid is None:
+            host_grid = derived_grid
+        elif host_grid != derived_grid:
+            logger.warning(
+                "host bounds %s inconsistent with topology %s / chip "
+                "bounds %s; falling back to positional coordinates",
+                host_grid, topology, bounds,
+            )
+            return None
+        if hosts > 1 and host_grid.num_chips != hosts:
+            logger.warning(
+                "host grid %s holds %d hosts but the slice reports %d; "
+                "falling back to positional coordinates",
+                host_grid, host_grid.num_chips, hosts,
+            )
+            return None
+        return bounds, host_grid, grounded
+
+    @staticmethod
+    def _derive_compact_bounds(
+        topology: MeshShape, n_per_host: int
+    ) -> Optional[MeshShape]:
+        return derive_host_block(topology, n_per_host)
+
     # -- device probing ----------------------------------------------------
 
-    def _probe_accel_nodes(self) -> list[tuple[int, str, str]]:
-        """Find (index, path, kind) for TPU device nodes.
+    def _probe_accel_nodes(self) -> list[tuple[int, str, str, dict]]:
+        """Find (index, path, kind, meta) for TPU device nodes.
 
-        kind is "accel" for /dev/accel* char devices (sysfs metadata
-        available) or "vfio" for /dev/vfio/* group nodes (v5p+ GKE hosts;
-        no accel-class sysfs entry, so metadata comes from env only).
+        kind is "accel" for /dev/accel* char devices (meta read from sysfs
+        here, once) or "vfio" for /dev/vfio/* group nodes (v5p+ GKE hosts;
+        meta carries the iommu-derived PCI address).
         """
         nodes = []
         for path in sorted(glob.glob(_hostpath(self.config.dev_root, "dev/accel[0-9]*"))):
@@ -345,14 +487,62 @@ class RealChipLib(ChipLib):
             except OSError:
                 continue
             if stat.S_ISCHR(st.st_mode):
-                nodes.append((int(m.group(1)), path, "accel"))
+                index = int(m.group(1))
+                nodes.append(
+                    (index, path, "accel", self._sysfs_chip_meta(index))
+                )
         if not nodes:
-            vfio_paths = sorted(
-                glob.glob(_hostpath(self.config.dev_root, "dev/vfio/[0-9]*"))
-            )
-            for local_idx, path in enumerate(vfio_paths):
-                nodes.append((local_idx, path, "vfio"))
+            nodes = self._probe_vfio_nodes()
         return nodes
+
+    def _probe_vfio_nodes(self) -> list[tuple[int, str, str, dict]]:
+        """vfio group nodes, ordered by metadata rather than glob luck.
+
+        A vfio group number carries no chip identity; the stable order is
+        the PCI address of the group's device (resolved via
+        /sys/kernel/iommu_groups/<g>/devices). Chip indices then come from
+        ``TPU_VISIBLE_CHIPS`` when the runtime published it, else from the
+        PCI-ordered position."""
+        entries = []  # (sort key, group path)
+        for path in glob.glob(
+            _hostpath(self.config.dev_root, "dev/vfio/[0-9]*")
+        ):
+            group = os.path.basename(path)
+            pci = self._vfio_pci_address(group)
+            # PCI addresses sort correctly as strings within one domain;
+            # fall back to the numeric group id when sysfs is stripped.
+            entries.append(((pci or "~", int(group)), path))
+        entries.sort()
+        visible = [
+            _safe_int(v, -1)
+            for v in self._env("TPU_VISIBLE_CHIPS").split(",")
+            if v.strip()
+        ]
+        usable = (
+            len(visible) == len(entries)
+            and all(v >= 0 for v in visible)
+            and len(set(visible)) == len(visible)  # dupes would collapse
+        )                                          # two chips into one name
+        if visible and not usable:
+            logger.warning(
+                "TPU_VISIBLE_CHIPS %r unusable for %d vfio nodes; "
+                "using PCI-ordered indices", visible, len(entries),
+            )
+        nodes = []
+        for pos, ((pci, _), path) in enumerate(entries):
+            meta = {"pci_address": pci} if pci != "~" else {}
+            nodes.append((visible[pos] if usable else pos, path, "vfio", meta))
+        return nodes
+
+    def _vfio_pci_address(self, group: str) -> str:
+        devdir = _hostpath(
+            self.config.sysfs_root, f"kernel/iommu_groups/{group}/devices"
+        )
+        try:
+            devs = sorted(os.listdir(devdir))
+        except OSError:
+            return ""
+        return devs[0] if devs else ""
 
     def _sysfs_chip_meta(self, index: int) -> dict[str, str]:
         """Read PCI metadata for accel device `index` from sysfs."""
@@ -375,38 +565,88 @@ class RealChipLib(ChipLib):
         return meta
 
     def enumerate_chips(self) -> list[ChipInfo]:
+        """Probe device nodes and derive each chip's mesh coordinate.
+
+        Coordinate contract (the ground truth behind ``coord``,
+        ``iciX/Y/Z`` and the ``submesh{2x2,4x4}Id`` contiguity attributes;
+        reference discipline: attributes come from the device library's
+        metadata, not position — nvlib.go:202-313):
+
+        1. The slice topology T comes from ``TPU_TOPOLOGY``; the per-host
+           chip block B from ``TPU_CHIPS_PER_HOST_BOUNDS`` and the host
+           grid H from ``TPU_HOST_BOUNDS`` (libtpu's own variables, with
+           ChipLibConfig overrides). Each may be derived from the others
+           (T = H∘B elementwise).
+        2. Host w (``TPU_WORKER_ID``) owns the block of chips whose origin
+           is ``H.coord_at(w) * B`` — the same x-outermost/z-fastest
+           linearisation ``MeshShape.coords`` uses everywhere.
+        3. Device index n (the accelN minor, or the vfio chip index from
+           ``TPU_VISIBLE_CHIPS``/PCI order) sits at ``B.coord_at(n)``
+           WITHIN the block: global = origin + local. Index-keyed, not
+           ordinal-keyed — a host with a missing/hidden chip still
+           publishes true coordinates for the rest (round-2 verdict:
+           positional gpos published confidently wrong contiguity on any
+           non-host-major or heterogeneous layout).
+        4. If the grids are absent or inconsistent, fall back to the
+           positional mapping and SKIP publishing submesh tile attributes
+           (deviceinfo withholds them when ``coords_reliable`` is False),
+           so a scheduler can never gang-allocate on made-up contiguity.
+        """
         nodes = self._probe_accel_nodes()
         # Reject foreign accel-class devices (other vendors' NPUs also appear
         # as /dev/accelN): keep a node only if its sysfs vendor is Google or
         # vendor metadata is unavailable (vfio nodes, stripped sysfs).
         kept = []
-        for index, path, kind in nodes:
+        for index, path, kind, meta in nodes:
             if kind == "accel":
-                vendor = self._sysfs_chip_meta(index).get("vendor", "")
+                vendor = meta.get("vendor", "")
                 if vendor and vendor != self.GOOGLE_PCI_VENDOR:
                     logger.info("skipping non-TPU accel device %s (vendor %s)",
                                 path, vendor)
                     continue
-            kept.append((index, path, kind))
+            kept.append((index, path, kind, meta))
         nodes = kept
         if not nodes:
             logger.warning("no TPU device nodes found under %s", self.config.dev_root)
             return []
-        first_meta = (
-            self._sysfs_chip_meta(nodes[0][0]) if nodes[0][2] == "accel" else {}
-        )
-        generation = self._detect_generation(first_meta.get("device", ""))
+        generation = self._detect_generation(nodes[0][3].get("device", ""))
         spec = GENERATIONS.get(generation, GENERATIONS["v4"])
         slice_id, topology, host_id, hosts = self._slice_metadata(
             generation, len(nodes)
         )
+        grids = self._grid_metadata(topology, hosts)
+        origin = None
+        grounded = False
+        if grids is not None:
+            bounds, host_grid, grounded = grids
+            if host_id < host_grid.num_chips:
+                hc = host_grid.coord_at(host_id)
+                origin = Coord(
+                    hc.x * bounds.x, hc.y * bounds.y, hc.z * bounds.z
+                )
+            else:
+                logger.warning(
+                    "host id %d outside host grid %s; falling back to "
+                    "positional coordinates", host_id, host_grid,
+                )
         all_coords = list(topology.coords())
         chips = []
-        for local_idx, (index, path, kind) in enumerate(nodes):
-            meta = self._sysfs_chip_meta(index) if kind == "accel" else {}
-            # Global position = host offset + local ordinal.
-            gpos = host_id * len(nodes) + local_idx
-            coord = all_coords[gpos] if gpos < len(all_coords) else Coord(0, 0, 0)
+        for local_idx, (index, path, kind, meta) in enumerate(nodes):
+            indexed = origin is not None and 0 <= index < bounds.num_chips
+            coords_reliable = indexed and grounded
+            if indexed:
+                local = bounds.coord_at(index)
+                coord = Coord(
+                    origin.x + local.x, origin.y + local.y,
+                    origin.z + local.z,
+                )
+            else:
+                # Positional fallback: ordinal within this host's nodes.
+                gpos = host_id * len(nodes) + local_idx
+                coord = (
+                    all_coords[gpos] if gpos < len(all_coords)
+                    else Coord(0, 0, 0)
+                )
             uid_src = meta.get("pci_address") or f"{slice_id}/{index}"
             serial = hashlib.sha256(uid_src.encode()).hexdigest()[:12]
             chips.append(
@@ -425,6 +665,7 @@ class RealChipLib(ChipLib):
                     pci_address=meta.get("pci_address", ""),
                     numa_node=_safe_int(meta.get("numa_node"), -1),
                     driver_version=self._libtpu_version(),
+                    coords_reliable=coords_reliable,
                 )
             )
         return chips

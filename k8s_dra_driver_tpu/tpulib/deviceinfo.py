@@ -65,6 +65,11 @@ class ChipInfo:
     numa_node: int = -1
     driver_version: str = "0.0.0"   # libtpu version
     firmware_version: str = "0.0.0"
+    # False when the chip library could not ground the coordinate in
+    # runtime metadata (chiplib.RealChipLib.enumerate_chips contract) —
+    # the contiguity tile attributes are then withheld so a scheduler
+    # never gang-allocates on made-up adjacency.
+    coords_reliable: bool = True
 
     def canonical_name(self) -> str:
         return f"tpu-{self.index}"
@@ -113,8 +118,6 @@ class ChipInfo:
                     "sliceTopology": _attr(str(self.slice_topology)),
                     "hostId": _attr(self.host_id),
                     "hostsPerSlice": _attr(self.hosts_per_slice),
-                    "submesh2x2Id": _attr(self.submesh_tile_id(2, 2, 1)),
-                    "submesh4x4Id": _attr(self.submesh_tile_id(4, 4, 1)),
                     "pcieAddress": _attr(self.pci_address),
                     "numaNode": _attr(self.numa_node),
                     "driverVersion": _version_attr(self.driver_version),
@@ -127,6 +130,10 @@ class ChipInfo:
                 },
             },
         }
+        if self.coords_reliable:
+            attrs = dev["basic"]["attributes"]
+            attrs["submesh2x2Id"] = _attr(self.submesh_tile_id(2, 2, 1))
+            attrs["submesh4x4Id"] = _attr(self.submesh_tile_id(4, 4, 1))
         if self.cores >= 2:
             # A whole-chip claim drains the chip's counter set, so the
             # scheduler cannot also hand out this chip's TensorCore

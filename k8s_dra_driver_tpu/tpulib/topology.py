@@ -95,6 +95,28 @@ class MeshShape:
         ):
             yield Coord(x, y, z)
 
+    def coord_at(self, index: int) -> Coord:
+        """The index'th coordinate in ``coords()`` order (x outermost, z
+        fastest) without materialising the iterator. This linearisation IS
+        the coordinate contract: device index n on a host maps to the n'th
+        cell of the host's chip block."""
+        if not 0 <= index < self.num_chips:
+            raise IndexError(f"index {index} outside {self}")
+        yz = self.y * self.z
+        return Coord(index // yz, (index % yz) // self.z, index % self.z)
+
+    def index_of(self, c: Coord) -> int:
+        """Inverse of ``coord_at``."""
+        return (c.x * self.y + c.y) * self.z + c.z
+
+    def divides(self, other: "MeshShape") -> bool:
+        """True iff this shape tiles ``other`` exactly along every axis."""
+        return (
+            other.x % self.x == 0
+            and other.y % self.y == 0
+            and other.z % self.z == 0
+        )
+
     def contains(self, c: Coord) -> bool:
         return 0 <= c.x < self.x and 0 <= c.y < self.y and 0 <= c.z < self.z
 

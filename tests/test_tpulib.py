@@ -29,6 +29,12 @@ class TestTopology:
         assert MeshShape.parse("2x2").num_chips == 4
         assert MeshShape.parse("2x2").z == 1
 
+    def test_coord_at_index_of_roundtrip(self):
+        shape = MeshShape.parse("4x2x3")
+        for i, c in enumerate(shape.coords()):
+            assert shape.coord_at(i) == c
+            assert shape.index_of(c) == i
+
     def test_coord_parse(self):
         assert Coord.parse("1,2") == Coord(1, 2, 0)
         assert str(Coord(1, 2, 3)) == "1,2,3"
@@ -188,6 +194,165 @@ class TestRealChipLib:
         lib = RealChipLib(ChipLibConfig(dev_root=str(tmp_path)))
         lib.init()
         assert lib.enumerate_chips() == []
+
+
+class TestCoordinateContract:
+    """Metadata-true coordinate derivation (round-1 task 8 / round-2
+    verdict #1): coords come from the TPU runtime's own grid metadata
+    (TPU_CHIPS_PER_HOST_BOUNDS / TPU_HOST_BOUNDS / TPU_WORKER_ID), keyed
+    by device index — never by enumeration position."""
+
+    def _host(self, tmp_path, present=(0, 1, 2, 3)):
+        dev = tmp_path / "dev"
+        dev.mkdir()
+        sys_accel = tmp_path / "sys" / "class" / "accel"
+        for i in present:
+            try:
+                os.mknod(dev / f"accel{i}", 0o666 | stat.S_IFCHR,
+                         os.makedev(120, i))
+            except PermissionError:
+                pytest.skip("mknod requires privileges")
+            d = sys_accel / f"accel{i}" / "device"
+            d.mkdir(parents=True)
+            (d / "vendor").write_text("0x1ae0\n")
+            (d / "device").write_text("0x0062\n")
+            (d / "numa_node").write_text("0\n")
+        return tmp_path
+
+    def _env(self, monkeypatch, **extra):
+        base = {
+            "TPU_ACCELERATOR_TYPE": "v5p-16",
+            "TPU_TOPOLOGY": "4x2x1",
+            "TPU_WORKER_ID": "1",
+            "TPU_WORKER_HOSTNAMES": "host-a,host-b",
+            "TPU_CHIPS_PER_HOST_BOUNDS": "2,2,1",
+            "TPU_HOST_BOUNDS": "2,1,1",
+        }
+        base.update(extra)
+        for k, v in base.items():
+            if v is None:
+                monkeypatch.delenv(k, raising=False)
+            else:
+                monkeypatch.setenv(k, v)
+
+    def _lib(self, root):
+        lib = RealChipLib(ChipLibConfig(
+            dev_root=str(root), sysfs_root=str(root / "sys")))
+        lib.init()
+        return lib
+
+    def test_multihost_coords_from_grid_metadata(self, tmp_path, monkeypatch):
+        """Worker 1 in a 2x1x1 host grid with 2x2x1 per-host blocks owns
+        the x=2..3 block; device index n sits at B.coord_at(n) within it."""
+        root = self._host(tmp_path)
+        self._env(monkeypatch)
+        chips = self._lib(root).enumerate_chips()
+        by_index = {c.index: str(c.coord) for c in chips}
+        assert by_index == {
+            0: "2,0,0", 1: "2,1,0", 2: "3,0,0", 3: "3,1,0"}
+        assert all(c.coords_reliable for c in chips)
+        # All four share one truthful 2x2 tile, and it names the x=2..3 half.
+        tiles = {c.get_device()["basic"]["attributes"]["submesh2x2Id"]
+                 ["string"] for c in chips}
+        assert len(tiles) == 1
+        assert tiles.pop().endswith(":2x2x1:1-0-0")
+
+    def test_missing_chip_does_not_shift_neighbours(self, tmp_path,
+                                                    monkeypatch):
+        """A hidden/broken chip (no /dev/accel2) must not displace the
+        others' coordinates — the old positional mapping shifted accel3
+        into accel2's cell and published wrong contiguity."""
+        root = self._host(tmp_path, present=(0, 1, 3))
+        self._env(monkeypatch)
+        chips = self._lib(root).enumerate_chips()
+        by_index = {c.index: str(c.coord) for c in chips}
+        assert by_index == {0: "2,0,0", 1: "2,1,0", 3: "3,1,0"}
+        assert all(c.coords_reliable for c in chips)
+
+    def test_multihost_without_grid_metadata_withholds_tiles(
+            self, tmp_path, monkeypatch):
+        """Multi-host with NO bounds metadata: the per-host block is a
+        heuristic, so chips still get coordinates but the contiguity tile
+        attributes are withheld — a scheduler can never gang-allocate on
+        guessed adjacency."""
+        root = self._host(tmp_path)
+        self._env(monkeypatch, TPU_CHIPS_PER_HOST_BOUNDS=None,
+                  TPU_HOST_BOUNDS=None)
+        chips = self._lib(root).enumerate_chips()
+        assert len(chips) == 4
+        assert not any(c.coords_reliable for c in chips)
+        for c in chips:
+            attrs = c.get_device()["basic"]["attributes"]
+            assert "submesh2x2Id" not in attrs
+            assert "submesh4x4Id" not in attrs
+
+    def test_inconsistent_bounds_fall_back_positional(self, tmp_path,
+                                                      monkeypatch):
+        """Bounds that don't tile the topology are rejected: positional
+        coords, no tile attributes, no crash."""
+        root = self._host(tmp_path)
+        self._env(monkeypatch, TPU_CHIPS_PER_HOST_BOUNDS="3,1,1")
+        chips = self._lib(root).enumerate_chips()
+        assert len(chips) == 4
+        assert not any(c.coords_reliable for c in chips)
+
+    def test_zero_bounds_do_not_crash(self, tmp_path, monkeypatch):
+        """A zero axis in the bounds env is malformed metadata, not a
+        ZeroDivisionError."""
+        root = self._host(tmp_path)
+        self._env(monkeypatch, TPU_CHIPS_PER_HOST_BOUNDS="0,2,1",
+                  TPU_HOST_BOUNDS=None)
+        chips = self._lib(root).enumerate_chips()
+        assert len(chips) == 4  # fell back (to the derived or positional map)
+
+    def test_host_count_mismatch_rejected(self, tmp_path, monkeypatch):
+        """A host grid that disagrees with the slice's reported host count
+        is conflicting metadata: nothing grounded gets published."""
+        root = self._host(tmp_path)
+        self._env(monkeypatch,
+                  TPU_WORKER_HOSTNAMES="a,b,c,d")  # 4 hosts, grid fits 2
+        chips = self._lib(root).enumerate_chips()
+        assert len(chips) == 4
+        assert not any(c.coords_reliable for c in chips)
+
+    def test_single_host_stays_grounded(self, tmp_path, monkeypatch):
+        """One host owning the whole slice needs no grid metadata: the
+        topology IS the block, and index-keyed mapping is exact."""
+        root = self._host(tmp_path)
+        self._env(monkeypatch, TPU_ACCELERATOR_TYPE="v5p-8",
+                  TPU_TOPOLOGY="2x2x1", TPU_WORKER_ID=None,
+                  TPU_WORKER_HOSTNAMES=None,
+                  TPU_CHIPS_PER_HOST_BOUNDS=None, TPU_HOST_BOUNDS=None)
+        chips = self._lib(root).enumerate_chips()
+        assert {c.index: str(c.coord) for c in chips} == {
+            0: "0,0,0", 1: "0,1,0", 2: "1,0,0", 3: "1,1,0"}
+        assert all(c.coords_reliable for c in chips)
+
+    def test_vfio_identity_from_iommu_pci(self, tmp_path, monkeypatch):
+        """vfio group numbers carry no chip identity: order comes from the
+        group's PCI address (via /sys/kernel/iommu_groups), and chip
+        indices from TPU_VISIBLE_CHIPS when published."""
+        (tmp_path / "dev" / "vfio").mkdir(parents=True)
+        # Group numbers in REVERSE PCI order: group 0 is the higher bus.
+        for group, pci in (("0", "0000:00:05.0"), ("1", "0000:00:04.0")):
+            (tmp_path / "dev" / "vfio" / group).write_text("")
+            d = (tmp_path / "sys" / "kernel" / "iommu_groups" / group
+                 / "devices")
+            d.mkdir(parents=True)
+            (d / pci).mkdir()
+        self._env(monkeypatch, TPU_ACCELERATOR_TYPE="v5p-8",
+                  TPU_TOPOLOGY="2x1x1", TPU_WORKER_ID=None,
+                  TPU_WORKER_HOSTNAMES=None,
+                  TPU_CHIPS_PER_HOST_BOUNDS=None, TPU_HOST_BOUNDS=None,
+                  TPU_VISIBLE_CHIPS="0,1")
+        chips = self._lib(tmp_path).enumerate_chips()
+        by_index = {c.index: c for c in chips}
+        # PCI 04.0 (group 1) is chip 0; PCI 05.0 (group 0) is chip 1.
+        assert by_index[0].device_paths[0].endswith("vfio/1")
+        assert by_index[1].device_paths[0].endswith("vfio/0")
+        assert by_index[0].pci_address == "0000:00:04.0"
+        # UUIDs are PCI-derived, so stable across group renumbering.
+        assert by_index[0].uuid != by_index[1].uuid
 
 
 class TestNativeShim:
