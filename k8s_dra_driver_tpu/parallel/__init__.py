@@ -10,6 +10,7 @@ from .mesh import (
     mesh_from_env,
 )
 from .ring import ring_attention, ulysses_attention
+from .shim import SharingRuntime, apply_sharing_env, timeshare_lease
 from .sharding import (
     DEFAULT_RULES,
     batch_sharding,
@@ -29,6 +30,9 @@ __all__ = [
     "ulysses_attention",
     "coordinator_from_env",
     "initialize_distributed",
+    "SharingRuntime",
+    "apply_sharing_env",
+    "timeshare_lease",
     "DEFAULT_RULES",
     "spec_for",
     "named_sharding",

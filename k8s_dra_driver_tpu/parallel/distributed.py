@@ -44,6 +44,12 @@ def initialize_distributed(
 
     Returns True if distributed mode was initialized.
     """
+    # Sharing first: the shim must adjust TPU_VISIBLE_CHIPS /
+    # XLA_PYTHON_CLIENT_MEM_FRACTION before jax initializes a backend.
+    from .shim import apply_sharing_env
+
+    apply_sharing_env()
+
     import jax
 
     coordinator = coordinator or coordinator_from_env()

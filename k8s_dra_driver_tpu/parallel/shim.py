@@ -1,0 +1,244 @@
+"""Workload-side sharing shim: makes the driver's sharing env REAL.
+
+The node plugin's sharing managers (plugin/sharing.py) inject a
+claim-level envelope — ``TPU_DRA_SHARING``, ``TPU_DRA_MAX_PROCESSES``,
+``TPU_DRA_HBM_LIMIT_BYTES``, ``TPU_DRA_TIMESHARE_QUANTUM``, a shared
+coordination dir — the per-PROCESS consequences of which only the
+workload process itself can apply (which slot am I, which chips do I
+see, when may I touch the device). This module is that consumer,
+invoked automatically by ``initialize_distributed`` or directly by an
+entrypoint.
+
+Reference behavior bar: GPU time-slicing / MPS actually change device
+behavior (lengrongfu/k8s-dra-driver, cmd/nvidia-dra-plugin/
+sharing.go:103-122 and :185-344). On TPU there is no on-device knob and
+no control daemon; the real mechanisms are
+
+- **process-shared**: libtpu/XLA env — a unique process slot (flock'd
+  file in the shared dir, so two processes can never claim the same
+  slot), a per-slot ``TPU_VISIBLE_CHIPS`` partition when the claim's
+  chips divide across processes, and the HBM budget applied through
+  ``XLA_PYTHON_CLIENT_MEM_FRACTION`` (the allocator fraction JAX
+  honors) computed from the driver-injected limit and chip HBM size.
+- **time-shared**: cooperative gating — ``timeshare_lease()`` holds an
+  exclusive flock on the claim's shared lock file while the process
+  runs device work; the quantum hint bounds the advisory lease length.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import errno
+import fcntl
+import logging
+import os
+from typing import IO, Iterator, MutableMapping, Optional
+
+logger = logging.getLogger(__name__)
+
+# Quantum hint level (TPU_DRA_TIMESHARE_QUANTUM, api/v1alpha1/sharing.py
+# INTERVALS) → advisory lease seconds.
+_QUANTUM_SECONDS = {0: 1.0, 1: 0.1, 2: 1.0, 3: 10.0}
+
+
+class SharingRuntimeError(RuntimeError):
+    pass
+
+
+# The process's applied decision (default-environ path). Holding it here
+# keeps the slot flock alive for the process lifetime — a dropped
+# SharingRuntime releases its slot.
+_active: Optional["SharingRuntime"] = None
+
+# Marker the shim leaves in the env so a second invocation (entrypoint
+# calls apply_sharing_env, then initialize_distributed calls it again)
+# can't burn a second slot or re-partition the already-halved chip list.
+_APPLIED_MARKER = "TPU_DRA_SHIM_APPLIED"
+
+
+@dataclasses.dataclass
+class SharingRuntime:
+    """What the shim decided for THIS process."""
+
+    mode: str
+    slot: int = -1
+    max_processes: int = 1
+    visible_chips: Optional[str] = None
+    mem_fraction: Optional[float] = None
+    quantum_seconds: Optional[float] = None
+    # The slot lock must live as long as the process; dropping the
+    # runtime object releases the slot.
+    _slot_lock: Optional[IO[str]] = None
+
+    def release(self) -> None:
+        if self._slot_lock is not None:
+            self._slot_lock.close()
+            self._slot_lock = None
+
+
+def _acquire_slot(shared_dir: str, max_processes: int) -> tuple[int, IO[str]]:
+    """First free slot in [0, max_processes): an exclusive flock on
+    slot-N.lock. The lock dies with the process, so a crashed worker's
+    slot frees itself — no daemon, no leases to expire (the property MPS
+    gets from its control daemon, sharing.go:185-344)."""
+    os.makedirs(shared_dir, exist_ok=True)
+    for i in range(max_processes):
+        f = open(os.path.join(shared_dir, f"slot-{i}.lock"), "a+")
+        try:
+            fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            return i, f
+        except OSError as e:
+            f.close()
+            if e.errno not in (errno.EAGAIN, errno.EACCES):
+                raise
+    raise SharingRuntimeError(
+        f"all {max_processes} process slots of shared claim are busy "
+        f"(dir {shared_dir})"
+    )
+
+
+def _partition_visible_chips(
+    visible: str, slot: int, max_processes: int
+) -> Optional[str]:
+    """Slot's share of the claim's chips, when they divide evenly; None
+    leaves the claim-level visibility untouched (all processes share all
+    chips and the HBM fraction is the budget)."""
+    chips = [c.strip() for c in visible.split(",") if c.strip()]
+    if not chips or len(chips) % max_processes != 0:
+        return None
+    per = len(chips) // max_processes
+    return ",".join(chips[slot * per:(slot + 1) * per])
+
+
+def apply_sharing_env(
+    environ: Optional[MutableMapping[str, str]] = None,
+) -> Optional[SharingRuntime]:
+    """Apply the driver's sharing envelope to this process.
+
+    Mutates ``environ`` (default ``os.environ``) BEFORE the TPU runtime
+    initializes — call it ahead of the first jax import/device touch
+    (``initialize_distributed`` does). Returns the decision record, or
+    None when the claim is exclusive (no envelope present).
+    """
+    global _active
+    env = environ if environ is not None else os.environ
+    mode = env.get("TPU_DRA_SHARING", "")
+    if not mode:
+        return None
+    if env.get(_APPLIED_MARKER):
+        # Idempotent: the first application's decision stands.
+        return _active if environ is None else None
+
+    if mode == "process-shared":
+        max_p = max(int(env.get("TPU_DRA_MAX_PROCESSES", "1") or 1), 1)
+        shared_dir = env.get("TPU_DRA_SHARED_DIR", "")
+        slot, lock = (-1, None)
+        if shared_dir:
+            # Acquire even when maxProcesses == 1: that's the case where
+            # a second process sneaking in MUST be refused.
+            slot, lock = _acquire_slot(shared_dir, max_p)
+        rt = SharingRuntime(
+            mode=mode, slot=slot, max_processes=max_p, _slot_lock=lock
+        )
+        if slot >= 0:
+            env.setdefault("TPU_DRA_PROCESS_SLOT", str(slot))
+            part = _partition_visible_chips(
+                env.get("TPU_VISIBLE_CHIPS", ""), slot, max_p
+            )
+            if part is not None:
+                env["TPU_VISIBLE_CHIPS"] = part
+                rt.visible_chips = part
+        limit = int(env.get("TPU_DRA_HBM_LIMIT_BYTES", "0") or 0)
+        hbm = int(env.get("TPU_DRA_CHIP_HBM_BYTES", "0") or 0)
+        if limit > 0 and hbm > 0:
+            frac = min(limit / hbm, 1.0)
+            env.setdefault("XLA_PYTHON_CLIENT_MEM_FRACTION", f"{frac:.4f}")
+            rt.mem_fraction = float(env["XLA_PYTHON_CLIENT_MEM_FRACTION"])
+        logger.info(
+            "process-shared claim: slot %d/%d, visible=%s, mem_fraction=%s",
+            slot, max_p, rt.visible_chips or "(claim-wide)",
+            rt.mem_fraction,
+        )
+        env[_APPLIED_MARKER] = "1"
+        if environ is None:
+            _active = rt
+        return rt
+
+    if mode == "time-shared":
+        level = int(env.get("TPU_DRA_TIMESHARE_QUANTUM", "0") or 0)
+        rt = SharingRuntime(
+            mode=mode,
+            quantum_seconds=_QUANTUM_SECONDS.get(level, 1.0),
+        )
+        logger.info(
+            "time-shared claim: quantum level %d (%.1fs advisory lease); "
+            "gate device work with timeshare_lease()",
+            level, rt.quantum_seconds,
+        )
+        env[_APPLIED_MARKER] = "1"
+        if environ is None:
+            _active = rt
+        return rt
+
+    logger.warning("unknown TPU_DRA_SHARING mode %r ignored", mode)
+    return None
+
+
+@contextlib.contextmanager
+def timeshare_lease(
+    environ: Optional[MutableMapping[str, str]] = None,
+) -> Iterator[None]:
+    """Exclusive device lease for a time-shared claim.
+
+    Wrap each chunk of device work (a training step, an inference batch):
+    the lease flocks ONE LOCK FILE PER CHIP (``TPU_DRA_CHIP_UUIDS``) in
+    the node-global rendezvous dir, always in sorted order (no
+    deadlocks). Per-chip locks mean claims with overlapping but unequal
+    chip sets contend exactly on the chips they share — which IS the
+    time-slicing. Holding a lease much longer than the operator-chosen
+    quantum is logged, since co-tenants are starving meanwhile. On an
+    exclusive claim (no envelope) this is a free no-op, so library code
+    can use it unconditionally.
+    """
+    import time
+
+    env = environ if environ is not None else os.environ
+    if env.get("TPU_DRA_SHARING", "") != "time-shared":
+        yield
+        return
+    shared_dir = env.get("TPU_DRA_SHARED_DIR", "")
+    if not shared_dir:
+        logger.warning(
+            "time-shared claim without TPU_DRA_SHARED_DIR; lease is a no-op"
+        )
+        yield
+        return
+    os.makedirs(shared_dir, exist_ok=True)
+    names = sorted(
+        u.strip() for u in env.get("TPU_DRA_CHIP_UUIDS", "").split(",")
+        if u.strip()
+    ) or ["timeshare"]
+    level = int(env.get("TPU_DRA_TIMESHARE_QUANTUM", "0") or 0)
+    quantum = _QUANTUM_SECONDS.get(level, 1.0)
+    files = []
+    try:
+        for name in names:
+            f = open(os.path.join(shared_dir, f"{name}.lock"), "a+")
+            files.append(f)
+            fcntl.flock(f, fcntl.LOCK_EX)
+        start = time.monotonic()
+        yield
+        held = time.monotonic() - start
+        if held > 2 * quantum:
+            logger.warning(
+                "time-share lease held %.2fs, over the %.1fs quantum — "
+                "co-tenant processes were starved; shorten device-work "
+                "chunks or raise the claim's interval", held, quantum,
+            )
+    finally:
+        for f in reversed(files):
+            try:
+                fcntl.flock(f, fcntl.LOCK_UN)
+            finally:
+                f.close()

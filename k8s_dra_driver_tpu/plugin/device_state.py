@@ -98,7 +98,9 @@ class DeviceState:
         self.cdi.create_standard_device_spec_file(self.allocatable)
 
         share_state = SharingStateStore(f"{state_dir}/sharing")
-        self.ts_manager = TimeShareManager(self.chiplib, share_state)
+        self.ts_manager = TimeShareManager(
+            self.chiplib, share_state, f"{state_dir}/time-share"
+        )
         self.ps_manager = ProcessShareManager(
             self.chiplib, share_state, f"{state_dir}/process-share"
         )

@@ -5,14 +5,19 @@ cmd/nvidia-dra-plugin/sharing.go:97-442). The GPU mechanisms do not map 1:1:
 
 - GPU time-slicing is an nvidia-smi knob on the device
   (sharing.go:103-122); TPU has no on-device scheduler knob, so TimeShared
-  is realised by (a) marking the chip's runtime mode and (b) injecting a
-  quantum hint the workload-side runtime shim honours when multiple
-  processes round-robin the chip.
+  is realised by (a) marking the chip's runtime mode, (b) mounting a
+  shared rendezvous dir, and (c) injecting a quantum hint — the
+  workload-side shim (parallel/shim.py ``timeshare_lease``) round-robins
+  co-tenants through an exclusive flock in that dir.
 - MPS is a per-claim control daemon Deployment + pipe/shm dirs
   (sharing.go:185-344); TPU process sharing needs no daemon — libtpu
-  multi-process support is configured purely through env
-  (process bounds, per-process HBM limits), so a ProcessShare "session" is
-  a state-dir entry plus the env/mount edits for the claim's containers.
+  multi-process support is configured purely through env, so a
+  ProcessShare "session" is a state-dir entry plus the env/mount edits
+  for the claim's containers. The HBM budget maps onto
+  ``XLA_PYTHON_CLIENT_MEM_FRACTION`` (the allocator cap JAX honors),
+  and the shim (parallel/shim.py ``apply_sharing_env``) enforces
+  maxProcesses via flock'd slot files and partitions
+  ``TPU_VISIBLE_CHIPS`` per process slot.
 
 What carries over unchanged: the full-device-only guard, per-claim session
 identity (claimUID + digest of UUIDs, sharing.go:151-155), mode exclusivity
@@ -152,11 +157,22 @@ def _require_full_chips(devices: list[AllocatableDevice], what: str) -> None:
 
 
 class TimeShareManager:
-    """TimeSlicingManager analog (sharing.go:97-122)."""
+    """TimeSlicingManager analog (sharing.go:97-122).
 
-    def __init__(self, chiplib: ChipLib, state: SharingStateStore):
+    The workload-side lease (parallel/shim.py timeshare_lease) needs a
+    rendezvous point every co-tenant of a chip can flock. ONE node-global
+    dir is mounted into every time-shared container, and the locks inside
+    are PER CHIP (``<uuid>.lock``, advertised via TPU_DRA_CHIP_UUIDS), so
+    claims with overlapping but unequal chip sets contend exactly on the
+    chips they actually share.
+    """
+
+    def __init__(self, chiplib: ChipLib, state: SharingStateStore,
+                 run_dir: str):
         self.chiplib = chiplib
         self.state = state
+        self.run_dir = run_dir
+        os.makedirs(run_dir, exist_ok=True)
 
     def set_time_share(
         self,
@@ -176,7 +192,16 @@ class TimeShareManager:
             env={
                 "TPU_DRA_SHARING": "time-shared",
                 "TPU_DRA_TIMESHARE_QUANTUM": str(config.quantum_level()),
-            }
+                "TPU_DRA_SHARED_DIR": "/var/run/tpu-dra-shared",
+                "TPU_DRA_CHIP_UUIDS": ",".join(sorted(uuids)),
+            },
+            mounts=[
+                {
+                    "hostPath": self.run_dir,
+                    "containerPath": "/var/run/tpu-dra-shared",
+                    "options": ["rw", "rbind"],
+                }
+            ],
         )
 
     def reset(self, claim_uid: str, uuids: list[str]) -> None:
@@ -189,6 +214,12 @@ class TimeShareManager:
         freed = [u for u in uuids if self.state.release(u, claim_uid)]
         if freed:
             self.chiplib.set_sharing_mode(freed, SHARING_EXCLUSIVE)
+        for u in freed:
+            # Last tenant of chip u gone: its lock file goes too.
+            try:
+                os.unlink(os.path.join(self.run_dir, f"{u}.lock"))
+            except OSError:
+                pass
 
 
 def _session_id(claim_uid: str, uuids: list[str]) -> str:
@@ -250,6 +281,14 @@ class ProcessShareSession:
             # Also cap XLA's premapped buffer so runtimes without the shim
             # still respect the budget.
             hbm_env["TPU_PREMAPPED_BUFFER_SIZE"] = str(floor)
+            # Map the budget onto the knob JAX actually honors: the client
+            # allocator fraction. The shim recomputes per-process values;
+            # setting it here means even shim-less workloads are capped.
+            chip_hbm = min(c.hbm_bytes for c in chips)
+            if chip_hbm > 0:
+                hbm_env["TPU_DRA_CHIP_HBM_BYTES"] = str(chip_hbm)
+                frac = min(floor / chip_hbm, 1.0)
+                hbm_env["XLA_PYTHON_CLIENT_MEM_FRACTION"] = f"{frac:.4f}"
         pct = self.config.default_active_core_percentage
         if pct is not None:
             hbm_env["TPU_DRA_ACTIVE_CORE_PERCENTAGE"] = str(pct)

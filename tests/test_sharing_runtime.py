@@ -1,0 +1,332 @@
+"""Sharing made real: two OS processes demonstrably honor the limits.
+
+Round-2 verdict #4: the driver injected TPU_DRA_* env nothing consumed.
+Now plugin/sharing.py maps the HBM budget onto the knob JAX honors
+(XLA_PYTHON_CLIENT_MEM_FRACTION) and parallel/shim.py is the promised
+workload-side consumer: slot acquisition, chip partitioning, and the
+time-share lease. Reference behavior bar: sharing.go:103-122 (time
+slice), :185-344 (MPS daemon).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+from k8s_dra_driver_tpu.parallel.shim import (
+    apply_sharing_env,
+    timeshare_lease,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_worker(code: str, env: dict) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-c", code],
+        env={**os.environ, **env},
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+
+
+class TestProcessShareShim:
+    def test_two_processes_get_disjoint_slots_and_chips(self, tmp_path):
+        """Two real processes of one process-shared claim: unique slots,
+        disjoint TPU_VISIBLE_CHIPS halves, capped allocator fraction."""
+        env = {
+            "TPU_DRA_SHARING": "process-shared",
+            "TPU_DRA_MAX_PROCESSES": "2",
+            "TPU_DRA_SHARED_DIR": str(tmp_path),
+            "TPU_VISIBLE_CHIPS": "0,1,2,3",
+            "TPU_DRA_HBM_LIMIT_BYTES": str(8 << 30),
+            "TPU_DRA_CHIP_HBM_BYTES": str(16 << 30),
+        }
+        code = """
+import json, os, sys, time
+from k8s_dra_driver_tpu.parallel.shim import apply_sharing_env
+rt = apply_sharing_env()
+print(json.dumps({
+    "slot": rt.slot,
+    "visible": os.environ["TPU_VISIBLE_CHIPS"],
+    "fraction": os.environ["XLA_PYTHON_CLIENT_MEM_FRACTION"],
+}))
+time.sleep(1.0)  # hold the slot so the sibling can't reuse it
+"""
+        import threading
+
+        results = []
+
+        def launch():
+            results.append(run_worker(code, env))
+
+        threads = [threading.Thread(target=launch) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        outs = []
+        for r in results:
+            assert r.returncode == 0, r.stderr
+            outs.append(json.loads(r.stdout.strip().splitlines()[-1]))
+        assert {o["slot"] for o in outs} == {0, 1}
+        by_slot = {o["slot"]: o for o in outs}
+        assert by_slot[0]["visible"] == "0,1"
+        assert by_slot[1]["visible"] == "2,3"
+        # 8GiB budget on a 16GiB chip -> half the allocator.
+        assert all(float(o["fraction"]) == 0.5 for o in outs)
+
+    def test_overcommit_is_refused(self, tmp_path):
+        """A third process beyond maxProcesses finds no slot — the limit
+        is enforced, not advisory."""
+        env = {
+            "TPU_DRA_SHARING": "process-shared",
+            "TPU_DRA_MAX_PROCESSES": "1",
+            "TPU_DRA_SHARED_DIR": str(tmp_path),
+        }
+        rt = apply_sharing_env(dict(env, **{}))  # hold slot 0 in-process
+        # Fake a live holder: _acquire_slot in THIS process keeps the lock.
+        slot, lock = rt.slot, rt._slot_lock
+        assert slot == 0 and lock is not None
+        code = """
+from k8s_dra_driver_tpu.parallel.shim import (
+    SharingRuntimeError, apply_sharing_env)
+try:
+    apply_sharing_env()
+except SharingRuntimeError:
+    print("REFUSED")
+"""
+        r = run_worker(code, env)
+        assert r.returncode == 0, r.stderr
+        assert "REFUSED" in r.stdout
+        rt.release()
+
+    def test_crashed_holder_frees_slot(self, tmp_path):
+        """flock dies with the process: a crashed worker's slot is
+        immediately reusable (the property MPS needs its daemon for)."""
+        env = {
+            "TPU_DRA_SHARING": "process-shared",
+            "TPU_DRA_MAX_PROCESSES": "1",
+            "TPU_DRA_SHARED_DIR": str(tmp_path),
+        }
+        code = """
+from k8s_dra_driver_tpu.parallel.shim import apply_sharing_env
+rt = apply_sharing_env()
+print("slot", rt.slot)
+"""  # process exits, releasing the flock
+        r1 = run_worker(code, env)
+        assert "slot 0" in r1.stdout, r1.stderr
+        r2 = run_worker(code, env)
+        assert "slot 0" in r2.stdout, r2.stderr
+
+    def test_idempotent_application(self, tmp_path):
+        """An entrypoint calling apply_sharing_env() AND
+        initialize_distributed() (which calls it again) must not burn a
+        second slot or re-partition the already-halved chip list."""
+        environ = {
+            "TPU_DRA_SHARING": "process-shared",
+            "TPU_DRA_MAX_PROCESSES": "2",
+            "TPU_DRA_SHARED_DIR": str(tmp_path),
+            "TPU_VISIBLE_CHIPS": "0,1,2,3",
+        }
+        rt = apply_sharing_env(environ)
+        try:
+            assert environ["TPU_VISIBLE_CHIPS"] == "0,1"
+            assert apply_sharing_env(environ) is None  # second call: no-op
+            assert environ["TPU_VISIBLE_CHIPS"] == "0,1"  # NOT re-halved
+        finally:
+            rt.release()
+
+    def test_indivisible_chips_stay_claim_wide(self, tmp_path):
+        environ = {
+            "TPU_DRA_SHARING": "process-shared",
+            "TPU_DRA_MAX_PROCESSES": "2",
+            "TPU_DRA_SHARED_DIR": str(tmp_path),
+            "TPU_VISIBLE_CHIPS": "0,1,2",  # 3 chips, 2 processes
+        }
+        rt = apply_sharing_env(environ)
+        try:
+            assert rt.visible_chips is None
+            assert environ["TPU_VISIBLE_CHIPS"] == "0,1,2"
+        finally:
+            rt.release()
+
+    def test_exclusive_claim_is_untouched(self):
+        environ = {"SOME": "ENV"}
+        assert apply_sharing_env(environ) is None
+        assert environ == {"SOME": "ENV"}
+
+
+class TestTimeShareShim:
+    def test_leases_are_mutually_exclusive(self, tmp_path):
+        """Two processes round-robin the device under timeshare_lease:
+        their critical sections never overlap — this IS the time
+        slicing."""
+        env = {
+            "TPU_DRA_SHARING": "time-shared",
+            "TPU_DRA_TIMESHARE_QUANTUM": "1",
+            "TPU_DRA_SHARED_DIR": str(tmp_path),
+            "TPU_DRA_CHIP_UUIDS": "TPU-aaa,TPU-bbb",
+        }
+        code = """
+import json, os, sys, time
+from k8s_dra_driver_tpu.parallel.shim import timeshare_lease
+spans = []
+for _ in range(5):
+    with timeshare_lease():
+        start = time.monotonic()
+        time.sleep(0.02)  # "device work"
+        spans.append((start, time.monotonic()))
+with open(os.environ["SPAN_FILE"], "w") as f:
+    json.dump(spans, f)
+"""
+        import threading
+
+        results = []
+
+        def launch(i):
+            results.append(run_worker(
+                code, dict(env, SPAN_FILE=str(tmp_path / f"spans{i}.json"))
+            ))
+
+        threads = [
+            threading.Thread(target=launch, args=(i,)) for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for r in results:
+            assert r.returncode == 0, r.stderr
+        spans0 = json.load(open(tmp_path / "spans0.json"))
+        spans1 = json.load(open(tmp_path / "spans1.json"))
+        assert len(spans0) == len(spans1) == 5
+        for s0, e0 in spans0:
+            for s1, e1 in spans1:
+                assert e0 <= s1 or e1 <= s0, (
+                    f"leases overlap: ({s0},{e0}) vs ({s1},{e1})"
+                )
+
+    def test_overlapping_claims_contend_on_shared_chip(self, tmp_path):
+        """Claim A on chips {X,Y}, claim B on {X} alone: per-chip locks
+        make them mutually exclusive on X even though the chip SETS
+        differ — the round-3 review caught a set-keyed design granting
+        them disjoint locks."""
+        base = {
+            "TPU_DRA_SHARING": "time-shared",
+            "TPU_DRA_TIMESHARE_QUANTUM": "0",
+            "TPU_DRA_SHARED_DIR": str(tmp_path),
+        }
+        code = """
+import json, os, time
+from k8s_dra_driver_tpu.parallel.shim import timeshare_lease
+spans = []
+for _ in range(5):
+    with timeshare_lease():
+        start = time.monotonic()
+        time.sleep(0.02)
+        spans.append((start, time.monotonic()))
+with open(os.environ["SPAN_FILE"], "w") as f:
+    json.dump(spans, f)
+"""
+        import threading
+
+        results = []
+
+        def launch(i, uuids):
+            results.append(run_worker(code, dict(
+                base, TPU_DRA_CHIP_UUIDS=uuids,
+                SPAN_FILE=str(tmp_path / f"ospans{i}.json"))))
+
+        threads = [
+            threading.Thread(target=launch, args=(0, "TPU-xxx,TPU-yyy")),
+            threading.Thread(target=launch, args=(1, "TPU-xxx")),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for r in results:
+            assert r.returncode == 0, r.stderr
+        spans0 = json.load(open(tmp_path / "ospans0.json"))
+        spans1 = json.load(open(tmp_path / "ospans1.json"))
+        for s0, e0 in spans0:
+            for s1, e1 in spans1:
+                assert e0 <= s1 or e1 <= s0, "overlap on shared chip X"
+
+    def test_noop_without_envelope(self):
+        with timeshare_lease(environ={}):
+            pass  # free pass-through on exclusive claims
+
+
+class TestDriverInjectsRealKnobs:
+    def test_process_share_edits_cap_the_allocator(self, tmp_path):
+        """container_edits must carry the JAX-honored fraction computed
+        from the HBM budget, not just driver-invented env."""
+        from k8s_dra_driver_tpu.api.v1alpha1 import ProcessSharedConfig
+        from k8s_dra_driver_tpu.plugin.sharing import (
+            ProcessShareManager,
+            SharingStateStore,
+        )
+        from k8s_dra_driver_tpu.tpulib import FakeChipLib
+
+        lib = FakeChipLib(generation="v5e", topology="2x2x1")
+        lib.init()
+        devices = list(
+            lib.enumerate_all_possible_devices({"chip"}).values()
+        )[:1]
+        mgr = ProcessShareManager(
+            lib, SharingStateStore(str(tmp_path / "state")),
+            str(tmp_path / "run"),
+        )
+        cfg = ProcessSharedConfig.from_dict(
+            {"maxProcesses": 2, "defaultHbmLimit": "8Gi"}
+        )
+        cfg.normalize()
+        cfg.validate()
+        session = mgr.new_session("uid-1", devices, cfg)
+        session.start()
+        edits = session.container_edits()
+        # v5e chip = 16GiB HBM; 8GiB budget -> 0.5 fraction.
+        assert edits.env["XLA_PYTHON_CLIENT_MEM_FRACTION"] == "0.5000"
+        assert edits.env["TPU_DRA_CHIP_HBM_BYTES"] == str(16 << 30)
+        assert edits.env["TPU_DRA_HBM_LIMIT_BYTES"] == str(8 << 30)
+        session.stop()
+
+    def test_time_share_edits_mount_rendezvous_dir(self, tmp_path):
+        from k8s_dra_driver_tpu.api.v1alpha1 import TimeSharedConfig
+        from k8s_dra_driver_tpu.plugin.sharing import (
+            SharingStateStore,
+            TimeShareManager,
+        )
+        from k8s_dra_driver_tpu.tpulib import FakeChipLib
+
+        lib = FakeChipLib(generation="v5e", topology="2x2x1")
+        lib.init()
+        devices = list(
+            lib.enumerate_all_possible_devices({"chip"}).values()
+        )[:1]
+        mgr = TimeShareManager(
+            lib, SharingStateStore(str(tmp_path / "state")),
+            str(tmp_path / "run"),
+        )
+        cfg = TimeSharedConfig.from_dict({"interval": "Short"})
+        edits = mgr.set_time_share("uid-a", devices, cfg)
+        assert edits.env["TPU_DRA_SHARED_DIR"] == "/var/run/tpu-dra-shared"
+        uuids = sorted(d.chip.uuid for d in devices)
+        assert edits.env["TPU_DRA_CHIP_UUIDS"] == ",".join(uuids)
+        # EVERY time-shared claim mounts the one node-global dir, so
+        # overlapping claims contend on the per-chip locks inside it.
+        host_dir = edits.mounts[0]["hostPath"]
+        assert host_dir == str(tmp_path / "run")
+        edits2 = mgr.set_time_share("uid-b", devices, cfg)
+        assert edits2.mounts[0]["hostPath"] == host_dir
+        # A chip's lock file outlives one claim, dies with the last.
+        lock = os.path.join(host_dir, f"{uuids[0]}.lock")
+        open(lock, "w").close()  # as the workload's lease would
+        mgr.reset("uid-a", [d.chip.uuid for d in devices])
+        assert os.path.exists(lock)
+        mgr.reset("uid-b", [d.chip.uuid for d in devices])
+        assert not os.path.exists(lock)
