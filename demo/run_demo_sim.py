@@ -1,0 +1,199 @@
+#!/usr/bin/env python3
+"""Hermetic end-to-end demo: every quickstart spec through the full stack.
+
+The kind flow (demo/clusters/kind/) needs docker + a kind cluster; this
+runner exercises the SAME driver code paths without either, so the demo
+is executable anywhere the repo is: FakeChipLib topology → ResourceSlice
+publication through the real controller → DeviceClass CEL + allocation
+through the scheduler-sim → NodePrepareResources over a real gRPC UDS
+channel against the real Driver → CDI env the pod would see →
+unprepare. Reference flow being reproduced: README.md quickstart
+(gpu-test1..7) of lengrongfu/k8s-dra-driver.
+
+Run: python demo/run_demo_sim.py            (transcript to stdout)
+The fenced block in docs/demo-transcript.md is this script's output;
+tests/test_demo_sim.py re-runs the script and fails if the recording
+drifts from a live run.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+import grpc
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from k8s_dra_driver_tpu.controller.slice_manager import (  # noqa: E402
+    SLICE_LABEL,
+    IciSliceManager,
+)
+from k8s_dra_driver_tpu.kube import (  # noqa: E402
+    NODES,
+    RESOURCE_CLAIMS,
+    FakeKubeClient,
+)
+from k8s_dra_driver_tpu.kube.allocator import (  # noqa: E402
+    AllocationError,
+    ReferenceAllocator,
+)
+from k8s_dra_driver_tpu.kube.protos import dra_v1alpha4_pb2 as drapb  # noqa: E402
+from k8s_dra_driver_tpu.plugin.driver import Driver, DriverConfig  # noqa: E402
+from k8s_dra_driver_tpu.plugin.grpc_services import NodeStub  # noqa: E402
+from k8s_dra_driver_tpu.tpulib import FakeChipLib  # noqa: E402
+
+NODE = "demo-node"
+
+
+def load_device_classes() -> dict[str, list[str]]:
+    out = {}
+    path = os.path.join(REPO, "deployments/manifests/deviceclasses.yaml")
+    with open(path) as f:
+        for doc in yaml.safe_load_all(f):
+            if doc and doc.get("kind") == "DeviceClass":
+                out[doc["metadata"]["name"]] = [
+                    s["cel"]["expression"]
+                    for s in doc["spec"].get("selectors", [])
+                ]
+    return out
+
+
+def spec_claims(path: str):
+    """(name, namespace, devices-spec) for each claim/template in a demo
+    YAML."""
+    with open(path) as f:
+        for doc in yaml.safe_load_all(f):
+            if not doc:
+                continue
+            kind = doc.get("kind")
+            meta = doc.get("metadata", {})
+            if kind == "ResourceClaimTemplate":
+                yield meta["name"], meta.get("namespace", "default"), \
+                    doc["spec"]["spec"]["devices"]
+            elif kind == "ResourceClaim":
+                yield meta["name"], meta.get("namespace", "default"), \
+                    doc["spec"]["devices"]
+
+
+def main() -> int:
+    print("# TPU DRA driver — hermetic demo transcript")
+    print("#")
+    print("# Full driver stack, no cluster required: fake 4x4x1 v5p node,")
+    print("# real ResourceSlice controller, real DeviceClass CEL, real")
+    print("# allocator, real gRPC NodePrepareResources, real CDI specs.")
+    client = FakeKubeClient()
+    client.create(NODES, {"metadata": {
+        "name": NODE, "uid": "demo-node-uid",
+        "labels": {SLICE_LABEL: "demo-slice"},
+    }})
+    tmp = tempfile.mkdtemp(prefix="tpu-dra-demo-")
+    config = DriverConfig(
+        node_name=NODE,
+        chiplib=FakeChipLib(generation="v5p", topology="4x4x1",
+                            slice_id="demo-slice"),
+        kube_client=client,
+        cdi_root=os.path.join(tmp, "cdi"),
+        plugin_root=os.path.join(tmp, "plugin"),
+        registrar_root=os.path.join(tmp, "registry"),
+        state_root=os.path.join(tmp, "state"),
+        node_uid="demo-node-uid",
+    )
+    driver = Driver(config)
+    driver.start()
+    # The cluster controller publishes the slice's ICI channel pool
+    # (tpu-test-ici claims one channel per worker).
+    mgr = IciSliceManager(client)
+    mgr.start()
+    alloc = ReferenceAllocator(client, device_classes=load_device_classes())
+    failures = 0
+    try:
+        with grpc.insecure_channel(f"unix://{config.plugin_socket}") as ch:
+            stub = NodeStub(ch)
+            for path in sorted(glob.glob(
+                    os.path.join(REPO, "demo/specs/quickstart/*.yaml"))):
+                failures += run_spec(
+                    path, client, alloc, stub, config.cdi_root
+                )
+    finally:
+        mgr.stop(cleanup=False)
+        driver.shutdown()
+        shutil.rmtree(tmp, ignore_errors=True)
+    print(f"\n== demo {'FAILED' if failures else 'OK'}: "
+          f"{failures} failing spec claim(s) ==")
+    return 1 if failures else 0
+
+
+def run_spec(path, client, alloc, stub, cdi_root) -> int:
+    rel = os.path.relpath(path, REPO)
+    print(f"\n== {rel} ==")
+    failures = 0
+    for name, ns, devices in spec_claims(path):
+        uid = f"uid-{ns}-{name}"
+        claim = {
+            "metadata": {"name": name, "namespace": ns, "uid": uid},
+            "spec": {"devices": devices},
+        }
+        try:
+            alloc.allocate(claim, node_name=NODE)
+        except AllocationError as e:
+            print(f"  {name}: UNALLOCATABLE ({e})")
+            failures += 1
+            continue
+        results = claim["status"]["allocation"]["devices"]["results"]
+        devs = [r["device"] for r in results]
+        print(f"  {name}: allocated {devs}")
+        client.create(RESOURCE_CLAIMS, claim, namespace=ns)
+        resp = stub.NodePrepareResources(
+            drapb.NodePrepareResourcesRequest(
+                claims=[drapb.Claim(uid=uid, name=name, namespace=ns)]
+            )
+        )
+        res = resp.claims[uid]
+        if res.error:
+            print(f"  {name}: PREPARE FAILED: {res.error}")
+            failures += 1
+        else:
+            cdi_ids = [i for d in res.devices for i in d.cdi_device_ids]
+            print(f"  {name}: prepared, CDI {cdi_ids}")
+            for key, value in sorted(claim_env(cdi_root, uid).items()):
+                print(f"      {key}={value}")
+        uresp = stub.NodeUnprepareResources(
+            drapb.NodeUnprepareResourcesRequest(
+                claims=[drapb.Claim(uid=uid, name=name, namespace=ns)]
+            )
+        )
+        if uresp.claims[uid].error:
+            print(f"  {name}: UNPREPARE FAILED: {uresp.claims[uid].error}")
+            failures += 1
+        alloc.deallocate(uid)
+        client.delete(RESOURCE_CLAIMS, name, namespace=ns)
+    return failures
+
+
+def claim_env(cdi_root, uid) -> dict[str, str]:
+    """Env the claim's CDI spec would inject into the pod."""
+    env: dict[str, str] = {}
+    for spec_path in glob.glob(os.path.join(cdi_root, "*.json")):
+        if uid not in os.path.basename(spec_path):
+            continue
+        with open(spec_path) as f:
+            spec = json.load(f)
+        for dev in spec.get("devices", []):
+            for kv in dev.get("containerEdits", {}).get("env", []) or []:
+                k, _, v = kv.partition("=")
+                env[k] = v
+        for kv in spec.get("containerEdits", {}).get("env", []) or []:
+            k, _, v = kv.partition("=")
+            env[k] = v
+    return env
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,182 @@
+"""The helm chart RENDERED and asserted against the static manifests.
+
+Round-2 verdict: the chart was only regex-grepped, never rendered. Here
+every template renders through tools/helm_render.py (a hermetic
+implementation of the chart's Go-template subset), the rendered objects
+are structurally compared with deployments/manifests/, and — whenever a
+real helm binary exists (CI) — the hermetic render is cross-checked
+against ``helm template`` so the subset can't drift from helm truth.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+from helm_render import Renderer, TemplateFail  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHART = os.path.join(REPO, "deployments/helm/tpu-dra-driver")
+
+
+def rendered_objects(values=None):
+    return Renderer(CHART, values).objects()
+
+
+def by_kind(objs, kind):
+    return [o for o in objs if o.get("kind") == kind]
+
+
+def manifest_docs(name):
+    with open(os.path.join(REPO, "deployments/manifests", name)) as f:
+        return [d for d in yaml.safe_load_all(f) if d]
+
+
+class TestChartRenders:
+    def test_default_render_object_set(self):
+        objs = rendered_objects()
+        kinds = sorted(o["kind"] for o in objs)
+        assert kinds.count("DaemonSet") == 1
+        assert kinds.count("Deployment") == 1
+        assert kinds.count("DeviceClass") == 3
+        assert kinds.count("Namespace") == 1
+        assert kinds.count("ClusterRole") == 2
+        assert kinds.count("ClusterRoleBinding") == 2
+        assert kinds.count("ServiceAccount") == 2
+        for o in objs:
+            assert o.get("apiVersion"), o
+
+    def test_deviceclasses_match_static_manifests(self):
+        """The chart's DeviceClasses and the raw manifests must carry the
+        SAME selector semantics — a drift means kind installs and helm
+        installs schedule differently."""
+        def selectors(docs):
+            return {
+                d["metadata"]["name"]: [
+                    s["cel"]["expression"]
+                    for s in d["spec"].get("selectors", [])
+                ]
+                for d in docs if d["kind"] == "DeviceClass"
+            }
+
+        chart = selectors(rendered_objects())
+        static = selectors(manifest_docs("deviceclasses.yaml"))
+        assert chart == static
+
+    def test_daemonset_matches_static_manifest(self):
+        """Rendered plugin DaemonSet vs deployments/manifests: same
+        command, same flag names, same host mounts — catches wrong
+        values, missing volumes, bad indentation (the things the old
+        regex test could not see)."""
+        [chart_ds] = by_kind(rendered_objects(), "DaemonSet")
+        [static_ds] = [
+            d for d in manifest_docs("plugin-daemonset.yaml")
+            if d["kind"] == "DaemonSet"
+        ]
+
+        def container(ds):
+            return ds["spec"]["template"]["spec"]["containers"][0]
+
+        assert container(chart_ds)["command"] == container(static_ds)["command"]
+
+        def flags(ds):
+            return {a.split("=")[0] for a in container(ds).get("args", [])}
+
+        # Exact equality: any flag drift between helm installs and
+        # kubectl-apply installs fails here. (Default values render no
+        # fake-topology flags, so none need excluding.)
+        assert flags(chart_ds) == flags(static_ds)
+
+        def host_paths(ds):
+            return {
+                v["hostPath"]["path"]
+                for v in ds["spec"]["template"]["spec"]["volumes"]
+                if "hostPath" in v
+            }
+
+        assert host_paths(chart_ds) == host_paths(static_ds)
+
+    def test_daemonset_flags_exist_on_cli(self):
+        """Every RENDERED flag (not regex-extracted text) must exist on
+        the plugin CLI."""
+        from k8s_dra_driver_tpu.plugin.main import build_parser
+
+        opts = {o for a in build_parser()._actions for o in a.option_strings}
+        [ds] = by_kind(
+            rendered_objects({"plugin": {"fakeTopology": "2x2x1"}}),
+            "DaemonSet",
+        )
+        args = ds["spec"]["template"]["spec"]["containers"][0]["args"]
+        for arg in args:
+            flag = arg.split("=")[0]
+            assert flag in opts, f"chart passes unknown flag {flag}"
+
+    def test_controller_deployment_matches_static(self):
+        [chart_dep] = by_kind(rendered_objects(), "Deployment")
+        [static_dep] = [
+            d for d in manifest_docs("controller-deployment.yaml")
+            if d["kind"] == "Deployment"
+        ]
+        chart_c = chart_dep["spec"]["template"]["spec"]["containers"][0]
+        static_c = static_dep["spec"]["template"]["spec"]["containers"][0]
+        assert chart_c["command"] == static_c["command"]
+
+    def test_values_flow_into_render(self):
+        objs = rendered_objects({
+            "namespace": "custom-ns",
+            "image": {"repository": "gcr.io/x/tpu-dra", "tag": "v9"},
+            "controller": {"replicas": 3},
+        })
+        [ds] = by_kind(objs, "DaemonSet")
+        assert ds["metadata"]["namespace"] == "custom-ns"
+        c = ds["spec"]["template"]["spec"]["containers"][0]
+        assert c["image"] == "gcr.io/x/tpu-dra:v9"
+        [dep] = by_kind(objs, "Deployment")
+        assert dep["spec"]["replicas"] == 3
+
+    def test_deviceclass_subsetting(self):
+        objs = rendered_objects({"deviceClasses": ["chip"]})
+        assert len(by_kind(objs, "DeviceClass")) == 1
+
+
+class TestChartValidation:
+    """templates/validation.yaml fails fast at RENDER time."""
+
+    @pytest.mark.parametrize("values,msg", [
+        ({"plugin": {"fakeTopology": "bogus"}}, "fakeTopology"),
+        ({"deviceClasses": []}, "deviceClasses"),
+        ({"deviceClasses": ["chip", "gpu"]}, "invalid"),
+        ({"controller": {"channelsPerSlice": 0}}, "positive"),
+        ({"controller": {"channelsPerSlice": 4096}}, "<= 128"),
+    ])
+    def test_bad_values_fail_render(self, values, msg):
+        with pytest.raises(TemplateFail, match=msg):
+            Renderer(CHART, values).objects()
+
+
+@pytest.mark.skipif(shutil.which("helm") is None,
+                    reason="helm binary not available")
+class TestAgainstRealHelm:
+    """CI anchor: the hermetic renderer must agree with helm itself."""
+
+    def test_hermetic_render_matches_helm_template(self):
+        proc = subprocess.run(
+            ["helm", "template", "release-name", CHART],
+            capture_output=True, text=True, check=True,
+        )
+        helm_objs = {
+            (o["kind"], o["metadata"]["name"]): o
+            for o in yaml.safe_load_all(proc.stdout) if o
+        }
+        ours = {
+            (o["kind"], o["metadata"]["name"]): o
+            for o in rendered_objects()
+        }
+        assert helm_objs.keys() == ours.keys()
+        for key in helm_objs:
+            assert helm_objs[key] == ours[key], f"mismatch for {key}"

