@@ -1,19 +1,55 @@
-"""Per-op efficiency on the chip: isolate matmul vs flash kernel."""
+"""Per-op efficiency on the chip: isolate matmul vs flash kernel.
+
+Timing methodology (shared with bench.py): the axon remote-execution
+runtime makes ``block_until_ready`` a no-op and memoizes identical
+dispatches, while any value fetch costs a ~90ms tunnel round-trip. So we
+time a DEPENDENCY CHAIN of n iterations (each iteration's input folds in
+the previous output, so nothing can be elided or memoized) with a single
+fetch at the end, at two chain lengths; the slope (T(n2)-T(n1))/(n2-n1)
+is the true per-op device time with the round-trip cancelled out.
+"""
 import time
 import jax, jax.numpy as jnp
 from k8s_dra_driver_tpu.ops.attention import flash_attention, set_attention_blocks
 
 PEAK = 197e12
 
-def timeit(fn, args, flops, name, n=6):
-    outs = fn(*args); jax.block_until_ready(outs)
-    t0 = time.perf_counter()
-    for i in range(n):
-        outs = fn(*args)
-        jax.block_until_ready(outs)
-    dt = (time.perf_counter() - t0) / n
+
+def _force(out):
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(leaf.ravel()[0].astype(jnp.float32))
+
+
+def _default_chain(args, out):
+    """Fold a zero-scaled scalar of `out` into the first arg: keeps values
+    bit-identical in expectation but makes iteration i+1 depend on i."""
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    dep = (leaf.ravel()[0] * 0).astype(args[0].dtype)
+    return (args[0] + dep, *args[1:])
+
+
+def timeit(fn, args, flops, name, n1=3, n2=12, chain=_default_chain):
+    # The chain state carries ACROSS run() calls: restarting from the same
+    # base args would let the memoizing runtime elide each run's prefix
+    # (the same iterations it already executed last run), biasing the
+    # slope low.
+    state = {"a": args}
+
+    def run(n):
+        a = state["a"]
+        out = None
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn(*a)
+            a = chain(a, out)
+        _force(out)
+        state["a"] = a
+        return time.perf_counter() - t0
+    run(2)  # warm / compile
+    dt = (run(n2) - run(n1)) / (n2 - n1)
     print(f"{name}: {dt*1e3:.2f} ms  {flops/dt/1e12:.1f} TF/s  "
           f"{flops/dt/PEAK*100:.1f}% peak", flush=True)
+
 
 k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
 
@@ -35,14 +71,27 @@ qkv = jax.jit(lambda x, w: jnp.einsum("bth,hkgd->btkgd", x, w))
 timeit(qkv, (x, w), 2*8*2048*2048*8*6*64, "einsum_qkv")
 
 # flash attention fwd (b8 h32 s2048 d64, causal), pallas
-set_attention_blocks(512, 2048)
+set_attention_blocks(1024, 1024)
 q = jax.random.normal(k1, (8, 32, 2048, 64), jnp.bfloat16)
 kk = jax.random.normal(k2, (8, 8, 2048, 64), jnp.bfloat16)
 vv = jax.random.normal(k3, (8, 8, 2048, 64), jnp.bfloat16)
 fa = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True, force_pallas=True))
 attn_flops = 2 * 2 * 8 * 32 * 2048 * 2048 * 64 * 0.5
-timeit(fa, (q, kk, vv), attn_flops, "flash_fwd_pallas")
+
+
+def _attn_chain(args, out):
+    # out has q's shape: feed it back as next q (distinct values each iter).
+    return (out.astype(args[0].dtype), *args[1:])
+
+
+timeit(fa, (q, kk, vv), attn_flops, "flash_fwd_pallas", chain=_attn_chain)
 
 # flash fwd+bwd
 fab = jax.jit(jax.grad(lambda q, k, v: flash_attention(q, k, v, causal=True, force_pallas=True).astype(jnp.float32).sum(), argnums=(0,1,2)))
-timeit(fab, (q, kk, vv), attn_flops*3.5, "flash_fwd_bwd_pallas")
+
+
+def _grad_chain(args, out):
+    return (out[0].astype(args[0].dtype), *args[1:])
+
+
+timeit(fab, (q, kk, vv), attn_flops*3.5, "flash_fwd_bwd_pallas", chain=_grad_chain)

@@ -1,0 +1,46 @@
+import time, functools
+import jax, jax.numpy as jnp
+import k8s_dra_driver_tpu.ops.attention as A
+
+def fetch(o):
+    leaf = jax.tree_util.tree_leaves(o)[0]
+    float(leaf.ravel()[0].astype(jnp.float32))
+
+def slope(fn, args, chain, n1=3, n2=12):
+    def run(n):
+        a = args; out = None
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn(*a)
+            a = chain(a, out)
+        fetch(out)
+        return time.perf_counter() - t0
+    run(2)
+    return (run(n2) - run(n1)) / (n2 - n1)
+
+k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+B, H, HKV, S, D = 8, 32, 8, 2048, 64
+q = jax.random.normal(k1, (B, H, S, D), jnp.bfloat16)
+kk = jax.random.normal(k2, (B, HKV, S, D), jnp.bfloat16)
+vv = jax.random.normal(k3, (B, HKV, S, D), jnp.bfloat16)
+useful = 2 * 2 * B * H * S * S * D * 0.5
+chain = lambda a, o: (o.astype(a[0].dtype), *a[1:])
+gchain = lambda a, o: (o[0].astype(a[0].dtype), *a[1:])
+
+for bq, bk in [(256,256),(256,512),(512,256),(512,512),(1024,512),(512,1024),(1024,1024),(2048,512),(512,2048),(1024,2048),(2048,2048)]:
+    try:
+        fa = jax.jit(lambda q,k,v,bq=bq,bk=bk: A._flash_diff(q, k, v, True, D**-0.5, False, bq, bk))
+        dt = slope(fa, (q, kk, vv), chain)
+        fab = jax.jit(jax.grad(lambda q,k,v,bq=bq,bk=bk: A._flash_diff(q, k, v, True, D**-0.5, False, bq, bk).astype(jnp.float32).sum(), argnums=(0,1,2)))
+        dtb = slope(fab, (q, kk, vv), gchain)
+        print(f"blocks {bq}x{bk}: fwd {dt*1e3:6.2f} ms ({useful/dt/1e12:5.1f} TF/s)  fwd+bwd {dtb*1e3:6.2f} ms ({useful*3.5/dtb/1e12:5.1f} TF/s, {useful*3.5/dtb/197e12*100:.1f}%)", flush=True)
+    except Exception as e:
+        print(f"blocks {bq}x{bk}: FAILED {type(e).__name__}: {str(e)[:110]}", flush=True)
+
+# XLA reference (with GQA repeat)
+xa = jax.jit(lambda q,k,v: A.flash_attention(q, k, v, causal=True))
+A.set_attention_impl("xla")
+dt = slope(xa, (q, kk, vv), chain)
+xab = jax.jit(jax.grad(lambda q,k,v: A.flash_attention(q, k, v, causal=True).astype(jnp.float32).sum(), argnums=(0,1,2)))
+dtb = slope(xab, (q, kk, vv), gchain)
+print(f"XLA ref: fwd {dt*1e3:6.2f} ms ({useful/dt/1e12:5.1f} TF/s)  fwd+bwd {dtb*1e3:6.2f} ms ({useful*3.5/dtb/1e12:5.1f} TF/s, {useful*3.5/dtb/197e12*100:.1f}%)")

@@ -1,0 +1,29 @@
+"""On-chip numerics: pallas kernels vs XLA oracle, fwd + grads."""
+import jax, jax.numpy as jnp
+import k8s_dra_driver_tpu.ops.attention as A
+
+k1, k2, k3, k4 = jax.random.split(jax.random.PRNGKey(7), 4)
+B, H, HKV, S, D = 2, 8, 2, 1024, 64
+q = jax.random.normal(k1, (B, H, S, D), jnp.bfloat16)
+k = jax.random.normal(k2, (B, HKV, S, D), jnp.bfloat16)
+v = jax.random.normal(k3, (B, HKV, S, D), jnp.bfloat16)
+do = jax.random.normal(k4, (B, H, S, D), jnp.bfloat16)
+
+for causal in (True, False):
+    def pal(q, k, v):
+        return A._flash_diff(q, k, v, causal, D**-0.5, False, 512, 512)
+    def xla(q, k, v):
+        kk = jnp.repeat(k, H // HKV, axis=1)
+        vv = jnp.repeat(v, H // HKV, axis=1)
+        return A.attention_reference(q, kk, vv, causal=causal)
+    o_p = jax.jit(pal)(q, k, v)
+    o_x = jax.jit(xla)(q, k, v)
+    err = float(jnp.max(jnp.abs(o_p.astype(jnp.float32) - o_x.astype(jnp.float32))))
+    vjp_p = jax.jit(lambda q,k,v,do: jax.vjp(pal, q, k, v)[1](do))
+    vjp_x = jax.jit(lambda q,k,v,do: jax.vjp(xla, q, k, v)[1](do))
+    gp = vjp_p(q, k, v, do)
+    gx = vjp_x(q, k, v, do)
+    gerr = max(float(jnp.max(jnp.abs(a.astype(jnp.float32)-b.astype(jnp.float32)))) for a,b in zip(gp, gx))
+    print(f"causal={causal}: fwd max err {err:.4f}, grad max err {gerr:.4f}")
+    assert err < 0.03 and gerr < 0.06, (err, gerr)
+print("on-chip kernel numerics OK")

@@ -64,23 +64,37 @@ def run_bench(preset, batch, seq, peak_flops, remat_policy="flash"):
         jax.random.PRNGKey(1), (batch, seq + 1), 0, config.vocab_size
     )
 
-    grad_fn = jax.jit(
-        jax.value_and_grad(
-            lambda p, t: loss_fn(
-                p, t, config, remat=True, remat_policy=remat_policy
+    # A full SGD train step: grad + parameter update with the params buffer
+    # donated. The update makes each step's params depend on the previous
+    # step's — the dependency chain the timing below needs — and donation
+    # keeps gradient memory flat (grads never escape the compiled program).
+    def sgd_step(p, t):
+        loss, grads = jax.value_and_grad(
+            lambda p_: loss_fn(
+                p_, t, config, remat=True, remat_policy=remat_policy
             )
-        ),
-        donate_argnums=(),
-    )
+        )(p)
+        new_p = jax.tree_util.tree_map(
+            lambda w, g: (w - 1e-4 * g).astype(w.dtype), p, grads
+        )
+        return loss, new_p
 
-    # Warmup / compile.
-    loss, grads = grad_fn(params, tokens)
-    jax.block_until_ready((loss, grads))
+    step_fn = jax.jit(sgd_step, donate_argnums=(0,))
 
-    # Each timed step gets distinct input (pre-staged on device) so no layer
-    # of the stack can elide or memoize repeated identical executions, and
-    # every step is individually synced.
-    n_steps = 2 if preset == "tiny" else 6
+    # Warmup / compile (the float() fetch forces real execution — see below).
+    loss, params = step_fn(params, tokens)
+    float(loss)
+
+    # Timing methodology for remote-execution runtimes (axon): dispatch is
+    # async, ``block_until_ready`` does not wait, identical dispatches are
+    # memoized, and every value fetch costs a ~90ms tunnel round-trip. So:
+    # each step's params depend on the previous step's update (sequential,
+    # all-distinct — nothing can be elided or memoized), and ONE scalar
+    # fetch at the end forces the whole chain. Timing two chain lengths and
+    # taking the slope cancels the round-trip; on a local backend the same
+    # arithmetic is simply per-step time.
+    n1 = 1 if preset == "tiny" else 2
+    n2 = 3 if preset == "tiny" else 8
     batches = [
         jax.device_put(
             jax.random.randint(
@@ -88,18 +102,22 @@ def run_bench(preset, batch, seq, peak_flops, remat_policy="flash"):
                 config.vocab_size,
             )
         )
-        for i in range(n_steps)
+        for i in range(4)
     ]
     jax.block_until_ready(batches)
-    t0 = time.perf_counter()
-    losses = []
-    for bt in batches:
-        loss, grads = grad_fn(params, bt)
-        # Host round-trip each step: block_until_ready alone may not force
-        # execution through remote-execution runtimes.
-        losses.append(float(loss))
-    dt = (time.perf_counter() - t0) / n_steps
-    loss = losses[-1]
+
+    def run_chain(n):
+        nonlocal params
+        t0 = time.perf_counter()
+        loss = None
+        for i in range(n):
+            loss, params = step_fn(params, batches[i % len(batches)])
+        chained_loss = float(loss)
+        return time.perf_counter() - t0, chained_loss
+
+    t_short, _ = run_chain(n1)
+    t_long, loss = run_chain(n2)
+    dt = (t_long - t_short) / (n2 - n1)
 
     n_tokens = batch * seq
     # fwd 2N + bwd 4N matmul FLOPs per token, + attention quadratic term.

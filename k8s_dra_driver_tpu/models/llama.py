@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os
 from typing import Any, Optional
 
 import jax
@@ -286,7 +287,7 @@ def chunked_cross_entropy(
     hidden: jax.Array,                   # [B, S, H]
     lm_head: jax.Array,                  # [H, V]
     targets: jax.Array,                  # [B, S]
-    chunk: int = 256,
+    chunk: Optional[int] = None,
 ) -> jax.Array:
     """Next-token CE without materializing [B, S, V] logits.
 
@@ -296,6 +297,10 @@ def chunked_cross_entropy(
     (recomputed), trading a second lm_head matmul for gigabytes.
     """
     b, s, h = hidden.shape
+    if chunk is None:
+        # Sweepable on hardware (the scan length / matmul size trade-off
+        # is generation-dependent); 256 is the v5e default.
+        chunk = int(os.environ.get("TPU_DRA_CE_CHUNK", "256"))
     if s % chunk:
         # Largest divisor of s not exceeding the requested chunk, so the
         # no-[B,S,V]-materialization guarantee holds for any seq length.

@@ -24,6 +24,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+# Softmax runs in base 2 inside the kernels: exp2 is cheaper on the VPU
+# than exp, and folding log2(e) into the score scale makes it free
+# (FlashAttention does the same on tensor cores). lse stays natural-log
+# at the API boundary.
+LOG2E = 1.4426950408889634
 
 
 def attention_reference(
@@ -66,21 +71,20 @@ def _flash_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # Causal pruning: kv block strictly after the q block contributes nothing.
-    run = True
-    if causal:
-        run = ik * block_k <= iq * block_q + (block_q - 1)
-
-    @pl.when(run)
-    def _step():
-        q = q_ref[0].astype(jnp.float32)           # [BQ, D]
-        k = k_ref[0].astype(jnp.float32)           # [BK, D]
-        v = v_ref[0].astype(jnp.float32)           # [BK, D]
+    def _step(masked):
+        # Keep the storage dtype (bf16) INTO the dots: the MXU multiplies
+        # bf16 at full rate and accumulates f32 via
+        # preferred_element_type; a pre-cast to f32 would run the whole
+        # matmul at the ~4x slower f32 rate. Softmax math stays f32, in
+        # base 2 (LOG2E folded into the scale).
+        q = q_ref[0]                                # [BQ, D]
+        k = k_ref[0]                                # [BK, D]
+        v = v_ref[0]                                # [BK, D]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale                                   # [BQ, BK]
-        if causal:
+        ) * (scale * LOG2E)                         # [BQ, BK] f32, base-2
+        if masked:
             qpos = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
@@ -90,23 +94,60 @@ def _flash_kernel(
             s = jnp.where(kpos <= qpos, s, NEG_INF)
         m_prev = m_ref[:]                           # [BQ, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)                      # [BQ, BK]
-        if causal:
-            p = jnp.where(kpos <= qpos, p, 0.0)
-        correction = jnp.exp(m_prev - m_new)        # [BQ, 1]
+        # exp2(NEG_INF - m) underflows to exactly 0, so masked entries
+        # need no second select (a fully-masked row cannot occur: causal
+        # pruning only runs blocks whose rows reach the diagonal).
+        p = jnp.exp2(s - m_new)                     # [BQ, BK]
+        correction = jnp.exp2(m_prev - m_new)       # [BQ, 1]
         l_ref[:] = l_ref[:] * correction + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_ref[:] = m_new
+
+    if causal:
+        # Three block classes: past the diagonal (skipped — contributes
+        # nothing), fully visible (no mask work on the VPU), straddling
+        # the diagonal (iota + select).
+        first_q = iq * block_q
+        last_k = ik * block_k + block_k - 1
+        full = last_k <= first_q
+        straddle = jnp.logical_and(
+            ik * block_k <= first_q + block_q - 1, jnp.logical_not(full)
+        )
+
+        @pl.when(full)
+        def _full():
+            _step(masked=False)
+
+        @pl.when(straddle)
+        def _straddle():
+            _step(masked=True)
+    else:
+        _step(masked=False)
 
     @pl.when(ik == nk - 1)
     def _finish():
         l = jnp.maximum(l_ref[:], 1e-30)
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
-        # logsumexp of each score row: softmax = exp(s*scale - lse).
-        lse_ref[0] = m_ref[:] + jnp.log(l)
+        # Natural-log logsumexp of each score row (m is base-2):
+        # softmax = exp(s*scale - lse).
+        lse_ref[0] = (m_ref[:] + jnp.log2(l)) * (1.0 / LOG2E)
+
+
+def _fit_block(s: int, want: int) -> int:
+    """A block size <= `want` that divides the sequence length (their gcd),
+    so configured blocks (e.g. the 1024 default) work for any S they don't
+    divide exactly — S=1536 gets 512, S=2048 keeps 1024."""
+    import math
+
+    fit = math.gcd(s, want)
+    assert fit >= 8, (
+        f"seq len {s} shares no usable block size with {want}; pad the "
+        f"sequence to a multiple of 8"
+    )
+    return fit
 
 
 def _flash_attention_pallas(
@@ -129,11 +170,8 @@ def _flash_attention_pallas(
         f"q heads ({h}) must be a multiple of kv heads ({hkv})"
     )
     g = h // hkv
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
-    assert s % block_q == 0 and s % block_k == 0, (
-        f"seq len {s} must be a multiple of block sizes {block_q}/{block_k}"
-    )
+    block_q = _fit_block(s, block_q)
+    block_k = _fit_block(s, block_k)
     bh = b * h
     qr = q.reshape(bh, s, d)
     kr = k.reshape(b * hkv, s, d)
@@ -207,35 +245,35 @@ def _flash_bwd_dkdv_kernel(
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    run = True
-    if causal:
-        # q block must end at or after the kv block start.
-        run = (iq + 1) * block_q - 1 >= ik * block_k
-
-    @pl.when(run)
-    def _step():
-        q = q_ref[0].astype(jnp.float32)          # [BQ, D]
-        k = k_ref[0].astype(jnp.float32)          # [BK, D]
-        v = v_ref[0].astype(jnp.float32)          # [BK, D]
-        do = do_ref[0].astype(jnp.float32)        # [BQ, D]
-        lse = lse_ref[0]                          # [BQ, 1]
+    def _step(masked):
+        # bf16 into the dots, f32 out (see _flash_kernel note): p and ds
+        # are cast to the storage dtype for their matmuls exactly like
+        # FlashAttention-2 on tensor cores; lse/delta stay f32. Softmax
+        # recomputation in base 2: p = exp2(s*scale*LOG2E - lse*LOG2E).
+        q = q_ref[0]                              # [BQ, D]
+        k = k_ref[0]                              # [BK, D]
+        v = v_ref[0]                              # [BK, D]
+        do = do_ref[0]                            # [BQ, D]
+        lse2 = lse_ref[0] * LOG2E                 # [BQ, 1]
         delta = delta_ref[0]                      # [BQ, 1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale                                  # [BQ, BK]
-        p = jnp.exp(s - lse)
-        if causal:
+        ) * (scale * LOG2E)                        # [BQ, BK] f32, base-2
+        if masked:
+            # Mask BEFORE the exp: a masked score can exceed lse (it was
+            # never part of the softmax), and exp2 of that would be inf.
             qpos = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
             kpos = ik * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            p = jnp.where(kpos <= qpos, p, 0.0)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        p = jnp.exp2(s - lse2)
         # dV += P^T dO
         dv_acc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         # dS = P ⊙ (dO V^T - delta); dK += dS^T Q * scale
@@ -245,9 +283,27 @@ def _flash_bwd_dkdv_kernel(
         )
         ds = p * (dp - delta)
         dk_acc[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
+
+    if causal:
+        first_q = iq * block_q
+        last_k = ik * block_k + block_k - 1
+        full = last_k <= first_q
+        straddle = jnp.logical_and(
+            ik * block_k <= first_q + block_q - 1, jnp.logical_not(full)
+        )
+
+        @pl.when(full)
+        def _full():
+            _step(masked=False)
+
+        @pl.when(straddle)
+        def _straddle():
+            _step(masked=True)
+    else:
+        _step(masked=False)
 
     @pl.when(pid2 == n2 - 1)
     def _finish():
@@ -269,40 +325,55 @@ def _flash_bwd_dq_kernel(
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    run = True
-    if causal:
-        run = ik * block_k <= iq * block_q + (block_q - 1)
-
-    @pl.when(run)
-    def _step():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
+    def _step(masked):
+        # bf16 into the dots, f32 out; base-2 softmax recomputation (see
+        # _flash_bwd_dkdv_kernel note).
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse2 = lse_ref[0] * LOG2E
         delta = delta_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale
-        p = jnp.exp(s - lse)
-        if causal:
+        ) * (scale * LOG2E)
+        if masked:
             qpos = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
             kpos = ik * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            p = jnp.where(kpos <= qpos, p, 0.0)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        p = jnp.exp2(s - lse2)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta)
         dq_acc[:] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
+
+    if causal:
+        first_q = iq * block_q
+        last_k = ik * block_k + block_k - 1
+        full = last_k <= first_q
+        straddle = jnp.logical_and(
+            ik * block_k <= first_q + block_q - 1, jnp.logical_not(full)
+        )
+
+        @pl.when(full)
+        def _full():
+            _step(masked=False)
+
+        @pl.when(straddle)
+        def _straddle():
+            _step(masked=True)
+    else:
+        _step(masked=False)
 
     @pl.when(ik == nk - 1)
     def _finish():
@@ -319,11 +390,8 @@ def _flash_attention_bwd_pallas(
         f"q heads ({h}) must be a multiple of kv heads ({hkv})"
     )
     g = h // hkv
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
-    assert s % block_q == 0 and s % block_k == 0, (
-        f"seq len {s} must be a multiple of block sizes {block_q}/{block_k}"
-    )
+    block_q = _fit_block(s, block_q)
+    block_k = _fit_block(s, block_k)
     bh = b * h
     bhkv = b * hkv
     nq = s // block_q
@@ -458,11 +526,12 @@ _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 _ATTN_IMPL = os.environ.get("TPU_DRA_ATTN_IMPL", "auto")
 
 # Kernel block sizes, sweepable per generation (VMEM budget differs between
-# v5e and v5p). Defaults are the v5e sweep winner (512x2048 at s2048; blocks
-# clamp to the seq len for shorter sequences, so the wide-K default is safe
-# everywhere S % 512 == 0).
-_BLOCK_Q = int(os.environ.get("TPU_DRA_ATTN_BLOCK_Q", "512"))
-_BLOCK_K = int(os.environ.get("TPU_DRA_ATTN_BLOCK_K", "2048"))
+# v5e and v5p). Defaults are the v5e sweep winner (1024x1024 at s2048 —
+# fwd+bwd 48 TF/s useful vs 29 at 512x2048; blocks clamp to the seq len for
+# shorter sequences, so the default is safe everywhere S % 1024 == 0 or
+# S <= 1024).
+_BLOCK_Q = int(os.environ.get("TPU_DRA_ATTN_BLOCK_Q", "1024"))
+_BLOCK_K = int(os.environ.get("TPU_DRA_ATTN_BLOCK_K", "1024"))
 
 
 def set_attention_impl(impl: str) -> None:

@@ -143,6 +143,18 @@ class TestChartRenders:
         objs = rendered_objects({"deviceClasses": ["chip"]})
         assert len(by_kind(objs, "DeviceClass")) == 1
 
+    def test_gke_values_overlay_renders(self):
+        """The GKE flavor (role of the reference's demo/clusters/gke/)
+        renders with its overlay applied: GKE node selector, no fake
+        topology flags."""
+        overlay = yaml.safe_load(open(os.path.join(
+            CHART, "values-gke.yaml")))
+        [ds] = by_kind(rendered_objects(overlay), "DaemonSet")
+        sel = ds["spec"]["template"]["spec"]["nodeSelector"]
+        assert "cloud.google.com/gke-tpu-accelerator" in sel
+        args = ds["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert not any(a.startswith("--fake") for a in args)
+
 
 class TestChartValidation:
     """templates/validation.yaml fails fast at RENDER time."""

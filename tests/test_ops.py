@@ -54,6 +54,24 @@ class TestFlashAttention:
         ref = attention_reference(q, kx, vx, causal=True)
         np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
+    def test_block_size_not_dividing_seq(self):
+        # S=384 with 256-blocks: _fit_block drops to their gcd (128) so a
+        # configured block that doesn't divide S still works (fwd + bwd).
+        from k8s_dra_driver_tpu.ops.attention import _flash_diff
+
+        b, h, s, d = 1, 2, 384, 32
+        q, k, v = (rand(b, h, s, d, seed=i) for i in range(3))
+        out = _flash_diff(q, k, v, True, d ** -0.5, True, 256, 256)
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+        gp = jax.grad(
+            lambda q: _flash_diff(q, k, v, True, d ** -0.5, True, 256, 256).sum()
+        )(q)
+        gr = jax.grad(
+            lambda q: attention_reference(q, k, v, causal=True).sum()
+        )(q)
+        np.testing.assert_allclose(gp, gr, atol=2e-4, rtol=2e-4)
+
     def test_bf16_runs(self):
         b, h, s, d = 1, 2, 128, 64
         q, k, v = (
