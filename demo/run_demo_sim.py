@@ -103,6 +103,10 @@ def main() -> int:
         plugin_root=os.path.join(tmp, "plugin"),
         registrar_root=os.path.join(tmp, "registry"),
         state_root=os.path.join(tmp, "state"),
+        # Hermetic: point driver discovery into the sandbox so the sim's
+        # output never depends on whether THIS machine has a libtpu wheel.
+        driver_root=os.path.join(tmp, "driver-root"),
+        driver_root_ctr_path=os.path.join(tmp, "driver-root"),
         node_uid="demo-node-uid",
     )
     driver = Driver(config)

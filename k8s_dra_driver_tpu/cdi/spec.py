@@ -77,6 +77,8 @@ class CDIHandler:
         cdi_root: str,
         driver_name: str = DEFAULT_DRIVER_NAME,
         dev_root: str = "/",
+        driver_root: str = "/",
+        driver_root_ctr_path: Optional[str] = None,
     ):
         self.cdi_root = cdi_root
         self.driver_name = driver_name
@@ -84,7 +86,47 @@ class CDIHandler:
         self.device_class = "chip"
         self.claim_class = "claim"
         self.dev_root = dev_root
+        # driver_root is the HOST path of the driver installation (what CDI
+        # hostPath fields must name); driver_root_ctr_path is where that
+        # directory is mounted inside THIS container, i.e. where the search
+        # actually runs. They coincide when running on the host.
+        self.driver_root = driver_root
+        self.driver_root_ctr_path = (
+            driver_root_ctr_path if driver_root_ctr_path is not None
+            else driver_root
+        )
         os.makedirs(cdi_root, exist_ok=True)
+
+    # Stable in-container home for the runtime library mount; JAX loads
+    # libtpu from TPU_LIBRARY_PATH when set.
+    CONTAINER_LIBTPU = "/usr/lib/tpu/libtpu.so"
+
+    def _libtpu_edits(self) -> ContainerEdits:
+        """Driver-library injection (nvcdi driver-mount analog): when the
+        configured driver root holds a libtpu.so, mount it read-only into
+        workload containers and point TPU_LIBRARY_PATH at it. No-op when
+        absent — containers then use their image's own libtpu.
+
+        Probed at every spec WRITE (not cached at startup): claim specs are
+        written at prepare time, so a driver installed after plugin startup
+        (the usual driver-installer DaemonSet race) is picked up by the
+        next claim without a plugin restart."""
+        from ..tpulib.driverroot import DriverRoot
+
+        droot = DriverRoot(
+            root=self.driver_root_ctr_path, host_root=self.driver_root
+        )
+        lib = droot.libtpu_or_none()
+        if lib is None:
+            return ContainerEdits()
+        return ContainerEdits(
+            env={"TPU_LIBRARY_PATH": self.CONTAINER_LIBTPU},
+            mounts=[{
+                "hostPath": droot.to_host_path(lib),
+                "containerPath": self.CONTAINER_LIBTPU,
+                "options": ["ro", "nosuid", "nodev", "bind"],
+            }],
+        )
 
     # -- qualified names (cdi.go:286-298 analog) ---------------------------
 
@@ -141,7 +183,7 @@ class CDIHandler:
             "devices": devices,
             "containerEdits": ContainerEdits(
                 env={"TPU_DRA_MANAGED": "1"}
-            ).to_cdi(),
+            ).merge(self._libtpu_edits()).to_cdi(),
         }
         path = self._base_spec_path()
         _atomic_write_json(path, spec)
@@ -157,7 +199,10 @@ class CDIHandler:
 
         ``device_edits`` maps device name → claim-specific edits (the env the
         sharing manager / device state computed). ``common_env`` applies to
-        every container using any device of the claim (topology env).
+        every container using any device of the claim (topology env), and is
+        merged with the driver-library injection (claims are prepared after
+        startup, so this is the injection point that survives the
+        driver-installed-late race).
         """
         devices = []
         for name, edits in sorted(device_edits.items()):
@@ -172,8 +217,11 @@ class CDIHandler:
             "kind": f"{self.vendor}/{self.claim_class}",
             "devices": devices,
         }
-        if common_env:
-            spec["containerEdits"] = ContainerEdits(env=dict(common_env)).to_cdi()
+        common = ContainerEdits(env=dict(common_env or {})).merge(
+            self._libtpu_edits()
+        ).to_cdi()
+        if common:
+            spec["containerEdits"] = common
         path = self._claim_spec_path(claim_uid)
         _atomic_write_json(path, spec)
         return path

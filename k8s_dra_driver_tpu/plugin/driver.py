@@ -43,6 +43,8 @@ class DriverConfig:
     plugin_root: str = "/var/lib/kubelet/plugins/tpu.google.com"
     registrar_root: str = "/var/lib/kubelet/plugins_registry"
     state_root: str = "/var/lib/tpu-dra"
+    driver_root: str = "/"
+    driver_root_ctr_path: str = "/"
     device_classes: frozenset = frozenset({"chip", "tensorcore", "ici"})
     node_uid: str = ""
     cleanup_interval_seconds: float = 600.0  # 0 disables the orphan cleaner
@@ -80,7 +82,12 @@ class Driver(NodeServicer):
         )
         self.state = DeviceState(
             chiplib=config.chiplib,
-            cdi=CDIHandler(config.cdi_root, driver_name=config.driver_name),
+            cdi=CDIHandler(
+                config.cdi_root,
+                driver_name=config.driver_name,
+                driver_root=config.driver_root,
+                driver_root_ctr_path=config.driver_root_ctr_path,
+            ),
             checkpoint=CheckpointManager(config.checkpoint_path),
             driver_name=config.driver_name,
             pool_name=config.node_name,

@@ -53,10 +53,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--device-classes",
                    default=_env("DEVICE_CLASSES", "chip,tensorcore,ici"),
                    help="comma-separated device classes to serve [DEVICE_CLASSES]")
-    p.add_argument("--dev-root", default=_env("DEV_ROOT", "/"),
-                   help="host root containing /dev [DEV_ROOT]")
+    p.add_argument("--dev-root", default=_env("DEV_ROOT", ""),
+                   help="host root containing /dev; defaults to the driver "
+                        "root when that is a dev root, else / [DEV_ROOT]")
     p.add_argument("--sysfs-root", default=_env("SYSFS_ROOT", "/sys"),
                    help="sysfs mount [SYSFS_ROOT]")
+    p.add_argument("--driver-root", default=_env("DRIVER_ROOT", "/"),
+                   help="HOST path of the driver installation (libtpu etc); "
+                        "emitted in CDI hostPath fields [DRIVER_ROOT]")
+    p.add_argument("--driver-root-ctr-path",
+                   default=_env("DRIVER_ROOT_CTR_PATH", ""),
+                   help="where --driver-root is mounted inside THIS "
+                        "container (the layered search runs here); default: "
+                        "same as --driver-root [DRIVER_ROOT_CTR_PATH]")
     p.add_argument("--kubeconfig", default=_env("KUBECONFIG", ""),
                    help="kubeconfig path (default: in-cluster) [KUBECONFIG]")
     p.add_argument("--no-kube", action="store_true",
@@ -72,13 +81,35 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def make_chiplib(args) -> ChipLib:
+def resolve_roots(args):
+    """Driver-root layering (root.go:64-81 analog): the search runs at the
+    container-visible mount; an unset --dev-root falls back to the driver
+    root when that contains dev/, else /. Logs what was discovered so a
+    misconfigured mount is visible at startup."""
+    from ..tpulib.driverroot import DriverRoot, DriverRootError
+
+    ctr = args.driver_root_ctr_path or args.driver_root
+    droot = DriverRoot(root=ctr, host_root=args.driver_root)
+    dev_root = args.dev_root or droot.dev_root()
+    lib = droot.libtpu_or_none()
+    try:
+        tpu_info = droot.find_binary("tpu-info")
+    except DriverRootError:
+        tpu_info = None
+    logger.info(
+        "driver root %s (at %s): libtpu=%s tpu-info=%s dev_root=%s",
+        args.driver_root, ctr, lib or "<none>", tpu_info or "<none>", dev_root,
+    )
+    return dev_root, ctr
+
+
+def make_chiplib(args, dev_root: str) -> ChipLib:
     if args.fake_topology:
         return FakeChipLib(
             generation=args.fake_generation, topology=args.fake_topology
         )
     return RealChipLib(
-        ChipLibConfig(dev_root=args.dev_root, sysfs_root=args.sysfs_root)
+        ChipLibConfig(dev_root=dev_root, sysfs_root=args.sysfs_root)
     )
 
 
@@ -105,15 +136,18 @@ def main(argv=None) -> int:
         kube_client = make_kube_client(args.kubeconfig)
         node_uid = lookup_node_uid(kube_client, args.node_name)
 
+    dev_root, driver_root_ctr = resolve_roots(args)
     config = DriverConfig(
         node_name=args.node_name,
-        chiplib=make_chiplib(args),
+        chiplib=make_chiplib(args, dev_root),
         kube_client=kube_client,
         driver_name=args.driver_name,
         cdi_root=args.cdi_root,
         plugin_root=args.plugin_root,
         registrar_root=args.registrar_root,
         state_root=args.state_root,
+        driver_root=args.driver_root,
+        driver_root_ctr_path=driver_root_ctr,
         device_classes=frozenset(args.device_classes.split(",")),
         node_uid=node_uid,
     )

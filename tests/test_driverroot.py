@@ -1,0 +1,183 @@
+"""Driver-root resolution (root.go:25-107 analog): layered search for
+libtpu.so / tpu-info under a configurable prefix, symlink re-anchoring,
+dev-root detection, and the CDI library-injection wiring."""
+
+import json
+import os
+
+import pytest
+
+from k8s_dra_driver_tpu.cdi.spec import CDIHandler
+from k8s_dra_driver_tpu.tpulib.deviceinfo import AllocatableDevices
+from k8s_dra_driver_tpu.tpulib.driverroot import (
+    DriverRoot,
+    DriverRootError,
+)
+
+
+def mkfile(path, content=b"x"):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+class TestLayeredSearch:
+    def test_finds_library_in_system_path(self, tmp_path):
+        root = str(tmp_path)
+        mkfile(f"{root}/usr/lib/x86_64-linux-gnu/libtpu.so")
+        r = DriverRoot(root)
+        assert r.find_library() == f"{root}/usr/lib/x86_64-linux-gnu/libtpu.so"
+
+    def test_finds_library_in_site_packages_glob(self, tmp_path):
+        root = str(tmp_path)
+        mkfile(f"{root}/usr/lib/python3.12/site-packages/libtpu/libtpu.so")
+        assert DriverRoot(root).find_library().endswith(
+            "site-packages/libtpu/libtpu.so"
+        )
+
+    def test_root_itself_searched_first(self, tmp_path):
+        root = str(tmp_path)
+        mkfile(f"{root}/libtpu.so")
+        mkfile(f"{root}/usr/lib64/libtpu.so")
+        assert DriverRoot(root).find_library() == f"{root}/libtpu.so"
+
+    def test_missing_raises(self, tmp_path):
+        with pytest.raises(DriverRootError):
+            DriverRoot(str(tmp_path)).find_library()
+
+    def test_find_binary(self, tmp_path):
+        root = str(tmp_path)
+        mkfile(f"{root}/usr/bin/tpu-info")
+        assert DriverRoot(root).find_binary() == f"{root}/usr/bin/tpu-info"
+
+    def test_directory_named_like_lib_is_skipped(self, tmp_path):
+        root = str(tmp_path)
+        os.makedirs(f"{root}/usr/lib64/libtpu.so")  # dir, not a file
+        mkfile(f"{root}/lib64/libtpu.so")
+        assert DriverRoot(root).find_library() == f"{root}/lib64/libtpu.so"
+
+
+class TestSymlinks:
+    def test_relative_symlink_resolves(self, tmp_path):
+        root = str(tmp_path)
+        mkfile(f"{root}/usr/lib64/libtpu.so.1")
+        os.symlink("libtpu.so.1", f"{root}/usr/lib64/libtpu.so")
+        assert DriverRoot(root).find_library() == f"{root}/usr/lib64/libtpu.so.1"
+
+    def test_absolute_symlink_reanchored_under_root(self, tmp_path):
+        # A host symlink /usr/lib64/libtpu.so -> /opt/tpu/lib/libtpu.so
+        # must resolve under the MOUNTED root, not the container's /opt.
+        root = str(tmp_path)
+        mkfile(f"{root}/opt/tpu/lib/libtpu.so")
+        os.makedirs(f"{root}/usr/lib64", exist_ok=True)
+        os.symlink("/opt/tpu/lib/libtpu.so", f"{root}/usr/lib64/libtpu.so")
+        assert DriverRoot(root).find_library() == f"{root}/opt/tpu/lib/libtpu.so"
+
+    def test_symlink_loop_skipped(self, tmp_path):
+        root = str(tmp_path)
+        os.makedirs(f"{root}/usr/lib64", exist_ok=True)
+        os.symlink("loop.b", f"{root}/usr/lib64/loop.a")
+        os.symlink("loop.a", f"{root}/usr/lib64/loop.b")
+        os.symlink("loop.a", f"{root}/usr/lib64/libtpu.so")
+        mkfile(f"{root}/lib64/libtpu.so")  # the non-looping fallback wins
+        assert DriverRoot(root).find_library() == f"{root}/lib64/libtpu.so"
+
+    def test_dotdot_symlink_cannot_escape_root(self, tmp_path):
+        # An over-dotted relative target (common in real packaging) must
+        # clamp at the root like a chroot, not escape into the plugin
+        # container's own filesystem.
+        root = str(tmp_path / "droot")
+        mkfile(f"{root}/usr/lib/libtpu.so.1")
+        os.makedirs(f"{root}/usr/lib64", exist_ok=True)
+        os.symlink(
+            "../../../../../../usr/lib/libtpu.so.1",
+            f"{root}/usr/lib64/libtpu.so",
+        )
+        assert DriverRoot(root).find_library() == f"{root}/usr/lib/libtpu.so.1"
+
+
+class TestHostPathTranslation:
+    def test_to_host_path_swaps_prefix(self, tmp_path):
+        root = str(tmp_path)
+        r = DriverRoot(root=root, host_root="/on/the/host")
+        assert (
+            r.to_host_path(f"{root}/usr/lib64/libtpu.so")
+            == "/on/the/host/usr/lib64/libtpu.so"
+        )
+
+    def test_to_host_path_defaults_to_identity(self, tmp_path):
+        root = str(tmp_path)
+        p = f"{root}/usr/lib64/libtpu.so"
+        assert DriverRoot(root).to_host_path(p) == p
+
+    def test_to_host_path_rejects_outside_paths(self, tmp_path):
+        r = DriverRoot(root=str(tmp_path / "a"), host_root="/h")
+        with pytest.raises(DriverRootError):
+            r.to_host_path("/etc/passwd")
+
+
+class TestDevRoot:
+    def test_dev_root_detected(self, tmp_path):
+        root = str(tmp_path)
+        os.makedirs(f"{root}/dev")
+        assert DriverRoot(root).is_dev_root()
+        assert DriverRoot(root).dev_root() == root
+
+    def test_non_dev_root_defaults_to_slash(self, tmp_path):
+        r = DriverRoot(str(tmp_path))
+        assert not r.is_dev_root()
+        assert r.dev_root() == "/"
+
+
+class TestCdiInjection:
+    def _base_spec(self, cdi_root, driver_root, ctr_path=None):
+        h = CDIHandler(
+            cdi_root, driver_root=driver_root, driver_root_ctr_path=ctr_path
+        )
+        path = h.create_standard_device_spec_file(AllocatableDevices())
+        with open(path) as f:
+            return json.load(f)
+
+    def test_libtpu_mounted_and_env_pointed(self, tmp_path):
+        droot = str(tmp_path / "host")
+        mkfile(f"{droot}/usr/lib64/libtpu.so")
+        spec = self._base_spec(str(tmp_path / "cdi"), droot)
+        edits = spec["containerEdits"]
+        assert "TPU_LIBRARY_PATH=/usr/lib/tpu/libtpu.so" in edits["env"]
+        [mount] = edits["mounts"]
+        assert mount["hostPath"] == f"{droot}/usr/lib64/libtpu.so"
+        assert mount["containerPath"] == "/usr/lib/tpu/libtpu.so"
+        assert "ro" in mount["options"]
+
+    def test_hostpath_translated_to_host_namespace(self, tmp_path):
+        # The search runs where the plugin container sees the mount
+        # (ctr_path); the emitted hostPath must name the HOST location.
+        ctr = str(tmp_path / "mnt")
+        mkfile(f"{ctr}/usr/lib64/libtpu.so")
+        spec = self._base_spec(
+            str(tmp_path / "cdi"), "/the/host/root", ctr_path=ctr
+        )
+        [mount] = spec["containerEdits"]["mounts"]
+        assert mount["hostPath"] == "/the/host/root/usr/lib64/libtpu.so"
+
+    def test_no_libtpu_no_injection(self, tmp_path):
+        spec = self._base_spec(str(tmp_path / "cdi"), str(tmp_path / "empty"))
+        edits = spec["containerEdits"]
+        assert "mounts" not in edits
+        assert all(not e.startswith("TPU_LIBRARY_PATH") for e in edits["env"])
+
+    def test_claim_spec_probes_at_prepare_time(self, tmp_path):
+        # Driver installed AFTER handler construction (installer-DaemonSet
+        # race): the claim spec written later must still inject.
+        droot = str(tmp_path / "host")
+        h = CDIHandler(str(tmp_path / "cdi"), driver_root=droot)
+        h.create_standard_device_spec_file(AllocatableDevices())
+        mkfile(f"{droot}/usr/lib64/libtpu.so")  # lands late
+        path = h.create_claim_spec_file("claim-1", {}, {"TPU_TOPOLOGY": "2x2x1"})
+        with open(path) as f:
+            spec = json.load(f)
+        edits = spec["containerEdits"]
+        assert "TPU_LIBRARY_PATH=/usr/lib/tpu/libtpu.so" in edits["env"]
+        assert "TPU_TOPOLOGY=2x2x1" in edits["env"]
+        [mount] = edits["mounts"]
+        assert mount["hostPath"] == f"{droot}/usr/lib64/libtpu.so"

@@ -143,6 +143,21 @@ class TestChartRenders:
         objs = rendered_objects({"deviceClasses": ["chip"]})
         assert len(by_kind(objs, "DeviceClass")) == 1
 
+    def test_driver_root_mounted_and_flagged(self):
+        """The driverRoot value must produce all three pieces: the host
+        volume, the in-container mount at /driver-root, and the flag pair
+        telling the plugin where each side lives."""
+        [ds] = by_kind(rendered_objects({"plugin": {"driverRoot": "/opt/tpu"}}),
+                       "DaemonSet")
+        pod = ds["spec"]["template"]["spec"]
+        [vol] = [v for v in pod["volumes"] if v["name"] == "driver-root"]
+        assert vol["hostPath"]["path"] == "/opt/tpu"
+        c = pod["containers"][0]
+        [m] = [m for m in c["volumeMounts"] if m["name"] == "driver-root"]
+        assert m["mountPath"] == "/driver-root" and m["readOnly"]
+        assert "--driver-root=/opt/tpu" in c["args"]
+        assert "--driver-root-ctr-path=/driver-root" in c["args"]
+
     def test_gke_values_overlay_renders(self):
         """The GKE flavor (role of the reference's demo/clusters/gke/)
         renders with its overlay applied: GKE node selector, no fake
