@@ -1,0 +1,59 @@
+"""Experiment: dimension_semantics + per-direction blocks."""
+import time, functools
+import jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+import k8s_dra_driver_tpu.ops.attention as A
+
+def fetch(o):
+    leaf = jax.tree_util.tree_leaves(o)[0]
+    float(leaf.ravel()[0].astype(jnp.float32))
+
+state = {}
+def slope(name, fn, args, chain, n1=3, n2=12):
+    state[name] = args
+    def run(n):
+        a = state[name]; out = None
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn(*a)
+            a = chain(a, out)
+        fetch(out)
+        state[name] = a
+        return time.perf_counter() - t0
+    run(2)
+    return (run(n2) - run(n1)) / (n2 - n1)
+
+k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+B, H, HKV, S, D = 8, 32, 8, 2048, 64
+q = jax.random.normal(k1, (B, H, S, D), jnp.bfloat16)
+kk = jax.random.normal(k2, (B, HKV, S, D), jnp.bfloat16)
+vv = jax.random.normal(k3, (B, HKV, S, D), jnp.bfloat16)
+useful = 2 * 2 * B * H * S * S * D * 0.5
+chain = lambda a, o: (o.astype(a[0].dtype), *a[1:])
+gchain = lambda a, o: (o[0].astype(a[0].dtype), *a[1:])
+
+# Patch pallas_call to add dimension_semantics via monkey wrapper
+orig_pallas_call = pl.pallas_call
+def patched(kernel, **kw):
+    kw.setdefault("compiler_params", pltpu.CompilerParams(
+        dimension_semantics=("parallel", "arbitrary", "arbitrary")))
+    return orig_pallas_call(kernel, **kw)
+
+for label, patch in [("baseline", False), ("dimsem", True)]:
+    pl.pallas_call = patched if patch else orig_pallas_call
+    A._flash_attention_pallas.__globals__["pl"].pallas_call = pl.pallas_call
+    for bq, bk in [(1024, 1024), (2048, 512)]:
+        fa = jax.jit(lambda q,k,v,bq=bq,bk=bk: A._flash_diff(q, k, v, True, D**-0.5, False, bq, bk))
+        try:
+            dt = slope(f"{label}{bq}x{bk}", fa, (q, kk, vv), chain)
+            print(f"{label} fwd {bq}x{bk}: {dt*1e3:.2f} ms ({useful/dt/1e12:.1f} TF/s)", flush=True)
+        except Exception as e:
+            print(f"{label} fwd {bq}x{bk}: FAIL {type(e).__name__} {str(e)[:80]}", flush=True)
+    fab = jax.jit(jax.grad(lambda q,k,v: A._flash_diff(q, k, v, True, D**-0.5, False, 1024, 1024).astype(jnp.float32).sum(), argnums=(0,1,2)))
+    try:
+        dtb = slope(f"{label}b", fab, (q, kk, vv), gchain)
+        print(f"{label} fwd+bwd 1024x1024: {dtb*1e3:.2f} ms ({useful*3.5/dtb/1e12:.1f} TF/s)", flush=True)
+    except Exception as e:
+        print(f"{label} fwd+bwd: FAIL {type(e).__name__} {str(e)[:80]}", flush=True)
+pl.pallas_call = orig_pallas_call

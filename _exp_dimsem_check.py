@@ -1,0 +1,23 @@
+import jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+import k8s_dra_driver_tpu.ops.attention as A
+
+k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+B, H, HKV, S, D = 2, 8, 2, 2048, 64
+q = jax.random.normal(k1, (B, H, S, D), jnp.bfloat16)
+kk = jax.random.normal(k2, (B, HKV, S, D), jnp.bfloat16)
+vv = jax.random.normal(k3, (B, HKV, S, D), jnp.bfloat16)
+
+ref = jax.jit(lambda q,k,v: A._flash_diff(q, k, v, True, D**-0.5, False, 1024, 1024))(q, kk, vv)
+
+orig = pl.pallas_call
+def patched(kernel, **kw):
+    kw.setdefault("compiler_params", pltpu.CompilerParams(
+        dimension_semantics=("parallel", "arbitrary", "arbitrary")))
+    return orig(kernel, **kw)
+pl.pallas_call = patched
+out = jax.jit(lambda q,k,v: A._flash_diff(q, k, v, True, D**-0.5, False, 1024, 1024))(q, kk, vv)
+pl.pallas_call = orig
+err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32))))
+print("max err dimsem vs baseline:", err)

@@ -479,9 +479,10 @@ def _flash_attention_bwd_pallas(
 # automatic VJP). The forward saves only q, k, v, out and the per-row
 # logsumexp; the backward recomputes score blocks from lse — flash-style, no
 # [S, S] materialization in either direction.
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _flash_diff(q, k, v, causal, scale, interpret=False,
-                block_q=512, block_k=512):
+                block_q=512, block_k=512,
+                bwd_block_q=0, bwd_block_k=0):
     return _flash_attention_pallas(
         q, k, v, causal, scale, block_q=block_q, block_k=block_k,
         interpret=interpret,
@@ -489,7 +490,8 @@ def _flash_diff(q, k, v, causal, scale, interpret=False,
 
 
 def _flash_diff_fwd(q, k, v, causal, scale, interpret=False,
-                    block_q=512, block_k=512):
+                    block_q=512, block_k=512,
+                    bwd_block_q=0, bwd_block_k=0):
     out, lse = _flash_attention_pallas(
         q, k, v, causal, scale, block_q=block_q, block_k=block_k,
         interpret=interpret, return_lse=True,
@@ -512,11 +514,16 @@ def _flash_diff_fwd(q, k, v, causal, scale, interpret=False,
     return out, res
 
 
-def _flash_diff_bwd(causal, scale, interpret, block_q, block_k, res, g):
+def _flash_diff_bwd(causal, scale, interpret, block_q, block_k,
+                    bwd_block_q, bwd_block_k, res, g):
+    # The backward's block economics differ from the forward's (4-dot
+    # kernels, tighter VMEM): it gets its own config; 0 means follow the
+    # forward's (the one sentinel, everywhere).
     q, k, v, out, lse = res
     return _flash_attention_bwd_pallas(
         q, k, v, out, lse, g, causal, scale,
-        block_q=block_q, block_k=block_k, interpret=interpret,
+        block_q=bwd_block_q or block_q, block_k=bwd_block_k or block_k,
+        interpret=interpret,
     )
 
 
@@ -532,6 +539,10 @@ _ATTN_IMPL = os.environ.get("TPU_DRA_ATTN_IMPL", "auto")
 # S <= 1024).
 _BLOCK_Q = int(os.environ.get("TPU_DRA_ATTN_BLOCK_Q", "1024"))
 _BLOCK_K = int(os.environ.get("TPU_DRA_ATTN_BLOCK_K", "1024"))
+# Backward-pass blocks (0 = same as forward): the bwd kernels do 4 dots and
+# carry more VMEM per step, so their optimum can differ from the forward's.
+_BWD_BLOCK_Q = int(os.environ.get("TPU_DRA_ATTN_BWD_BLOCK_Q", "0"))
+_BWD_BLOCK_K = int(os.environ.get("TPU_DRA_ATTN_BWD_BLOCK_K", "0"))
 
 
 def set_attention_impl(impl: str) -> None:
@@ -541,10 +552,18 @@ def set_attention_impl(impl: str) -> None:
     _ATTN_IMPL = impl
 
 
-def set_attention_blocks(block_q: int, block_k: int) -> None:
-    """Override the Pallas kernel block sizes (must divide the seq len)."""
-    global _BLOCK_Q, _BLOCK_K
+def set_attention_blocks(block_q: int, block_k: int,
+                         bwd_block_q: int | None = None,
+                         bwd_block_k: int | None = None) -> None:
+    """Override the Pallas kernel block sizes. For the backward blocks,
+    None leaves the current (possibly env-set) values untouched and 0
+    means "follow the forward blocks"."""
+    global _BLOCK_Q, _BLOCK_K, _BWD_BLOCK_Q, _BWD_BLOCK_K
     _BLOCK_Q, _BLOCK_K = block_q, block_k
+    if bwd_block_q is not None:
+        _BWD_BLOCK_Q = bwd_block_q
+    if bwd_block_k is not None:
+        _BWD_BLOCK_K = bwd_block_k
 
 
 def attention_impl_label() -> str:
@@ -554,10 +573,11 @@ def attention_impl_label() -> str:
     return "pallas" if on_tpu and _ATTN_IMPL != "xla" else "xla"
 
 
-def attention_blocks() -> tuple[int, int]:
-    """The (block_q, block_k) the kernel will use (before seq-len clamping)
-    — public so benchmarks can record the config they actually measured."""
-    return _BLOCK_Q, _BLOCK_K
+def attention_blocks() -> tuple[int, int, int, int]:
+    """The (block_q, block_k, bwd_block_q, bwd_block_k) the kernels will
+    use (before seq-len clamping; 0 = bwd follows fwd) — public so
+    benchmarks can record the config they actually measured."""
+    return _BLOCK_Q, _BLOCK_K, _BWD_BLOCK_Q, _BWD_BLOCK_K
 
 
 def flash_attention(
@@ -582,7 +602,7 @@ def flash_attention(
     if use_pallas:
         return _flash_diff(
             q, k, v, causal, scale, interpret or not on_tpu,
-            _BLOCK_Q, _BLOCK_K,
+            _BLOCK_Q, _BLOCK_K, _BWD_BLOCK_Q, _BWD_BLOCK_K,
         )
     if k.shape[1] != q.shape[1]:
         reps = q.shape[1] // k.shape[1]
