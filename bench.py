@@ -54,8 +54,11 @@ def pick_config():
 def run_bench(preset, batch, seq, peak_flops, remat_policy="flash_qkv"):
     from k8s_dra_driver_tpu.models.llama import PRESETS, init_params, loss_fn
     config = PRESETS[preset]
-    if config.max_seq_len < seq + 1:
-        seq = config.max_seq_len - 1
+    # The model consumes `seq` positions (inputs are tokens[:, :-1]), so
+    # seq may equal max_seq_len exactly — every preset's max_seq_len is a
+    # valid flash-blockable length, unlike the odd max_seq_len - 1.
+    if config.max_seq_len < seq:
+        seq = config.max_seq_len
 
     params = jax.jit(
         lambda k: init_params(config, k)
