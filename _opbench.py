@@ -1,97 +1,120 @@
 """Per-op efficiency on the chip: isolate matmul vs flash kernel.
 
-Timing methodology (shared with bench.py): the axon remote-execution
-runtime makes ``block_until_ready`` a no-op and memoizes identical
-dispatches, while any value fetch costs a ~90ms tunnel round-trip. So we
-time a DEPENDENCY CHAIN of n iterations (each iteration's input folds in
-the previous output, so nothing can be elided or memoized) with a single
-fetch at the end, at two chain lengths; the slope (T(n2)-T(n1))/(n2-n1)
-is the true per-op device time with the round-trip cancelled out.
+Timing methodology: the axon remote-execution runtime makes
+``block_until_ready`` a no-op, memoizes identical dispatches, charges a
+~90ms tunnel round-trip per value fetch, and adds ~0.65ms of overhead per
+DISPATCH — so op-level timing must happen inside ONE compiled program.
+Each measurement jits a ``lax.scan`` over N pre-staged distinct inputs
+(distinctness defeats memoization; the scalar carry defeats DCE), fetches
+one scalar, and takes the slope between two scan lengths to cancel the
+round-trip and warmup. Caveat: the chip may be time-shared, so sub-ms
+slopes still jitter — treat results as a health check, not a tuner; tune
+with bench.py (full-model steps are far above the noise floor).
 """
 import time
-import jax, jax.numpy as jnp
-from k8s_dra_driver_tpu.ops.attention import flash_attention, set_attention_blocks
+
+import jax
+import jax.numpy as jnp
+
+from k8s_dra_driver_tpu.ops.attention import _flash_diff
 
 PEAK = 197e12
+N1, N2 = 4, 12
 
 
-def _force(out):
-    leaf = jax.tree_util.tree_leaves(out)[0]
-    float(leaf.ravel()[0].astype(jnp.float32))
+def measure(label, per_x, xstack, flops, reps=3):
+    def mk(n):
+        def body(c, x):
+            return c + per_x(x), None
+        return jax.jit(
+            lambda xs: jax.lax.scan(body, jnp.zeros((), jnp.float32), xs[:n])[0]
+        )
+    fa, fb = mk(N1), mk(N2)
+    # Every timed call needs a DISTINCT input: the runtime memoizes
+    # identical (program, input) executions, so re-timing the same call
+    # returns a cached result at round-trip speed. Pre-stage perturbed
+    # copies and force them onto the device before timing.
+    # 2^-6 steps survive bf16 rounding (2^-9 would round back to 1.0,
+    # making all variants bit-identical and the memoizer's prey).
+    def variant(i):
+        # Built (and forced) right before its single timed use, freed right
+        # after — only ONE stack copy is live at a time on the 16GB chip.
+        v = xstack * (1.0 + 2.0 ** -6 * i)
+        float(v.ravel()[0].astype(jnp.float32))
+        return v
 
+    warm = variant(2 * reps)  # warmup-only input, never timed (it's cached)
+    float(fa(warm))
+    float(fb(warm))  # compile both
+    del warm
 
-def _default_chain(args, out):
-    """Fold a zero-scaled scalar of `out` into the first arg: keeps values
-    bit-identical in expectation but makes iteration i+1 depend on i."""
-    leaf = jax.tree_util.tree_leaves(out)[0]
-    dep = (leaf.ravel()[0] * 0).astype(args[0].dtype)
-    return (args[0] + dep, *args[1:])
-
-
-def timeit(fn, args, flops, name, n1=3, n2=12, chain=_default_chain):
-    # The chain state carries ACROSS run() calls: restarting from the same
-    # base args would let the memoizing runtime elide each run's prefix
-    # (the same iterations it already executed last run), biasing the
-    # slope low.
-    state = {"a": args}
-
-    def run(n):
-        a = state["a"]
-        out = None
+    def once(f, i):
+        v = variant(i)
         t0 = time.perf_counter()
-        for _ in range(n):
-            out = fn(*a)
-            a = chain(a, out)
-        _force(out)
-        state["a"] = a
+        float(f(v))
         return time.perf_counter() - t0
-    run(2)  # warm / compile
-    dt = (run(n2) - run(n1)) / (n2 - n1)
-    print(f"{name}: {dt*1e3:.2f} ms  {flops/dt/1e12:.1f} TF/s  "
+    # Chip time-sharing drifts on ~second scales: timing the short and the
+    # long scan back-to-back and differencing per pair cancels the drift;
+    # the median rides out the residual spikes.
+    diffs = sorted(
+        once(fb, 2 * i) - once(fa, 2 * i + 1) for i in range(reps)
+    )
+    dt = diffs[reps // 2] / (N2 - N1)
+    print(f"{label}: {dt*1e3:.2f} ms  {flops/dt/1e12:.1f} TF/s  "
           f"{flops/dt/PEAK*100:.1f}% peak", flush=True)
+
+
+def scalar(x):
+    # DCE-defeating reduction over EVERY element: a strided slice would let
+    # XLA rewrite slice-of-dot into a small dot and skip most of the work.
+    # The full reduce costs one extra memory pass over the output.
+    return jnp.sum(x.astype(jnp.float32))
 
 
 k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
 
 # Big matmul like gate/up: [16384, 2048] x [2048, 16384]
-a = jax.random.normal(k1, (16384, 2048), jnp.bfloat16)
+astack = jax.random.normal(k1, (N2, 16384, 2048), jnp.bfloat16)
 b = jax.random.normal(k2, (2048, 16384), jnp.bfloat16)
-mm = jax.jit(lambda a, b: a @ b)
-timeit(mm, (a, b), 2*16384*2048*16384, "matmul_16k_2k_16k")
-
-# matmul with 64-wide output (qkv-head-dim shape): [16384,2048]x[2048,64]
-b64 = jax.random.normal(k2, (2048, 64), jnp.bfloat16)
-mm64 = jax.jit(lambda a, b: a @ b)
-timeit(mm64, (a, b64), 2*16384*2048*64, "matmul_N64")
+measure("matmul_16k_2k_16k", lambda a: scalar(a @ b), astack,
+        2 * 16384 * 2048 * 16384)
+del astack, b
 
 # einsum like fused qkv: bth,hkgd->btkgd
+xstack = jax.random.normal(k1, (N2, 8, 2048, 2048), jnp.bfloat16)
 w = jax.random.normal(k2, (2048, 8, 6, 64), jnp.bfloat16)
-x = jax.random.normal(k1, (8, 2048, 2048), jnp.bfloat16)
-qkv = jax.jit(lambda x, w: jnp.einsum("bth,hkgd->btkgd", x, w))
-timeit(qkv, (x, w), 2*8*2048*2048*8*6*64, "einsum_qkv")
-
-# flash attention fwd (b8 h32 s2048 d64, causal), pallas
-set_attention_blocks(1024, 1024)
-q = jax.random.normal(k1, (8, 32, 2048, 64), jnp.bfloat16)
-kk = jax.random.normal(k2, (8, 8, 2048, 64), jnp.bfloat16)
-vv = jax.random.normal(k3, (8, 8, 2048, 64), jnp.bfloat16)
-fa = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True, force_pallas=True))
-attn_flops = 2 * 2 * 8 * 32 * 2048 * 2048 * 64 * 0.5
+measure("einsum_qkv",
+        lambda x: scalar(jnp.einsum("bth,hkgd->btkgd", x, w)), xstack,
+        2 * 8 * 2048 * 2048 * 8 * 6 * 64)
+del xstack, w
 
 
-def _attn_chain(args, out):
-    # out has q's shape: feed it back as next q (distinct values each iter).
-    return (out.astype(args[0].dtype), *args[1:])
+def flash_suite(tag, B, H, HKV, S, D):
+    qstack = jax.random.normal(k1, (N2, B, H, S, D), jnp.bfloat16)
+    kk = jax.random.normal(k2, (B, HKV, S, D), jnp.bfloat16)
+    vv = jax.random.normal(k3, (B, HKV, S, D), jnp.bfloat16)
+    useful = 2 * 2 * B * H * S * S * D * 0.5
+    measure(f"flash_fwd_{tag}",
+            lambda q: scalar(
+                _flash_diff(q, kk, vv, True, D ** -0.5, False, 1024, 1024)
+            ),
+            qstack, useful)
+    def fwd_bwd(q):
+        # Differentiate wrt q AND k/v: grads of k/v feed the dkdv pallas
+        # kernel — gradding only q lets XLA dead-code-eliminate it and the
+        # "fwd+bwd" figure silently measures fwd + dq alone.
+        gq, gk, gv = jax.grad(
+            lambda qq, kk_, vv_: _flash_diff(
+                qq, kk_, vv_, True, D ** -0.5, False, 1024, 1024
+            ).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2),
+        )(q, kk, vv)
+        return scalar(gq) + scalar(gk) + scalar(gv)
+
+    measure(f"flash_fwd_bwd_{tag}", fwd_bwd, qstack, useful * 3.5)
 
 
-timeit(fa, (q, kk, vv), attn_flops, "flash_fwd_pallas", chain=_attn_chain)
-
-# flash fwd+bwd
-fab = jax.jit(jax.grad(lambda q, k, v: flash_attention(q, k, v, causal=True, force_pallas=True).astype(jnp.float32).sum(), argnums=(0,1,2)))
-
-
-def _grad_chain(args, out):
-    return (out[0].astype(args[0].dtype), *args[1:])
-
-
-timeit(fab, (q, kk, vv), attn_flops*3.5, "flash_fwd_bwd_pallas", chain=_grad_chain)
+# The local bench geometry (1b preset: d=64) and the 8B target geometry
+# (d=128, full MXU lanes).
+flash_suite("d64", 8, 32, 8, 2048, 64)
+flash_suite("d128", 2, 32, 8, 2048, 128)
