@@ -598,7 +598,13 @@ def flash_attention(
     d = q.shape[-1]
     scale = scale if scale is not None else d ** -0.5
     on_tpu = jax.default_backend() == "tpu"
-    use_pallas = force_pallas or (on_tpu and _ATTN_IMPL != "xla")
+    # Auto-dispatch falls back to XLA for sequence lengths the kernel can't
+    # block (_fit_block needs multiples of 8); force_pallas keeps the
+    # loud assert for callers that insist.
+    blockable = q.shape[-2] % 8 == 0 and k.shape[-2] % 8 == 0
+    use_pallas = force_pallas or (
+        on_tpu and _ATTN_IMPL != "xla" and blockable
+    )
     if use_pallas:
         return _flash_diff(
             q, k, v, causal, scale, interpret or not on_tpu,

@@ -60,6 +60,170 @@ def _merge(m1, l1, o1, m2, l2, o2):
     return m, l1 * a1 + l2 * a2, o1 * a1 + o2 * a2
 
 
+# ---------------------------------------------------------------------------
+# Flash ring: per-hop Pallas flash kernels + lse-based merge, custom VJP.
+#
+# The XLA einsum path below ("xla" impl) materializes [Sq, Skv] scores per
+# hop; this path instead runs the flash kernel on each (q-shard, kv-shard)
+# pair, so per-hop memory stays O(block) and the MXU sees the same kernels
+# as single-chip attention. Three hop classes under causal masking: the
+# diagonal hop runs the causal kernel, hops holding earlier kv run the
+# unmasked kernel, and hops holding later kv skip compute entirely (the
+# rotation still happens — the ring must keep turning). K/V rotate at
+# their NATIVE GQA head count (no repeat), dividing ICI traffic by the
+# group size versus the XLA path.
+#
+# Differentiation: per-hop VJPs would need d/d(lse) terms the flash
+# backward doesn't produce, so the WHOLE ring gets one custom VJP (the
+# ring-attention recipe): forward saves (q, k, v, global out, global lse);
+# backward rides the ring again, calling the flash backward kernels with
+# the GLOBAL lse/out per hop — dq accumulates at home, dk/dv accumulate
+# on carriers that rotate alongside their kv shard and arrive home after
+# n hops. dk/dv rotate in f32 (n-term accumulation in bf16 would drift).
+# ---------------------------------------------------------------------------
+
+
+def _hop_class(kv_idx, idx, causal):
+    """0 = diagonal (causal kernel), 1 = fully visible, 2 = skip."""
+    if not causal:
+        return jnp.int32(1)
+    return jnp.where(
+        kv_idx == idx, jnp.int32(0),
+        jnp.where(kv_idx < idx, jnp.int32(1), jnp.int32(2)),
+    )
+
+
+def _ring_flash_fwd_loop(q, k, v, axis_name, scale, causal, interpret):
+    from ..ops.attention import NEG_INF as _NI
+    from ..ops.attention import _flash_attention_pallas
+
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    b, h, sq, d = q.shape
+
+    from ..ops.attention import attention_blocks
+
+    bq, bk, _, _ = attention_blocks()  # honor the swept/env-set config
+
+    def attend(kc, vc, causal_hop):
+        out, lse = _flash_attention_pallas(
+            q, kc, vc, causal_hop, scale, block_q=bq, block_k=bk,
+            interpret=interpret, return_lse=True,
+        )
+        return out.astype(jnp.float32), lse[..., None]  # [B,H,Sq,D], [B,H,Sq,1]
+
+    def skip(kc, vc):
+        return (
+            jnp.zeros(q.shape, jnp.float32),
+            jnp.full((b, h, sq, 1), _NI, jnp.float32),
+        )
+
+    def step(t, carry):
+        out_acc, lse_acc, k_cur, v_cur = carry
+        kv_idx = (idx - t) % n
+        out_hop, lse_hop = jax.lax.switch(
+            _hop_class(kv_idx, idx, causal),
+            [
+                lambda kc, vc: attend(kc, vc, True),
+                lambda kc, vc: attend(kc, vc, False),
+                skip,
+            ],
+            k_cur, v_cur,
+        )
+        # lse-weighted merge of normalized partials; a skipped hop (lse =
+        # NEG_INF) contributes weight-0 zeros.
+        lse_new = jnp.logaddexp(lse_acc, lse_hop)
+        out_acc = (
+            out_acc * jnp.exp(lse_acc - lse_new)
+            + out_hop * jnp.exp(lse_hop - lse_new)
+        )
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return out_acc, lse_new, k_nxt, v_nxt
+
+    out = jnp.zeros(q.shape, jnp.float32)
+    lse = jnp.full((b, h, sq, 1), _NI, jnp.float32)
+    out, lse, _, _ = jax.lax.fori_loop(0, n, step, (out, lse, k, v))
+    return out.astype(q.dtype), lse[..., 0]  # lse: [B, H, Sq]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_flash(q, k, v, axis_name, scale, causal, interpret):
+    out, _ = _ring_flash_fwd_loop(q, k, v, axis_name, scale, causal, interpret)
+    return out
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis_name, scale, causal, interpret):
+    out, lse = _ring_flash_fwd_loop(
+        q, k, v, axis_name, scale, causal, interpret
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_vjp_bwd(axis_name, scale, causal, interpret, res, g):
+    from ..ops.attention import _flash_attention_bwd_pallas
+
+    q, k, v, out, lse = res
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    from ..ops.attention import attention_blocks
+
+    bq, bk, bbq, bbk = attention_blocks()
+
+    def grads(kc, vc, causal_hop):
+        dq_h, dk_h, dv_h = _flash_attention_bwd_pallas(
+            q, kc.astype(q.dtype), vc.astype(q.dtype), out, lse, g,
+            causal_hop, scale,
+            block_q=bbq or bq, block_k=bbk or bk, interpret=interpret,
+        )
+        return (
+            dq_h.astype(jnp.float32),
+            dk_h.astype(jnp.float32),
+            dv_h.astype(jnp.float32),
+        )
+
+    def skip(kc, vc):
+        return (
+            jnp.zeros(q.shape, jnp.float32),
+            jnp.zeros(kc.shape, jnp.float32),
+            jnp.zeros(vc.shape, jnp.float32),
+        )
+
+    def step(t, carry):
+        dq_acc, dk_acc, dv_acc, k_cur, v_cur = carry
+        kv_idx = (idx - t) % n
+        dq_h, dk_h, dv_h = jax.lax.switch(
+            _hop_class(kv_idx, idx, causal),
+            [
+                lambda kc, vc: grads(kc, vc, True),
+                lambda kc, vc: grads(kc, vc, False),
+                skip,
+            ],
+            k_cur, v_cur,
+        )
+        dq_acc = dq_acc + dq_h
+        # dk/dv accumulators rotate WITH their kv shard; after n hops each
+        # arrives back at the shard's home device with every q-shard's
+        # contribution folded in.
+        dk_acc = jax.lax.ppermute(dk_acc + dk_h, axis_name, perm)
+        dv_acc = jax.lax.ppermute(dv_acc + dv_h, axis_name, perm)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return dq_acc, dk_acc, dv_acc, k_nxt, v_nxt
+
+    dq = jnp.zeros(q.shape, jnp.float32)
+    dk = jnp.zeros(k.shape, jnp.float32)
+    dv = jnp.zeros(v.shape, jnp.float32)
+    dq, dk, dv, _, _ = jax.lax.fori_loop(0, n, step, (dq, dk, dv, k, v))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
 def _ring_attention_local(
     q, k, v, *, axis_name: str, scale: float, causal: bool
 ):
@@ -103,11 +267,59 @@ def ring_attention(
     scale: Optional[float] = None,
     batch_axes: tuple = ("data", "fsdp"),
     head_axis: Optional[str] = "tensor",
+    impl: str = "auto",
 ) -> jax.Array:
     """Sequence-parallel attention. q,k,v: [B, H, S, D] sharded with S over
-    ``axis_name`` (and optionally B over batch axes / H over tensor)."""
+    ``axis_name`` (and optionally B over batch axes / H over tensor).
+
+    ``impl``: "flash" runs the Pallas flash kernels per ring hop (GQA kv
+    rotates un-repeated, masked hops skip compute — see the flash-ring
+    section above); "xla" is the einsum reference; "auto" picks flash on
+    TPU (interpret-mode flash elsewhere is kernel-accurate but slow).
+    """
+    assert impl in ("auto", "flash", "xla"), impl
+    from ..ops.attention import attention_impl_label
+
     d = q.shape[-1]
     scale = scale if scale is not None else d ** -0.5
+    on_tpu = jax.default_backend() == "tpu"
+    n_seq = mesh.shape[axis_name]
+    s_local = q.shape[-2] // max(n_seq, 1)
+    # "auto" follows the global attention dispatch (so the documented
+    # TPU_DRA_ATTN_IMPL=xla escape hatch covers ring attention too) and
+    # requires flash-blockable shard lengths (multiples of 8).
+    use_flash = impl == "flash" or (
+        impl == "auto"
+        and attention_impl_label() == "pallas"
+        and s_local % 8 == 0
+    )
+    if use_flash:
+        h, hkv = q.shape[1], k.shape[1]
+        tp = mesh.shape[head_axis] if head_axis else 1
+        if hkv % max(tp, 1):
+            # kv heads don't divide over the tensor axis at native GQA
+            # count: repeat by the smallest group divisor that does (full
+            # group in the worst case — then it matches the xla path).
+            g = h // hkv
+            r = next(
+                (r for r in range(2, g + 1)
+                 if g % r == 0 and (hkv * r) % tp == 0),
+                g,
+            )
+            k = jnp.repeat(k, r, axis=1)
+            v = jnp.repeat(v, r, axis=1)
+        kv_spec = P(batch_axes, head_axis, axis_name, None)
+        fn = shard_map(
+            # custom_vjp nondiff args must stay positional.
+            lambda q_, k_, v_: _ring_flash(
+                q_, k_, v_, axis_name, scale, causal, not on_tpu
+            ),
+            mesh=mesh,
+            in_specs=(kv_spec, kv_spec, kv_spec),
+            out_specs=kv_spec,
+            check_vma=False,
+        )
+        return fn(q, k, v)
     if k.shape[1] != q.shape[1]:  # GQA: replicate kv heads first
         reps = q.shape[1] // k.shape[1]
         k = jnp.repeat(k, reps, axis=1)
@@ -143,7 +355,7 @@ def ulysses_attention(
     """All-to-all sequence parallelism (DeepSpeed-Ulysses style): exchange
     sequence shards for head shards, run full-sequence attention locally on
     H/n heads, exchange back. Requires H % n == 0."""
-    from ..ops.attention import attention_reference
+    from ..ops.attention import flash_attention
 
     d = q.shape[-1]
     scale = scale if scale is not None else d ** -0.5
@@ -151,8 +363,11 @@ def ulysses_attention(
         reps = q.shape[1] // k.shape[1]
         k = jnp.repeat(k, reps, axis=1)
         v = jnp.repeat(v, reps, axis=1)
+    # flash_attention dispatches the Pallas kernel on TPU and the XLA
+    # reference elsewhere — the local full-sequence attention after the
+    # all-to-all gets the same kernels as single-chip attention.
     attn = attn_fn or (
-        lambda q_, k_, v_: attention_reference(q_, k_, v_, causal, scale)
+        lambda q_, k_, v_: flash_attention(q_, k_, v_, causal, scale)
     )
 
     def local(q, k, v):

@@ -72,6 +72,19 @@ class TestFlashAttention:
         )(q)
         np.testing.assert_allclose(gp, gr, atol=2e-4, rtol=2e-4)
 
+    def test_unblockable_seq_falls_back_to_xla(self, monkeypatch):
+        # S=100 (not a multiple of 8): auto dispatch must use the XLA path
+        # rather than hit the kernel's block assert — even when the module
+        # thinks it's on TPU.
+        import k8s_dra_driver_tpu.ops.attention as A
+
+        monkeypatch.setattr(A.jax, "default_backend", lambda: "tpu")
+        b, h, s, d = 1, 2, 100, 32
+        q, k, v = (rand(b, h, s, d, seed=i) for i in range(3))
+        out = flash_attention(q, k, v, causal=True)
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
     def test_bf16_runs(self):
         b, h, s, d = 1, 2, 128, 64
         q, k, v = (
