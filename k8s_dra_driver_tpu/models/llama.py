@@ -283,6 +283,44 @@ def forward(
     return (x @ params["lm_head"]).astype(jnp.float32)
 
 
+def forward_pipelined(
+    params: dict,
+    tokens: jax.Array,                  # [B, S] int32
+    config: LlamaConfig,
+    mesh: Mesh,
+    n_microbatches: int,
+    return_hidden: bool = False,
+) -> jax.Array:
+    """Causal LM forward with the transformer trunk run as a pipeline over
+    the mesh "pipe" axis (parallel/pipeline.py): layers split into
+    contiguous stages, microbatches stream through via ppermute. Embedding
+    and the LM head stay outside the pipeline (they are a small fraction
+    of the FLOPs and keep the stage function a same-shape transform)."""
+    from ..parallel.pipeline import pipeline, stage_params
+
+    c = config
+    s = tokens.shape[1]
+    x = params["embed"][tokens]
+    cos, sin = rope_frequencies(c.head_dim, s, c.rope_theta, dtype=jnp.float32)
+    staged = stage_params(params["layers"], mesh.shape["pipe"])
+
+    def stage_fn(layers_local, x_mb):
+        def block(x, layer):
+            x = _attention_block(x, layer, c, cos, sin, None, False)
+            x = _mlp_block(x, layer, c)
+            return x, None
+        x_mb, _ = jax.lax.scan(block, x_mb, layers_local)
+        return x_mb
+
+    x = pipeline(
+        stage_fn, staged, x, mesh=mesh, n_microbatches=n_microbatches
+    )
+    x = rmsnorm(x, params["final_norm"], c.norm_eps)
+    if return_hidden:
+        return x
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
 def chunked_cross_entropy(
     hidden: jax.Array,                   # [B, S, H]
     lm_head: jax.Array,                  # [H, V]

@@ -1,0 +1,279 @@
+"""Mixtral-style sparse Mixture-of-Experts on the Llama trunk.
+
+TPU-first design: routing is CAPACITY-BASED with fully static shapes (no
+data-dependent shapes anywhere, so the whole model jits and shards like
+the dense trunk), and dispatch/combine are one-hot einsums that lower to
+MXU matmuls — the GShard/Switch formulation rather than gather/scatter.
+Expert weights carry a leading E axis sharded over the mesh "expert" axis
+(parallel/mesh.py); under jit the dispatched activations get a matching
+sharding constraint, so XLA inserts the dispatch/combine all-to-alls.
+
+Attention, norms, rope, remat policies, and the chunked cross-entropy are
+the dense trunk's own (models/llama.py) — an MoE model differs only in
+its MLP block, the router aux loss threading through the layer scan, and
+the per-layer expert weights.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.norms import rmsnorm
+from ..ops.rotary import rope_frequencies
+from .llama import (
+    LlamaConfig,
+    _attention_block,
+    _remat_transform,
+    chunked_cross_entropy,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeConfig(LlamaConfig):
+    n_experts: int = 8
+    top_k: int = 2
+    # Per-expert token slots = capacity_factor * (top_k * S / E), the
+    # GShard convention; overflowing tokens drop that expert (their other
+    # choice, and the residual path, still carry them).
+    capacity_factor: float = 1.25
+    # Switch-style load-balancing auxiliary loss coefficient.
+    aux_coef: float = 0.01
+
+    def num_params(self) -> int:
+        h, m, v, l = self.hidden, self.mlp_hidden, self.vocab_size, self.n_layers
+        kv = self.n_kv_heads * self.head_dim
+        e = self.n_experts
+        per_layer = (
+            h * h + 2 * h * kv + h * h          # attention
+            + h * e                              # router
+            + e * 3 * h * m                      # experts (gate, up, down)
+            + 2 * h
+        )
+        return v * h + l * per_layer + h + h * v
+
+    def flops_per_token(self) -> float:
+        """Active-parameter FLOPs (top_k experts of E), fwd+bwd."""
+        h, m, v, l = self.hidden, self.mlp_hidden, self.vocab_size, self.n_layers
+        kv = self.n_kv_heads * self.head_dim
+        active_per_layer = (
+            h * h + 2 * h * kv + h * h
+            + h * self.n_experts
+            + self.top_k * 3 * h * m
+            + 2 * h
+        )
+        n_active = v * h + l * active_per_layer + h + h * v
+        attn = 12 * l * h * self.max_seq_len
+        return 6 * n_active + attn
+
+
+MOE_PRESETS: dict[str, MoeConfig] = {
+    # Hermetic-test size.
+    "tiny-moe": MoeConfig(
+        vocab_size=256, hidden=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        mlp_hidden=128, max_seq_len=128, dtype=jnp.float32,
+        n_experts=4, top_k=2,
+    ),
+    # Single-v5e-chip bench size (active params ≈ the dense 1b).
+    "8x160m": MoeConfig(
+        vocab_size=32000, hidden=768, n_layers=12, n_heads=12, n_kv_heads=12,
+        mlp_hidden=2048, max_seq_len=2048, n_experts=8, top_k=2,
+    ),
+    # Mixtral-8x7B geometry.
+    "8x7b": MoeConfig(
+        vocab_size=32000, hidden=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        mlp_hidden=14336, max_seq_len=8192, rope_theta=1e6,
+        n_experts=8, top_k=2,
+    ),
+}
+
+
+def init_params(config: MoeConfig, key: jax.Array) -> dict:
+    """Parameter pytree: the dense trunk's layout (layers stacked on axis
+    0, fused QKV — llama.init_params docstring) with the MLP replaced by
+    router + per-expert weights."""
+    c = config
+    keys = jax.random.split(key, 12)
+    h, m, v, l, e = c.hidden, c.mlp_hidden, c.vocab_size, c.n_layers, c.n_experts
+    hq = c.n_heads * c.head_dim
+    g = c.n_heads // c.n_kv_heads
+
+    def norm_init(k, *shape, fan_in):
+        scale = 1.0 / math.sqrt(fan_in)
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(c.dtype)
+
+    return {
+        "embed": norm_init(keys[0], v, h, fan_in=h),
+        "layers": {
+            "wqkv": norm_init(
+                keys[1], l, h, c.n_kv_heads, g + 2, c.head_dim, fan_in=h
+            ),
+            "wo": norm_init(keys[2], l, hq, h, fan_in=hq),
+            # Router stays f32: tiny, and top-k on bf16 logits is noisy.
+            "wr": (jax.random.normal(keys[3], (l, h, e), jnp.float32)
+                   / math.sqrt(h)),
+            "w_gateup": norm_init(keys[4], l, e, h, 2, m, fan_in=h),
+            "w_down": norm_init(keys[5], l, e, m, h, fan_in=m),
+            "ln_attn": jnp.ones((l, h), c.dtype),
+            "ln_mlp": jnp.ones((l, h), c.dtype),
+        },
+        "final_norm": jnp.ones((h,), c.dtype),
+        "lm_head": norm_init(keys[6], h, v, fan_in=h),
+    }
+
+
+def param_specs(config: MoeConfig) -> dict:
+    """PartitionSpecs: dense-trunk TP/fsdp plus the expert axis on every
+    per-expert weight (the leading None is the layer-scan dim)."""
+    return {
+        "embed": P("tensor", "fsdp"),
+        "layers": {
+            "wqkv": P(None, "fsdp", "tensor", None, None),
+            "wo": P(None, "tensor", "fsdp"),
+            "wr": P(None, None, None),
+            "w_gateup": P(None, "expert", "fsdp", None, "tensor"),
+            "w_down": P(None, "expert", "tensor", "fsdp"),
+            "ln_attn": P(None, None),
+            "ln_mlp": P(None, None),
+        },
+        "final_norm": P(None),
+        "lm_head": P("fsdp", "tensor"),
+    }
+
+
+def _capacity(config: MoeConfig, seq: int) -> int:
+    c = config
+    return max(1, int(c.capacity_factor * c.top_k * seq / c.n_experts))
+
+
+def _route(probs: jax.Array, config: MoeConfig, cap: int):
+    """Static-shape top-k routing with per-expert capacity.
+
+    probs: [B, S, E] router softmax. Returns (dispatch [B,S,E,C] 0/1,
+    combine [B,S,E,C] gate-weighted, aux scalar). Choice k queues behind
+    choices < k for capacity slots (GShard priority order); tokens past
+    capacity are dropped for that expert only.
+    """
+    c = config
+    e = c.n_experts
+    masks, gates = [], []
+    remaining = probs
+    for _ in range(c.top_k):
+        idx = jnp.argmax(remaining, axis=-1)               # [B, S]
+        m = jax.nn.one_hot(idx, e, dtype=probs.dtype)      # [B, S, E]
+        gates.append(jnp.sum(remaining * m, axis=-1))      # [B, S]
+        masks.append(m)
+        remaining = remaining * (1.0 - m)
+
+    # Load-balancing aux (Switch eq. 4): frac of tokens whose FIRST choice
+    # is e  ×  mean router prob of e, summed and scaled by E.
+    frac = jnp.mean(masks[0], axis=(0, 1))                 # [E]
+    mean_prob = jnp.mean(probs, axis=(0, 1))               # [E]
+    aux = e * jnp.sum(frac * mean_prob)
+
+    denom = sum(gates) + 1e-9
+    dispatch = jnp.zeros(probs.shape + (cap,), probs.dtype)
+    combine = jnp.zeros_like(dispatch)
+    count = jnp.zeros(probs.shape[:1] + (1, e), probs.dtype)  # [B, 1, E]
+    for m, gate in zip(masks, gates):
+        pos = jnp.cumsum(m, axis=1) - m + count            # [B, S, E]
+        count = count + jnp.sum(m, axis=1, keepdims=True)
+        keep = m * (pos < cap)
+        poh = jax.nn.one_hot(
+            pos.astype(jnp.int32), cap, dtype=probs.dtype
+        ) * keep[..., None]
+        dispatch = dispatch + poh
+        combine = combine + poh * (gate / denom)[..., None, None]
+    return dispatch, combine, aux
+
+
+def _moe_block(x, layer, config: MoeConfig, mesh: Optional[Mesh]):
+    """Sparse MLP: route → dispatch einsum → per-expert fused gate/up +
+    down → combine einsum → residual. Returns (x, aux)."""
+    c = config
+    b, s, h = x.shape
+    cap = _capacity(c, s)
+    xn = rmsnorm(x, layer["ln_mlp"], c.norm_eps)
+    logits = jnp.einsum(
+        "bsh,he->bse", xn.astype(jnp.float32), layer["wr"]
+    )
+    probs = jax.nn.softmax(logits, axis=-1)
+    dispatch, combine, aux = _route(probs, c, cap)
+
+    # [E, B, C, H]: expert-major so the "expert" mesh axis shards dim 0.
+    xe = jnp.einsum("bsec,bsh->ebch", dispatch.astype(xn.dtype), xn)
+    if mesh is not None and "expert" in mesh.shape:
+        xe = jax.lax.with_sharding_constraint(
+            xe, jax.sharding.NamedSharding(
+                mesh, P("expert", ("data", "fsdp"), None, None)
+            )
+        )
+    gu = jnp.einsum("ebch,ehum->ebcum", xe, layer["w_gateup"])
+    gate = jax.nn.silu(gu[..., 0, :].astype(jnp.float32))
+    up = gu[..., 1, :].astype(jnp.float32)
+    ye = jnp.einsum(
+        "ebcm,emh->ebch", (gate * up).astype(x.dtype), layer["w_down"]
+    )
+    out = jnp.einsum(
+        "bsec,ebch->bsh", combine.astype(jnp.float32),
+        ye.astype(jnp.float32),
+    )
+    return x + out.astype(x.dtype), aux
+
+
+def forward(
+    params: dict,
+    tokens: jax.Array,                  # [B, S] int32
+    config: MoeConfig,
+    mesh: Optional[Mesh] = None,
+    use_ring: bool = False,
+    remat: bool = False,
+    return_hidden: bool = False,
+    remat_policy: str = "full",
+):
+    """Causal LM forward. Returns (logits_or_hidden, aux_loss)."""
+    c = config
+    s = tokens.shape[1]
+    x = params["embed"][tokens]
+    cos, sin = rope_frequencies(c.head_dim, s, c.rope_theta, dtype=jnp.float32)
+
+    def block(carry, layer):
+        x, aux = carry
+        x = _attention_block(x, layer, c, cos, sin, mesh, use_ring)
+        x, aux_l = _moe_block(x, layer, c, mesh)
+        return (x, aux + aux_l), None
+
+    block = _remat_transform(remat, remat_policy)(block)
+    (x, aux), _ = jax.lax.scan(
+        block, (x, jnp.zeros((), jnp.float32)), params["layers"]
+    )
+    aux = aux / c.n_layers
+    x = rmsnorm(x, params["final_norm"], c.norm_eps)
+    if return_hidden:
+        return x, aux
+    return (x @ params["lm_head"]).astype(jnp.float32), aux
+
+
+def loss_fn(
+    params: dict,
+    tokens: jax.Array,                   # [B, S+1]
+    config: MoeConfig,
+    mesh: Optional[Mesh] = None,
+    use_ring: bool = False,
+    remat: bool = True,
+    remat_policy: str = "full",
+) -> jax.Array:
+    """Next-token CE + load-balancing aux."""
+    inputs = tokens[:, :-1]
+    targets = tokens[:, 1:]
+    hidden, aux = forward(
+        params, inputs, config, mesh, use_ring, remat, return_hidden=True,
+        remat_policy=remat_policy,
+    )
+    ce = chunked_cross_entropy(hidden, params["lm_head"], targets)
+    return ce + config.aux_coef * aux

@@ -7,10 +7,13 @@ module turns that into a ``jax.sharding.Mesh`` whose axis layout matches the
 physical ICI topology — so XLA's collectives ride ICI neighbours instead of
 arbitrary device orderings.
 
-Axis convention (outer → inner): ``("data", "fsdp", "sequence", "tensor")``.
+Axis convention (outer → inner):
+``("data", "fsdp", "pipe", "expert", "sequence", "tensor")``.
 - ``tensor``  — innermost, mapped onto directly-connected chips: per-op
   all-reduces must be the cheapest collective.
 - ``sequence`` — ring/all-to-all sequence parallelism for long context.
+- ``expert``  — MoE expert parallelism; dispatch/combine all-to-alls.
+- ``pipe``    — pipeline stages; neighbour-only activation transfers.
 - ``fsdp``    — parameter sharding; all-gathers overlap with compute.
 - ``data``    — pure data parallel, outermost (can span DCN between slices).
 """
@@ -26,7 +29,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("data", "fsdp", "sequence", "tensor")
+AXES = ("data", "fsdp", "pipe", "expert", "sequence", "tensor")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -35,12 +38,15 @@ class MeshConfig:
 
     data: int = 1
     fsdp: int = 1
+    pipe: int = 1
+    expert: int = 1
     sequence: int = 1
     tensor: int = 1
 
     @property
-    def shape(self) -> tuple[int, int, int, int]:
-        return (self.data, self.fsdp, self.sequence, self.tensor)
+    def shape(self) -> tuple[int, int, int, int, int, int]:
+        return (self.data, self.fsdp, self.pipe, self.expert,
+                self.sequence, self.tensor)
 
     @property
     def num_devices(self) -> int:

@@ -1,0 +1,97 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the "pipe" axis.
+
+TPU-first shape: stages are devices along the mesh's "pipe" axis, stage
+weights are the layer stack reshaped [n_stages, L/n_stages, ...] and
+sharded on the leading axis, and activations move stage-to-stage with
+``ppermute`` — a neighbour transfer that rides one ICI hop per step. The
+schedule is plain GPipe: microbatch j enters stage p at step p + j, so a
+run of M microbatches over P stages takes M + P - 1 steps with a bubble
+fraction of (P-1)/(M+P-1). Everything is a static-shape ``fori_loop``
+(lowered to scan), so the whole pipeline jits, shards, and reverse-mode
+differentiates without a custom VJP — the backward replays the schedule
+in reverse through the transposed ppermutes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def stage_params(layer_stack: Any, n_stages: int) -> Any:
+    """Reshape a layer-stacked pytree [L, ...] → [n_stages, L/n_stages, ...]
+    so the leading axis can shard over "pipe"."""
+    def split(a):
+        l = a.shape[0]
+        assert l % n_stages == 0, (
+            f"{l} layers do not split over {n_stages} pipeline stages"
+        )
+        return a.reshape(n_stages, l // n_stages, *a.shape[1:])
+    return jax.tree_util.tree_map(split, layer_stack)
+
+
+def pipeline(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    staged: Any,                      # [n_stages, L/P, ...] pytree
+    x: jax.Array,                     # [B, ...]
+    *,
+    mesh: Mesh,
+    n_microbatches: int,
+    axis_name: str = "pipe",
+    batch_axes: Optional[tuple] = ("data", "fsdp"),
+) -> jax.Array:
+    """Run ``stage_fn`` (same-shape activation transform, e.g. a scan over
+    this stage's transformer layers) as a P-stage pipeline. Returns the
+    transformed batch."""
+    b = x.shape[0]
+    m = n_microbatches
+    assert b % m == 0, f"batch {b} not divisible into {m} microbatches"
+    xs = x.reshape(m, b // m, *x.shape[1:])
+
+    def local(staged_local, xs_local):
+        idx = jax.lax.axis_index(axis_name)
+        p = jax.lax.psum(1, axis_name)
+        me = jax.tree_util.tree_map(lambda a: a[0], staged_local)
+        shift = [(i, (i + 1) % p) for i in range(p)]
+
+        def step(t, carry):
+            buf, outs = carry
+            # Stage 0 draws microbatch t from the input queue; later
+            # stages consume what the previous stage handed over.
+            inp = jnp.where(idx == 0, xs_local[jnp.clip(t, 0, m - 1)], buf)
+            y = stage_fn(me, inp)
+            # The last stage finishes microbatch t - (P-1) at step t.
+            j = t - (p - 1)
+            write = jnp.logical_and(idx == p - 1, j >= 0)
+            outs = jnp.where(
+                write, outs.at[jnp.clip(j, 0, m - 1)].set(y), outs
+            )
+            buf = jax.lax.ppermute(y, axis_name, shift)
+            return buf, outs
+
+        buf = jnp.zeros_like(xs_local[0])
+        outs = jnp.zeros_like(xs_local)
+        _, outs = jax.lax.fori_loop(0, m + p - 1, step, (buf, outs))
+        # Results live on the last stage; replicate along the pipe axis so
+        # the out_spec needn't special-case it.
+        return jax.lax.psum(
+            jnp.where(idx == p - 1, outs, jnp.zeros_like(outs)), axis_name
+        )
+
+    spec_params = jax.tree_util.tree_map(
+        lambda a: P(axis_name, *([None] * (a.ndim - 1))), staged
+    )
+    mb_spec = P(None, batch_axes, *([None] * (x.ndim - 1)))
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec_params, mb_spec),
+        out_specs=mb_spec,
+        check_vma=False,
+    )
+    out = fn(staged, xs)
+    return out.reshape(b, *x.shape[1:])

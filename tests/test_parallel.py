@@ -48,7 +48,14 @@ class TestMeshConfig:
 
     def test_build_mesh(self, devices):
         mesh = build_mesh(MeshConfig(data=2, fsdp=2, sequence=1, tensor=2))
-        assert mesh.shape == {"data": 2, "fsdp": 2, "sequence": 1, "tensor": 2}
+        assert mesh.shape == {"data": 2, "fsdp": 2, "pipe": 1, "expert": 1,
+                              "sequence": 1, "tensor": 2}
+
+    def test_build_mesh_expert_pipe(self, devices):
+        mesh = build_mesh(MeshConfig(data=1, fsdp=1, pipe=2, expert=2,
+                                     sequence=1, tensor=2))
+        assert mesh.shape == {"data": 1, "fsdp": 1, "pipe": 2, "expert": 2,
+                              "sequence": 1, "tensor": 2}
 
     def test_wrong_count_rejected(self, devices):
         with pytest.raises(ValueError, match="needs"):
