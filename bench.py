@@ -51,8 +51,25 @@ def pick_config():
     return "1b", 8, 2048, spec.peak_bf16_flops
 
 
-def run_bench(preset, batch, seq, peak_flops, remat_policy="flash_qkv"):
-    from k8s_dra_driver_tpu.models.llama import PRESETS, init_params, loss_fn
+def run_bench(preset, batch, seq, peak_flops, remat_policy="flash_qkv",
+              model="dense"):
+    if model == "moe":
+        from k8s_dra_driver_tpu.models.moe import (
+            MOE_PRESETS as PRESETS,
+            init_params,
+            loss_fn,
+        )
+    else:
+        from k8s_dra_driver_tpu.models.llama import (
+            PRESETS,
+            init_params,
+            loss_fn,
+        )
+    if preset not in PRESETS:
+        raise SystemExit(
+            f"preset {preset!r} not in the {model} model family; valid: "
+            f"{sorted(PRESETS)}"
+        )
     config = PRESETS[preset]
     # The model consumes `seq` positions (inputs are tokens[:, :-1]), so
     # seq may equal max_seq_len exactly — every preset's max_seq_len is a
@@ -96,8 +113,9 @@ def run_bench(preset, batch, seq, peak_flops, remat_policy="flash_qkv"):
     # fetch at the end forces the whole chain. Timing two chain lengths and
     # taking the slope cancels the round-trip; on a local backend the same
     # arithmetic is simply per-step time.
-    n1 = 1 if preset == "tiny" else 2
-    n2 = 3 if preset == "tiny" else 8
+    tiny = preset.startswith("tiny")
+    n1 = 1 if tiny else 2
+    n2 = 3 if tiny else 8
     batches = [
         jax.device_put(
             jax.random.randint(
@@ -123,15 +141,14 @@ def run_bench(preset, batch, seq, peak_flops, remat_policy="flash_qkv"):
     dt = (t_long - t_short) / (n2 - n1)
 
     n_tokens = batch * seq
-    # fwd 2N + bwd 4N matmul FLOPs per token, + attention quadratic term.
-    n_params = config.num_params()
-    attn_flops = 12 * config.n_layers * config.hidden * seq
-    flops_per_token = 6 * n_params + attn_flops
-    achieved = flops_per_token * n_tokens / dt
+    # fwd 2N + bwd 4N matmul FLOPs/token + attention quadratic term; for
+    # MoE, N counts ACTIVE params (top_k experts), the MFU convention.
+    achieved = config.flops_per_token(seq) * n_tokens / dt
     mfu = achieved / peak_flops
 
+    family = "mixtral" if model == "moe" else "llama3"
     return {
-        "metric": f"llama3_{preset}_train_mfu_b{batch}_s{seq}",
+        "metric": f"{family}_{preset}_train_mfu_b{batch}_s{seq}",
         "value": round(mfu, 4),
         "unit": "mfu_fraction",
         "vs_baseline": round(mfu / 0.50, 4),
@@ -157,6 +174,13 @@ def main() -> int:
 
     preset, batch, seq, peak_flops = pick_config()
     # Experiment overrides (bench sweeps).
+    model = os.environ.get("TPU_DRA_BENCH_MODEL", "dense")
+    if model not in ("dense", "moe"):
+        print(f"unknown TPU_DRA_BENCH_MODEL {model!r}; valid: "
+              f"['dense', 'moe']", file=sys.stderr)
+        return 2
+    if model == "moe" and "TPU_DRA_BENCH_PRESET" not in os.environ:
+        preset = "tiny-moe" if preset == "tiny" else "8x160m"
     preset = os.environ.get("TPU_DRA_BENCH_PRESET", preset)
     batch = int(os.environ.get("TPU_DRA_BENCH_BATCH", batch))
     seq = int(os.environ.get("TPU_DRA_BENCH_SEQ", seq))
@@ -168,7 +192,8 @@ def main() -> int:
         return 2
 
     try:
-        result = run_bench(preset, batch, seq, peak_flops, remat_policy)
+        result = run_bench(preset, batch, seq, peak_flops, remat_policy,
+                           model)
         result["detail"]["attn"] = attention_impl_label()
     except Exception as e:
         # Pallas may be unavailable on this backend/runtime combination;
@@ -176,7 +201,8 @@ def main() -> int:
         print(f"pallas path failed ({type(e).__name__}); retrying with XLA "
               f"attention", file=sys.stderr)
         set_attention_impl("xla")
-        result = run_bench(preset, batch, seq, peak_flops, remat_policy)
+        result = run_bench(preset, batch, seq, peak_flops, remat_policy,
+                           model)
         result["detail"]["attn"] = "xla"
     result["detail"]["remat"] = remat_policy
     result["detail"]["blocks"] = "x".join(map(str, attention_blocks()))

@@ -30,10 +30,12 @@ __all__ = [
     "make_eval_step",
 ]
 
+from . import moe
 from .checkpoint import latest_step, restore_checkpoint, save_checkpoint
 from .decode import KVCache, decode_step, generate, prefill
 
 __all__ += [
+    "moe",
     "KVCache",
     "prefill",
     "decode_step",

@@ -49,10 +49,11 @@ class LlamaConfig:
     def head_dim(self) -> int:
         return self.hidden // self.n_heads
 
-    def flops_per_token(self) -> float:
-        """Dense fwd+bwd FLOPs/token ≈ 6N + attention term."""
+    def flops_per_token(self, seq: Optional[int] = None) -> float:
+        """Dense fwd+bwd FLOPs/token ≈ 6N + attention term (at ``seq``,
+        default max_seq_len)."""
         n = self.num_params()
-        attn = 12 * self.n_layers * self.hidden * self.max_seq_len
+        attn = 12 * self.n_layers * self.hidden * (seq or self.max_seq_len)
         return 6 * n + attn
 
     def num_params(self) -> int:

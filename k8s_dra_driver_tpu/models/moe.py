@@ -57,7 +57,7 @@ class MoeConfig(LlamaConfig):
         )
         return v * h + l * per_layer + h + h * v
 
-    def flops_per_token(self) -> float:
+    def flops_per_token(self, seq: Optional[int] = None) -> float:
         """Active-parameter FLOPs (top_k experts of E), fwd+bwd."""
         h, m, v, l = self.hidden, self.mlp_hidden, self.vocab_size, self.n_layers
         kv = self.n_kv_heads * self.head_dim
@@ -68,7 +68,7 @@ class MoeConfig(LlamaConfig):
             + 2 * h
         )
         n_active = v * h + l * active_per_layer + h + h * v
-        attn = 12 * l * h * self.max_seq_len
+        attn = 12 * l * h * (seq or self.max_seq_len)
         return 6 * n_active + attn
 
 

@@ -9,6 +9,7 @@ from .mesh import (
     host_mesh_shape,
     mesh_from_env,
 )
+from .pipeline import pipeline, stage_params
 from .ring import ring_attention, ulysses_attention
 from .shim import SharingRuntime, apply_sharing_env, timeshare_lease
 from .sharding import (
@@ -26,6 +27,8 @@ __all__ = [
     "build_mesh",
     "mesh_from_env",
     "host_mesh_shape",
+    "pipeline",
+    "stage_params",
     "ring_attention",
     "ulysses_attention",
     "coordinator_from_env",
