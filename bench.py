@@ -16,6 +16,7 @@ landed on. On CPU (no TPU visible) a tiny config keeps the harness green.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -56,6 +57,7 @@ def run_bench(preset, batch, seq, peak_flops, remat_policy="flash_qkv",
     if model == "moe":
         from k8s_dra_driver_tpu.models.moe import (
             MOE_PRESETS as PRESETS,
+            effective_router_group,
             init_params,
             loss_fn,
         )
@@ -71,6 +73,13 @@ def run_bench(preset, batch, seq, peak_flops, remat_policy="flash_qkv",
             f"{sorted(PRESETS)}"
         )
     config = PRESETS[preset]
+    if model == "moe":
+        import dataclasses
+        group = os.environ.get("TPU_DRA_BENCH_MOE_GROUP")
+        if group is not None:
+            # 0 is a meaningful value (whole-sequence routing), so only an
+            # UNSET env keeps the preset default.
+            config = dataclasses.replace(config, router_group=int(group))
     # The model consumes `seq` positions (inputs are tokens[:, :-1]), so
     # seq may equal max_seq_len exactly — every preset's max_seq_len is a
     # valid flash-blockable length, unlike the odd max_seq_len - 1.
@@ -153,6 +162,10 @@ def run_bench(preset, batch, seq, peak_flops, remat_policy="flash_qkv",
         "unit": "mfu_fraction",
         "vs_baseline": round(mfu / 0.50, 4),
         "detail": {
+            **(
+                {"moe_group": effective_router_group(config, seq)}
+                if model == "moe" else {}
+            ),
             "tokens_per_s": round(n_tokens / dt, 1),
             "step_ms": round(dt * 1e3, 2),
             "loss": float(loss),
@@ -163,8 +176,6 @@ def run_bench(preset, batch, seq, peak_flops, remat_policy="flash_qkv",
 
 
 def main() -> int:
-    import os
-
     from k8s_dra_driver_tpu.models.llama import REMAT_POLICIES
     from k8s_dra_driver_tpu.ops.attention import (
         attention_blocks,

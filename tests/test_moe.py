@@ -100,6 +100,19 @@ class TestForward:
             np.array(moe_out), np.array(dense_out), atol=2e-5, rtol=2e-5
         )
 
+    def test_router_group_matches_whole_sequence_at_full_capacity(self):
+        """With capacity ample enough that nothing drops, grouped routing
+        picks the same experts/gates as whole-sequence routing."""
+        base = dataclasses.replace(CFG, capacity_factor=4.0)
+        grouped = dataclasses.replace(base, router_group=16)
+        params = init_params(base, jax.random.PRNGKey(0))
+        t = tokens()
+        o1, _ = forward(params, t, base)
+        o2, _ = forward(params, t, grouped)
+        np.testing.assert_allclose(
+            np.array(o1), np.array(o2), atol=2e-5, rtol=2e-5
+        )
+
     def test_loss_and_grads_finite(self):
         params = init_params(CFG, jax.random.PRNGKey(0))
         t = jax.random.randint(
