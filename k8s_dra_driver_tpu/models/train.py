@@ -17,7 +17,16 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .llama import LlamaConfig, init_params, loss_fn, param_specs
+from .llama import LlamaConfig
+
+
+def _model_fns(config: LlamaConfig):
+    """(init_params, loss_fn, param_specs) for the config's model family —
+    MoeConfig subclasses LlamaConfig, so the sparse check comes first."""
+    from . import llama, moe
+
+    mod = moe if isinstance(config, moe.MoeConfig) else llama
+    return mod.init_params, mod.loss_fn, mod.param_specs
 
 
 @dataclasses.dataclass
@@ -67,6 +76,7 @@ def init_train_state(
             f"tensor parallel degree {tensor} must divide n_kv_heads "
             f"({config.n_kv_heads}); use tensor <= n_kv_heads"
         )
+    init_params, _, param_specs = _model_fns(config)
     pspecs = param_specs(config)
     param_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs)
 
@@ -93,6 +103,7 @@ def make_train_step(
     remat: bool = True,
 ):
     """Build the jitted train step: (state, tokens[B, S+1]) → (state, loss)."""
+    _, loss_fn, _ = _model_fns(config)
     batch_sharding = NamedSharding(mesh, P(("data", "fsdp"), None))
 
     def step(state: TrainState, tokens: jax.Array):
@@ -118,6 +129,7 @@ def make_train_step(
 
 
 def make_eval_step(config: LlamaConfig, mesh: Mesh, use_ring: bool = False):
+    _, loss_fn, _ = _model_fns(config)
     batch_sharding = NamedSharding(mesh, P(("data", "fsdp"), None))
 
     def step(params, tokens):
