@@ -1,0 +1,73 @@
+"""Decode (serving) throughput on the chip: KV-cache autoregressive
+tokens/s for the HBM-sized Llama preset.
+
+Timing: ``generate`` (prefill + N-step while_loop decode) and ``prefill``
+alone are each ONE compiled program; their time difference over distinct
+prompts is N steady-state decode steps with the tunnel round-trip and
+prompt processing cancelled. Decode is HBM-bound — every step streams
+all weights except the embedding table, which is only gathered — so the
+roofline companion is non_embed_params_bytes / HBM_bandwidth.
+Remote compiles are minutes per program — this tool compiles exactly two.
+"""
+import time
+
+import jax
+
+from k8s_dra_driver_tpu.models.decode import generate, prefill
+from k8s_dra_driver_tpu.models.llama import PRESETS, init_params
+
+# The 1b preset's generate program takes >15 min in the remote compiler
+# (while_loop + layer scan + 128k-vocab head in one program); 160m keeps
+# the tool usable (~2 min/program) and the per-step roofline comparison
+# is the same shape.
+PRESET = "160m"
+BATCH = 8
+PROMPT = 128
+N = 96
+
+config = PRESETS[PRESET]
+params = jax.jit(lambda k: init_params(config, k))(jax.random.PRNGKey(0))
+
+prompts = [
+    jax.random.randint(
+        jax.random.PRNGKey(10 + i), (BATCH, PROMPT), 0, config.vocab_size
+    )
+    for i in range(8)
+]
+jax.block_until_ready(prompts)
+
+# Both programs size their KV cache identically so prefill cost matches.
+gen = jax.jit(lambda p: generate(params, p, config, N))
+pre = jax.jit(lambda p: prefill(params, p, config, PROMPT + N))
+
+
+def run(fn, prompt, out_of):
+    t0 = time.perf_counter()
+    out = fn(prompt)
+    float(out_of(out))  # forces execution through remote runtimes
+    return time.perf_counter() - t0
+
+
+t0 = time.perf_counter()
+run(gen, prompts[6], lambda o: o[0, -1])
+print(f"generate compiled in {time.perf_counter()-t0:.0f}s", flush=True)
+t0 = time.perf_counter()
+run(pre, prompts[7], lambda o: o[0][0, 0])
+print(f"prefill compiled in {time.perf_counter()-t0:.0f}s", flush=True)
+
+diffs = sorted(
+    run(gen, prompts[2 * i], lambda o: o[0, -1])
+    - run(pre, prompts[2 * i + 1], lambda o: o[0][0, 0])
+    for i in range(3)
+)
+step = diffs[1] / N  # median
+# Embedding rows are gathered, not streamed; everything else (incl. the
+# lm_head matmul) is read in full every step.
+streamed = config.num_params() - config.vocab_size * config.hidden
+hbm_roofline_ms = streamed * 2 / 810e9 * 1e3  # bf16 bytes / v5e HBM BW
+print(
+    f"decode {PRESET} b{BATCH}: {step*1e3:.2f} ms/step, "
+    f"{BATCH/step:.0f} tok/s aggregate "
+    f"(param-read roofline ~{hbm_roofline_ms:.2f} ms/step)",
+    flush=True,
+)
