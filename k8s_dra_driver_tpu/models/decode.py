@@ -17,8 +17,20 @@ import jax.numpy as jnp
 from ..ops.norms import rmsnorm
 from ..ops.rotary import rope_frequencies
 from .llama import LlamaConfig, _mlp_block, attn_out, project_qkv
+from .moe import MoeConfig, _moe_block
 
 NEG_INF = -1e30
+
+
+def _mlp_or_moe(x, layer, config):
+    """The per-layer FFN for the config's family: sparse MoE routing for
+    MoeConfig (aux loss dropped — inference), dense otherwise. At decode
+    (T=1) a single token can only occupy slot 0 of each chosen expert, so
+    routing never overflows regardless of capacity_factor."""
+    if isinstance(config, MoeConfig):
+        x, _aux = _moe_block(x, layer, config, mesh=None)
+        return x
+    return _mlp_block(x, layer, config)
 
 
 @dataclasses.dataclass
@@ -106,7 +118,7 @@ def _forward_with_cache(
         )
         o = _cached_attention(q, k_cache, v_cache, new_len, scale)
         x = attn_out(x, o, layer)
-        x = _mlp_block(x, layer, c)
+        x = _mlp_or_moe(x, layer, c)
         return x, (k_cache, v_cache)
 
     x, (new_k, new_v) = jax.lax.scan(

@@ -73,6 +73,60 @@ class TestPrefillDecode:
         assert int(cache.length) == 0
 
 
+class TestMoeDecode:
+    """The KV-cache path serves the sparse family too: cached inference
+    must match the MoE full forward (routing recomputed per position)."""
+
+    def _setup(self):
+        import dataclasses
+
+        from k8s_dra_driver_tpu.models.moe import MOE_PRESETS
+        from k8s_dra_driver_tpu.models.moe import init_params as moe_init
+
+        # Ample capacity: capacity drops depend on which OTHER tokens
+        # compete for an expert, so token-by-token decode only equals the
+        # full forward when nothing overflows (drop-free is also the
+        # serving-time convention).
+        cfg = dataclasses.replace(
+            MOE_PRESETS["tiny-moe"], capacity_factor=8.0
+        )
+        params = moe_init(cfg, jax.random.PRNGKey(0))
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(1), (2, 12), 0, cfg.vocab_size
+        )
+        return cfg, params, prompt
+
+    def test_prefill_matches_forward(self):
+        from k8s_dra_driver_tpu.models.moe import forward as moe_forward
+
+        cfg, params, prompt = self._setup()
+        full, _aux = moe_forward(params, prompt, cfg)
+        last, cache = prefill(params, prompt, cfg, max_len=32)
+        np.testing.assert_allclose(last, full[:, -1], atol=1e-4, rtol=1e-4)
+
+    def test_decode_matches_forward_incrementally(self):
+        from k8s_dra_driver_tpu.models.moe import forward as moe_forward
+
+        cfg, params, prompt = self._setup()
+        last, cache = prefill(params, prompt, cfg, max_len=32)
+        seq = prompt
+        for _ in range(3):
+            tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            seq = jnp.concatenate([seq, tok[:, None]], axis=1)
+            full, _aux = moe_forward(params, seq, cfg)
+            last, cache = decode_step(params, tok, cache, cfg)
+            np.testing.assert_allclose(
+                last, full[:, -1], atol=2e-4, rtol=2e-4
+            )
+
+    def test_generate_jits(self):
+        cfg, params, prompt = self._setup()
+        out = jax.jit(
+            lambda p: generate(params, p, cfg, max_new_tokens=4)
+        )(prompt)
+        assert out.shape == (2, 16)
+
+
 class TestOrbaxCheckpoint:
     def test_save_restore_roundtrip(self, tmp_path):
         from k8s_dra_driver_tpu.models.checkpoint import (
