@@ -323,3 +323,54 @@ def tensorcore_visibility_env(cores: list[TensorCoreInfo]) -> dict[str, str]:
     if not cores:
         return {}
     return claim_visibility_env([], cores)
+
+
+# Default base for per-channel coordinator ports. jax.distributed's
+# conventional port is 8476; offsetting by the channel number gives every
+# claimed channel on a slice a disjoint rendezvous, the way IMEX channel
+# ids partition the cross-node memory domain (imex.go:43-45).
+COORDINATOR_BASE_PORT = 8476
+
+
+def ici_channel_launch_env(
+    hostnames: list[str], channel: int, host_id: Optional[int] = None
+) -> dict[str, str]:
+    """Cross-host launch env for an ICI-channel claim.
+
+    The IciChannelInfo contract (tpulib/deviceinfo.py): preparing a channel
+    materialises the common launch environment that makes jax.distributed
+    over ICI/DCN work — the consumer is parallel.distributed.
+    initialize_distributed, which reads exactly these variables. Worker 0
+    hosts the coordinator; the port is derived from the claimed channel so
+    concurrent jobs on one slice rendezvous on disjoint ports.
+
+    Empty when the chip library has no hostname ground truth — preparation
+    must not invent addresses.
+    """
+    if not hostnames:
+        return {}
+    raw = os.environ.get("TPU_DRA_COORDINATOR_BASE_PORT",
+                         str(COORDINATOR_BASE_PORT))
+    try:
+        base = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"invalid TPU_DRA_COORDINATOR_BASE_PORT {raw!r}: must be an "
+            f"integer port number"
+        ) from None
+    port = base + channel
+    if not 1 <= port <= 65535:
+        raise ValueError(
+            f"coordinator port {port} (base {base} + channel {channel}) "
+            f"outside 1-65535"
+        )
+    env = {
+        "TPU_WORKER_HOSTNAMES": ",".join(hostnames),
+        "TPU_DRA_COORDINATOR": f"{hostnames[0]}:{port}",
+    }
+    # Channel-only claims carry no chips, so chip_visibility_env never runs
+    # for them; the process id still has to reach initialize_distributed or
+    # every gang member would boot as process 0.
+    if host_id is not None:
+        env["TPU_WORKER_ID"] = str(host_id)
+    return env

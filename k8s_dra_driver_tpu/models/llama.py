@@ -89,6 +89,12 @@ PRESETS: dict[str, LlamaConfig] = {
         mlp_hidden=8192, max_seq_len=8192,
     ),
     "8b": LlamaConfig(),  # Llama-3-8B
+    # Llama-3-8B PER-LAYER geometry (hidden 4096, 32q/8kv heads -> d=128,
+    # ffn 14336) at a depth/vocab that fits one 16G chip: the BASELINE.md
+    # target is 8B MFU, and MFU is set by per-layer shapes, not depth.
+    "8b-L8": LlamaConfig(
+        vocab_size=32000, n_layers=8, max_seq_len=8192,
+    ),
     "70b": LlamaConfig(
         vocab_size=128256, hidden=8192, n_layers=80, n_heads=64, n_kv_heads=8,
         mlp_hidden=28672, max_seq_len=8192,

@@ -61,6 +61,14 @@ def initialize_distributed(
     if coordinator is None or num_processes <= 1:
         logger.info("single-host job; skipping jax.distributed")
         return False
+    # Multi-process on the CPU backend needs a collectives transport; gloo
+    # is the in-tree one. Harmless on TPU (only make_cpu_client reads it);
+    # guarded because the option is version-dependent.
+    try:
+        if not jax.config.jax_cpu_collectives_implementation:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # pragma: no cover - older jax without the option
+        pass
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_processes,

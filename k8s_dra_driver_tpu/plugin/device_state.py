@@ -24,7 +24,12 @@ from ..api.v1alpha1 import (
     TpuChipConfig,
     decode_config,
 )
-from ..cdi.spec import CDIHandler, ContainerEdits, claim_visibility_env
+from ..cdi.spec import (
+    CDIHandler,
+    ContainerEdits,
+    claim_visibility_env,
+    ici_channel_launch_env,
+)
 from ..tpulib.chiplib import SHARING_EXCLUSIVE, ChipLib
 from ..tpulib.deviceinfo import (
     AllocatableDevice,
@@ -251,6 +256,25 @@ class DeviceState:
                 [d.chip for d in all_devices if d.chip is not None],
                 [d.tensorcore for d in all_devices if d.tensorcore is not None],
             )
+            # Cross-host launch env (IciChannelInfo contract): ONE rendezvous
+            # per claim, named by the lowest claimed channel across ALL
+            # config groups, so gang members never dial different ports.
+            channels = [
+                d.ici_channel.channel for d in all_devices
+                if d.ici_channel is not None
+            ]
+            if channels:
+                host_id = next(
+                    (d.chip.host_id for d in self.allocatable.values()
+                     if d.chip is not None),
+                    None,
+                )
+                common_env.update(
+                    ici_channel_launch_env(
+                        self.chiplib.worker_hostnames(), min(channels),
+                        host_id,
+                    )
+                )
             self.cdi.create_claim_spec_file(claim_uid, claim_device_edits, common_env)
         except BaseException:
             # Roll back acquisitions from already-applied groups; otherwise a

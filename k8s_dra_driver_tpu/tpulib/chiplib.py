@@ -176,6 +176,18 @@ class ChipLib(abc.ABC):
             for i in range(ICI_CHANNEL_COUNT)
         ]
 
+    def worker_hostnames(self) -> list[str]:
+        """Hostnames of all workers in this host's slice, worker-id order.
+
+        Ground truth for the cross-host launch env an ICI-channel prepare
+        injects (cdi.spec.ici_channel_launch_env): worker 0 hosts the
+        jax.distributed coordinator. Empty when the platform metadata does
+        not carry hostnames (single-host, or bare-metal without the GKE
+        TPU env) — preparation then omits the coordinator env and the
+        workload falls back to its own bootstrap.
+        """
+        return []
+
     # --- side-effecting operations used at Prepare time -------------------
 
     @abc.abstractmethod
@@ -205,11 +217,13 @@ class FakeChipLib(ChipLib):
         hosts_per_slice: int = 1,
         slice_id: str = "",
         chips_per_host: Optional[int] = None,
+        hostnames: Optional[list[str]] = None,
     ):
         self.generation = generation
         self.topology = MeshShape.parse(topology)
         self.host_id = host_id
         self.hosts_per_slice = hosts_per_slice
+        self.hostnames = list(hostnames) if hostnames else []
         self.slice_id = slice_id or f"{generation}-{self.topology}-fake"
         self.chips_per_host = (
             chips_per_host
@@ -290,6 +304,9 @@ class FakeChipLib(ChipLib):
         self.created_channels.append(channel)
         return f"/dev/tpu-ici-channels/channel{channel}"
 
+    def worker_hostnames(self) -> list[str]:
+        return list(self.hostnames)
+
 
 # ---------------------------------------------------------------------------
 # Real backend: /dev/accel* + sysfs probing (C++ shim with Python fallback)
@@ -364,11 +381,11 @@ class RealChipLib(ChipLib):
         host_id = self.config.host_id or _safe_int(
             self._env("TPU_WORKER_ID", "0"), 0
         )
-        hostnames = self._env("TPU_WORKER_HOSTNAMES", "")
+        hostnames = self.worker_hostnames()
         hosts = (
             self.config.hosts_per_slice
             if self.config.hosts_per_slice > 1
-            else (len(hostnames.split(",")) if hostnames else 1)
+            else (len(hostnames) if hostnames else 1)
         )
         if topo_s:
             topology = MeshShape.parse(topo_s)
@@ -711,6 +728,12 @@ class RealChipLib(ChipLib):
             os.mknod(path, 0o666 | stat.S_IFCHR, os.makedev(major, channel))
             os.chmod(path, 0o666)
         return path
+
+    def worker_hostnames(self) -> list[str]:
+        """Slice worker hostnames from the platform env (GKE TPU node pools
+        export TPU_WORKER_HOSTNAMES in worker-id order)."""
+        raw = self._env("TPU_WORKER_HOSTNAMES", "")
+        return [h.strip() for h in raw.split(",") if h.strip()]
 
     def _ici_major(self) -> int:
         """Device major for ICI channel nodes from /proc/devices

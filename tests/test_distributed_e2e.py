@@ -1,0 +1,206 @@
+"""Two-process jax.distributed bootstrap from driver-injected env.
+
+The full multi-host story, executed for real: two node plugins (one per
+fake host) prepare a gang claim with an ICI channel; each prepare injects
+the cross-host launch env (coordinator address, worker hostnames, worker
+id) into the claim's CDI spec; two REAL subprocesses consume exactly that
+env via ``initialize_distributed()``, form one global two-process JAX
+platform over the gloo CPU transport, and run a cross-process collective.
+
+This is the proof the reference never had for its IMEX path (SURVEY.md §4:
+manual GPU demos only): the driver's output contract — "a pod lands with
+the right env and neighbors" — drives an actual jax.distributed cluster.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+
+from k8s_dra_driver_tpu.cdi import CDIHandler
+from k8s_dra_driver_tpu.plugin.checkpoint import CheckpointManager
+from k8s_dra_driver_tpu.plugin.device_state import DeviceState
+from k8s_dra_driver_tpu.tpulib import FakeChipLib
+
+DRIVER = "tpu.google.com"
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER_SRC = """
+import jax
+
+# A DRA-scheduled pod on TPU hardware skips both updates; this simulated
+# pod pins the hermetic CPU platform the way tests/conftest.py does.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 1)
+
+from k8s_dra_driver_tpu.parallel.distributed import initialize_distributed
+
+assert initialize_distributed(), "driver env did not trigger distributed init"
+
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+mesh = Mesh(jax.devices(), ("data",))
+pid = jax.process_index()
+local = jnp.full((4,), float(pid + 1))
+arr = jax.make_array_from_single_device_arrays(
+    (8,),
+    NamedSharding(mesh, P("data")),
+    [jax.device_put(local, jax.local_devices()[0])],
+)
+total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(arr)
+print("RESULT", jax.process_count(), float(total.addressable_data(0)),
+      flush=True)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _make_claim(uid: str, devices: list[str]) -> dict:
+    results = [
+        {"request": "req-0", "driver": DRIVER, "pool": "node", "device": d}
+        for d in devices
+    ]
+    return {
+        "metadata": {"name": "gang", "namespace": "default", "uid": uid},
+        "status": {
+            "allocation": {
+                "devices": {
+                    "results": results,
+                    "config": [
+                        {
+                            "source": "FromClaim",
+                            "requests": [],
+                            "opaque": {
+                                "driver": DRIVER,
+                                "parameters": {
+                                    "apiVersion": "tpu.google.com/v1alpha1",
+                                    "kind": "IciChannelConfig",
+                                },
+                            },
+                        }
+                    ],
+                }
+            }
+        },
+    }
+
+
+def _prepare_host_env(
+    tmp_path, host_id: int, hostnames: list[str], devices=None
+) -> dict:
+    """Run one node plugin's prepare and return the claim-spec env."""
+    lib = FakeChipLib(
+        generation="v5e",
+        topology="2x2x1",
+        host_id=host_id,
+        hosts_per_slice=2,
+        chips_per_host=2,
+        hostnames=hostnames,
+        slice_id="v5e-2x2x1-gang",
+    )
+    host_dir = tmp_path / f"host{host_id}"
+    state = DeviceState(
+        chiplib=lib,
+        cdi=CDIHandler(str(host_dir / "cdi")),
+        checkpoint=CheckpointManager(str(host_dir / "checkpoint.json")),
+        driver_name=DRIVER,
+        pool_name="node",
+        state_dir=str(host_dir / "state"),
+    )
+    uid = f"uid-gang-{host_id}"
+    state.prepare(
+        _make_claim(uid, devices or ["tpu-0", "tpu-1", "ici-channel-3"])
+    )
+    spec = json.loads(
+        (host_dir / "cdi" / f"k8s.tpu.google.com-claim_{uid}.json").read_text()
+    )
+    env: dict[str, str] = {}
+    edit_sets = [dev.get("containerEdits", {}) for dev in spec["devices"]]
+    edit_sets.append(spec.get("containerEdits", {}))  # claim-common env
+    for edits in edit_sets:
+        for kv in edits.get("env", []) or []:
+            k, _, v = kv.partition("=")
+            env[k] = v
+    return env
+
+
+class TestLaunchEnvInjection:
+    def test_channel_prepare_injects_coordinator(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPU_DRA_COORDINATOR_BASE_PORT", "9000")
+        env = _prepare_host_env(tmp_path, 0, ["w0.slice", "w1.slice"])
+        assert env["TPU_WORKER_HOSTNAMES"] == "w0.slice,w1.slice"
+        # Port = base + channel, so concurrent jobs on one slice get
+        # disjoint rendezvous.
+        assert env["TPU_DRA_COORDINATOR"] == "w0.slice:9003"
+        assert env["TPU_WORKER_ID"] == "0"
+
+    def test_no_hostnames_no_invented_coordinator(self, tmp_path):
+        env = _prepare_host_env(tmp_path, 0, [])
+        assert "TPU_DRA_COORDINATOR" not in env
+        assert "TPU_WORKER_HOSTNAMES" not in env
+
+    def test_channel_only_claim_still_carries_worker_id(self, tmp_path):
+        """A gang claim of just the channel (no chips) must still tell each
+        pod WHICH process it is, or every member boots as process 0."""
+        env = _prepare_host_env(
+            tmp_path, 1, ["w0.slice", "w1.slice"],
+            devices=["ici-channel-3"],
+        )
+        assert env["TPU_WORKER_ID"] == "1"
+        assert env["TPU_DRA_COORDINATOR"].startswith("w0.slice:")
+
+
+class TestTwoProcessBootstrap:
+    def test_gang_claim_forms_jax_cluster(self, tmp_path, monkeypatch):
+        port = _free_port()
+        # ici-channel-3 is claimed below: pick the base so base+3 == port.
+        monkeypatch.setenv("TPU_DRA_COORDINATOR_BASE_PORT", str(port - 3))
+        hostnames = ["127.0.0.1", "127.0.0.1"]
+
+        worker_py = tmp_path / "worker.py"
+        worker_py.write_text(WORKER_SRC)
+
+        procs = []
+        for host_id in (0, 1):
+            claim_env = _prepare_host_env(tmp_path, host_id, hostnames)
+            env = dict(os.environ)
+            # The claim spec's env IS the pod env (CDI merge).
+            env.update(claim_env)
+            env["PYTHONPATH"] = REPO_ROOT
+            # The harness may preset a hardware platform / virtual-device
+            # flags; the worker pins its own hermetic platform.
+            env.pop("JAX_PLATFORMS", None)
+            env.pop("XLA_FLAGS", None)
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, str(worker_py)],
+                    env=env,
+                    cwd=REPO_ROOT,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                    text=True,
+                )
+            )
+
+        outs = []
+        try:
+            for p in procs:
+                out, err = p.communicate(timeout=150)
+                outs.append((p.returncode, out, err))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+
+        for rc, out, err in outs:
+            assert rc == 0, f"worker failed:\n{out}\n{err}"
+            # Two processes, one device each; sum over the global array is
+            # 4*1 (worker 0's shard) + 4*2 (worker 1's) = 12.
+            assert "RESULT 2 12.0" in out, f"unexpected output:\n{out}\n{err}"

@@ -182,6 +182,70 @@ class TestPrepareOverGrpc:
             )
         assert "UID mismatch" in resp.claims["uid-old"].error
 
+    def test_channel_claim_injects_launch_env(self, tmp_path, monkeypatch):
+        """A channel claim prepared over the REAL RPC path lands the
+        cross-host launch env in the claim CDI spec (IciChannelInfo
+        contract; consumed by parallel.distributed in the pod)."""
+        import json
+
+        monkeypatch.setenv("TPU_DRA_COORDINATOR_BASE_PORT", "9100")
+        client = FakeKubeClient()
+        client.create(NODES, {"metadata": {"name": "node-a",
+                                           "uid": "node-uid-1"}})
+        config = DriverConfig(
+            node_name="node-a",
+            chiplib=FakeChipLib(
+                generation="v5p", topology="2x2x1", hosts_per_slice=2,
+                chips_per_host=2,
+                hostnames=["w0.internal", "w1.internal"],
+            ),
+            kube_client=client,
+            cdi_root=str(tmp_path / "cdi"),
+            plugin_root=str(tmp_path / "plugin"),
+            registrar_root=str(tmp_path / "registry"),
+            state_root=str(tmp_path / "state"),
+            node_uid="node-uid-1",
+        )
+        driver = Driver(config)
+        driver.start()
+        try:
+            claim = {
+                "metadata": {"name": "gang", "namespace": "default",
+                             "uid": "uid-ch"},
+                "status": {"allocation": {"devices": {"results": [
+                    {"request": "req-0", "driver": DRIVER, "pool": "node-a",
+                     "device": d}
+                    for d in ["tpu-0", "ici-channel-5"]
+                ], "config": [{
+                    "source": "FromClaim", "requests": ["req-0"],
+                    "opaque": {"driver": DRIVER, "parameters": {
+                        "apiVersion": "tpu.google.com/v1alpha1",
+                        "kind": "IciChannelConfig"}},
+                }]}}},
+            }
+            client.create(RESOURCE_CLAIMS, claim, namespace="default")
+            with grpc.insecure_channel(f"unix://{config.plugin_socket}") as ch:
+                stub = NodeStub(ch)
+                resp = stub.NodePrepareResources(
+                    drapb.NodePrepareResourcesRequest(
+                        claims=[drapb.Claim(uid="uid-ch", name="gang",
+                                            namespace="default")]
+                    )
+                )
+            assert resp.claims["uid-ch"].error == ""
+            spec = json.loads(
+                (tmp_path / "cdi"
+                 / "k8s.tpu.google.com-claim_uid-ch.json").read_text()
+            )
+            env = dict(
+                kv.partition("=")[::2]
+                for kv in spec["containerEdits"]["env"]
+            )
+            assert env["TPU_DRA_COORDINATOR"] == "w0.internal:9105"
+            assert env["TPU_WORKER_HOSTNAMES"] == "w0.internal,w1.internal"
+        finally:
+            driver.shutdown()
+
 
 class TestSlicePublication:
     def test_slices_published_on_start(self, harness):
