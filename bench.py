@@ -44,12 +44,15 @@ def pick_config():
     hbm = spec.hbm_bytes
     # fwd+bwd without optimizer state needs ~5 bytes/param (bf16 p+g, f32
     # masters absent) + activations under remat; stay under half of HBM
-    # with params+grads.
+    # with params+grads. The BASELINE.md metric is Llama-3-**8B** MFU, so
+    # every tier runs the 8B per-layer geometry (d=128 heads, ffn 14336):
+    # on 16G chips at the depth/vocab/batch that fits (MFU is set by the
+    # per-layer shapes, not depth — see models/llama.py "8b-L8").
     if hbm >= 90 << 30:
         return "8b", 8, 2048, spec.peak_bf16_flops
     if hbm >= 30 << 30:
-        return "3b", 8, 2048, spec.peak_bf16_flops
-    return "1b", 8, 2048, spec.peak_bf16_flops
+        return "8b-L8", 8, 2048, spec.peak_bf16_flops
+    return "8b-L8", 4, 2048, spec.peak_bf16_flops
 
 
 def run_bench(preset, batch, seq, peak_flops, remat_policy="flash_qkv",
