@@ -13,16 +13,24 @@
 //   tpud_chip_meta(sysfs_root, index, buf, buflen)  -> "key=value\n" blob
 //   tpud_mknod_char(path, major, minor, mode)       -> 0 or -errno
 //   tpud_read_file(path, buf, buflen)               -> bytes read or -errno
+//   tpud_vfio_groups(dev_root, sysfs_root, buf, buflen)
+//                                                   -> "group=N pci=ADDR\n" blob
+//   tpud_watch_devdir(dev_root, timeout_ms)         -> 1 event, 0 timeout,
+//                                                      -errno error
 
 #include <cerrno>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <dirent.h>
+#include <poll.h>
 #include <string>
+#include <sys/inotify.h>
 #include <sys/stat.h>
 #include <sys/sysmacros.h>
 #include <sys/types.h>
 #include <unistd.h>
+#include <vector>
 
 extern "C" {
 
@@ -97,6 +105,80 @@ int tpud_read_file(const char *path, char *buf, int buflen) {
   if ((int)out.size() >= buflen) return -ERANGE;
   ::memcpy(buf, out.c_str(), out.size() + 1);
   return (int)out.size();
+}
+
+// Resolve every /dev/vfio/<N> group to the PCI address of its bound device
+// via /sys/kernel/iommu_groups/<N>/devices (the identity a bare group
+// number lacks; consumed by RealChipLib._vfio_pci_address).  One line per
+// group: "group=N pci=0000:aa:00.0" — pci empty if sysfs is stripped.
+int tpud_vfio_groups(const char *dev_root, const char *sysfs_root, char *buf,
+                     int buflen) {
+  std::string vdir = std::string(dev_root ? dev_root : "/") + "/dev/vfio";
+  DIR *d = ::opendir(vdir.c_str());
+  if (!d) return -errno;
+  std::string out;
+  struct dirent *e;
+  while ((e = ::readdir(d)) != nullptr) {
+    char *end = nullptr;
+    long group = ::strtol(e->d_name, &end, 10);
+    if (end == e->d_name || *end != '\0') continue;  // "vfio" ctrl node etc.
+    std::string gdir = std::string(sysfs_root ? sysfs_root : "/sys") +
+                       "/kernel/iommu_groups/" + e->d_name + "/devices";
+    std::string pci;
+    DIR *g = ::opendir(gdir.c_str());
+    if (g) {
+      struct dirent *ge;
+      while ((ge = ::readdir(g)) != nullptr) {
+        if (ge->d_name[0] == '.') continue;
+        pci = ge->d_name;  // first (only) device in a TPU group
+        break;
+      }
+      ::closedir(g);
+    }
+    out += "group=" + std::to_string(group) + " pci=" + pci + "\n";
+  }
+  ::closedir(d);
+  if ((int)out.size() >= buflen) return -ERANGE;
+  ::memcpy(buf, out.c_str(), out.size() + 1);
+  return (int)out.size();
+}
+
+// Block until a device node appears/disappears under {dev_root}/dev or
+// {dev_root}/dev/vfio (chip hot-plug, vfio rebind, ICI channel churn), or
+// the timeout lapses.  The driver's republish loop sleeps here instead of
+// polling sysfs.  Returns 1 on a relevant event, 0 on timeout, -errno.
+int tpud_watch_devdir(const char *dev_root, int timeout_ms) {
+  int fd = ::inotify_init1(IN_NONBLOCK | IN_CLOEXEC);
+  if (fd < 0) return -errno;
+  std::string base = std::string(dev_root ? dev_root : "/") + "/dev";
+  const unsigned mask = IN_CREATE | IN_DELETE | IN_ATTRIB | IN_MOVED_TO;
+  int nwatch = 0;
+  if (::inotify_add_watch(fd, base.c_str(), mask) >= 0) nwatch++;
+  std::string vfio = base + "/vfio";
+  if (::inotify_add_watch(fd, vfio.c_str(), mask) >= 0) nwatch++;
+  if (nwatch == 0) {
+    int err = errno;
+    ::close(fd);
+    return -err;
+  }
+  struct pollfd pfd = {fd, POLLIN, 0};
+  int rc = ::poll(&pfd, 1, timeout_ms);
+  if (rc < 0) {
+    int err = errno;
+    ::close(fd);
+    return -err;
+  }
+  int got = 0;
+  if (rc > 0) {
+    // Drain; any event under the watched dirs counts (the Python side
+    // re-enumerates and diffs, so false positives are only a cheap scan).
+    char evbuf[4096];
+    while (::read(fd, evbuf, sizeof(evbuf)) > 0) {
+    }
+    got = 1;
+  }
+  ::close(fd);
+  return got;
 }
 
 }  // extern "C"

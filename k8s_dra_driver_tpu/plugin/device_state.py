@@ -422,6 +422,47 @@ class DeviceState:
     # Publication
     # ------------------------------------------------------------------
 
+    def refresh_allocatable(self) -> bool:
+        """Re-enumerate the chip inventory; True when it changed.
+
+        The consumer is the driver's device-watch loop: chip hot-plug /
+        vfio rebind must reach the published ResourceSlices, a path the
+        reference lacks entirely (NVML enumeration happens once at
+        startup, nvlib.go:111-136). Prepared claims are unaffected — they
+        carry their own device snapshots through the checkpoint.
+        """
+        fresh = self.chiplib.enumerate_all_possible_devices(
+            self.device_classes
+        )
+        with self._lock:
+            changed = (
+                {n: d.get_device() for n, d in fresh.items()}
+                != {n: d.get_device() for n, d in self.allocatable.items()}
+            )
+            if changed:
+                # The base CDI spec must keep entries that prepared claims'
+                # recorded cdi_device_ids still point at (a mid-rebind
+                # enumeration must not break a container about to start);
+                # the allocatable map and published slices track the fresh
+                # truth only, so a vanished chip cannot be newly prepared.
+                spec_devices = dict(fresh)
+                for name in self._prepared_device_names():
+                    if name not in spec_devices and name in self.allocatable:
+                        spec_devices[name] = self.allocatable[name]
+                self.allocatable = fresh
+                self.cdi.create_standard_device_spec_file(spec_devices)
+        return changed
+
+    def _prepared_device_names(self) -> set:
+        """Device names referenced by any checkpointed prepared claim."""
+        names = set()
+        for rec in self.checkpoint.read().values():
+            for group in rec.get("groups", []):
+                for dev in group.get("devices", []):
+                    if dev.get("name"):
+                        names.add(dev["name"])
+        return names
+
     def published_resources(self) -> dict[str, Any]:
         """DriverResources (pool spec) for the ResourceSlice controller —
         node-local devices only, ICI channels are published by the cluster

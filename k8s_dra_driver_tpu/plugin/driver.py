@@ -48,6 +48,10 @@ class DriverConfig:
     device_classes: frozenset = frozenset({"chip", "tensorcore", "ici"})
     node_uid: str = ""
     cleanup_interval_seconds: float = 600.0  # 0 disables the orphan cleaner
+    # Device-inventory watch: re-enumerate (woken early by the chip
+    # library's inotify, where available) and republish on change. 0
+    # disables; the reference enumerates once at startup only.
+    device_watch_interval_seconds: float = 30.0
 
     @property
     def plugin_socket(self) -> str:
@@ -79,6 +83,11 @@ class Driver(NodeServicer):
         )
         self._m_prepare_latency = Histogram(
             "tpu_dra_claim_prepare_seconds", "Prepare latency", self.registry
+        )
+        self._m_inventory_refreshes = Counter(
+            "tpu_dra_inventory_refreshes_total",
+            "Device inventory changes republished",
+            self.registry,
         )
         self.state = DeviceState(
             chiplib=config.chiplib,
@@ -118,12 +127,64 @@ class Driver(NodeServicer):
         )
         if self.config.cleanup_interval_seconds > 0:
             self.cleaner.start()
+        self._watch_stop = threading.Event()
+        self._watch_thread: Optional[threading.Thread] = None
+        if self.config.device_watch_interval_seconds > 0:
+            self._watch_thread = threading.Thread(
+                target=self._device_watch_loop,
+                name="device-watch",
+                daemon=True,
+            )
+            self._watch_thread.start()
 
     def shutdown(self) -> None:
+        if getattr(self, "_watch_thread", None) is not None:
+            self._watch_stop.set()
+            # Wake an event-based waiter (FakeChipLib) so teardown is
+            # immediate; a native inotify wait is not interruptible, so the
+            # daemon thread just gets a short bounded join and dies with
+            # the process. The loop re-checks _watch_stop before touching
+            # state, so a late wake does nothing.
+            waker = getattr(self.state.chiplib, "device_event", None)
+            if waker is not None:
+                waker.set()
+            self._watch_thread.join(timeout=1.0)
         if getattr(self, "cleaner", None) is not None:
             self.cleaner.stop()
         self.plugin.stop()
         self.state.chiplib.shutdown()
+
+    def _device_watch_loop(self) -> None:
+        """Keep the published inventory true to the hardware: wake on a
+        device event (or every interval as a resync), re-enumerate, and
+        republish when the chip set changed. The reference has no analog —
+        its slices go stale on any post-start device change."""
+        interval = self.config.device_watch_interval_seconds
+        while not self._watch_stop.is_set():
+            try:
+                woke = self.state.chiplib.wait_device_event(interval)
+                # Debounce: a vfio rebind is a delete-then-create burst and
+                # the first event fires at the worst instant. Absorb events
+                # until the device tree has been quiet for a beat, so the
+                # loop only ever enumerates settled states.
+                while woke and not self._watch_stop.is_set():
+                    woke = self.state.chiplib.wait_device_event(
+                        min(0.2, interval)
+                    )
+            except Exception:
+                logger.exception("device watch failed; falling back to pacing")
+                if self._watch_stop.wait(interval):
+                    break
+            if self._watch_stop.is_set():
+                break
+            try:
+                if self.state.refresh_allocatable():
+                    self._m_inventory_refreshes.inc()
+                    logger.info("device inventory changed; republishing")
+                    if self.config.kube_client is not None:
+                        self.publish_resources()
+            except Exception:
+                logger.exception("device inventory refresh failed")
 
     def publish_resources(self) -> None:
         """Publish node-local devices (driver.go:69-80 analog; ICI channels

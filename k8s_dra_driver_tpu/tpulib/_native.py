@@ -46,6 +46,15 @@ class NativeShim:
                 ctypes.c_int,
             ]
             lib.tpud_read_file.restype = ctypes.c_int
+            lib.tpud_vfio_groups.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_char_p,
+                ctypes.c_char_p,
+                ctypes.c_int,
+            ]
+            lib.tpud_vfio_groups.restype = ctypes.c_int
+            lib.tpud_watch_devdir.argtypes = [ctypes.c_char_p, ctypes.c_int]
+            lib.tpud_watch_devdir.restype = ctypes.c_int
 
     def count_accel(self, dev_root: str) -> int:
         return self._lib.tpud_count_accel(dev_root.encode())
@@ -73,6 +82,31 @@ class NativeShim:
         if n < 0:
             raise OSError(-n, os.strerror(-n), path)
         return buf.value.decode()
+
+    def vfio_groups(self, dev_root: str, sysfs_root: str) -> dict[int, str]:
+        """{group number: pci address} for every /dev/vfio group node."""
+        buf = ctypes.create_string_buffer(65536)
+        n = self._lib.tpud_vfio_groups(
+            dev_root.encode(), sysfs_root.encode(), buf, len(buf)
+        )
+        if n < 0:
+            return {}
+        groups: dict[int, str] = {}
+        for line in buf.value.decode().splitlines():
+            fields = dict(
+                f.split("=", 1) for f in line.split(" ") if "=" in f
+            )
+            if "group" in fields:
+                groups[int(fields["group"])] = fields.get("pci", "")
+        return groups
+
+    def watch_devdir(self, dev_root: str, timeout_ms: int) -> bool:
+        """Block until a device node changes under {dev_root}/dev (inotify);
+        False on timeout. Raises when the directory cannot be watched."""
+        rc = self._lib.tpud_watch_devdir(dev_root.encode(), timeout_ms)
+        if rc < 0:
+            raise OSError(-rc, os.strerror(-rc), dev_root)
+        return rc > 0
 
 
 def _build() -> bool:

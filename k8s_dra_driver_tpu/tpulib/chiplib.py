@@ -23,6 +23,8 @@ import logging
 import os
 import re
 import stat
+import threading
+import time
 from typing import Optional
 
 from .deviceinfo import (
@@ -188,6 +190,16 @@ class ChipLib(abc.ABC):
         """
         return []
 
+    def wait_device_event(self, timeout_s: float) -> bool:
+        """Block until the device inventory MAY have changed (chip
+        hot-plug, vfio rebind), or the timeout lapses; returns True when an
+        event woke the wait. The driver's republish loop sleeps here; a
+        False return still triggers a periodic re-enumeration, so backends
+        without an event source (this default) just pace the resync.
+        """
+        time.sleep(timeout_s)
+        return False
+
     # --- side-effecting operations used at Prepare time -------------------
 
     @abc.abstractmethod
@@ -234,6 +246,9 @@ class FakeChipLib(ChipLib):
         # Side-effect journals for test assertions.
         self.sharing_modes: dict[str, str] = {}
         self.created_channels: list[int] = []
+        # Tests set() this to wake a driver watch loop immediately (the
+        # fake's stand-in for an inotify device event).
+        self.device_event = threading.Event()
 
     def init(self) -> None:
         self.initialized = True
@@ -306,6 +321,12 @@ class FakeChipLib(ChipLib):
 
     def worker_hostnames(self) -> list[str]:
         return list(self.hostnames)
+
+    def wait_device_event(self, timeout_s: float) -> bool:
+        if self.device_event.wait(timeout_s):
+            self.device_event.clear()
+            return True
+        return False
 
 
 # ---------------------------------------------------------------------------
@@ -520,12 +541,23 @@ class RealChipLib(ChipLib):
         /sys/kernel/iommu_groups/<g>/devices). Chip indices then come from
         ``TPU_VISIBLE_CHIPS`` when the runtime published it, else from the
         PCI-ordered position."""
+        # One native call resolves every group's PCI identity (the batch
+        # enumeration role go-nvml's VisitDevices plays); the per-group
+        # Python walk remains the fallback.
+        native_groups: dict[int, str] = {}
+        if self._native is not None and self._native.available:
+            native_groups = self._native.vfio_groups(
+                self.config.dev_root, self.config.sysfs_root
+            )
         entries = []  # (sort key, group path)
         for path in glob.glob(
             _hostpath(self.config.dev_root, "dev/vfio/[0-9]*")
         ):
             group = os.path.basename(path)
-            pci = self._vfio_pci_address(group)
+            pci = (
+                native_groups.get(_safe_int(group, -1))
+                or self._vfio_pci_address(group)
+            )
             # PCI addresses sort correctly as strings within one domain;
             # fall back to the numeric group id when sysfs is stripped.
             entries.append(((pci or "~", int(group)), path))
@@ -734,6 +766,21 @@ class RealChipLib(ChipLib):
         export TPU_WORKER_HOSTNAMES in worker-id order)."""
         raw = self._env("TPU_WORKER_HOSTNAMES", "")
         return [h.strip() for h in raw.split(",") if h.strip()]
+
+    def wait_device_event(self, timeout_s: float) -> bool:
+        """inotify on {dev_root}/dev (+ /dev/vfio) via the native shim —
+        wakes the driver's republish loop the moment a chip node appears
+        or disappears. Falls back to plain pacing (periodic resync still
+        re-enumerates) when the shim or the watch is unavailable."""
+        if self._native is not None and self._native.available:
+            try:
+                return self._native.watch_devdir(
+                    self.config.dev_root, int(timeout_s * 1000)
+                )
+            except OSError as e:
+                logger.debug("device watch unavailable: %s", e)
+        time.sleep(timeout_s)
+        return False
 
     def _ici_major(self) -> int:
         """Device major for ICI channel nodes from /proc/devices

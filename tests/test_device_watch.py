@@ -1,0 +1,116 @@
+"""Device-inventory watch → republish loop.
+
+The reference enumerates NVML devices once at startup (nvlib.go:111-136);
+any later hot-plug / vfio rebind leaves its ResourceSlices stale. Here the
+driver re-enumerates on device events (native inotify on real hosts, an
+Event on the fake) and republishes when the chip set changed.
+"""
+
+import time
+
+from k8s_dra_driver_tpu.kube import NODES, RESOURCE_SLICES, FakeKubeClient
+from k8s_dra_driver_tpu.plugin.driver import Driver, DriverConfig
+from k8s_dra_driver_tpu.tpulib import FakeChipLib
+
+
+def make_driver(tmp_path, lib, interval=0.1):
+    client = FakeKubeClient()
+    client.create(NODES, {"metadata": {"name": "node-a", "uid": "nu-1"}})
+    config = DriverConfig(
+        node_name="node-a",
+        chiplib=lib,
+        kube_client=client,
+        cdi_root=str(tmp_path / "cdi"),
+        plugin_root=str(tmp_path / "plugin"),
+        registrar_root=str(tmp_path / "registry"),
+        state_root=str(tmp_path / "state"),
+        node_uid="nu-1",
+        device_watch_interval_seconds=interval,
+    )
+    return Driver(config), client
+
+
+def slice_device_names(client):
+    names = []
+    for s in client.list(RESOURCE_SLICES):
+        for d in (s.get("spec", {}).get("devices") or []):
+            names.append(d["name"])
+    return sorted(names)
+
+
+class TestRefreshAllocatable:
+    def test_no_change_no_refresh(self, tmp_path):
+        lib = FakeChipLib(generation="v5e", topology="2x2x1")
+        driver, _ = make_driver(tmp_path, lib, interval=0)
+        assert driver.state.refresh_allocatable() is False
+
+    def test_chip_change_detected(self, tmp_path):
+        lib = FakeChipLib(generation="v5e", topology="2x2x1")
+        driver, _ = make_driver(tmp_path, lib, interval=0)
+        before = len(driver.state.allocatable)
+        lib.chips_per_host = 2  # two chips "unbound" from the host
+        assert driver.state.refresh_allocatable() is True
+        assert len(driver.state.allocatable) < before
+
+    def test_prepared_claim_keeps_cdi_entry_across_refresh(self, tmp_path):
+        """A mid-rebind refresh must not break the CDI id a prepared claim
+        recorded: the base spec retains prepared-referenced devices even
+        while they are transiently absent from the inventory."""
+        import json
+
+        lib = FakeChipLib(generation="v5e", topology="2x2x1")
+        driver, _ = make_driver(tmp_path, lib, interval=0)
+        claim = {
+            "metadata": {"name": "c", "namespace": "default", "uid": "uid-k"},
+            "status": {"allocation": {"devices": {"results": [
+                {"request": "r", "driver": "tpu.google.com", "pool": "node-a",
+                 "device": "tpu-3"}
+            ], "config": []}}},
+        }
+        driver.state.prepare(claim)
+        lib.chips_per_host = 2  # tpu-2/tpu-3 vanish mid-rebind
+        assert driver.state.refresh_allocatable() is True
+
+        base = json.loads(
+            (tmp_path / "cdi" / "k8s.tpu.google.com-base.json").read_text()
+        )
+        names = {d["name"] for d in base["devices"]}
+        assert "tpu-3" in names          # prepared claim's entry retained
+        assert "tpu-2" not in names      # unreferenced ghost dropped
+        # The fresh truth governs scheduling surfaces.
+        assert "tpu-3" not in driver.state.allocatable
+        pub = {d["name"] for d in
+               driver.state.published_resources()["devices"]}
+        assert pub == {"tpu-0", "tpu-1"}
+
+
+class TestWatchLoop:
+    def test_hotplug_republishes(self, tmp_path):
+        lib = FakeChipLib(generation="v5e", topology="2x2x1")
+        driver, client = make_driver(tmp_path, lib)
+        driver.start()
+        try:
+            deadline = time.monotonic() + 10
+            while not slice_device_names(client):
+                assert time.monotonic() < deadline, "initial publish missing"
+                time.sleep(0.02)
+            assert len(slice_device_names(client)) == 4  # v5e: chips only
+
+            lib.chips_per_host = 2  # half the chips vanish
+            lib.device_event.set()  # the fake's "inotify" fires
+
+            while len(slice_device_names(client)) != 2:
+                assert time.monotonic() < deadline, (
+                    f"republish never happened: {slice_device_names(client)}"
+                )
+                time.sleep(0.02)
+        finally:
+            driver.shutdown()
+
+    def test_shutdown_is_prompt_and_quiet(self, tmp_path):
+        lib = FakeChipLib(generation="v5e", topology="2x2x1")
+        driver, _ = make_driver(tmp_path, lib, interval=30)  # long wait
+        driver.start()
+        t0 = time.monotonic()
+        driver.shutdown()
+        assert time.monotonic() - t0 < 2, "watch thread stalled shutdown"

@@ -1,0 +1,113 @@
+"""Native discovery shim: direct C-ABI coverage + the hot-plug watch.
+
+The reference's native layer (go-nvml cgo) is exercised only implicitly
+through manual GPU demos; here every exported symbol gets direct tests
+against synthetic /dev and /sys trees, plus the inotify watch that feeds
+the driver's republish loop.
+"""
+
+import os
+import stat
+import threading
+import time
+
+import pytest
+
+from k8s_dra_driver_tpu.tpulib import _native
+
+shim = _native.load()
+
+needs_native = pytest.mark.skipif(
+    not shim.available, reason="native shim unavailable (no g++?)"
+)
+
+
+@needs_native
+class TestNativeShim:
+    def test_count_accel(self, tmp_path):
+        dev = tmp_path / "dev"
+        dev.mkdir()
+        for i in range(3):
+            os.mknod(dev / f"accel{i}", 0o600 | stat.S_IFCHR, os.makedev(510, i))
+        (dev / "accel-not-a-chip-dir").mkdir()  # non-char entries don't count
+        assert shim.count_accel(str(tmp_path)) == 3
+
+    def test_chip_meta_reads_sysfs(self, tmp_path):
+        d = tmp_path / "class" / "accel" / "accel0" / "device"
+        d.mkdir(parents=True)
+        (d / "vendor").write_text("0x1ae0\n")
+        (d / "device").write_text("0x0062\n")
+        (d / "numa_node").write_text("1\n")
+        meta = shim.chip_meta(str(tmp_path), 0)
+        assert meta["vendor"] == "0x1ae0"
+        assert meta["device"] == "0x0062"
+        assert meta["numa_node"] == "1"
+
+    def test_vfio_groups_resolve_pci(self, tmp_path):
+        (tmp_path / "dev" / "vfio").mkdir(parents=True)
+        for g in (7, 12):
+            os.mknod(
+                tmp_path / "dev" / "vfio" / str(g),
+                0o600 | stat.S_IFCHR,
+                os.makedev(511, g),
+            )
+        # The vfio control node must be skipped (not a numeric group).
+        os.mknod(
+            tmp_path / "dev" / "vfio" / "vfio",
+            0o600 | stat.S_IFCHR,
+            os.makedev(10, 196),
+        )
+        sys_root = tmp_path / "sys"
+        for g, pci in ((7, "0000:5e:00.0"), (12, "0000:86:00.0")):
+            d = sys_root / "kernel" / "iommu_groups" / str(g) / "devices"
+            d.mkdir(parents=True)
+            (d / pci).mkdir()
+        groups = shim.vfio_groups(str(tmp_path), str(sys_root))
+        assert groups == {7: "0000:5e:00.0", 12: "0000:86:00.0"}
+
+    def test_vfio_groups_stripped_sysfs(self, tmp_path):
+        (tmp_path / "dev" / "vfio").mkdir(parents=True)
+        os.mknod(
+            tmp_path / "dev" / "vfio" / "3",
+            0o600 | stat.S_IFCHR,
+            os.makedev(511, 3),
+        )
+        groups = shim.vfio_groups(str(tmp_path), str(tmp_path / "nosys"))
+        assert groups == {3: ""}
+
+    def test_watch_devdir_times_out(self, tmp_path):
+        (tmp_path / "dev").mkdir()
+        t0 = time.monotonic()
+        assert shim.watch_devdir(str(tmp_path), 150) is False
+        assert time.monotonic() - t0 >= 0.14
+
+    def test_watch_devdir_sees_new_node(self, tmp_path):
+        (tmp_path / "dev").mkdir()
+
+        def plug():
+            time.sleep(0.15)
+            os.mknod(
+                tmp_path / "dev" / "accel0",
+                0o600 | stat.S_IFCHR,
+                os.makedev(510, 0),
+            )
+
+        th = threading.Thread(target=plug)
+        th.start()
+        try:
+            assert shim.watch_devdir(str(tmp_path), 5000) is True
+        finally:
+            th.join()
+
+    def test_watch_devdir_missing_dir_errors(self, tmp_path):
+        with pytest.raises(OSError):
+            shim.watch_devdir(str(tmp_path / "nope"), 10)
+
+    def test_mknod_and_read_file(self, tmp_path):
+        path = str(tmp_path / "channel7")
+        shim.mknod_char(path, 240, 7, 0o666)
+        st = os.stat(path)
+        assert stat.S_ISCHR(st.st_mode)
+        assert os.major(st.st_rdev) == 240 and os.minor(st.st_rdev) == 7
+        (tmp_path / "f").write_text("hello\n")
+        assert shim.read_file(str(tmp_path / "f")) == "hello"
