@@ -9,12 +9,14 @@ all weights except the embedding table, which is only gathered — so the
 roofline companion is non_embed_params_bytes / HBM_bandwidth.
 Remote compiles are minutes per program — this tool compiles exactly two.
 """
+import os
 import time
 
 import jax
 
 from k8s_dra_driver_tpu.models.decode import generate, prefill
 from k8s_dra_driver_tpu.models.llama import PRESETS, init_params
+from k8s_dra_driver_tpu.models.quant import quantize_params
 
 # The 1b preset's generate program takes >15 min in the remote compiler
 # (while_loop + layer scan + 128k-vocab head in one program); 160m keeps
@@ -24,9 +26,12 @@ PRESET = "160m"
 BATCH = 8
 PROMPT = 128
 N = 96
+QUANT = os.environ.get("TPU_DRA_DECODE_QUANT", "") == "int8"
 
 config = PRESETS[PRESET]
 params = jax.jit(lambda k: init_params(config, k))(jax.random.PRNGKey(0))
+if QUANT:
+    params = jax.jit(quantize_params)(params)
 
 prompts = [
     jax.random.randint(
@@ -64,10 +69,11 @@ step = diffs[1] / N  # median
 # Embedding rows are gathered, not streamed; everything else (incl. the
 # lm_head matmul) is read in full every step.
 streamed = config.num_params() - config.vocab_size * config.hidden
-hbm_roofline_ms = streamed * 2 / 810e9 * 1e3  # bf16 bytes / v5e HBM BW
+bytes_per_param = 1 if QUANT else 2  # int8 vs bf16 (scales negligible)
+hbm_roofline_ms = streamed * bytes_per_param / 810e9 * 1e3  # / v5e HBM BW
 print(
-    f"decode {PRESET} b{BATCH}: {step*1e3:.2f} ms/step, "
-    f"{BATCH/step:.0f} tok/s aggregate "
+    f"decode {PRESET}{'-int8' if QUANT else ''} b{BATCH}: "
+    f"{step*1e3:.2f} ms/step, {BATCH/step:.0f} tok/s aggregate "
     f"(param-read roofline ~{hbm_roofline_ms:.2f} ms/step)",
     flush=True,
 )

@@ -18,6 +18,7 @@ from ..ops.norms import rmsnorm
 from ..ops.rotary import rope_frequencies
 from .llama import LlamaConfig, _mlp_block, attn_out, project_qkv
 from .moe import MoeConfig, _moe_block
+from .quant import q_lookup, q_matmul
 
 NEG_INF = -1e30
 
@@ -99,7 +100,7 @@ def _forward_with_cache(
     c = config
     b, t = tokens.shape
     scale = c.head_dim ** -0.5
-    x = params["embed"][tokens]
+    x = q_lookup(params["embed"], tokens, c.dtype)
     cos, sin = rope_frequencies(
         c.head_dim, cache.max_len, c.rope_theta, dtype=jnp.float32
     )
@@ -125,7 +126,7 @@ def _forward_with_cache(
         block, x, (params["layers"], cache.k, cache.v)
     )
     x = rmsnorm(x, params["final_norm"], c.norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = q_matmul(x, params["lm_head"]).astype(jnp.float32)
     return logits, KVCache(k=new_k, v=new_v, length=new_len)
 
 

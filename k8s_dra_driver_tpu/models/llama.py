@@ -30,6 +30,7 @@ from ..ops.attention import flash_attention
 from ..ops.norms import rmsnorm
 from ..ops.rotary import apply_rope, rope_frequencies
 from ..parallel.ring import ring_attention
+from .quant import q_einsum, q_lookup, q_matmul
 
 
 @dataclasses.dataclass(frozen=True)
@@ -190,7 +191,9 @@ def project_qkv(
     b, t, _ = xn.shape
     g = c.n_heads // c.n_kv_heads
     # One fused matmul: [B,T,H] @ [H, KV, G+2, D] -> [B, T, KV, G+2, D].
-    qkv = jnp.einsum("bth,hkgd->btkgd", xn, layer["wqkv"])
+    # q_einsum is the int8-serving seam (models/quant.py): identity for
+    # float weights, dequant-fused matmul for QuantTensor weights.
+    qkv = q_einsum("bth,hkgd->btkgd", xn, layer["wqkv"])
     q = qkv[..., :g, :].reshape(b, t, c.n_heads, c.head_dim)
     k = qkv[..., g, :]                                  # [B, T, KV, D]
     v = qkv[..., g + 1, :]
@@ -203,7 +206,7 @@ def attn_out(x: jax.Array, o: jax.Array, layer: dict) -> jax.Array:
     """Output projection + residual. o: [B, H, T, D] attention result."""
     b, _, t, _ = o.shape
     flat = o.transpose(0, 2, 1, 3).reshape(b, t, -1)
-    return x + (flat.astype(x.dtype) @ layer["wo"]).astype(x.dtype)
+    return x + q_matmul(flat.astype(x.dtype), layer["wo"]).astype(x.dtype)
 
 
 def _attention_block(x, layer, config: LlamaConfig, cos, sin, mesh, use_ring):
@@ -220,11 +223,11 @@ def _attention_block(x, layer, config: LlamaConfig, cos, sin, mesh, use_ring):
 def _mlp_block(x, layer, config: LlamaConfig):
     xn = rmsnorm(x, layer["ln_mlp"], config.norm_eps)
     # One fused matmul: [B,T,H] @ [H, 2, M] -> [B, T, 2, M].
-    gu = jnp.einsum("bth,hcm->btcm", xn, layer["w_gateup"])
+    gu = q_einsum("bth,hcm->btcm", xn, layer["w_gateup"])
     gate = jax.nn.silu(gu[..., 0, :].astype(jnp.float32))
     up = gu[..., 1, :].astype(jnp.float32)
     prod = checkpoint_name((gate * up).astype(x.dtype), "mlp_prod")
-    return x + (prod @ layer["w_down"]).astype(x.dtype)
+    return x + q_matmul(prod, layer["w_down"]).astype(x.dtype)
 
 
 # Remat policies, cheapest-memory first. "full" recomputes the whole block
@@ -274,7 +277,7 @@ def forward(
     chunkwise instead)."""
     c = config
     s = tokens.shape[1]
-    x = params["embed"][tokens]          # [B, S, H]
+    x = q_lookup(params["embed"], tokens, c.dtype)   # [B, S, H]
     cos, sin = rope_frequencies(c.head_dim, s, c.rope_theta, dtype=jnp.float32)
 
     def block(x, layer):
@@ -287,7 +290,7 @@ def forward(
     x = rmsnorm(x, params["final_norm"], c.norm_eps)
     if return_hidden:
         return x
-    return (x @ params["lm_head"]).astype(jnp.float32)
+    return q_matmul(x, params["lm_head"]).astype(jnp.float32)
 
 
 def forward_pipelined(

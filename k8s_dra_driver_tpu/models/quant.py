@@ -1,0 +1,120 @@
+"""Weight-only int8 quantization for serving.
+
+Decode at small batch is weight-streaming-bound (docs/performance.md): every
+step reads the full parameter set from HBM while the MXU idles. Halving the
+bytes halves the floor. tpu-first design:
+
+- **Per-output-channel symmetric int8**: scale = amax/127 over the
+  contraction axis, kept with ``keepdims`` so the per-layer ``lax.scan``
+  slices q and scale together.
+- **Dequant fused into the consumer**: the matmul runs
+  ``einsum(x, q.astype(bf16)) * scale`` — XLA fuses the int8→bf16 convert
+  into the dot's operand read, so HBM traffic is int8 and the MXU still
+  sees bf16 (int8 never enters the accumulator path; no accuracy cliff).
+- **Pytree-transparent**: ``QuantTensor`` is a registered dataclass; the
+  quantized params keep the exact tree structure of the float params, so
+  the KV-cache decode path (models/decode.py) runs unchanged through the
+  ``q_einsum``/``q_matmul``/``q_lookup`` seams in models/llama.py.
+
+The reference driver has no serving stack at all; this lives in the
+workload layer its claims schedule.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class QuantTensor:
+    """int8 weights + per-output-channel scales (same rank, keepdims)."""
+
+    q: jax.Array      # int8, original shape
+    scale: jax.Array  # f32, contraction axis collapsed to 1
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+
+jax.tree_util.register_dataclass(
+    QuantTensor, data_fields=["q", "scale"], meta_fields=[]
+)
+
+
+def quantize_tensor(w: jax.Array, axis: int) -> QuantTensor:
+    """Symmetric per-channel int8 over the contraction ``axis``."""
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=axis, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return QuantTensor(q=q, scale=scale)
+
+
+# Contraction axes of the dense Llama weight stack (llama.init_params):
+# leading L is the scan dim, the reduction input follows it.
+_LAYER_AXES = {"wqkv": 1, "wo": 1, "w_gateup": 1, "w_down": 1}
+
+
+def quantize_params(params: dict) -> dict:
+    """Quantize every large matmul weight of a dense-Llama param tree.
+
+    Norm gains stay float (tiny, precision-critical). Raises on MoE trees —
+    expert weights route through grouped einsums this seam does not cover
+    yet.
+    """
+    layers = params["layers"]
+    if "wr" in layers:  # router weights mark the MoE family (moe.init_params)
+        raise NotImplementedError(
+            "int8 serving currently covers the dense family only"
+        )
+    qlayers = dict(layers)
+    for name, axis in _LAYER_AXES.items():
+        qlayers[name] = quantize_tensor(layers[name], axis)
+    out = dict(params)
+    out["layers"] = qlayers
+    out["embed"] = quantize_tensor(params["embed"], axis=1)   # per-row
+    out["lm_head"] = quantize_tensor(params["lm_head"], axis=0)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Compute seams: transparent for float weights, dequant-fused for int8.
+# ---------------------------------------------------------------------------
+
+
+def q_einsum(pattern: str, x: jax.Array, w) -> jax.Array:
+    """``einsum(pattern, x, w)`` where w may be a QuantTensor.
+
+    The scale is constant over the contraction axis, so it factors out of
+    the sum: einsum(x, q*scale) == einsum(x, q) * scale (scale broadcast
+    over the batch dims of the output).
+    """
+    if isinstance(w, QuantTensor):
+        y = jnp.einsum(pattern, x, w.q.astype(x.dtype))
+        # Drop exactly the collapsed contraction axis (axis 0 of the
+        # per-layer weight); the remaining axes line up with the trailing
+        # output axes.
+        scale = jnp.squeeze(w.scale, axis=0)
+        return (y.astype(jnp.float32) * scale).astype(x.dtype)
+    return jnp.einsum(pattern, x, w)
+
+
+def q_matmul(x: jax.Array, w) -> jax.Array:
+    """``x @ w`` where w may be a QuantTensor ([K, N], scale [1, N])."""
+    if isinstance(w, QuantTensor):
+        y = x @ w.q.astype(x.dtype)
+        return (y.astype(jnp.float32) * w.scale[0]).astype(x.dtype)
+    return x @ w
+
+
+def q_lookup(emb, tokens: jax.Array, dtype) -> jax.Array:
+    """Embedding gather where the table may be row-quantized ([V, H] with
+    per-row scale [V, 1]); ``dtype`` is the model compute dtype."""
+    if isinstance(emb, QuantTensor):
+        rows = emb.q[tokens].astype(dtype)
+        return rows * emb.scale[tokens].astype(dtype)
+    return emb[tokens]

@@ -100,6 +100,10 @@ class DeviceState:
         self.allocatable: AllocatableDevices = (
             self.chiplib.enumerate_all_possible_devices(self.device_classes)
         )
+        # What the base CDI spec currently contains — a superset of
+        # allocatable while prepared claims pin entries for transiently
+        # absent devices (refresh_allocatable).
+        self._base_spec_devices: AllocatableDevices = dict(self.allocatable)
         self.cdi.create_standard_device_spec_file(self.allocatable)
 
         share_state = SharingStateStore(f"{state_dir}/sharing")
@@ -445,11 +449,16 @@ class DeviceState:
                 # enumeration must not break a container about to start);
                 # the allocatable map and published slices track the fresh
                 # truth only, so a vanished chip cannot be newly prepared.
+                # Retention reads the PREVIOUS spec contents, not
+                # allocatable, so the pin survives any number of unrelated
+                # inventory changes until the claim unprepares.
                 spec_devices = dict(fresh)
                 for name in self._prepared_device_names():
-                    if name not in spec_devices and name in self.allocatable:
-                        spec_devices[name] = self.allocatable[name]
+                    if (name not in spec_devices
+                            and name in self._base_spec_devices):
+                        spec_devices[name] = self._base_spec_devices[name]
                 self.allocatable = fresh
+                self._base_spec_devices = spec_devices
                 self.cdi.create_standard_device_spec_file(spec_devices)
         return changed
 

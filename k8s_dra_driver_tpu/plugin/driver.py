@@ -13,6 +13,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import threading
+import time
 from typing import Optional
 
 from ..cdi.spec import CDIHandler
@@ -165,9 +166,12 @@ class Driver(NodeServicer):
                 woke = self.state.chiplib.wait_device_event(interval)
                 # Debounce: a vfio rebind is a delete-then-create burst and
                 # the first event fires at the worst instant. Absorb events
-                # until the device tree has been quiet for a beat, so the
-                # loop only ever enumerates settled states.
-                while woke and not self._watch_stop.is_set():
+                # until the device tree has been quiet for a beat — but
+                # bounded, so sustained unrelated /dev churn (tty ATTRIB
+                # noise etc.) cannot starve the refresh forever.
+                settle_deadline = time.monotonic() + 2.0
+                while (woke and not self._watch_stop.is_set()
+                       and time.monotonic() < settle_deadline):
                     woke = self.state.chiplib.wait_device_event(
                         min(0.2, interval)
                     )

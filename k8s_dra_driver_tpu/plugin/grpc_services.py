@@ -21,6 +21,12 @@ from ..kube.protos import pluginregistration_v1_pb2 as regpb
 logger = logging.getLogger(__name__)
 
 DRA_SERVICE_NAME = "v1alpha3.Node"
+# k8s 1.32 moved the DRA gRPC service to v1beta1.DRAPlugin
+# (k8s.io/kubelet/pkg/apis/dra/v1beta1). The message wire format is
+# field-identical — protobuf carries no type names on the wire — so one
+# implementation serves both names and either kubelet generation connects.
+DRA_SERVICE_NAME_V1BETA1 = "v1beta1.DRAPlugin"
+DRA_SERVICE_NAMES = (DRA_SERVICE_NAME, DRA_SERVICE_NAME_V1BETA1)
 REGISTRATION_SERVICE_NAME = "pluginregistration.Registration"
 
 
@@ -73,36 +79,40 @@ class NodeServicer:
 
 
 def add_node_servicer_to_server(servicer: NodeServicer, server: grpc.Server) -> None:
-    handlers = {
-        "NodePrepareResources": grpc.unary_unary_rpc_method_handler(
-            _logged(DRA_SERVICE_NAME, "NodePrepareResources",
-                    servicer.NodePrepareResources),
-            request_deserializer=drapb.NodePrepareResourcesRequest.FromString,
-            response_serializer=drapb.NodePrepareResourcesResponse.SerializeToString,
-        ),
-        "NodeUnprepareResources": grpc.unary_unary_rpc_method_handler(
-            _logged(DRA_SERVICE_NAME, "NodeUnprepareResources",
-                    servicer.NodeUnprepareResources),
-            request_deserializer=drapb.NodeUnprepareResourcesRequest.FromString,
-            response_serializer=drapb.NodeUnprepareResourcesResponse.SerializeToString,
-        ),
-    }
-    server.add_generic_rpc_handlers(
-        (grpc.method_handlers_generic_handler(DRA_SERVICE_NAME, handlers),)
-    )
+    for service_name in DRA_SERVICE_NAMES:
+        handlers = {
+            "NodePrepareResources": grpc.unary_unary_rpc_method_handler(
+                _logged(service_name, "NodePrepareResources",
+                        servicer.NodePrepareResources),
+                request_deserializer=drapb.NodePrepareResourcesRequest.FromString,
+                response_serializer=drapb.NodePrepareResourcesResponse.SerializeToString,
+            ),
+            "NodeUnprepareResources": grpc.unary_unary_rpc_method_handler(
+                _logged(service_name, "NodeUnprepareResources",
+                        servicer.NodeUnprepareResources),
+                request_deserializer=drapb.NodeUnprepareResourcesRequest.FromString,
+                response_serializer=drapb.NodeUnprepareResourcesResponse.SerializeToString,
+            ),
+        }
+        server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(service_name, handlers),)
+        )
 
 
 class NodeStub:
-    """Client stub (used by tests / a fake kubelet)."""
+    """Client stub (used by tests / a fake kubelet). ``service_name``
+    selects the kubelet generation to impersonate: the v1alpha3 Node
+    service (k8s 1.31) or v1beta1.DRAPlugin (1.32+)."""
 
-    def __init__(self, channel: grpc.Channel):
+    def __init__(self, channel: grpc.Channel,
+                 service_name: str = DRA_SERVICE_NAME):
         self.NodePrepareResources = channel.unary_unary(
-            f"/{DRA_SERVICE_NAME}/NodePrepareResources",
+            f"/{service_name}/NodePrepareResources",
             request_serializer=drapb.NodePrepareResourcesRequest.SerializeToString,
             response_deserializer=drapb.NodePrepareResourcesResponse.FromString,
         )
         self.NodeUnprepareResources = channel.unary_unary(
-            f"/{DRA_SERVICE_NAME}/NodeUnprepareResources",
+            f"/{service_name}/NodeUnprepareResources",
             request_serializer=drapb.NodeUnprepareResourcesRequest.SerializeToString,
             response_deserializer=drapb.NodeUnprepareResourcesResponse.FromString,
         )

@@ -779,8 +779,7 @@ class RealChipLib(ChipLib):
                 )
             except OSError as e:
                 logger.debug("device watch unavailable: %s", e)
-        time.sleep(timeout_s)
-        return False
+        return super().wait_device_event(timeout_s)
 
     def _ici_major(self) -> int:
         """Device major for ICI channel nodes from /proc/devices

@@ -138,8 +138,14 @@ class TestOrbaxCheckpoint:
         params = init_params(TINY, jax.random.PRNGKey(0))
         save_checkpoint(str(tmp_path / "ckpt"), params, step=7)
         assert latest_step(str(tmp_path / "ckpt")) == 7
+        # Templates carry shardings: restore places arrays without reading
+        # the sharding file back (the supported path for restoring onto a
+        # different topology).
+        sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
         template = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=sharding),
+            params,
         )
         restored = restore_checkpoint(str(tmp_path / "ckpt"), template)
         np.testing.assert_allclose(

@@ -71,10 +71,13 @@ class TestRefreshAllocatable:
         lib.chips_per_host = 2  # tpu-2/tpu-3 vanish mid-rebind
         assert driver.state.refresh_allocatable() is True
 
-        base = json.loads(
-            (tmp_path / "cdi" / "k8s.tpu.google.com-base.json").read_text()
-        )
-        names = {d["name"] for d in base["devices"]}
+        def base_names():
+            base = json.loads(
+                (tmp_path / "cdi" / "k8s.tpu.google.com-base.json").read_text()
+            )
+            return {d["name"] for d in base["devices"]}
+
+        names = base_names()
         assert "tpu-3" in names          # prepared claim's entry retained
         assert "tpu-2" not in names      # unreferenced ghost dropped
         # The fresh truth governs scheduling surfaces.
@@ -82,6 +85,18 @@ class TestRefreshAllocatable:
         pub = {d["name"] for d in
                driver.state.published_resources()["devices"]}
         assert pub == {"tpu-0", "tpu-1"}
+
+        # The pin survives FURTHER unrelated inventory changes (retention
+        # reads the previous spec, not the already-swapped allocatable).
+        lib.chips_per_host = 1
+        assert driver.state.refresh_allocatable() is True
+        assert "tpu-3" in base_names()
+
+        # Unprepare releases the pin at the next change.
+        driver.state.unprepare("uid-k")
+        lib.chips_per_host = 2
+        assert driver.state.refresh_allocatable() is True
+        assert "tpu-3" not in base_names()
 
 
 class TestWatchLoop:

@@ -111,6 +111,33 @@ class TestPrepareOverGrpc:
             assert uresp.claims["uid-1"].error == ""
         assert driver.state.checkpoint.read() == {}
 
+    def test_v1beta1_service_name_served(self, harness):
+        """A k8s 1.32+ kubelet dials v1beta1.DRAPlugin; the same handlers
+        answer both generations (messages are wire-identical)."""
+        from k8s_dra_driver_tpu.plugin.grpc_services import (
+            DRA_SERVICE_NAME_V1BETA1,
+        )
+
+        driver, client, config = harness
+        add_claim(client, "uid-b1", ["tpu-0"], name="beta-claim")
+        with grpc.insecure_channel(f"unix://{config.plugin_socket}") as ch:
+            stub = NodeStub(ch, service_name=DRA_SERVICE_NAME_V1BETA1)
+            resp = stub.NodePrepareResources(
+                drapb.NodePrepareResourcesRequest(
+                    claims=[drapb.Claim(uid="uid-b1", name="beta-claim",
+                                        namespace="default")]
+                )
+            )
+            assert resp.claims["uid-b1"].error == ""
+            uresp = stub.NodeUnprepareResources(
+                drapb.NodeUnprepareResourcesRequest(
+                    claims=[drapb.Claim(uid="uid-b1", name="beta-claim",
+                                        namespace="default")]
+                )
+            )
+            assert uresp.claims["uid-b1"].error == ""
+        assert driver.state.checkpoint.read() == {}
+
     def test_rpc_call_logging(self, harness, caplog):
         """Every DRA RPC emits a debug log line with method, claim UIDs
         and latency (reference framework behavior: draplugin.go:89-94 at
