@@ -310,7 +310,7 @@ def forward_pipelined(
 
     c = config
     s = tokens.shape[1]
-    x = params["embed"][tokens]
+    x = q_lookup(params["embed"], tokens, c.dtype)
     cos, sin = rope_frequencies(c.head_dim, s, c.rope_theta, dtype=jnp.float32)
     staged = stage_params(params["layers"], mesh.shape["pipe"])
 
@@ -328,7 +328,7 @@ def forward_pipelined(
     x = rmsnorm(x, params["final_norm"], c.norm_eps)
     if return_hidden:
         return x
-    return (x @ params["lm_head"]).astype(jnp.float32)
+    return q_matmul(x, params["lm_head"]).astype(jnp.float32)
 
 
 def chunked_cross_entropy(

@@ -32,6 +32,7 @@ from .llama import (
     _remat_transform,
     chunked_cross_entropy,
 )
+from .quant import q_dequant, q_lookup, q_matmul
 
 
 @dataclasses.dataclass(frozen=True)
@@ -239,11 +240,16 @@ def _moe_block(x, layer, config: MoeConfig, mesh: Optional[Mesh]):
                 mesh, P("expert", ("data", "fsdp"), None, None)
             )
         )
-    gu = jnp.einsum("ebch,ehum->ebcum", xe, layer["w_gateup"])
+    # q_dequant is the int8-serving seam (models/quant.py): identity for
+    # float weights, fused dequant for QuantTensor expert stacks.
+    gu = jnp.einsum(
+        "ebch,ehum->ebcum", xe, q_dequant(layer["w_gateup"], xe.dtype)
+    )
     gate = jax.nn.silu(gu[..., 0, :].astype(jnp.float32))
     up = gu[..., 1, :].astype(jnp.float32)
     ye = jnp.einsum(
-        "ebcm,emh->ebch", (gate * up).astype(x.dtype), layer["w_down"]
+        "ebcm,emh->ebch", (gate * up).astype(x.dtype),
+        q_dequant(layer["w_down"], x.dtype),
     )
     out = jnp.einsum(
         "bsec,ebch->bsh", combine.astype(jnp.float32),
@@ -266,7 +272,7 @@ def forward(
     """Causal LM forward. Returns (logits_or_hidden, aux_loss)."""
     c = config
     s = tokens.shape[1]
-    x = params["embed"][tokens]
+    x = q_lookup(params["embed"], tokens, c.dtype)
     cos, sin = rope_frequencies(c.head_dim, s, c.rope_theta, dtype=jnp.float32)
 
     def block(carry, layer):
@@ -283,7 +289,7 @@ def forward(
     x = rmsnorm(x, params["final_norm"], c.norm_eps)
     if return_hidden:
         return x, aux
-    return (x @ params["lm_head"]).astype(jnp.float32), aux
+    return q_matmul(x, params["lm_head"]).astype(jnp.float32), aux
 
 
 def loss_fn(

@@ -54,25 +54,24 @@ def quantize_tensor(w: jax.Array, axis: int) -> QuantTensor:
     return QuantTensor(q=q, scale=scale)
 
 
-# Contraction axes of the dense Llama weight stack (llama.init_params):
-# leading L is the scan dim, the reduction input follows it.
-_LAYER_AXES = {"wqkv": 1, "wo": 1, "w_gateup": 1, "w_down": 1}
+# Contraction axes of the stacked weight tensors (leading L is the scan
+# dim, the reduction input follows). The MoE family's expert stacks carry
+# an extra expert dim before the contraction (moe.init_params:126-129);
+# its router stays float (tiny, and routing is precision-sensitive).
+_DENSE_AXES = {"wqkv": 1, "wo": 1, "w_gateup": 1, "w_down": 1}
+_MOE_AXES = {"wqkv": 1, "wo": 1, "w_gateup": 2, "w_down": 2}
 
 
 def quantize_params(params: dict) -> dict:
-    """Quantize every large matmul weight of a dense-Llama param tree.
+    """Quantize every large matmul weight of a Llama or MoE param tree.
 
-    Norm gains stay float (tiny, precision-critical). Raises on MoE trees —
-    expert weights route through grouped einsums this seam does not cover
-    yet.
+    Norm gains and MoE router weights stay float (tiny,
+    precision-critical).
     """
     layers = params["layers"]
-    if "wr" in layers:  # router weights mark the MoE family (moe.init_params)
-        raise NotImplementedError(
-            "int8 serving currently covers the dense family only"
-        )
+    axes = _MOE_AXES if "wr" in layers else _DENSE_AXES
     qlayers = dict(layers)
-    for name, axis in _LAYER_AXES.items():
+    for name, axis in axes.items():
         qlayers[name] = quantize_tensor(layers[name], axis)
     out = dict(params)
     out["layers"] = qlayers
@@ -109,6 +108,17 @@ def q_matmul(x: jax.Array, w) -> jax.Array:
         y = x @ w.q.astype(x.dtype)
         return (y.astype(jnp.float32) * w.scale[0]).astype(x.dtype)
     return x @ w
+
+
+def q_dequant(w, dtype) -> jax.Array:
+    """Materialized dequant for shapes the factored seams don't cover
+    (the MoE expert einsums, whose expert dim leads the output). The
+    keepdims scale broadcasts against q directly; XLA fuses the
+    convert-and-scale into the consuming dot's operand read, so HBM still
+    streams int8."""
+    if isinstance(w, QuantTensor):
+        return (w.q.astype(jnp.float32) * w.scale).astype(dtype)
+    return w
 
 
 def q_lookup(emb, tokens: jax.Array, dtype) -> jax.Array:

@@ -48,6 +48,9 @@ class DriverConfig:
     driver_root_ctr_path: str = "/"
     device_classes: frozenset = frozenset({"chip", "tensorcore", "ici"})
     node_uid: str = ""
+    # Versions advertised on the registration socket: ("1.0.0",) for k8s
+    # 1.31 kubelets, ("v1beta1.DRAPlugin",) for 1.32+ (see kubeletplugin).
+    registration_versions: tuple = ("1.0.0",)
     cleanup_interval_seconds: float = 600.0  # 0 disables the orphan cleaner
     # Device-inventory watch: re-enumerate (woken early by the chip
     # library's inotify, where available) and republish on change. 0
@@ -112,6 +115,7 @@ class Driver(NodeServicer):
             registrar_socket=config.registrar_socket,
             kube_client=config.kube_client,
             node_uid=config.node_uid,
+            registration_versions=list(config.registration_versions),
         )
 
     def start(self) -> None:

@@ -36,12 +36,18 @@ from .grpc_services import (
 
 logger = logging.getLogger(__name__)
 
-# Version string advertised on the registration socket. Kubelet semver-parses
-# this (it is a plugin-API version, not the gRPC service name); the reference
-# framework advertises "1.0.0" (vendor kubeletplugin/noderegistrar.go:40).
-# The DRA service kubelet actually calls is selected by the gRPC service name
-# (grpc_services.DRA_SERVICE_NAME), independent of this string.
+# Version strings advertised on the registration socket. A k8s 1.31 kubelet
+# SEMVER-parses these (plugin-API version; the reference framework
+# advertises "1.0.0", vendor kubeletplugin/noderegistrar.go:40) and then
+# dials the v1alpha3 Node service; a 1.32+ kubelet selects the DRA gRPC
+# service BY NAME from this list ("v1beta1.DRAPlugin"). The two schemes are
+# mutually unintelligible — a non-semver entry can fail 1.31 registration
+# outright — so the advertised list is a deploy-time choice
+# (KubeletPlugin(registration_versions=...), helm: plugin.apiVersions);
+# the plugin itself always serves BOTH service names on the socket
+# (grpc_services.DRA_SERVICE_NAMES).
 REGISTRATION_VERSION = "1.0.0"
+REGISTRATION_VERSION_V1BETA1 = "v1beta1.DRAPlugin"
 
 
 def _serve_uds(path: str, register) -> grpc.Server:
@@ -68,7 +74,7 @@ class _RegistrationService(RegistrationServicer):
             type="DRAPlugin",
             name=self.plugin.driver_name,
             endpoint=self.plugin.plugin_socket,
-            supported_versions=[REGISTRATION_VERSION],
+            supported_versions=list(self.plugin.registration_versions),
         )
 
     def NotifyRegistrationStatus(self, request, context):
@@ -97,6 +103,7 @@ class KubeletPlugin:
         registrar_socket: str,
         kube_client: Optional[KubeClient] = None,
         node_uid: str = "",
+        registration_versions: Optional[list[str]] = None,
     ):
         self.node_server = node_server
         self.driver_name = driver_name
@@ -105,6 +112,9 @@ class KubeletPlugin:
         self.registrar_socket = registrar_socket
         self.kube_client = kube_client
         self.node_uid = node_uid
+        self.registration_versions = list(
+            registration_versions or [REGISTRATION_VERSION]
+        )
         self._dra_server: Optional[grpc.Server] = None
         self._reg_server: Optional[grpc.Server] = None
         self._slice_controller: Optional[ResourceSliceController] = None

@@ -53,6 +53,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--device-classes",
                    default=_env("DEVICE_CLASSES", "chip,tensorcore,ici"),
                    help="comma-separated device classes to serve [DEVICE_CLASSES]")
+    p.add_argument("--plugin-api-versions",
+                   default=_env("PLUGIN_API_VERSIONS", "1.0.0"),
+                   help="comma-separated versions advertised to the kubelet "
+                        "plugin watcher: '1.0.0' for k8s 1.31, "
+                        "'v1beta1.DRAPlugin' for 1.32+ (both DRA gRPC "
+                        "services are always served) [PLUGIN_API_VERSIONS]")
     p.add_argument("--dev-root", default=_env("DEV_ROOT", ""),
                    help="host root containing /dev; defaults to the driver "
                         "root when that is a dev root, else / [DEV_ROOT]")
@@ -150,6 +156,9 @@ def main(argv=None) -> int:
         driver_root_ctr_path=driver_root_ctr,
         device_classes=frozenset(args.device_classes.split(",")),
         node_uid=node_uid,
+        registration_versions=tuple(
+            v.strip() for v in args.plugin_api_versions.split(",") if v.strip()
+        ),
     )
     driver = Driver(config)
     driver.start()

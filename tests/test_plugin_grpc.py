@@ -79,6 +79,34 @@ class TestRegistration:
             "error": "",
         }
 
+    def test_v1beta1_registration_versions(self, tmp_path):
+        """Deployed for a k8s 1.32+ kubelet, GetInfo advertises the DRA
+        service name instead of the 1.31 semver string."""
+        client = FakeKubeClient()
+        client.create(NODES, {"metadata": {"name": "node-a",
+                                           "uid": "node-uid-1"}})
+        config = DriverConfig(
+            node_name="node-a",
+            chiplib=FakeChipLib(generation="v5p", topology="2x2x1"),
+            kube_client=client,
+            cdi_root=str(tmp_path / "cdi"),
+            plugin_root=str(tmp_path / "plugin"),
+            registrar_root=str(tmp_path / "registry"),
+            state_root=str(tmp_path / "state"),
+            node_uid="node-uid-1",
+            registration_versions=("v1beta1.DRAPlugin",),
+        )
+        driver = Driver(config)
+        driver.start()
+        try:
+            with grpc.insecure_channel(
+                f"unix://{config.registrar_socket}"
+            ) as ch:
+                info = RegistrationStub(ch).GetInfo(regpb.InfoRequest())
+                assert list(info.supported_versions) == ["v1beta1.DRAPlugin"]
+        finally:
+            driver.shutdown()
+
 
 class TestPrepareOverGrpc:
     def test_prepare_unprepare_roundtrip(self, harness):
