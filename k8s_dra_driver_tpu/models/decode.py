@@ -18,7 +18,7 @@ from ..ops.norms import rmsnorm
 from ..ops.rotary import rope_frequencies
 from .llama import LlamaConfig, _mlp_block, attn_out, project_qkv
 from .moe import MoeConfig, _moe_block
-from .quant import q_lookup, q_matmul
+from .quant import q_lookup, q_matmul, quantize_tensor
 
 NEG_INF = -1e30
 
@@ -63,17 +63,81 @@ jax.tree_util.register_dataclass(
 )
 
 
-def _cached_attention(q, k_cache, v_cache, valid_len, scale):
+@dataclasses.dataclass
+class QuantKVCache:
+    """int8 KV cache with per-(position, head) scales.
+
+    Long-context decode streams the cache from HBM every step; int8 halves
+    that traffic. The score einsum contracts over D, so k's scale (constant
+    over D) factors OUT of the sum — exact, no fusion reliance; v's scale
+    varies over the contraction axis S, so it folds INTO the probabilities
+    instead (also exact). Layout: k,v int8 [L, B, H_kv, S_max, D]; scales
+    f32 [L, B, H_kv, S_max].
+    """
+
+    k: jax.Array
+    k_scale: jax.Array
+    v: jax.Array
+    v_scale: jax.Array
+    length: jax.Array  # [] int32: filled positions
+
+    @classmethod
+    def init(
+        cls, config: LlamaConfig, batch: int, max_len: int
+    ) -> "QuantKVCache":
+        shape = (
+            config.n_layers, batch, config.n_kv_heads, max_len,
+            config.head_dim,
+        )
+        return cls(
+            k=jnp.zeros(shape, jnp.int8),
+            k_scale=jnp.zeros(shape[:-1], jnp.float32),
+            v=jnp.zeros(shape, jnp.int8),
+            v_scale=jnp.zeros(shape[:-1], jnp.float32),
+            length=jnp.zeros((), jnp.int32),
+        )
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[3]
+
+
+jax.tree_util.register_dataclass(
+    QuantKVCache,
+    data_fields=["k", "k_scale", "v", "v_scale", "length"],
+    meta_fields=[],
+)
+
+
+def _quantize_kv(x):
+    """[B, H, T, D] -> (int8 values, f32 scales [B, H, T]); symmetric
+    per-vector quantization over D (one shared recipe: quant.
+    quantize_tensor)."""
+    qt = quantize_tensor(x, axis=-1)
+    return qt.q, jnp.squeeze(qt.scale, axis=-1)
+
+
+def _cached_attention(q, k_cache, v_cache, valid_len, scale,
+                      k_scale=None, v_scale=None):
     """q: [B, H, T, D]; caches: [B, H_kv, S_max, D]; positions >= valid_len
-    masked. T is the new-token count (prompt at prefill, 1 at decode)."""
+    masked. T is the new-token count (prompt at prefill, 1 at decode).
+    With k_scale/v_scale the caches are int8 (QuantKVCache read path)."""
     hq, hkv = q.shape[1], k_cache.shape[1]
     if hq != hkv:
         reps = hq // hkv
         k_cache = jnp.repeat(k_cache, reps, axis=1)
         v_cache = jnp.repeat(v_cache, reps, axis=1)
+        if k_scale is not None:
+            k_scale = jnp.repeat(k_scale, reps, axis=1)
+            v_scale = jnp.repeat(v_scale, reps, axis=1)
     s = jnp.einsum(
-        "bhtd,bhsd->bhts", q, k_cache, preferred_element_type=jnp.float32
+        "bhtd,bhsd->bhts", q, k_cache.astype(q.dtype),
+        preferred_element_type=jnp.float32,
     ) * scale
+    if k_scale is not None:
+        # k's per-position scale is constant over the contracted D axis,
+        # so it multiplies the finished scores exactly.
+        s = s * k_scale[:, :, None, :]
     t = q.shape[2]
     s_max = k_cache.shape[2]
     # Causal within the new tokens + cache-length bound. New token i sits at
@@ -83,18 +147,23 @@ def _cached_attention(q, k_cache, v_cache, valid_len, scale):
     mask = kpos <= qpos
     s = jnp.where(mask[None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    out_dtype = q.dtype
+    if v_scale is not None:
+        # v's scale varies over the contraction axis S: fold it into the
+        # probabilities (exact), then contract against raw int8 values.
+        p = p * v_scale[:, :, None, :]
     return jnp.einsum(
-        "bhts,bhsd->bhtd", p.astype(v_cache.dtype), v_cache
+        "bhts,bhsd->bhtd", p.astype(out_dtype), v_cache.astype(out_dtype)
     )
 
 
 def _forward_with_cache(
     params: dict,
     tokens: jax.Array,            # [B, T] new tokens
-    cache: KVCache,
+    cache: "KVCache | QuantKVCache",
     config: LlamaConfig,
     positions: jax.Array,         # [T] absolute positions of the new tokens
-) -> tuple[jax.Array, KVCache]:
+) -> "tuple[jax.Array, KVCache | QuantKVCache]":
     """Run the stack over new tokens, reading+writing the cache.
     Returns (logits [B, T, V], updated cache)."""
     c = config
@@ -106,28 +175,60 @@ def _forward_with_cache(
     )
     start = cache.length
     new_len = start + t
+    quantized = isinstance(cache, QuantKVCache)
 
     def block(x, layer_and_cache):
-        layer, k_cache, v_cache = layer_and_cache
+        if quantized:
+            layer, k_cache, ks, v_cache, vs = layer_and_cache
+        else:
+            layer, k_cache, v_cache = layer_and_cache
+            ks = vs = None
         xn = rmsnorm(x, layer["ln_attn"], c.norm_eps)
         q, k, v = project_qkv(xn, layer, c, cos, sin, positions=positions)
-        k_cache = jax.lax.dynamic_update_slice(
-            k_cache, k.astype(k_cache.dtype), (0, 0, start, 0)
-        )
-        v_cache = jax.lax.dynamic_update_slice(
-            v_cache, v.astype(v_cache.dtype), (0, 0, start, 0)
-        )
-        o = _cached_attention(q, k_cache, v_cache, new_len, scale)
+        if quantized:
+            k8, k_s = _quantize_kv(k)
+            v8, v_s = _quantize_kv(v)
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, k8, (0, 0, start, 0)
+            )
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, v8, (0, 0, start, 0)
+            )
+            ks = jax.lax.dynamic_update_slice(ks, k_s, (0, 0, start))
+            vs = jax.lax.dynamic_update_slice(vs, v_s, (0, 0, start))
+        else:
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, k.astype(k_cache.dtype), (0, 0, start, 0)
+            )
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, v.astype(v_cache.dtype), (0, 0, start, 0)
+            )
+        o = _cached_attention(q, k_cache, v_cache, new_len, scale,
+                              k_scale=ks, v_scale=vs)
         x = attn_out(x, o, layer)
         x = _mlp_or_moe(x, layer, c)
+        if quantized:
+            return x, (k_cache, ks, v_cache, vs)
         return x, (k_cache, v_cache)
 
-    x, (new_k, new_v) = jax.lax.scan(
-        block, x, (params["layers"], cache.k, cache.v)
-    )
+    if quantized:
+        x, (new_k, new_ks, new_v, new_vs) = jax.lax.scan(
+            block, x,
+            (params["layers"], cache.k, cache.k_scale, cache.v,
+             cache.v_scale),
+        )
+        new_cache = QuantKVCache(
+            k=new_k, k_scale=new_ks, v=new_v, v_scale=new_vs,
+            length=new_len,
+        )
+    else:
+        x, (new_k, new_v) = jax.lax.scan(
+            block, x, (params["layers"], cache.k, cache.v)
+        )
+        new_cache = KVCache(k=new_k, v=new_v, length=new_len)
     x = rmsnorm(x, params["final_norm"], c.norm_eps)
     logits = q_matmul(x, params["lm_head"]).astype(jnp.float32)
-    return logits, KVCache(k=new_k, v=new_v, length=new_len)
+    return logits, new_cache
 
 
 def prefill(
@@ -135,10 +236,14 @@ def prefill(
     tokens: jax.Array,            # [B, S] prompt
     config: LlamaConfig,
     max_len: int,
-) -> tuple[jax.Array, KVCache]:
-    """Process the prompt; returns (last-position logits [B, V], cache)."""
+    quantize_cache: bool = False,
+) -> "tuple[jax.Array, KVCache | QuantKVCache]":
+    """Process the prompt; returns (last-position logits [B, V], cache).
+    ``quantize_cache`` stores KV in int8 with per-position scales
+    (QuantKVCache) — half the cache traffic for long-context decode."""
     b, s = tokens.shape
-    cache = KVCache.init(config, b, max_len)
+    cache_cls = QuantKVCache if quantize_cache else KVCache
+    cache = cache_cls.init(config, b, max_len)
     positions = jnp.arange(s)
     logits, cache = _forward_with_cache(
         params, tokens, cache, config, positions
@@ -149,9 +254,9 @@ def prefill(
 def decode_step(
     params: dict,
     token: jax.Array,             # [B] latest token
-    cache: KVCache,
+    cache: "KVCache | QuantKVCache",
     config: LlamaConfig,
-) -> tuple[jax.Array, KVCache]:
+) -> "tuple[jax.Array, KVCache | QuantKVCache]":
     """One autoregressive step; returns (next-token logits [B, V], cache)."""
     positions = cache.length[None]
     logits, cache = _forward_with_cache(
@@ -167,11 +272,13 @@ def generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     rng: Optional[jax.Array] = None,
+    quantize_cache: bool = False,
 ) -> jax.Array:
     """Greedy (or sampled) generation, fully jitted: returns [B, S + N]."""
     b, s = prompt.shape
     max_len = s + max_new_tokens
-    logits, cache = prefill(params, prompt, config, max_len)
+    logits, cache = prefill(params, prompt, config, max_len,
+                            quantize_cache=quantize_cache)
     out = jnp.zeros((b, max_new_tokens), jnp.int32)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 

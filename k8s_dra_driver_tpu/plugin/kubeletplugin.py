@@ -45,9 +45,9 @@ logger = logging.getLogger(__name__)
 # outright — so the advertised list is a deploy-time choice
 # (KubeletPlugin(registration_versions=...), helm: plugin.apiVersions);
 # the plugin itself always serves BOTH service names on the socket
-# (grpc_services.DRA_SERVICE_NAMES).
+# (grpc_services.DRA_SERVICE_NAMES — the 1.32+ scheme's version string IS
+# grpc_services.DRA_SERVICE_NAME_V1BETA1).
 REGISTRATION_VERSION = "1.0.0"
-REGISTRATION_VERSION_V1BETA1 = "v1beta1.DRAPlugin"
 
 
 def _serve_uds(path: str, register) -> grpc.Server:

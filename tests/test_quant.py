@@ -171,6 +171,36 @@ class TestQuantizedModel:
         assert out.shape == (2, 11)
         assert (out[:, :5] == prompt).all()
 
+    def test_int8_kv_cache_decode_close(self, params):
+        """QuantKVCache (int8 values + per-position scales) tracks the
+        float cache path closely through prefill + stepwise decode."""
+        from k8s_dra_driver_tpu.models.decode import QuantKVCache
+
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(13), (2, 8), 0, CONFIG.vocab_size
+        )
+        ref, refc = prefill(params, tokens[:, :4], CONFIG, max_len=16)
+        got, qc = prefill(params, tokens[:, :4], CONFIG, max_len=16,
+                          quantize_cache=True)
+        assert isinstance(qc, QuantKVCache)
+        assert qc.k.dtype == jnp.int8 and qc.v.dtype == jnp.int8
+        np.testing.assert_allclose(got, ref, rtol=3e-2, atol=5e-2)
+        for i in range(4, 8):
+            ref, refc = decode_step(params, tokens[:, i], refc, CONFIG)
+            got, qc = decode_step(params, tokens[:, i], qc, CONFIG)
+            np.testing.assert_allclose(got, ref, rtol=3e-2, atol=5e-2)
+
+    def test_int8_weights_and_cache_compose(self, qparams):
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(14), (2, 5), 0, CONFIG.vocab_size
+        )
+        out = jax.jit(
+            lambda p, t: generate(p, t, CONFIG, max_new_tokens=6,
+                                  quantize_cache=True)
+        )(qparams, prompt)
+        assert out.shape == (2, 11)
+        assert (out[:, :5] == prompt).all()
+
     def test_greedy_tokens_mostly_agree(self, params, qparams):
         tokens = jax.random.randint(
             jax.random.PRNGKey(10), (4, 24), 0, CONFIG.vocab_size
