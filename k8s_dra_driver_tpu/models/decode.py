@@ -23,13 +23,14 @@ from .quant import q_lookup, q_matmul, quantize_tensor
 NEG_INF = -1e30
 
 
-def _mlp_or_moe(x, layer, config):
+def _mlp_or_moe(x, layer, config, mesh=None):
     """The per-layer FFN for the config's family: sparse MoE routing for
     MoeConfig (aux loss dropped — inference), dense otherwise. At decode
     (T=1) a single token can only occupy slot 0 of each chosen expert, so
-    routing never overflows regardless of capacity_factor."""
+    routing never overflows regardless of capacity_factor. ``mesh`` lets
+    ep-sharded serving constrain the dispatch to the expert axis."""
     if isinstance(config, MoeConfig):
-        x, _aux = _moe_block(x, layer, config, mesh=None)
+        x, _aux = _moe_block(x, layer, config, mesh=mesh)
         return x
     return _mlp_block(x, layer, config)
 
@@ -121,40 +122,40 @@ def _cached_attention(q, k_cache, v_cache, valid_len, scale,
                       k_scale=None, v_scale=None):
     """q: [B, H, T, D]; caches: [B, H_kv, S_max, D]; positions >= valid_len
     masked. T is the new-token count (prompt at prefill, 1 at decode).
-    With k_scale/v_scale the caches are int8 (QuantKVCache read path)."""
-    hq, hkv = q.shape[1], k_cache.shape[1]
-    if hq != hkv:
-        reps = hq // hkv
-        k_cache = jnp.repeat(k_cache, reps, axis=1)
-        v_cache = jnp.repeat(v_cache, reps, axis=1)
-        if k_scale is not None:
-            k_scale = jnp.repeat(k_scale, reps, axis=1)
-            v_scale = jnp.repeat(v_scale, reps, axis=1)
+    With k_scale/v_scale the caches are int8 (QuantKVCache read path).
+
+    GQA is contracted in grouped form (q reshaped to [B, H_kv, G, T, D])
+    so the H_kv-sized cache is read once — a materialized head repeat
+    would stream a G-times-larger cache copy every step, forfeiting
+    exactly the bandwidth the int8 cache saves."""
+    b, hq, t, d = q.shape
+    hkv = k_cache.shape[1]
+    qg = q.reshape(b, hkv, hq // hkv, t, d)  # heads are kv-major
     s = jnp.einsum(
-        "bhtd,bhsd->bhts", q, k_cache.astype(q.dtype),
+        "bhgtd,bhsd->bhgts", qg, k_cache.astype(q.dtype),
         preferred_element_type=jnp.float32,
     ) * scale
     if k_scale is not None:
         # k's per-position scale is constant over the contracted D axis,
         # so it multiplies the finished scores exactly.
-        s = s * k_scale[:, :, None, :]
-    t = q.shape[2]
+        s = s * k_scale[:, :, None, None, :]
     s_max = k_cache.shape[2]
     # Causal within the new tokens + cache-length bound. New token i sits at
     # absolute position valid_len - t + i.
     qpos = valid_len - t + jnp.arange(t)[:, None]
     kpos = jnp.arange(s_max)[None, :]
     mask = kpos <= qpos
-    s = jnp.where(mask[None, None], s, NEG_INF)
+    s = jnp.where(mask[None, None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     out_dtype = q.dtype
     if v_scale is not None:
         # v's scale varies over the contraction axis S: fold it into the
         # probabilities (exact), then contract against raw int8 values.
-        p = p * v_scale[:, :, None, :]
-    return jnp.einsum(
-        "bhts,bhsd->bhtd", p.astype(out_dtype), v_cache.astype(out_dtype)
+        p = p * v_scale[:, :, None, None, :]
+    out = jnp.einsum(
+        "bhgts,bhsd->bhgtd", p.astype(out_dtype), v_cache.astype(out_dtype)
     )
+    return out.reshape(b, hq, t, d)
 
 
 def _forward_with_cache(
@@ -163,6 +164,7 @@ def _forward_with_cache(
     cache: "KVCache | QuantKVCache",
     config: LlamaConfig,
     positions: jax.Array,         # [T] absolute positions of the new tokens
+    mesh=None,
 ) -> "tuple[jax.Array, KVCache | QuantKVCache]":
     """Run the stack over new tokens, reading+writing the cache.
     Returns (logits [B, T, V], updated cache)."""
@@ -206,7 +208,7 @@ def _forward_with_cache(
         o = _cached_attention(q, k_cache, v_cache, new_len, scale,
                               k_scale=ks, v_scale=vs)
         x = attn_out(x, o, layer)
-        x = _mlp_or_moe(x, layer, c)
+        x = _mlp_or_moe(x, layer, c, mesh=mesh)
         if quantized:
             return x, (k_cache, ks, v_cache, vs)
         return x, (k_cache, v_cache)
@@ -237,6 +239,7 @@ def prefill(
     config: LlamaConfig,
     max_len: int,
     quantize_cache: bool = False,
+    mesh=None,
 ) -> "tuple[jax.Array, KVCache | QuantKVCache]":
     """Process the prompt; returns (last-position logits [B, V], cache).
     ``quantize_cache`` stores KV in int8 with per-position scales
@@ -246,7 +249,7 @@ def prefill(
     cache = cache_cls.init(config, b, max_len)
     positions = jnp.arange(s)
     logits, cache = _forward_with_cache(
-        params, tokens, cache, config, positions
+        params, tokens, cache, config, positions, mesh=mesh
     )
     return logits[:, -1], cache
 
@@ -256,11 +259,12 @@ def decode_step(
     token: jax.Array,             # [B] latest token
     cache: "KVCache | QuantKVCache",
     config: LlamaConfig,
+    mesh=None,
 ) -> "tuple[jax.Array, KVCache | QuantKVCache]":
     """One autoregressive step; returns (next-token logits [B, V], cache)."""
     positions = cache.length[None]
     logits, cache = _forward_with_cache(
-        params, token[:, None], cache, config, positions
+        params, token[:, None], cache, config, positions, mesh=mesh
     )
     return logits[:, 0], cache
 
@@ -273,12 +277,13 @@ def generate(
     temperature: float = 0.0,
     rng: Optional[jax.Array] = None,
     quantize_cache: bool = False,
+    mesh=None,
 ) -> jax.Array:
     """Greedy (or sampled) generation, fully jitted: returns [B, S + N]."""
     b, s = prompt.shape
     max_len = s + max_new_tokens
     logits, cache = prefill(params, prompt, config, max_len,
-                            quantize_cache=quantize_cache)
+                            quantize_cache=quantize_cache, mesh=mesh)
     out = jnp.zeros((b, max_new_tokens), jnp.int32)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
@@ -294,7 +299,7 @@ def generate(
         key, sub = jax.random.split(key)
         tok = sample(logits, sub)
         out = out.at[:, i].set(tok)
-        logits, cache = decode_step(params, tok, cache, config)
+        logits, cache = decode_step(params, tok, cache, config, mesh=mesh)
         return i + 1, logits, cache, out, key
 
     def cond(carry):

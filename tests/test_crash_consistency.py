@@ -1,0 +1,159 @@
+"""Crash consistency: the plugin dies HARD mid-prepare and recovers.
+
+Exception paths are rollback-covered (test_device_state); a SIGKILL/OOM
+skips rollback entirely, which is the case the checkpoint-first design
+exists for (reference: device_state.go:128-159's idempotent Prepare +
+kubelet retries). Each scenario runs a REAL subprocess that os._exit()s
+at an injected point inside prepare, then restarts DeviceState over the
+same state dirs and drives recovery the way kubelet would.
+
+Crash points covered:
+- after the sharing-state acquire, before the checkpoint write → the
+  orphan cleaner must release the phantom hold (cleanup.py:110);
+- after the checkpoint write → the retried prepare must return the
+  cached result idempotently, and unprepare must fully clean up.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+DRIVER = "tpu.google.com"
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CRASH_SCRIPT = """
+import os, sys
+sys.path.insert(0, "@REPO@")
+from k8s_dra_driver_tpu.cdi import CDIHandler
+from k8s_dra_driver_tpu.plugin.checkpoint import CheckpointManager
+from k8s_dra_driver_tpu.plugin.device_state import DeviceState
+from k8s_dra_driver_tpu.tpulib import FakeChipLib
+
+root = sys.argv[1]
+crash_point = sys.argv[2]
+
+
+class CrashingLib(FakeChipLib):
+    # Simulates SIGKILL: no exception, no rollback, no atexit.
+    def set_sharing_mode(self, uuids, mode):
+        super().set_sharing_mode(uuids, mode)
+        if crash_point == "after-acquire" and mode != "exclusive":
+            os._exit(9)
+
+
+def make_state():
+    return DeviceState(
+        chiplib=CrashingLib(generation="v5p", topology="2x2x1"),
+        cdi=CDIHandler(os.path.join(root, "cdi")),
+        checkpoint=CheckpointManager(os.path.join(root, "checkpoint.json")),
+        driver_name="tpu.google.com",
+        pool_name="node-a",
+        state_dir=os.path.join(root, "state"),
+    )
+
+
+claim = {
+    "metadata": {"name": "c", "namespace": "default", "uid": "uid-crash"},
+    "status": {"allocation": {"devices": {"results": [
+        {"request": "r", "driver": "tpu.google.com", "pool": "node-a",
+         "device": "tpu-1"}
+    ], "config": [{
+        "source": "FromClaim", "requests": [],
+        "opaque": {"driver": "tpu.google.com", "parameters": {
+            "apiVersion": "tpu.google.com/v1alpha1",
+            "kind": "TpuChipConfig",
+            "sharing": {"strategy": "TimeShared"},
+        }},
+    }]}}},
+}
+
+state = make_state()
+state.prepare(claim)
+if crash_point == "after-checkpoint":
+    os._exit(9)
+"""
+
+
+def run_crash(tmp_path, crash_point: str) -> int:
+    script = tmp_path / "crash.py"
+    script.write_text(CRASH_SCRIPT.replace("@REPO@", REPO_ROOT))
+    proc = subprocess.run(
+        [sys.executable, str(script), str(tmp_path), crash_point],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    return proc.returncode
+
+
+def restart_state(tmp_path):
+    from k8s_dra_driver_tpu.cdi import CDIHandler
+    from k8s_dra_driver_tpu.plugin.checkpoint import CheckpointManager
+    from k8s_dra_driver_tpu.plugin.device_state import DeviceState
+    from k8s_dra_driver_tpu.tpulib import FakeChipLib
+
+    return DeviceState(
+        chiplib=FakeChipLib(generation="v5p", topology="2x2x1"),
+        cdi=CDIHandler(str(tmp_path / "cdi")),
+        checkpoint=CheckpointManager(str(tmp_path / "checkpoint.json")),
+        driver_name=DRIVER,
+        pool_name="node-a",
+        state_dir=str(tmp_path / "state"),
+    )
+
+
+def make_claim(uid="uid-crash", device="tpu-1"):
+    return {
+        "metadata": {"name": "c", "namespace": "default", "uid": uid},
+        "status": {"allocation": {"devices": {"results": [
+            {"request": "r", "driver": DRIVER, "pool": "node-a",
+             "device": device}
+        ], "config": []}}},
+    }
+
+
+class TestCrashMidPrepare:
+    def test_crash_after_acquire_cleaner_releases_phantom(self, tmp_path):
+        assert run_crash(tmp_path, "after-acquire") == 9
+        state = restart_state(tmp_path)
+        # Nothing checkpointed — the claim never finished preparing.
+        assert state.checkpoint.read() == {}
+        # The phantom TimeShared hold survived the crash on disk: a new
+        # EXCLUSIVE claim on the same chip must be refused until cleanup.
+        from k8s_dra_driver_tpu.plugin.sharing import SharingError
+
+        try:
+            state.prepare(make_claim(uid="uid-new"))
+            held = False
+        except SharingError:
+            held = True
+        assert held, "phantom sharing hold vanished without the cleaner"
+
+        from k8s_dra_driver_tpu.plugin.cleanup import OrphanCleaner
+
+        OrphanCleaner(state, kube_client=None, interval_seconds=0).clean_once()
+        # Cleaned: the chip is allocatable again.
+        devices = state.prepare(make_claim(uid="uid-new"))
+        assert devices[0].device_name == "tpu-1"
+        state.unprepare("uid-new")
+        assert state.checkpoint.read() == {}
+
+    def test_crash_after_checkpoint_retry_is_idempotent(self, tmp_path):
+        assert run_crash(tmp_path, "after-checkpoint") == 9
+        state = restart_state(tmp_path)
+        # The claim IS checkpointed; kubelet retries the RPC after the
+        # restart and must get the recorded result, not a re-prepare.
+        ckpt = state.checkpoint.read()
+        assert list(ckpt) == ["uid-crash"]
+        devices = state.prepare(make_claim())
+        assert devices[0].device_name == "tpu-1"
+        # The claim CDI spec written before the crash is intact JSON.
+        spec_path = (
+            tmp_path / "cdi" / "k8s.tpu.google.com-claim_uid-crash.json"
+        )
+        json.loads(spec_path.read_text())
+        # Full teardown leaves no residue.
+        state.unprepare("uid-crash")
+        assert state.checkpoint.read() == {}
+        assert not spec_path.exists()

@@ -1,10 +1,15 @@
-"""Multi-chip serving: KV-cache decode under a data x fsdp x tensor mesh.
+"""Multi-chip serving: KV-cache decode under sharded meshes.
 
 Training sharding is gated by the multichip dryrun; this pins the SERVING
 side: Megatron-TP params (kv heads sharded on "tensor"), batch sharded on
 "data", the KV cache sharded to match, and the whole prefill + decode
 path jitted over the mesh — numerics identical to the unsharded model.
+The MoE variant additionally pins that the expert dispatch constraint is
+present in the traced program (numerics alone cannot: sharding
+constraints change placement, never values).
 """
+
+import dataclasses
 
 import jax
 import numpy as np
@@ -25,12 +30,35 @@ PROMPT = 8
 MAX_LEN = 16
 
 
-@pytest.fixture(scope="module")
-def mesh():
+def _need_8_devices():
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 virtual devices")
-    devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
-    return Mesh(devs, ("data", "fsdp", "tensor"))
+
+
+def _shard(mesh, tree_of_specs, values):
+    shardings = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), tree_of_specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return jax.device_put(values, shardings)
+
+
+def _compare_prefill_and_decode(pre, step, sh_params, sh_tokens, ref):
+    """Shared protocol: prefill on the first PROMPT-2 tokens, then decode
+    the rest stepwise; every logits vector must match the unsharded full
+    forward's per-position logits."""
+    logits, cache = pre(sh_params, sh_tokens[:, :PROMPT - 2])
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(ref[:, PROMPT - 3]),
+        rtol=2e-4, atol=2e-4,
+    )
+    for i in range(PROMPT - 2, PROMPT):
+        logits, cache = step(sh_params, sh_tokens[:, i], cache)
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(ref[:, i]),
+            rtol=2e-4, atol=2e-4,
+        )
+    return cache
 
 
 def cache_specs():
@@ -39,20 +67,19 @@ def cache_specs():
     return KVCache(k=kv, v=kv, length=P())
 
 
-def test_sharded_decode_matches_unsharded(mesh):
+def test_sharded_decode_matches_unsharded():
+    _need_8_devices()
+    mesh = Mesh(
+        np.array(jax.devices()[:8]).reshape(2, 2, 2),
+        ("data", "fsdp", "tensor"),
+    )
     params = init_params(CONFIG, jax.random.PRNGKey(0))
     tokens = jax.random.randint(
         jax.random.PRNGKey(1), (BATCH, PROMPT), 0, CONFIG.vocab_size
     )
-
-    # Unsharded reference: the full forward's per-position logits.
     ref = forward(params, tokens, CONFIG)
 
-    shardings = jax.tree.map(
-        lambda spec: NamedSharding(mesh, spec), param_specs(CONFIG),
-        is_leaf=lambda x: isinstance(x, P),
-    )
-    sh_params = jax.device_put(params, shardings)
+    sh_params = _shard(mesh, param_specs(CONFIG), params)
     sh_tokens = jax.device_put(
         tokens, NamedSharding(mesh, P(("data", "fsdp"), None))
     )
@@ -66,20 +93,53 @@ def test_sharded_decode_matches_unsharded(mesh):
         lambda p, t: prefill(p, t, CONFIG, MAX_LEN),
         out_shardings=(logits_sh, cache_sh),
     )
-    logits, cache = pre(sh_params, sh_tokens[:, :PROMPT - 2])
-    np.testing.assert_allclose(
-        np.asarray(logits), np.asarray(ref[:, PROMPT - 3]),
-        rtol=2e-4, atol=2e-4,
-    )
-    assert cache.k.sharding.spec == cache_specs().k
-
     step = jax.jit(
         lambda p, tok, c: decode_step(p, tok, c, CONFIG),
         out_shardings=(logits_sh, cache_sh),
     )
-    for i in range(PROMPT - 2, PROMPT):
-        logits, cache = step(sh_params, sh_tokens[:, i], cache)
-        np.testing.assert_allclose(
-            np.asarray(logits), np.asarray(ref[:, i]),
-            rtol=2e-4, atol=2e-4,
-        )
+    cache = _compare_prefill_and_decode(pre, step, sh_params, sh_tokens, ref)
+    assert cache.k.sharding.spec == cache_specs().k
+
+
+def test_ep_sharded_moe_decode_matches_unsharded():
+    """MoE serving over an expert x fsdp x tensor mesh: the dispatch rides
+    the expert axis (with_sharding_constraint in _moe_block) and decode
+    numerics match the unsharded model."""
+    from k8s_dra_driver_tpu.models.moe import (
+        MOE_PRESETS,
+        forward as moe_forward,
+        init_params as moe_init,
+        param_specs as moe_specs,
+    )
+
+    _need_8_devices()
+    mesh = Mesh(
+        np.array(jax.devices()[:8]).reshape(1, 2, 2, 2),
+        ("data", "expert", "fsdp", "tensor"),
+    )
+    cfg = dataclasses.replace(MOE_PRESETS["tiny-moe"], capacity_factor=8.0)
+    params = moe_init(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(2), (BATCH, PROMPT), 0, cfg.vocab_size
+    )
+    ref, _ = moe_forward(params, tokens, cfg)
+
+    sh_params = _shard(mesh, moe_specs(cfg), params)
+    sh_tokens = jax.device_put(
+        tokens, NamedSharding(mesh, P(("data", "fsdp"), None))
+    )
+    # Numerics can't pin a sharding constraint (it changes placement, not
+    # values): assert the expert-axis dispatch constraint is actually in
+    # the traced program, and absent without a mesh.
+    jaxpr_with = str(jax.make_jaxpr(
+        lambda p, t: prefill(p, t, cfg, MAX_LEN, mesh=mesh)
+    )(params, tokens[:, :PROMPT - 2]))
+    jaxpr_without = str(jax.make_jaxpr(
+        lambda p, t: prefill(p, t, cfg, MAX_LEN)
+    )(params, tokens[:, :PROMPT - 2]))
+    assert "sharding_constraint" in jaxpr_with
+    assert "sharding_constraint" not in jaxpr_without
+
+    pre = jax.jit(lambda p, t: prefill(p, t, cfg, MAX_LEN, mesh=mesh))
+    step = jax.jit(lambda p, tok, c: decode_step(p, tok, c, cfg, mesh=mesh))
+    _compare_prefill_and_decode(pre, step, sh_params, sh_tokens, ref)
