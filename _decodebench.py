@@ -21,12 +21,19 @@ from k8s_dra_driver_tpu.models.quant import quantize_params
 # The 1b preset's generate program takes >15 min in the remote compiler
 # (while_loop + layer scan + 128k-vocab head in one program); 160m keeps
 # the tool usable (~2 min/program) and the per-step roofline comparison
-# is the same shape.
-PRESET = "160m"
+# is the same shape. Knobs: TPU_DRA_DECODE_PRESET (e.g. 160m-gqa),
+# TPU_DRA_DECODE_PROMPT (long-context cache costs), TPU_DRA_DECODE_QUANT
+# ("int8" = weights, "int8-kv" = KV cache, "int8,int8-kv" = both).
+PRESET = os.environ.get("TPU_DRA_DECODE_PRESET", "160m")
 BATCH = 8
-PROMPT = 128
+PROMPT = int(os.environ.get("TPU_DRA_DECODE_PROMPT", "128"))
 N = 96
-QUANT = os.environ.get("TPU_DRA_DECODE_QUANT", "") == "int8"
+_quant_modes = set(
+    m.strip() for m in os.environ.get("TPU_DRA_DECODE_QUANT", "").split(",")
+    if m.strip()
+)
+QUANT = "int8" in _quant_modes
+QUANT_KV = "int8-kv" in _quant_modes
 
 config = PRESETS[PRESET]
 params = jax.jit(lambda k: init_params(config, k))(jax.random.PRNGKey(0))
@@ -42,8 +49,12 @@ prompts = [
 jax.block_until_ready(prompts)
 
 # Both programs size their KV cache identically so prefill cost matches.
-gen = jax.jit(lambda p: generate(params, p, config, N))
-pre = jax.jit(lambda p: prefill(params, p, config, PROMPT + N))
+gen = jax.jit(
+    lambda p: generate(params, p, config, N, quantize_cache=QUANT_KV)
+)
+pre = jax.jit(
+    lambda p: prefill(params, p, config, PROMPT + N, quantize_cache=QUANT_KV)
+)
 
 
 def run(fn, prompt, out_of):
@@ -67,13 +78,25 @@ diffs = sorted(
 )
 step = diffs[1] / N  # median
 # Embedding rows are gathered, not streamed; everything else (incl. the
-# lm_head matmul) is read in full every step.
+# lm_head matmul) is read in full every step. The cache read grows with
+# the filled length; charge the mean over the measured decode span.
 streamed = config.num_params() - config.vocab_size * config.hidden
-bytes_per_param = 1 if QUANT else 2  # int8 vs bf16 (scales negligible)
-hbm_roofline_ms = streamed * bytes_per_param / 810e9 * 1e3  # / v5e HBM BW
+w_bytes = 1 if QUANT else 2  # int8 vs bf16 (scales negligible)
+mean_len = PROMPT + N / 2
+cache_elems = (
+    2 * config.n_layers * BATCH * config.n_kv_heads
+    * mean_len * config.head_dim
+)
+c_bytes = 1 if QUANT_KV else 2
+hbm_roofline_ms = (
+    (streamed * w_bytes + cache_elems * c_bytes) / 810e9 * 1e3  # v5e HBM BW
+)
+tags = "".join(
+    t for t, on in (("-int8", QUANT), ("-kvq", QUANT_KV)) if on
+)
 print(
-    f"decode {PRESET}{'-int8' if QUANT else ''} b{BATCH}: "
+    f"decode {PRESET}{tags} b{BATCH} prompt{PROMPT}: "
     f"{step*1e3:.2f} ms/step, {BATCH/step:.0f} tok/s aggregate "
-    f"(param-read roofline ~{hbm_roofline_ms:.2f} ms/step)",
+    f"(HBM roofline ~{hbm_roofline_ms:.2f} ms/step)",
     flush=True,
 )

@@ -81,6 +81,12 @@ PRESETS: dict[str, LlamaConfig] = {
         vocab_size=32000, hidden=768, n_layers=12, n_heads=12, n_kv_heads=12,
         mlp_hidden=2048, max_seq_len=2048,
     ),
+    # GQA sibling of 160m (3 q heads per kv head): serving-bench geometry
+    # for the grouped-cache contraction and the int8 KV cache.
+    "160m-gqa": LlamaConfig(
+        vocab_size=32000, hidden=768, n_layers=12, n_heads=12, n_kv_heads=4,
+        mlp_hidden=2048, max_seq_len=2048,
+    ),
     "1b": LlamaConfig(
         vocab_size=128256, hidden=2048, n_layers=16, n_heads=32, n_kv_heads=8,
         mlp_hidden=8192, max_seq_len=8192,

@@ -1,0 +1,71 @@
+"""Smoke tests for the harness gates: bench.py and __graft_entry__.
+
+The driver records BENCH_r{N}.json by running bench.py and validates the
+multi-chip story via __graft_entry__; a regression in either loses the
+round's evidence silently. These run the same entry points hermetically
+on CPU (bench auto-falls back to the tiny preset off-TPU).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestBenchSmoke:
+    def test_bench_emits_one_json_line(self):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        proc = subprocess.run(
+            [sys.executable, "bench.py"],
+            cwd=REPO_ROOT,
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=600,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+        assert len(lines) == 1, proc.stdout
+        result = json.loads(lines[0])
+        for key in ("metric", "value", "unit", "vs_baseline"):
+            assert key in result, result
+        assert result["unit"] == "mfu_fraction"
+        assert 0 < result["value"] <= 1.0
+        # Loss must be a finite number — a NaN step would still "emit one
+        # line" while measuring garbage.
+        assert result["detail"]["loss"] == result["detail"]["loss"]
+
+    def test_bench_rejects_unknown_model(self):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["TPU_DRA_BENCH_MODEL"] = "nope"
+        proc = subprocess.run(
+            [sys.executable, "bench.py"],
+            cwd=REPO_ROOT,
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 2
+
+
+class TestGraftEntry:
+    def test_entry_compiles_and_runs(self):
+        import jax
+
+        sys.path.insert(0, REPO_ROOT)
+        try:
+            import __graft_entry__ as g
+        finally:
+            sys.path.pop(0)
+        fn, args = g.entry()
+        out = jax.jit(fn)(*args)
+        assert all(
+            bool(jax.numpy.isfinite(x).all())
+            for x in jax.tree_util.tree_leaves(out)
+        )
