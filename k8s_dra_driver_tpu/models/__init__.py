@@ -32,14 +32,19 @@ __all__ = [
 
 from . import moe
 from .checkpoint import latest_step, restore_checkpoint, save_checkpoint
-from .decode import KVCache, decode_step, generate, prefill
+from .decode import KVCache, QuantKVCache, decode_step, generate, prefill
+from .quant import QuantTensor, quantize_params, quantize_specs
 
 __all__ += [
     "moe",
     "KVCache",
+    "QuantKVCache",
+    "QuantTensor",
     "prefill",
     "decode_step",
     "generate",
+    "quantize_params",
+    "quantize_specs",
     "save_checkpoint",
     "restore_checkpoint",
     "latest_step",

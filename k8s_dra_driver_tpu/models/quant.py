@@ -62,22 +62,26 @@ _DENSE_AXES = {"wqkv": 1, "wo": 1, "w_gateup": 1, "w_down": 1}
 _MOE_AXES = {"wqkv": 1, "wo": 1, "w_gateup": 2, "w_down": 2}
 
 
-def quantize_params(params: dict) -> dict:
-    """Quantize every large matmul weight of a Llama or MoE param tree.
-
-    Norm gains and MoE router weights stay float (tiny,
-    precision-critical).
-    """
-    layers = params["layers"]
+def _map_quant_tree(tree: dict, leaf_fn) -> dict:
+    """The single traversal both quantize_params and quantize_specs use:
+    apply ``leaf_fn(value, contraction_axis)`` to every weight the int8
+    path covers, so the two trees cannot structurally diverge. Norm gains
+    and MoE router weights stay untouched (tiny, precision-critical)."""
+    layers = tree["layers"]
     axes = _MOE_AXES if "wr" in layers else _DENSE_AXES
     qlayers = dict(layers)
     for name, axis in axes.items():
-        qlayers[name] = quantize_tensor(layers[name], axis)
-    out = dict(params)
+        qlayers[name] = leaf_fn(layers[name], axis)
+    out = dict(tree)
     out["layers"] = qlayers
-    out["embed"] = quantize_tensor(params["embed"], axis=1)   # per-row
-    out["lm_head"] = quantize_tensor(params["lm_head"], axis=0)
+    out["embed"] = leaf_fn(tree["embed"], 1)     # per-row
+    out["lm_head"] = leaf_fn(tree["lm_head"], 0)
     return out
+
+
+def quantize_params(params: dict) -> dict:
+    """Quantize every large matmul weight of a Llama or MoE param tree."""
+    return _map_quant_tree(params, quantize_tensor)
 
 
 # ---------------------------------------------------------------------------
@@ -108,6 +112,28 @@ def q_matmul(x: jax.Array, w) -> jax.Array:
         y = x @ w.q.astype(x.dtype)
         return (y.astype(jnp.float32) * w.scale[0]).astype(x.dtype)
     return x @ w
+
+
+def quantize_specs(specs: dict) -> dict:
+    """Map a float param-spec tree onto the quantized tree's structure.
+
+    Multi-chip int8 serving needs PartitionSpecs with the same pytree
+    shape as quantize_params' output: each quantized weight becomes a
+    QuantTensor of specs, where q keeps the weight's spec and the scale
+    (same rank, contraction axis collapsed to 1) drops that axis's
+    placement — a length-1 axis cannot be sharded. Shares
+    quantize_params' traversal, so the two trees stay congruent by
+    construction.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def one(spec, axis):
+        scale_spec = P(*[
+            None if i == axis else s for i, s in enumerate(spec)
+        ])
+        return QuantTensor(q=spec, scale=scale_spec)
+
+    return _map_quant_tree(specs, one)
 
 
 def q_dequant(w, dtype) -> jax.Array:

@@ -101,6 +101,38 @@ def test_sharded_decode_matches_unsharded():
     assert cache.k.sharding.spec == cache_specs().k
 
 
+def test_sharded_int8_decode_matches_unsharded():
+    """Multi-chip int8 serving: the quantized tree (QuantTensor leaves)
+    shards via quantize_specs and decodes to the same logits as the
+    unsharded quantized model."""
+    from k8s_dra_driver_tpu.models.quant import (
+        quantize_params,
+        quantize_specs,
+    )
+
+    _need_8_devices()
+    mesh = Mesh(
+        np.array(jax.devices()[:8]).reshape(2, 2, 2),
+        ("data", "fsdp", "tensor"),
+    )
+    qparams = quantize_params(init_params(CONFIG, jax.random.PRNGKey(0)))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(3), (BATCH, PROMPT), 0, CONFIG.vocab_size
+    )
+    ref = forward(qparams, tokens, CONFIG)
+
+    sh_params = _shard(mesh, quantize_specs(param_specs(CONFIG)), qparams)
+    assert sh_params["layers"]["wqkv"].q.sharding.spec == param_specs(
+        CONFIG
+    )["layers"]["wqkv"]
+    sh_tokens = jax.device_put(
+        tokens, NamedSharding(mesh, P(("data", "fsdp"), None))
+    )
+    pre = jax.jit(lambda p, t: prefill(p, t, CONFIG, MAX_LEN))
+    step = jax.jit(lambda p, tok, c: decode_step(p, tok, c, CONFIG))
+    _compare_prefill_and_decode(pre, step, sh_params, sh_tokens, ref)
+
+
 def test_ep_sharded_moe_decode_matches_unsharded():
     """MoE serving over an expert x fsdp x tensor mesh: the dispatch rides
     the expert axis (with_sharding_constraint in _moe_block) and decode
