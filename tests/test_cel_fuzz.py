@@ -1,0 +1,112 @@
+"""Grammar-driven fuzz of the CEL-subset engine.
+
+The engine's contract (kube/cel.py): any selector string either evaluates
+to a bool or raises CelError — no raw Python exception may escape, because
+the allocator maps CelError to "claim unallocatable" while anything else
+would kill the controller loop (the round-2 advisory bug class). A
+hand-rolled generator walks the supported grammar plus deliberate
+out-of-grammar mutations; every sample must keep the contract.
+"""
+
+import random
+
+import pytest
+
+from k8s_dra_driver_tpu.kube.cel import CelError, evaluate
+
+DRIVER = "tpu.google.com"
+
+ATTRS = {
+    "type": {"string": "chip"},
+    "generation": {"string": "v5p"},
+    "index": {"int": 2},
+    "cores": {"int": 2},
+    "coordX": {"int": 1},
+    "uuid": {"string": "TPU-abc"},
+    "healthy": {"bool": True},
+    "driverVersion": {"version": "1.2.3"},
+}
+CAPACITY = {"hbm": "95Gi", "tensorcores": "2"}
+
+ATTR_NAMES = list(ATTRS) + ["missing", "slice-id"]
+STRINGS = ['"chip"', '"v5p"', '"TPU-abc"', '""', '"x"']
+INTS = ["0", "1", "2", "-3", "95"]
+CMPS = ["==", "!=", "<", "<=", ">", ">="]
+
+
+def gen_atom(rng: random.Random, depth: int) -> str:
+    roll = rng.random()
+    if roll < 0.35:
+        name = rng.choice(ATTR_NAMES)
+        form = rng.random()
+        if form < 0.5:
+            return f'device.attributes["{DRIVER}"].{name}'
+        if form < 0.8:
+            return f'device.attributes["{DRIVER}"]["{name}"]'
+        return f'device.capacity["{DRIVER}"].{rng.choice(list(CAPACITY))}'
+    if roll < 0.5:
+        return rng.choice(STRINGS)
+    if roll < 0.65:
+        return rng.choice(INTS)
+    if roll < 0.75:
+        return rng.choice(["true", "false"])
+    if depth > 2:
+        return rng.choice(INTS)
+    return "(" + gen_expr(rng, depth + 1) + ")"
+
+
+def gen_expr(rng: random.Random, depth: int = 0) -> str:
+    roll = rng.random()
+    a = gen_atom(rng, depth)
+    if roll < 0.45:
+        return f"{a} {rng.choice(CMPS)} {gen_atom(rng, depth)}"
+    if roll < 0.65 and depth < 3:
+        return (f"{gen_expr(rng, depth + 1)} "
+                f"{rng.choice(['&&', '||'])} {gen_expr(rng, depth + 1)}")
+    if roll < 0.75:
+        return f"!({gen_expr(rng, depth + 1)})"
+    return a
+
+
+def mutate(rng: random.Random, expr: str) -> str:
+    """Push samples OUT of the grammar: truncations, garbage splices."""
+    kind = rng.random()
+    if kind < 0.3 and expr:
+        cut = rng.randrange(len(expr))
+        return expr[:cut]
+    if kind < 0.6:
+        junk = rng.choice(["@@", "0x", "def ", "||&&", '"', "].["])
+        pos = rng.randrange(len(expr) + 1)
+        return expr[:pos] + junk + expr[pos:]
+    return expr + rng.choice(["==", "&&", ".", "[", "~"])
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fuzz_contract_holds(seed):
+    rng = random.Random(seed)
+    for i in range(300):
+        expr = gen_expr(rng)
+        if i % 3 == 0:
+            expr = mutate(rng, expr)
+        try:
+            out = evaluate(expr, DRIVER, ATTRS, CAPACITY)
+        except CelError:
+            continue  # rejecting is fine; HOW it rejects is the contract
+        assert isinstance(out, bool), (expr, out)
+
+
+def test_known_type_mismatches_stay_in_contract():
+    """The advisory's exact bug class: comparisons across types must not
+    leak TypeError."""
+    cases = [
+        f'device.attributes["{DRIVER}"].uuid >= 16',
+        f'device.capacity["{DRIVER}"].hbm >= 16',
+        f'device.attributes["{DRIVER}"].index == "two" && true',
+        f'!(device.attributes["{DRIVER}"].healthy >= "yes")',
+    ]
+    for expr in cases:
+        try:
+            out = evaluate(expr, DRIVER, ATTRS, CAPACITY)
+            assert isinstance(out, bool), expr
+        except CelError:
+            pass

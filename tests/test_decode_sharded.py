@@ -128,9 +128,24 @@ def test_sharded_int8_decode_matches_unsharded():
     sh_tokens = jax.device_put(
         tokens, NamedSharding(mesh, P(("data", "fsdp"), None))
     )
-    pre = jax.jit(lambda p, t: prefill(p, t, CONFIG, MAX_LEN))
-    step = jax.jit(lambda p, tok, c: decode_step(p, tok, c, CONFIG))
-    _compare_prefill_and_decode(pre, step, sh_params, sh_tokens, ref)
+    # Pin the serving LAYOUT, not just values: without out_shardings XLA
+    # may resolve the cache/logits to a replicated placement and the
+    # numerics comparison would still pass.
+    cache_sh = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), cache_specs(),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    logits_sh = NamedSharding(mesh, P(("data", "fsdp"), None))
+    pre = jax.jit(
+        lambda p, t: prefill(p, t, CONFIG, MAX_LEN),
+        out_shardings=(logits_sh, cache_sh),
+    )
+    step = jax.jit(
+        lambda p, tok, c: decode_step(p, tok, c, CONFIG),
+        out_shardings=(logits_sh, cache_sh),
+    )
+    cache = _compare_prefill_and_decode(pre, step, sh_params, sh_tokens, ref)
+    assert cache.k.sharding.spec == cache_specs().k
 
 
 def test_ep_sharded_moe_decode_matches_unsharded():

@@ -159,48 +159,137 @@ class TestLaunchEnvInjection:
 
 class TestTwoProcessBootstrap:
     def test_gang_claim_forms_jax_cluster(self, tmp_path, monkeypatch):
-        port = _free_port()
-        # ici-channel-3 is claimed below: pick the base so base+3 == port.
-        monkeypatch.setenv("TPU_DRA_COORDINATOR_BASE_PORT", str(port - 3))
-        hostnames = ["127.0.0.1", "127.0.0.1"]
-
-        worker_py = tmp_path / "worker.py"
-        worker_py.write_text(WORKER_SRC)
-
-        procs = []
-        for host_id in (0, 1):
-            claim_env = _prepare_host_env(tmp_path, host_id, hostnames)
-            env = dict(os.environ)
-            # The claim spec's env IS the pod env (CDI merge).
-            env.update(claim_env)
-            env["PYTHONPATH"] = REPO_ROOT
-            # The harness may preset a hardware platform / virtual-device
-            # flags; the worker pins its own hermetic platform.
-            env.pop("JAX_PLATFORMS", None)
-            env.pop("XLA_FLAGS", None)
-            procs.append(
-                subprocess.Popen(
-                    [sys.executable, str(worker_py)],
-                    env=env,
-                    cwd=REPO_ROOT,
-                    stdout=subprocess.PIPE,
-                    stderr=subprocess.PIPE,
-                    text=True,
-                )
-            )
-
-        outs = []
-        try:
-            for p in procs:
-                out, err = p.communicate(timeout=150)
-                outs.append((p.returncode, out, err))
-        finally:
-            for p in procs:
-                if p.poll() is None:
-                    p.kill()
-
+        outs = _run_gang_workers(tmp_path, monkeypatch, WORKER_SRC)
         for rc, out, err in outs:
             assert rc == 0, f"worker failed:\n{out}\n{err}"
             # Two processes, one device each; sum over the global array is
             # 4*1 (worker 0's shard) + 4*2 (worker 1's) = 12.
             assert "RESULT 2 12.0" in out, f"unexpected output:\n{out}\n{err}"
+
+
+MODEL_WORKER_SRC = """
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 1)
+
+from k8s_dra_driver_tpu.parallel.distributed import initialize_distributed
+
+assert initialize_distributed()
+
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from k8s_dra_driver_tpu.models.decode import decode_step, prefill
+from k8s_dra_driver_tpu.models.llama import PRESETS, init_params
+
+cfg = PRESETS["tiny"]
+params = init_params(cfg, jax.random.PRNGKey(0))  # same seed on all hosts
+tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, cfg.vocab_size)
+
+mesh = Mesh(np.array(jax.devices()), ("data",))
+pid = jax.process_index()
+# Batch row `pid` lives on this host: the dp-sharded serving layout.
+local = jax.device_put(tokens[pid:pid + 1], jax.local_devices()[0])
+sh_tokens = jax.make_array_from_single_device_arrays(
+    (2, 8), NamedSharding(mesh, P("data", None)), [local]
+)
+rep = NamedSharding(mesh, P())
+sh_params = jax.device_put(params, jax.tree.map(lambda _: rep, params))
+
+logits_sh = NamedSharding(mesh, P("data", None))
+pre = jax.jit(lambda p, t: prefill(p, t, cfg, 12),
+              out_shardings=(logits_sh, None))
+logits, cache = pre(sh_params, sh_tokens[:, :7])
+logits, cache = jax.jit(
+    lambda p, tok, c: decode_step(p, tok, c, cfg),
+    out_shardings=(logits_sh, None),
+)(sh_params, sh_tokens[:, 7], cache)
+# Each host reports ITS batch row with a row-discriminating statistic
+# (argmax + a raw logit) so a swapped shard-to-row mapping cannot pass.
+mine = np.asarray(logits.addressable_data(0))[0]
+print("LOGITS", pid, int(mine.argmax()), float(mine[0]), flush=True)
+"""
+
+
+def _run_gang_workers(tmp_path, monkeypatch, worker_src: str):
+    """Prepare the two-host gang claim, launch one REAL subprocess per
+    host with exactly the claim-spec env, and return [(rc, out, err)]."""
+    port = _free_port()
+    # ici-channel-3 is claimed by _make_claim: pick base so base+3 == port.
+    monkeypatch.setenv("TPU_DRA_COORDINATOR_BASE_PORT", str(port - 3))
+    hostnames = ["127.0.0.1", "127.0.0.1"]
+    worker_py = tmp_path / "worker.py"
+    worker_py.write_text(worker_src)
+
+    procs = []
+    for host_id in (0, 1):
+        claim_env = _prepare_host_env(tmp_path, host_id, hostnames)
+        env = dict(os.environ)
+        # The claim spec's env IS the pod env (CDI merge).
+        env.update(claim_env)
+        env["PYTHONPATH"] = REPO_ROOT
+        # The harness may preset a hardware platform / virtual-device
+        # flags; the worker pins its own hermetic platform.
+        env.pop("JAX_PLATFORMS", None)
+        env.pop("XLA_FLAGS", None)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(worker_py)],
+                env=env, cwd=REPO_ROOT,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=150)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return outs
+
+
+class TestTwoProcessServing:
+    def test_dp_sharded_decode_across_hosts(self, tmp_path, monkeypatch):
+        """Actual model serving over the driver-bootstrapped cluster: the
+        tiny Llama decodes with the batch dp-sharded across two REAL
+        processes; each host's logits row must match the single-process
+        reference."""
+        import jax
+
+        from k8s_dra_driver_tpu.models.decode import decode_step, prefill
+        from k8s_dra_driver_tpu.models.llama import PRESETS, init_params
+
+        # Single-process reference with the same seeds the workers use.
+        cfg = PRESETS["tiny"]
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (2, 8), 0, cfg.vocab_size
+        )
+        logits, cache = prefill(params, tokens[:, :7], cfg, 12)
+        logits, _ = decode_step(params, tokens[:, 7], cache, cfg)
+        import numpy as np
+
+        ref = np.asarray(logits)
+        # Per-row argmax + a raw logit: discriminates the rows, so a
+        # swapped shard-to-row mapping cannot sneak past the tolerance.
+        want = {
+            i: (int(ref[i].argmax()), float(ref[i][0])) for i in (0, 1)
+        }
+
+        outs = _run_gang_workers(tmp_path, monkeypatch, MODEL_WORKER_SRC)
+
+        got = {}
+        for rc, out, err in outs:
+            assert rc == 0, f"worker failed:\n{out}\n{err}"
+            for line in out.splitlines():
+                if line.startswith("LOGITS"):
+                    _, pid, amax, val = line.split()
+                    got[int(pid)] = (int(amax), float(val))
+        assert sorted(got) == [0, 1], outs
+        for pid in (0, 1):
+            assert got[pid][0] == want[pid][0], (pid, got, want)
+            assert abs(got[pid][1] - want[pid][1]) < 1e-3, (pid, got, want)
