@@ -50,7 +50,15 @@ def initialize_distributed(
 
     apply_sharing_env()
 
-    import jax
+    try:
+        import jax
+    except ImportError:
+        # A jax-less container (e.g. the driver image running a claim
+        # plumbing check) still gets the sharing env applied above; there
+        # is no backend to wire, so this is a clean single-process no-op.
+        logger.info("jax not importable; sharing env applied, "
+                    "skipping jax.distributed")
+        return False
 
     coordinator = coordinator or coordinator_from_env()
     if num_processes is None:

@@ -1,0 +1,147 @@
+"""Allocator at pod-slice scale: a 64-chip v5p 4x4x4 slice over 16 hosts.
+
+The hermetic sim runs 2 hosts; this pins that the reference allocator's
+backtracking stays tractable and correct at the scale a real v5p-128
+(64 chips) slice publishes: 64 chips + 128 core partitions + counter
+sets across 16 node pools. Guards against pathological backtracking
+(a bounded wall-clock budget) and against contiguity/counter bugs that
+only appear off the toy topology.
+"""
+
+import time
+
+import pytest
+
+from k8s_dra_driver_tpu.kube import NODES, FakeKubeClient
+from k8s_dra_driver_tpu.kube.allocator import (
+    AllocationError,
+    ReferenceAllocator,
+)
+from k8s_dra_driver_tpu.kube.resourceslice import (
+    DriverResources,
+    Pool,
+    ResourceSliceController,
+)
+from k8s_dra_driver_tpu.tpulib import FakeChipLib
+
+DRIVER = "tpu.google.com"
+HOSTS = 16
+TOPOLOGY = "4x4x4"  # 64 chips, 4 per host
+
+
+def publish_cluster(client):
+    from k8s_dra_driver_tpu.tpulib.deviceinfo import counter_sets
+
+    for h in range(HOSTS):
+        node = f"node-{h:02d}"
+        client.create(NODES, {"metadata": {"name": node, "uid": f"u-{h}"}})
+        lib = FakeChipLib(
+            generation="v5p",
+            topology=TOPOLOGY,
+            host_id=h,
+            hosts_per_slice=HOSTS,
+            slice_id="big-slice",
+        )
+        devices = []
+        allocatable = lib.enumerate_all_possible_devices(
+            {"chip", "tensorcore"}
+        )
+        for name, dev in sorted(allocatable.items()):
+            devices.append(dev.get_device())
+        ctrl = ResourceSliceController(
+            client,
+            DRIVER,
+            scope=node,
+            owner={"kind": "Node", "name": node, "uid": f"u-{h}"},
+        )
+        ctrl.update(DriverResources(pools={
+            node: Pool(
+                devices=devices,
+                shared_counters=counter_sets(allocatable),
+                node_name=node,
+            )
+        }))
+        ctrl.sync_once()
+
+
+def gang_claim(uid, n, match=None):
+    reqs = [
+        {"name": f"chip-{i}", "deviceClassName": "tpu.google.com"}
+        for i in range(n)
+    ]
+    constraints = (
+        [{"requests": [r["name"] for r in reqs], "matchAttribute": match}]
+        if match else []
+    )
+    return {
+        "metadata": {"name": f"claim-{uid}", "namespace": "scale",
+                     "uid": uid},
+        "spec": {"devices": {"requests": reqs,
+                             "constraints": constraints}},
+    }
+
+
+class TestAllocatorScale:
+    def test_fill_the_slice_with_2x2_gangs(self):
+        """16 gang claims of 2x2 tiles exactly fill the 64-chip slice;
+        the 17th must fail. Whole run bounded to keep backtracking
+        honest."""
+        client = FakeKubeClient()
+        publish_cluster(client)
+        alloc = ReferenceAllocator(client, driver_name=DRIVER)
+
+        t0 = time.monotonic()
+        granted = []
+        for i in range(16):
+            claim = gang_claim(
+                f"uid-{i:02d}", 4, match="tpu.google.com/submesh2x2Id"
+            )
+            alloc.allocate(claim)
+            results = claim["status"]["allocation"]["devices"]["results"]
+            assert len(results) == 4
+            granted.append({(r["pool"], r["device"]) for r in results})
+        elapsed = time.monotonic() - t0
+        assert elapsed < 60, f"allocator pathologically slow: {elapsed:.1f}s"
+
+        # All 64 chips distinct across the 16 gangs.
+        all_devs = set().union(*granted)
+        assert len(all_devs) == 64
+
+        with pytest.raises(AllocationError):
+            alloc.allocate(gang_claim(
+                "uid-overflow", 4, match="tpu.google.com/submesh2x2Id"
+            ))
+
+    def test_core_counters_hold_at_scale(self):
+        """Claiming every chip whole leaves no core partition grantable
+        anywhere in the 16-pool inventory (counter sets at scale)."""
+        client = FakeKubeClient()
+        publish_cluster(client)
+        alloc = ReferenceAllocator(client, driver_name=DRIVER)
+        for i in range(8):
+            alloc.allocate(gang_claim(f"uid-w{i}", 8))
+        core_claim = {
+            "metadata": {"name": "core", "namespace": "scale",
+                         "uid": "uid-core"},
+            "spec": {"devices": {"requests": [{
+                "name": "core",
+                "deviceClassName": "tensorcore.tpu.google.com",
+            }]}},
+        }
+        with pytest.raises(AllocationError):
+            alloc.allocate(core_claim)
+
+    def test_release_reopens_capacity(self):
+        client = FakeKubeClient()
+        publish_cluster(client)
+        alloc = ReferenceAllocator(client, driver_name=DRIVER)
+        for i in range(16):
+            alloc.allocate(gang_claim(f"uid-{i:02d}", 4))
+        with_hole = gang_claim("uid-again", 4)
+        with pytest.raises(AllocationError):
+            alloc.allocate(with_hole)
+        alloc.deallocate("uid-07")
+        alloc.allocate(with_hole)
+        assert len(
+            with_hole["status"]["allocation"]["devices"]["results"]
+        ) == 4
