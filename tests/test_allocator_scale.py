@@ -112,6 +112,31 @@ class TestAllocatorScale:
                 "uid-overflow", 4, match="tpu.google.com/submesh2x2Id"
             ))
 
+    def test_4x4_submesh_gang(self):
+        """BASELINE.md's headline gang: a contiguous 4x4 v5p sub-mesh (16
+        chips) via the submesh4x4Id tile attribute, allocated whole from
+        the 4x4x4 slice; four of them drain the slice."""
+        client = FakeKubeClient()
+        publish_cluster(client)
+        alloc = ReferenceAllocator(client, driver_name=DRIVER)
+        granted = []
+        t0 = time.monotonic()
+        for i in range(4):
+            claim = gang_claim(
+                f"uid-4x4-{i}", 16, match="tpu.google.com/submesh4x4Id"
+            )
+            alloc.allocate(claim)
+            results = claim["status"]["allocation"]["devices"]["results"]
+            assert len(results) == 16
+            granted.append({(r["pool"], r["device"]) for r in results})
+        elapsed = time.monotonic() - t0
+        assert elapsed < 60, f"allocator pathologically slow: {elapsed:.1f}s"
+        assert len(set().union(*granted)) == 64
+        with pytest.raises(AllocationError):
+            alloc.allocate(gang_claim(
+                "uid-4x4-over", 16, match="tpu.google.com/submesh4x4Id"
+            ))
+
     def test_core_counters_hold_at_scale(self):
         """Claiming every chip whole leaves no core partition grantable
         anywhere in the 16-pool inventory (counter sets at scale)."""

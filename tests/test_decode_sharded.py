@@ -190,3 +190,48 @@ def test_ep_sharded_moe_decode_matches_unsharded():
     pre = jax.jit(lambda p, t: prefill(p, t, cfg, MAX_LEN, mesh=mesh))
     step = jax.jit(lambda p, tok, c: decode_step(p, tok, c, cfg, mesh=mesh))
     _compare_prefill_and_decode(pre, step, sh_params, sh_tokens, ref)
+
+
+def test_ep_sharded_int8_moe_decode_matches_unsharded():
+    """The full composition: int8 MoE expert stacks sharded over the
+    expert axis, decoding to the unsharded quantized model's logits."""
+    from k8s_dra_driver_tpu.models.moe import (
+        MOE_PRESETS,
+        forward as moe_forward,
+        init_params as moe_init,
+        param_specs as moe_specs,
+    )
+    from k8s_dra_driver_tpu.models.quant import (
+        QuantTensor,
+        quantize_params,
+        quantize_specs,
+    )
+
+    _need_8_devices()
+    mesh = Mesh(
+        np.array(jax.devices()[:8]).reshape(1, 2, 2, 2),
+        ("data", "expert", "fsdp", "tensor"),
+    )
+    cfg = dataclasses.replace(MOE_PRESETS["tiny-moe"], capacity_factor=8.0)
+    qparams = quantize_params(moe_init(cfg, jax.random.PRNGKey(0)))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(4), (BATCH, PROMPT), 0, cfg.vocab_size
+    )
+    ref, _ = moe_forward(qparams, tokens, cfg)
+
+    sh_params = _shard(mesh, quantize_specs(moe_specs(cfg)), qparams)
+    gate = sh_params["layers"]["w_gateup"]
+    assert isinstance(gate, QuantTensor)
+    assert gate.q.sharding.spec == moe_specs(cfg)["layers"]["w_gateup"]
+    sh_tokens = jax.device_put(
+        tokens, NamedSharding(mesh, P(("data", "fsdp"), None))
+    )
+    # The expert dispatch constraint must survive the quantized path too
+    # (numerics cannot pin it — same rationale as the float ep test).
+    jaxpr = str(jax.make_jaxpr(
+        lambda p, t: prefill(p, t, cfg, MAX_LEN, mesh=mesh)
+    )(qparams, tokens[:, :PROMPT - 2]))
+    assert "sharding_constraint" in jaxpr
+    pre = jax.jit(lambda p, t: prefill(p, t, cfg, MAX_LEN, mesh=mesh))
+    step = jax.jit(lambda p, tok, c: decode_step(p, tok, c, cfg, mesh=mesh))
+    _compare_prefill_and_decode(pre, step, sh_params, sh_tokens, ref)
