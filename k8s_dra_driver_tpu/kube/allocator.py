@@ -219,21 +219,26 @@ class ReferenceAllocator:
         requests = spec.get("requests", [])
         constraints = spec.get("constraints", [])
         selectors = selectors or {}
+        # adminAccess requests "ignore all ordinary claims with respect to
+        # access modes and any resource allocations" (types.go:448-456):
+        # they may land on reserved devices and neither reserve nor consume
+        # counters themselves.
+        admin_reqs = {r["name"] for r in requests if r.get("adminAccess")}
         with self._lock:
             devices, capacity = self._inventory()
             inventory = [
                 d
                 for d in devices
-                if (d["pool"], d["name"]) not in self._reservations
-                and (not node_name or not d["node"] or d["node"] == node_name)
+                if (not node_name or not d["node"] or d["node"] == node_name)
             ]
             results, picked_devs = self._solve(
                 requests, constraints, selectors, inventory, capacity
             )
             uid = claim["metadata"]["uid"]
-            for r in results:
+            for r, d in zip(results, picked_devs):
+                if r["request"] in admin_reqs:
+                    continue
                 self._reservations[(r["pool"], r["device"])] = uid
-            for d in picked_devs:
                 for pool, cset, cname, amount in _consumption_entries(d):
                     self._consumed[(pool, cset, cname)] = (
                         self._consumed.get((pool, cset, cname), 0) + amount
@@ -275,8 +280,6 @@ class ReferenceAllocator:
         tentative: dict[tuple[str, str, str], int] = {}
 
         def counters_fit(dev) -> bool:
-            if dev.get("invalid"):
-                return False  # flagged (and logged) once by _inventory
             for pool, cset, cname, amount in _consumption_entries(dev):
                 key = (pool, cset, cname)
                 cap = capacity.get(key)
@@ -326,8 +329,15 @@ class ReferenceAllocator:
                 for s in req.get("selectors", [])
                 if "cel" in s
             ]
+            admin = req.get("adminAccess", False)
             out = []
             for d in inventory:
+                # Ordinary requests never see reserved devices; admin
+                # requests observe them (monitoring over live workloads).
+                if not admin and (
+                    (d["pool"], d["name"]) in self._reservations
+                ):
+                    continue
                 if not class_matches(req.get("deviceClassName", ""), d):
                     continue
                 if not all(
@@ -358,6 +368,7 @@ class ReferenceAllocator:
                 return True
             req = requests[ri]
             count = req.get("count", 1)
+            admin = req.get("adminAccess", False)
             cands = [
                 d for d in candidates(req)
                 if not any(d is p for _, p in picked)
@@ -365,7 +376,9 @@ class ReferenceAllocator:
 
             def pick_n(chosen: list) -> bool:
                 if len(chosen) == count:
-                    if not _gang_contiguous(chosen):
+                    # Contiguity is a WORKLOAD constraint (ICI collectives);
+                    # admin picks observe, so fragmented sets are fine.
+                    if not admin and not _gang_contiguous(chosen):
                         return False
                     for d in chosen:
                         picked.append((req["name"], d))
@@ -380,16 +393,21 @@ class ReferenceAllocator:
                         continue
                     if not consistent(req["name"], d):
                         continue
-                    if not counters_fit(d):
+                    if d.get("invalid"):
+                        continue  # misconfigured slice: unusable either way
+                    # Admin picks consume nothing, so counters are moot.
+                    if not admin and not counters_fit(d):
                         continue
                     chosen.append(d)
-                    consume(d)
+                    if not admin:
+                        consume(d)
                     # Intra-request matchAttribute consistency.
                     if self._group_ok(
                         req["name"], chosen, match_groups
                     ) and pick_n(chosen):
                         return True
-                    unconsume(d)
+                    if not admin:
+                        unconsume(d)
                     chosen.pop()
                 return False
 

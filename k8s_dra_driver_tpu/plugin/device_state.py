@@ -191,13 +191,29 @@ class DeviceState:
             )
         configs = self.get_opaque_device_configs(claim)
 
+        # adminAccess requests (claim spec, types.go:448-456) get device
+        # access WITHOUT sharing acquisition: a monitoring pod must not
+        # conflict with — or evict — the workload holding the chip.
+        admin_reqs = {
+            r["name"]
+            for r in (
+                (claim.get("spec", {}).get("devices", {}) or {})
+                .get("requests") or []
+            )
+            if r.get("adminAccess")
+        }
+
         # Group allocation results by their resolved config instance.
         grouped: dict[int, tuple[OpaqueDeviceConfig, list[tuple[str, AllocatableDevice]]]] = {}
+        admin_members: list[tuple[str, AllocatableDevice]] = []
         for r in results:
             name = r["device"]
             dev = self.allocatable.get(name)
             if dev is None:
                 raise PrepareError(f"allocated device {name!r} is not allocatable here")
+            if r.get("request", "") in admin_reqs:
+                admin_members.append((r.get("request", ""), dev))
+                continue
             cfg = self._resolve_config(configs, r.get("request", ""), dev.type())
             key = id(cfg)
             grouped.setdefault(key, (cfg, []))[1].append((r.get("request", ""), dev))
@@ -229,25 +245,43 @@ class DeviceState:
                         claim_device_edits[name] = per_dev
                         cdi_ids.append(self.cdi.get_claim_device(claim_uid, name))
                     prepared_devices.append(
-                        PreparedDevice(
-                            type=dev.type(),
-                            name=name,
-                            uuids=dev.impl.uuids(),
-                            kubelet_device=KubeletDevice(
-                                request_names=[request] if request else [],
-                                pool_name=self.pool_name,
-                                device_name=name,
-                                cdi_device_ids=cdi_ids,
+                        self._make_prepared_device(
+                            request, dev, cdi_ids,
+                            channel_path=group_edits.channel_paths.get(
+                                name, ""
                             ),
-                            chip_index=(dev.chip.index if dev.chip else
-                                        dev.tensorcore.parent.index if dev.tensorcore else None),
-                            core_index=(dev.tensorcore.core_index if dev.tensorcore else None),
-                            channel=(dev.ici_channel.channel if dev.ici_channel else None),
-                            channel_path=group_edits.channel_paths.get(name, ""),
                         )
                     )
                 groups.append(
                     PreparedDeviceGroup(devices=prepared_devices, config=config.to_dict())
+                )
+
+            if admin_members:
+                # No sharing acquisition, no opaque config: device access +
+                # an env marker so the pod-side tooling knows it observes.
+                # Strategy "" in the recorded config makes unprepare a
+                # no-op release (_config_strategy).
+                admin_devices = []
+                for request, dev in admin_members:
+                    name = dev.canonical_name()
+                    cdi_ids = [self.cdi.get_standard_device(name)]
+                    admin_edit = ContainerEdits(env={"TPU_DRA_ADMIN": "1"})
+                    existing = claim_device_edits.get(name)
+                    # The same device may carry a workload group's edits
+                    # (admin ignores ordinary allocations): merge, never
+                    # clobber the workload's sharing env/mounts.
+                    claim_device_edits[name] = (
+                        existing.merge(admin_edit) if existing else admin_edit
+                    )
+                    cdi_ids.append(self.cdi.get_claim_device(claim_uid, name))
+                    admin_devices.append(
+                        self._make_prepared_device(request, dev, cdi_ids)
+                    )
+                groups.append(
+                    PreparedDeviceGroup(
+                        devices=admin_devices,
+                        config={"adminAccess": True},
+                    )
                 )
 
             # Visibility env over the WHOLE claim (all groups), so multi-group
@@ -255,7 +289,9 @@ class DeviceState:
             # if the claim-spec write fails (e.g. disk full) the sharing
             # acquisitions above must be rolled back too, or they leak —
             # the claim is never checkpointed, so unprepare would no-op.
-            all_devices = [d for _, (_, ms) in grouped.items() for _, d in ms]
+            all_devices = [
+                d for _, (_, ms) in grouped.items() for _, d in ms
+            ] + [d for _, d in admin_members]
             common_env = claim_visibility_env(
                 [d.chip for d in all_devices if d.chip is not None],
                 [d.tensorcore for d in all_devices if d.tensorcore is not None],
@@ -298,6 +334,35 @@ class DeviceState:
             namespace=claim["metadata"].get("namespace", ""),
             name=claim["metadata"].get("name", ""),
             groups=groups,
+        )
+
+    def _make_prepared_device(
+        self,
+        request: str,
+        dev: AllocatableDevice,
+        cdi_ids: list[str],
+        channel_path: str = "",
+    ) -> PreparedDevice:
+        """One PreparedDevice record (shared by the ordinary and admin
+        group builders, so their wiring cannot drift)."""
+        name = dev.canonical_name()
+        return PreparedDevice(
+            type=dev.type(),
+            name=name,
+            uuids=dev.impl.uuids(),
+            kubelet_device=KubeletDevice(
+                request_names=[request] if request else [],
+                pool_name=self.pool_name,
+                device_name=name,
+                cdi_device_ids=cdi_ids,
+            ),
+            chip_index=(dev.chip.index if dev.chip else
+                        dev.tensorcore.parent.index if dev.tensorcore
+                        else None),
+            core_index=(dev.tensorcore.core_index if dev.tensorcore
+                        else None),
+            channel=(dev.ici_channel.channel if dev.ici_channel else None),
+            channel_path=channel_path,
         )
 
     class _GroupEdits:

@@ -1,0 +1,167 @@
+"""adminAccess claims: monitoring access that ignores ordinary claims.
+
+resource.k8s.io semantics (v1alpha3 types.go:448-456): an adminAccess
+request "ignores all ordinary claims to the device with respect to
+access modes and any resource allocations". Pins both halves:
+
+- allocator: an admin request lands on a reserved device, consumes no
+  counters, and never blocks ordinary claims;
+- prepare: the admin pod gets device access + TPU_DRA_ADMIN without a
+  sharing acquisition, so it cannot conflict with (or on unprepare,
+  release) the workload's exclusive hold.
+"""
+
+import json
+
+import pytest
+
+from k8s_dra_driver_tpu.cdi import CDIHandler
+from k8s_dra_driver_tpu.kube import NODES, FakeKubeClient
+from k8s_dra_driver_tpu.kube.allocator import (
+    AllocationError,
+    ReferenceAllocator,
+)
+from k8s_dra_driver_tpu.kube.resourceslice import (
+    DriverResources,
+    Pool,
+    ResourceSliceController,
+)
+from k8s_dra_driver_tpu.plugin.checkpoint import CheckpointManager
+from k8s_dra_driver_tpu.plugin.device_state import DeviceState
+from k8s_dra_driver_tpu.tpulib import FakeChipLib
+from k8s_dra_driver_tpu.tpulib.deviceinfo import counter_sets
+
+DRIVER = "tpu.google.com"
+
+
+def publish_node(client, lib, node="node-a"):
+    client.create(NODES, {"metadata": {"name": node, "uid": "u-1"}})
+    allocatable = lib.enumerate_all_possible_devices({"chip", "tensorcore"})
+    ctrl = ResourceSliceController(
+        client, DRIVER, scope=node,
+        owner={"kind": "Node", "name": node, "uid": "u-1"},
+    )
+    ctrl.update(DriverResources(pools={
+        node: Pool(
+            devices=[d.get_device() for d in allocatable.values()],
+            shared_counters=counter_sets(allocatable),
+            node_name=node,
+        )
+    }))
+    ctrl.sync_once()
+
+
+def chip_claim(uid, admin=False, count=1):
+    req = {"name": "req-0", "deviceClassName": "tpu.google.com",
+           "count": count}
+    if admin:
+        req["adminAccess"] = True
+    return {
+        "metadata": {"name": f"c-{uid}", "namespace": "ns", "uid": uid},
+        "spec": {"devices": {"requests": [req]}},
+    }
+
+
+class TestAllocatorAdminAccess:
+    def make(self):
+        client = FakeKubeClient()
+        publish_node(
+            client, FakeChipLib(generation="v5e", topology="2x1x1")
+        )
+        return ReferenceAllocator(client, driver_name=DRIVER)
+
+    def test_admin_lands_on_reserved_device(self):
+        alloc = self.make()
+        for i in range(2):  # both chips taken by workloads
+            alloc.allocate(chip_claim(f"uid-w{i}"))
+        with pytest.raises(AllocationError):
+            alloc.allocate(chip_claim("uid-w2"))
+        admin = chip_claim("uid-admin", admin=True, count=2)
+        alloc.allocate(admin)
+        results = admin["status"]["allocation"]["devices"]["results"]
+        assert {r["device"] for r in results} == {"tpu-0", "tpu-1"}
+
+    def test_admin_ignores_contiguity(self):
+        """Fleet monitoring observes arbitrary chip sets: contiguity is a
+        workload (ICI collective) constraint, not an admin one."""
+        client = FakeKubeClient()
+        # Two separate 2-chip slices: no 4-chip set is ICI-contiguous.
+        for node, sid in (("node-a", "s1"), ("node-b", "s2")):
+            lib = FakeChipLib(
+                generation="v5e", topology="2x1x1", slice_id=sid
+            )
+            publish_node(client, lib, node=node)
+        alloc = ReferenceAllocator(client, driver_name=DRIVER)
+        with pytest.raises(AllocationError):
+            alloc.allocate(chip_claim("uid-gang", count=4))
+        admin = chip_claim("uid-admin", admin=True, count=4)
+        alloc.allocate(admin)
+        assert len(
+            admin["status"]["allocation"]["devices"]["results"]
+        ) == 4
+
+    def test_admin_consumes_nothing(self):
+        alloc = self.make()
+        alloc.allocate(chip_claim("uid-admin", admin=True, count=2))
+        # Every chip (and its cores, via counters) is still free for
+        # ordinary claims afterwards.
+        for i in range(2):
+            alloc.allocate(chip_claim(f"uid-w{i}"))
+        alloc.deallocate("uid-admin")  # no reservations to leak either
+
+
+class TestPrepareAdminAccess:
+    def test_admin_prepare_skips_sharing_and_coexists(self, tmp_path):
+        lib = FakeChipLib(generation="v5p", topology="2x2x1")
+        state = DeviceState(
+            chiplib=lib,
+            cdi=CDIHandler(str(tmp_path / "cdi")),
+            checkpoint=CheckpointManager(str(tmp_path / "checkpoint.json")),
+            driver_name=DRIVER,
+            pool_name="node-a",
+            state_dir=str(tmp_path / "state"),
+        )
+
+        def wire_claim(uid, admin):
+            c = {
+                "metadata": {"name": f"c-{uid}", "namespace": "ns",
+                             "uid": uid},
+                "spec": {"devices": {"requests": [{
+                    "name": "req-0",
+                    "deviceClassName": "tpu.google.com",
+                    **({"adminAccess": True} if admin else {}),
+                }]}},
+                "status": {"allocation": {"devices": {"results": [{
+                    "request": "req-0", "driver": DRIVER,
+                    "pool": "node-a", "device": "tpu-0",
+                }], "config": []}}},
+            }
+            return c
+
+        # Workload takes the chip exclusively; the admin claim on the SAME
+        # chip must still prepare.
+        state.prepare(wire_claim("uid-work", admin=False))
+        devices = state.prepare(wire_claim("uid-admin", admin=True))
+        assert devices[0].device_name == "tpu-0"
+
+        spec = json.loads(
+            (tmp_path / "cdi"
+             / "k8s.tpu.google.com-claim_uid-admin.json").read_text()
+        )
+        env = [
+            kv for d in spec["devices"]
+            for kv in d["containerEdits"].get("env", [])
+        ]
+        assert "TPU_DRA_ADMIN=1" in env
+
+        # Admin unprepare must NOT release the workload's exclusive hold:
+        # a second exclusive workload claim still conflicts.
+        state.unprepare("uid-admin")
+        from k8s_dra_driver_tpu.plugin.sharing import SharingError
+
+        with pytest.raises(SharingError) as exc:
+            state.prepare(wire_claim("uid-work2", admin=False))
+        assert "exclusively held" in str(exc.value)
+        # The workload's own lifecycle is untouched.
+        state.unprepare("uid-work")
+        assert state.checkpoint.read() == {}

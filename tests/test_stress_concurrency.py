@@ -106,6 +106,68 @@ class TestStress:
         ]
         assert claim_specs == [], claim_specs
 
+    def test_inventory_churn_during_prepares(self, tmp_path):
+        """refresh_allocatable (the device-watch path) races prepare /
+        unprepare under the shared lock: chips flap in and out of the
+        inventory while claims cycle. Invariants: no unexpected
+        exceptions, the checkpoint drains clean, and the base CDI spec
+        ends consistent with the final inventory."""
+        import json
+
+        state, lib = make_state(tmp_path)
+        stop = threading.Event()
+        errors: list[BaseException] = []
+
+        def churn_inventory():
+            flip = 0
+            while not stop.is_set():
+                lib.chips_per_host = 2 if flip % 2 else 4
+                flip += 1
+                try:
+                    state.refresh_allocatable()
+                except BaseException as e:
+                    errors.append(e)
+
+        def claim_cycle(t):
+            for i in range(30):
+                uid = f"uid-churn-{t}-{i}"
+                # tpu-0/1 exist in every inventory phase; prepare may
+                # still lose a sharing race to a sibling thread.
+                try:
+                    state.prepare(make_claim(uid, [f"tpu-{t % 2}"]))
+                except (PrepareError, SharingError):
+                    continue
+                except BaseException as e:
+                    errors.append(e)
+                    continue
+                state.unprepare(uid)
+
+        churner = threading.Thread(target=churn_inventory, daemon=True)
+        workers = [
+            threading.Thread(target=claim_cycle, args=(t,)) for t in range(4)
+        ]
+        churner.start()
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join(timeout=120)
+            assert not w.is_alive(), "claim worker deadlocked"
+        stop.set()
+        churner.join(timeout=10)
+        assert not churner.is_alive(), "inventory churner deadlocked"
+        assert not errors, errors[:3]
+
+        assert state.checkpoint.read() == {}
+        # Base spec reflects the final inventory exactly (no prepared
+        # claims remain to pin retired entries).
+        state.refresh_allocatable()
+        base = json.loads(
+            (tmp_path / "cdi" / "k8s.tpu.google.com-base.json").read_text()
+        )
+        assert {d["name"] for d in base["devices"]} == set(
+            state.allocatable
+        )
+
     def test_duplicate_concurrent_prepare_is_idempotent(self, tmp_path):
         """kubelet may retry a claim while the first RPC is in flight; all
         callers must see one consistent result and one checkpoint entry."""
