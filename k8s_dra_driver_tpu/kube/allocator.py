@@ -323,7 +323,7 @@ class ReferenceAllocator:
                 raise AllocationError(f"unknown device class {class_name!r}")
             return _attr_value(d["attributes"], "type") == dtype
 
-        def candidates(req):
+        def candidates(req, include_reserved=False):
             cel_selectors = [
                 s["cel"]["expression"]
                 for s in req.get("selectors", [])
@@ -332,9 +332,12 @@ class ReferenceAllocator:
             admin = req.get("adminAccess", False)
             out = []
             for d in inventory:
+                if d.get("invalid"):
+                    continue  # misconfigured slice: unallocatable, and it
+                    # must not inflate allocationMode=All's target count
                 # Ordinary requests never see reserved devices; admin
                 # requests observe them (monitoring over live workloads).
-                if not admin and (
+                if not (admin or include_reserved) and (
                     (d["pool"], d["name"]) in self._reservations
                 ):
                     continue
@@ -351,6 +354,20 @@ class ReferenceAllocator:
             return out
 
         picked: list[tuple[str, dict]] = []  # (request name, device)
+        admin_request_names = {
+            r["name"] for r in requests if r.get("adminAccess")
+        }
+
+        def picked_blocks(req_admin: bool, d) -> bool:
+            """Admin picks are invisible to ordinary placement and vice
+            versa (types.go:448-456) — exclusion applies only between
+            requests of the same access kind."""
+            for other_name, p in picked:
+                if p is d and (
+                    (other_name in admin_request_names) == req_admin
+                ):
+                    return True
+            return False
 
         def consistent(req_name, dev) -> bool:
             for group, attr in match_groups:
@@ -367,12 +384,32 @@ class ReferenceAllocator:
             if ri == len(requests):
                 return True
             req = requests[ri]
-            count = req.get("count", 1)
             admin = req.get("adminAccess", False)
+            mode = req.get("allocationMode", "ExactCount")
             cands = [
                 d for d in candidates(req)
-                if not any(d is p for _, p in picked)
+                if not picked_blocks(admin, d)
             ]
+            if mode == "All":
+                # Every matching device in scope (types.go:427-429): fails
+                # when some are already allocated — unless adminAccess,
+                # whose candidates() already includes reserved devices.
+                count = len(cands)
+                if count == 0:
+                    return False
+                if not admin and count != len(
+                    candidates(req, include_reserved=True)
+                ):
+                    return False  # some matching devices already allocated
+            elif mode == "ExactCount":
+                count = req.get("count", 1)
+            else:
+                # "Clients must refuse to handle requests with unknown
+                # modes" (types.go:435-436).
+                raise AllocationError(
+                    f"unknown allocationMode {mode!r} in request "
+                    f"{req.get('name')!r}"
+                )
 
             def pick_n(chosen: list) -> bool:
                 if len(chosen) == count:
@@ -389,12 +426,10 @@ class ReferenceAllocator:
                     return False
                 start = cands.index(chosen[-1]) + 1 if chosen else 0
                 for d in cands[start:]:
-                    if any(d is p for _, p in picked) or d in chosen:
+                    if picked_blocks(admin, d) or d in chosen:
                         continue
                     if not consistent(req["name"], d):
                         continue
-                    if d.get("invalid"):
-                        continue  # misconfigured slice: unusable either way
                     # Admin picks consume nothing, so counters are moot.
                     if not admin and not counters_fit(d):
                         continue

@@ -110,6 +110,101 @@ class TestAllocatorAdminAccess:
         alloc.deallocate("uid-admin")  # no reservations to leak either
 
 
+class TestAllocationModeAll:
+    def make(self):
+        client = FakeKubeClient()
+        publish_node(
+            client, FakeChipLib(generation="v5e", topology="2x1x1")
+        )
+        return ReferenceAllocator(client, driver_name=DRIVER)
+
+    def all_claim(self, uid, admin=False):
+        req = {"name": "req-0", "deviceClassName": "tpu.google.com",
+               "allocationMode": "All"}
+        if admin:
+            req["adminAccess"] = True
+        return {
+            "metadata": {"name": f"c-{uid}", "namespace": "ns",
+                         "uid": uid},
+            "spec": {"devices": {"requests": [req]}},
+        }
+
+    def test_all_takes_every_matching_device(self):
+        alloc = self.make()
+        claim = self.all_claim("uid-all")
+        alloc.allocate(claim)
+        results = claim["status"]["allocation"]["devices"]["results"]
+        assert {r["device"] for r in results} == {"tpu-0", "tpu-1"}
+
+    def test_all_fails_when_any_device_is_taken(self):
+        """types.go:427-429: All 'will fail if some devices are already
+        allocated, unless adminAccess is requested'."""
+        alloc = self.make()
+        alloc.allocate(chip_claim("uid-w0"))
+        with pytest.raises(AllocationError):
+            alloc.allocate(self.all_claim("uid-all"))
+        # The adminAccess escape hatch: observes everything regardless.
+        admin = self.all_claim("uid-all-admin", admin=True)
+        alloc.allocate(admin)
+        results = admin["status"]["allocation"]["devices"]["results"]
+        assert {r["device"] for r in results} == {"tpu-0", "tpu-1"}
+
+    def test_unknown_mode_refused(self):
+        """'Clients must refuse to handle requests with unknown modes.'"""
+        alloc = self.make()
+        claim = chip_claim("uid-x")
+        claim["spec"]["devices"]["requests"][0]["allocationMode"] = "Most"
+        with pytest.raises(AllocationError):
+            alloc.allocate(claim)
+
+    def test_invalid_device_does_not_poison_all(self):
+        """A misconfigured (invalid) device is unallocatable, but it must
+        not inflate All's target count and doom the healthy remainder."""
+        client = FakeKubeClient()
+        lib = FakeChipLib(generation="v5e", topology="2x1x1")
+        client.create(NODES, {"metadata": {"name": "node-a", "uid": "u"}})
+        allocatable = lib.enumerate_all_possible_devices({"chip"})
+        devices = [d.get_device() for d in allocatable.values()]
+        # Corrupt tpu-1: consume a counter no sharedCounters declares.
+        devices[1]["basic"]["consumesCounters"] = [{
+            "counterSet": "ghost", "counters": {"x": {"value": "1"}},
+        }]
+        ctrl = ResourceSliceController(
+            client, DRIVER, scope="node-a",
+            owner={"kind": "Node", "name": "node-a", "uid": "u"},
+        )
+        ctrl.update(DriverResources(pools={
+            "node-a": Pool(devices=devices, shared_counters=[],
+                           node_name="node-a")
+        }))
+        ctrl.sync_once()
+        alloc = ReferenceAllocator(client, driver_name=DRIVER)
+        claim = self.all_claim("uid-all")
+        alloc.allocate(claim)
+        results = claim["status"]["allocation"]["devices"]["results"]
+        assert {r["device"] for r in results} == {"tpu-0"}
+
+    def test_mixed_admin_and_workload_requests_in_one_claim(self):
+        """Admin picks are invisible to ordinary placement within the same
+        claim: observing every chip must not block the workload request."""
+        alloc = self.make()
+        claim = {
+            "metadata": {"name": "c-mix", "namespace": "ns",
+                         "uid": "uid-mix"},
+            "spec": {"devices": {"requests": [
+                {"name": "req-mon", "deviceClassName": "tpu.google.com",
+                 "adminAccess": True, "allocationMode": "All"},
+                {"name": "req-work", "deviceClassName": "tpu.google.com"},
+            ]}},
+        }
+        alloc.allocate(claim)
+        results = claim["status"]["allocation"]["devices"]["results"]
+        mon = {r["device"] for r in results if r["request"] == "req-mon"}
+        work = {r["device"] for r in results if r["request"] == "req-work"}
+        assert mon == {"tpu-0", "tpu-1"}
+        assert len(work) == 1 and work <= mon
+
+
 class TestPrepareAdminAccess:
     def test_admin_prepare_skips_sharing_and_coexists(self, tmp_path):
         lib = FakeChipLib(generation="v5p", topology="2x2x1")
