@@ -233,6 +233,11 @@ class CDIHandler:
         except FileNotFoundError:
             pass
 
+    def base_spec_exists(self) -> bool:
+        """Whether the standard device spec is on disk (inspection seam:
+        the file name is this class's private convention)."""
+        return os.path.exists(self._base_spec_path())
+
     def list_claim_spec_uids(self) -> list[str]:
         """UIDs with transient specs on disk — the orphan-cleanup seam the
         reference left as a TODO (driver.go:154-166)."""

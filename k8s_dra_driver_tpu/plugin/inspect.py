@@ -1,0 +1,208 @@
+"""Node-state inspection: the operator's view of one plugin's world.
+
+The role nvidia-smi plays when debugging the reference driver — except
+this driver's runtime state is plain files, so the inspector needs no
+hardware library: it reads the checkpoint (prepared claims), the durable
+sharing state, the CDI specs on disk, and (optionally) the live chip
+inventory, and prints one coherent summary. Read-only by construction.
+
+    python -m k8s_dra_driver_tpu.plugin.inspect \
+        --state-root /var/lib/tpu-dra --cdi-root /var/run/cdi
+
+``--json`` emits the same structure machine-readably (for support
+bundles / bug reports).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Optional
+
+from ..plugin.checkpoint import CheckpointManager
+from ..plugin.prepared import PreparedClaim
+from ..plugin.sharing import CorruptShareStateError, SharingStateStore
+
+
+def collect(
+    state_root: str,
+    cdi_root: str,
+    chiplib=None,
+    driver_name: str = "tpu.google.com",
+) -> dict[str, Any]:
+    """Gather the node's driver state into one structure (pure reads)."""
+    out: dict[str, Any] = {"stateRoot": state_root, "cdiRoot": cdi_root}
+
+    # Prepared claims from the checkpoint. A corrupt checkpoint is the
+    # crash artifact this tool exists to diagnose — report it and keep
+    # going (the sharing and CDI sections may still be readable).
+    ckpt_path = os.path.join(state_root, "checkpoint.json")
+    claims: list[dict[str, Any]] = []
+    try:
+        records = CheckpointManager(ckpt_path).read().items()
+    except FileNotFoundError:
+        records = []
+    except Exception as e:  # checksum mismatch, truncation, bad JSON
+        records = []
+        out["checkpointError"] = f"{type(e).__name__}: {e}"
+    for uid, rec in records:
+        try:
+            pc = PreparedClaim.from_dict(rec)
+        except Exception as e:
+            claims.append({"uid": uid, "error": f"malformed record: {e}"})
+            continue
+        claims.append({
+            "uid": uid,
+            "name": pc.name,
+            "namespace": pc.namespace,
+            "groups": [
+                {
+                    "strategy": (
+                        "adminAccess"
+                        if g.config.get("adminAccess")
+                        else (g.config.get("sharing") or {}).get(
+                            "strategy", ""
+                        ) or g.config.get("kind", "")
+                    ),
+                    "devices": [d.name for d in g.devices],
+                }
+                for g in pc.groups
+            ],
+        })
+    out["preparedClaims"] = claims
+
+    # Durable sharing state.
+    share_dir = os.path.join(state_root, "state", "sharing")
+    shares = []
+    if os.path.isdir(share_dir):
+        store = SharingStateStore(share_dir)
+        for uuid in store.list_chips():
+            try:
+                st = store.get(uuid)
+            except CorruptShareStateError:
+                shares.append({"chip": uuid, "error": "CORRUPT"})
+                continue
+            if st.claims:
+                shares.append({
+                    "chip": uuid,
+                    "mode": st.mode,
+                    "claims": sorted(st.claims),
+                })
+    out["sharingState"] = shares
+
+    # CDI specs on disk, cross-checked against the checkpoint.
+    prepared_uids = {c["uid"] for c in claims}
+    cdi = {"baseSpec": False, "claimSpecs": [], "orphanedClaimSpecs": []}
+    if os.path.isdir(cdi_root):
+        from ..cdi.spec import CDIHandler
+
+        handler = CDIHandler(cdi_root, driver_name=driver_name)
+        cdi["baseSpec"] = handler.base_spec_exists()
+        for uid in handler.list_claim_spec_uids():
+            cdi["claimSpecs"].append(uid)
+            if uid not in prepared_uids:
+                cdi["orphanedClaimSpecs"].append(uid)
+    out["cdi"] = cdi
+
+    # Live inventory, when a chip library is given (real probing needs a
+    # TPU host; the fake serves tests and demos).
+    if chiplib is not None:
+        chiplib.init()
+        out["inventory"] = [
+            {
+                "name": c.canonical_name(),
+                "uuid": c.uuid,
+                "generation": c.generation,
+                "coord": str(c.coord),
+                "sliceId": c.slice_id,
+            }
+            for c in chiplib.enumerate_chips()
+        ]
+    return out
+
+
+def render(state: dict[str, Any]) -> str:
+    lines = [f"tpu-dra node state ({state['stateRoot']})", ""]
+    if "checkpointError" in state:
+        lines.append(f"CHECKPOINT CORRUPT: {state['checkpointError']}")
+        lines.append("")
+    claims = state["preparedClaims"]
+    lines.append(f"prepared claims: {len(claims)}")
+    for c in claims:
+        if "error" in c:
+            lines.append(f"  {c['uid']}: {c['error']}")
+            continue
+        for g in c["groups"]:
+            lines.append(
+                f"  {c['namespace']}/{c['name']} ({c['uid']}): "
+                f"{','.join(g['devices'])} [{g['strategy'] or 'Exclusive'}]"
+            )
+    lines.append("")
+    shares = state["sharingState"]
+    lines.append(f"chips with sharing holds: {len(shares)}")
+    for s in shares:
+        if "error" in s:
+            lines.append(f"  {s['chip']}: {s['error']}")
+        else:
+            lines.append(
+                f"  {s['chip']}: {s['mode']} by {','.join(s['claims'])}"
+            )
+    lines.append("")
+    cdi = state["cdi"]
+    lines.append(
+        f"cdi: base spec {'present' if cdi['baseSpec'] else 'MISSING'}, "
+        f"{len(cdi['claimSpecs'])} claim specs"
+        + (
+            f", ORPHANED: {','.join(cdi['orphanedClaimSpecs'])}"
+            if cdi["orphanedClaimSpecs"] else ""
+        )
+    )
+    if "inventory" in state:
+        lines.append("")
+        lines.append(f"chips visible: {len(state['inventory'])}")
+        for c in state["inventory"]:
+            lines.append(
+                f"  {c['name']} {c['uuid']} {c['generation']} "
+                f"coord={c['coord']} slice={c['sliceId']}"
+            )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="Inspect a tpu-dra node's driver state (read-only)."
+    )
+    p.add_argument("--state-root", default="/var/lib/tpu-dra")
+    p.add_argument("--cdi-root", default="/var/run/cdi")
+    p.add_argument("--driver-name", default="tpu.google.com")
+    p.add_argument("--fake-topology", default="",
+                   help="inspect with a fake chip inventory (tests/demos)")
+    p.add_argument("--probe-chips", action="store_true",
+                   help="probe the real /dev + sysfs chip inventory")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+
+    chiplib = None
+    if args.fake_topology:
+        from ..tpulib import FakeChipLib
+
+        chiplib = FakeChipLib(topology=args.fake_topology)
+    elif args.probe_chips:
+        from ..tpulib.chiplib import RealChipLib
+
+        chiplib = RealChipLib()
+
+    state = collect(
+        args.state_root, args.cdi_root, chiplib, args.driver_name
+    )
+    if args.json:
+        print(json.dumps(state, indent=2))
+    else:
+        print(render(state))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -86,6 +86,18 @@ class SharingStateStore:
     def _path(self, uuid: str) -> str:
         return os.path.join(self.state_dir, f"{uuid}.share.json")
 
+    def list_chips(self) -> list[str]:
+        """Chip UUIDs with state files on disk (inspection seam: the
+        file-name convention is this class's private detail)."""
+        suffix = ".share.json"
+        try:
+            entries = os.listdir(self.state_dir)
+        except FileNotFoundError:
+            return []
+        return sorted(
+            e[: -len(suffix)] for e in entries if e.endswith(suffix)
+        )
+
     def get(self, uuid: str) -> _ChipShareState:
         try:
             with open(self._path(uuid)) as f:

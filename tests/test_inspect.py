@@ -1,0 +1,119 @@
+"""Node-state inspector: the read-only operator view.
+
+Builds real driver state (prepare through DeviceState), then asserts the
+inspector reports it faithfully — including the orphan and corruption
+signals an operator debugging a node actually needs.
+"""
+
+import json
+import subprocess
+import sys
+
+from k8s_dra_driver_tpu.cdi import CDIHandler
+from k8s_dra_driver_tpu.plugin.checkpoint import CheckpointManager
+from k8s_dra_driver_tpu.plugin.device_state import DeviceState
+from k8s_dra_driver_tpu.plugin.inspect import collect, render
+from k8s_dra_driver_tpu.tpulib import FakeChipLib
+
+DRIVER = "tpu.google.com"
+
+
+def make_state(tmp_path):
+    return DeviceState(
+        chiplib=FakeChipLib(generation="v5p", topology="2x2x1"),
+        cdi=CDIHandler(str(tmp_path / "cdi")),
+        checkpoint=CheckpointManager(str(tmp_path / "checkpoint.json")),
+        driver_name=DRIVER,
+        pool_name="node-a",
+        state_dir=str(tmp_path / "state"),
+    )
+
+
+def claim(uid, device, strategy=None):
+    cfgs = []
+    if strategy:
+        cfgs = [{
+            "source": "FromClaim", "requests": [],
+            "opaque": {"driver": DRIVER, "parameters": {
+                "apiVersion": "tpu.google.com/v1alpha1",
+                "kind": "TpuChipConfig",
+                "sharing": {"strategy": strategy},
+            }},
+        }]
+    return {
+        "metadata": {"name": f"c-{uid}", "namespace": "ns", "uid": uid},
+        "status": {"allocation": {"devices": {"results": [{
+            "request": "r", "driver": DRIVER, "pool": "node-a",
+            "device": device,
+        }], "config": cfgs}}},
+    }
+
+
+class TestInspector:
+    def test_reports_prepared_claims_and_sharing(self, tmp_path):
+        state = make_state(tmp_path)
+        state.prepare(claim("uid-a", "tpu-0", strategy="TimeShared"))
+        state.prepare(claim("uid-b", "tpu-1"))
+
+        out = collect(str(tmp_path), str(tmp_path / "cdi"))
+        assert {c["uid"] for c in out["preparedClaims"]} == {
+            "uid-a", "uid-b"
+        }
+        strategies = {
+            c["uid"]: c["groups"][0]["strategy"]
+            for c in out["preparedClaims"]
+        }
+        assert strategies["uid-a"] == "TimeShared"
+        holds = {s["chip"]: s for s in out["sharingState"]}
+        assert any(s["mode"] == "time-shared" for s in holds.values())
+        assert out["cdi"]["baseSpec"] is True
+        assert sorted(out["cdi"]["claimSpecs"]) == ["uid-a", "uid-b"]
+        assert out["cdi"]["orphanedClaimSpecs"] == []
+
+        text = render(out)
+        assert "ns/c-uid-a (uid-a): tpu-0 [TimeShared]" in text
+        assert "base spec present" in text
+
+    def test_flags_orphaned_cdi_spec(self, tmp_path):
+        state = make_state(tmp_path)
+        state.prepare(claim("uid-x", "tpu-0"))
+        # Simulate a crash artifact: checkpoint entry gone, spec remains.
+        state.checkpoint.write({})
+        out = collect(str(tmp_path), str(tmp_path / "cdi"))
+        assert out["cdi"]["orphanedClaimSpecs"] == ["uid-x"]
+        assert "ORPHANED: uid-x" in render(out)
+
+    def test_cli_json_with_fake_inventory(self, tmp_path):
+        state = make_state(tmp_path)
+        state.prepare(claim("uid-a", "tpu-0"))
+        proc = subprocess.run(
+            [sys.executable, "-m", "k8s_dra_driver_tpu.plugin.inspect",
+             "--state-root", str(tmp_path),
+             "--cdi-root", str(tmp_path / "cdi"),
+             "--fake-topology", "2x2x1", "--json"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stderr
+        out = json.loads(proc.stdout)
+        assert out["preparedClaims"][0]["uid"] == "uid-a"
+        assert len(out["inventory"]) == 4
+
+    def test_corrupt_checkpoint_is_reported_not_fatal(self, tmp_path):
+        """A truncated checkpoint (crash artifact) must not abort the
+        inspector: the sharing and CDI sections are still readable."""
+        state = make_state(tmp_path)
+        state.prepare(claim("uid-a", "tpu-0", strategy="TimeShared"))
+        (tmp_path / "checkpoint.json").write_text('{"truncated')
+        out = collect(str(tmp_path), str(tmp_path / "cdi"))
+        assert "checkpointError" in out
+        assert out["preparedClaims"] == []
+        # Still-readable sections survive.
+        assert out["sharingState"]
+        assert out["cdi"]["baseSpec"] is True
+        assert "CHECKPOINT CORRUPT" in render(out)
+
+    def test_empty_node_is_quiet(self, tmp_path):
+        out = collect(str(tmp_path), str(tmp_path / "cdi"))
+        assert out["preparedClaims"] == []
+        assert out["sharingState"] == []
+        assert "prepared claims: 0" in render(out)
