@@ -34,6 +34,7 @@ from . import moe
 from .checkpoint import latest_step, restore_checkpoint, save_checkpoint
 from .decode import KVCache, QuantKVCache, decode_step, generate, prefill
 from .quant import QuantTensor, quantize_params, quantize_specs
+from .speculative import speculative_generate
 
 __all__ += [
     "moe",
@@ -45,6 +46,7 @@ __all__ += [
     "generate",
     "quantize_params",
     "quantize_specs",
+    "speculative_generate",
     "save_checkpoint",
     "restore_checkpoint",
     "latest_step",

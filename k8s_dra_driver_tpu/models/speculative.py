@@ -1,0 +1,132 @@
+"""Greedy speculative decoding: draft proposes, target verifies.
+
+Latency lever for serving: a small draft model runs k cheap
+autoregressive steps, then the target scores all k proposals in ONE
+forward (parallel over positions — the MXU-friendly shape), accepting
+the longest matching prefix plus the target's own correction token. For
+greedy decoding the output is PROVABLY identical to running the target
+alone — acceptance only changes how many target forwards it takes.
+
+tpu-first construction: the whole loop is one compiled program
+(`lax.while_loop`), both KV caches are statically shaped, and rewinding
+a cache after a partial acceptance is free — the cache's scalar `length`
+masks everything beyond it, and later writes overwrite in place
+(models/decode.py's attention masks on valid_len).
+
+Single-sequence (B=1): acceptance lengths are per-sequence, and a
+scalar cache length cannot rewind rows independently. Composes with the
+int8 weight/cache paths (same decode machinery underneath).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from .decode import _forward_with_cache, prefill
+from .llama import LlamaConfig
+
+
+def _rewind(cache, length):
+    """A cache rewind is just the scalar length: entries beyond it are
+    masked in attention and overwritten by later writes."""
+    return dataclasses.replace(cache, length=length)
+
+
+def speculative_generate(
+    target_params: dict,
+    draft_params: dict,
+    prompt: jax.Array,            # [1, S]
+    target_config: LlamaConfig,
+    draft_config: LlamaConfig,
+    max_new_tokens: int,
+    k: int = 4,
+    quantize_cache: bool = False,
+) -> jax.Array:
+    """Greedy generation via draft speculation; returns [1, S + N].
+
+    ``k`` draft tokens are proposed per verification round. Requires the
+    two configs to share a vocabulary.
+    """
+    b, s = prompt.shape
+    assert b == 1, "speculative decoding rewinds one sequence's cache"
+    assert target_config.vocab_size == draft_config.vocab_size
+    # Headroom: a round may write k+1 positions beyond the committed
+    # length before rewinding.
+    max_len = s + max_new_tokens + k + 1
+
+    logits_t, cache_t = prefill(
+        target_params, prompt, target_config, max_len,
+        quantize_cache=quantize_cache,
+    )
+    _, cache_d = prefill(
+        draft_params, prompt, draft_config, max_len,
+        quantize_cache=quantize_cache,
+    )
+    first = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)  # [1]
+
+    out = jnp.zeros((1, max_new_tokens + k + 1), jnp.int32)
+    out = out.at[:, 0].set(first)
+
+    def draft_step(carry, _):
+        cache, tok, pos = carry
+        logits, cache = _forward_with_cache(
+            draft_params, tok[:, None], cache, draft_config, pos[None]
+        )
+        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        return (cache, nxt, pos + 1), nxt
+
+    def body(carry):
+        n, pending, cache_t, cache_d, out = carry
+        # Committed tokens so far: prompt (s) + n generated; `pending` is
+        # the last of them, not yet in either cache.
+        m = s + n
+        # Draft proposes g_1..g_k (one extra feed keeps its cache long
+        # enough for a full acceptance; the k+1-th proposal is unused).
+        (cache_d, _, _), proposals = jax.lax.scan(
+            draft_step, (cache_d, pending, m - 1), None, length=k + 1
+        )
+        g = proposals[:k, 0]                      # [k]
+
+        # Target verifies the whole chunk in one forward.
+        chunk = jnp.concatenate(
+            [pending[None], g[None, :]], axis=1
+        )                                          # [1, k+1]
+        positions = m - 1 + jnp.arange(k + 1)
+        logits, cache_t = _forward_with_cache(
+            target_params, chunk, cache_t, target_config, positions
+        )
+        y = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)  # [k+1]
+
+        # Longest matching prefix: g[i] must equal y[i] (the target's
+        # token after consuming the i-th fed token).
+        matches = jnp.cumprod((g == y[:k]).astype(jnp.int32))
+        a = jnp.sum(matches)                       # 0..k accepted drafts
+
+        # Commit g_1..g_a then the target's correction y_a.
+        idx = jnp.arange(out.shape[1])
+        accept_mask = (idx >= n) & (idx < n + a)
+        src = jnp.zeros_like(out[0]).at[
+            jnp.clip(n + jnp.arange(k), 0, out.shape[1] - 1)
+        ].set(g)
+        new_row = jnp.where(accept_mask, src, out[0])
+        new_row = new_row.at[n + a].set(y[a])
+        out = new_row[None, :]
+
+        # Rewind both caches to the committed length minus the pending
+        # token (the new pending is y_a, fed next round).
+        new_len = jnp.asarray(m + a, jnp.int32)
+        cache_t = _rewind(cache_t, new_len)
+        cache_d = _rewind(cache_d, new_len)
+        return n + a + 1, y[a][None], cache_t, cache_d, out
+
+    def cond(carry):
+        return carry[0] < max_new_tokens
+
+    n0 = jnp.asarray(1, jnp.int32)
+    _, _, _, _, out = jax.lax.while_loop(
+        cond, body, (n0, first, cache_t, cache_d, out)
+    )
+    return jnp.concatenate([prompt, out[:, :max_new_tokens]], axis=1)
