@@ -4,8 +4,13 @@ Latency lever for serving: a small draft model runs k cheap
 autoregressive steps, then the target scores all k proposals in ONE
 forward (parallel over positions — the MXU-friendly shape), accepting
 the longest matching prefix plus the target's own correction token. For
-greedy decoding the output is PROVABLY identical to running the target
-alone — acceptance only changes how many target forwards it takes.
+greedy decoding the output is identical to running the target alone —
+acceptance only changes how many target forwards it takes. The guarantee
+is exact under deterministic numerics (the CPU tests pin token
+equality); on TPU, bf16 reduction order differs between the chunked
+(T=k+1) and incremental (T=1) forwards, so a near-TIED argmax can
+resolve differently — the caveat every batched-verification
+implementation carries, negligible for trained models at temperature 0.
 
 tpu-first construction: the whole loop is one compiled program
 (`lax.while_loop`), both KV caches are statically shaped, and rewinding
