@@ -49,8 +49,12 @@ def speculative_generate(
     max_new_tokens: int,
     k: int = 4,
     quantize_cache: bool = False,
-) -> jax.Array:
-    """Greedy generation via draft speculation; returns [1, S + N].
+    return_stats: bool = False,
+):
+    """Greedy generation via draft speculation; returns [1, S + N], or
+    (tokens, stats) with ``return_stats`` — stats = {"rounds",
+    "accepted"}: tokens-per-round ≈ accepted/rounds + 1, the number that
+    says whether ``k`` (and the draft) pay for themselves.
 
     ``k`` draft tokens are proposed per verification round. Requires the
     two configs to share a vocabulary.
@@ -84,7 +88,7 @@ def speculative_generate(
         return (cache, nxt, pos + 1), nxt
 
     def body(carry):
-        n, pending, cache_t, cache_d, out = carry
+        n, pending, cache_t, cache_d, out, rounds, accepted = carry
         # Committed tokens so far: prompt (s) + n generated; `pending` is
         # the last of them, not yet in either cache.
         m = s + n
@@ -125,13 +129,18 @@ def speculative_generate(
         new_len = jnp.asarray(m + a, jnp.int32)
         cache_t = _rewind(cache_t, new_len)
         cache_d = _rewind(cache_d, new_len)
-        return n + a + 1, y[a][None], cache_t, cache_d, out
+        return (n + a + 1, y[a][None], cache_t, cache_d, out,
+                rounds + 1, accepted + a)
 
     def cond(carry):
         return carry[0] < max_new_tokens
 
     n0 = jnp.asarray(1, jnp.int32)
-    _, _, _, _, out = jax.lax.while_loop(
-        cond, body, (n0, first, cache_t, cache_d, out)
+    zero = jnp.asarray(0, jnp.int32)
+    _, _, _, _, out, rounds, accepted = jax.lax.while_loop(
+        cond, body, (n0, first, cache_t, cache_d, out, zero, zero)
     )
-    return jnp.concatenate([prompt, out[:, :max_new_tokens]], axis=1)
+    tokens = jnp.concatenate([prompt, out[:, :max_new_tokens]], axis=1)
+    if return_stats:
+        return tokens, {"rounds": rounds, "accepted": accepted}
+    return tokens

@@ -80,6 +80,25 @@ class TestSpeculative:
         )(target_params, draft, prompt)
         np.testing.assert_array_equal(np.asarray(out), reference)
 
+    def test_stats_reflect_draft_quality(self, target_params, prompt):
+        """A perfect draft accepts ~everything (few rounds); a garbage
+        draft accepts ~nothing (a round per token). The stats are the
+        tuning signal for k."""
+        k = 4
+        run = jax.jit(
+            lambda tp, dp, t: speculative_generate(
+                tp, dp, t, CONFIG, CONFIG, N, k=k, return_stats=True
+            )
+        )
+        _, good = run(target_params, target_params, prompt)
+        bad_draft = init_params(CONFIG, jax.random.PRNGKey(99))
+        _, bad = run(target_params, bad_draft, prompt)
+        good_rate = float(good["accepted"]) / float(good["rounds"])
+        bad_rate = float(bad["accepted"]) / float(bad["rounds"])
+        assert good_rate == k  # self-draft: every proposal accepted
+        assert bad_rate < good_rate
+        assert int(bad["rounds"]) >= int(good["rounds"])
+
     def test_int8_cache_composes_exactly(self, target_params, prompt):
         """Requantization of identical k/v values is deterministic, so the
         equivalence guarantee survives the int8 cache: token-exact against
