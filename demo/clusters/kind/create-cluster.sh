@@ -5,10 +5,49 @@ set -euo pipefail
 SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
 
 CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra}"
+# WORKERS>1 builds a multi-node cluster and labels each worker with its
+# position in a fake multi-host slice (the nvkind analog: the reference
+# partitions host GPUs among kind workers; here the fake slice spans
+# them). Pair with helm --set plugin.fakeHosts=$WORKERS.
+WORKERS="${WORKERS:-1}"
+
+CONFIG="${SCRIPT_DIR}/kind-cluster-config.yaml"
+if [ "${WORKERS}" -gt 1 ]; then
+  # Same cluster settings as the checked-in config, with N labeled
+  # workers (every worker carries the chip + slice labels the plugin
+  # DaemonSet and controller select on). KEEP IN SYNC with
+  # kind-cluster-config.yaml (feature gates, runtime config, CDI patch).
+  CONFIG="$(mktemp)"
+  trap 'rm -f "${CONFIG}"' EXIT
+  {
+    printf 'kind: Cluster\napiVersion: kind.x-k8s.io/v1alpha4\nnodes:\n'
+    printf '  - role: control-plane\n'
+    for _ in $(seq 1 "${WORKERS}"); do
+      printf '  - role: worker\n'
+      printf '    labels:\n'
+      printf '      tpu.google.com/chips: "true"\n'
+      printf '      tpu.google.com/slice-id: kind-slice-0\n'
+    done
+    printf 'featureGates:\n  DynamicResourceAllocation: true\n'
+    printf 'runtimeConfig:\n  resource.k8s.io/v1alpha3: "true"\n'
+    printf 'containerdConfigPatches:\n'
+    printf '  - |-\n'
+    printf '    [plugins."io.containerd.grpc.v1.cri"]\n'
+    printf '      enable_cdi = true\n'
+  } > "${CONFIG}"
+fi
 
 kind create cluster \
   --name "${CLUSTER_NAME}" \
-  --config "${SCRIPT_DIR}/kind-cluster-config.yaml"
+  --config "${CONFIG}"
+
+if [ "${WORKERS}" -gt 1 ]; then
+  i=0
+  for node in $(kind get nodes --name "${CLUSTER_NAME}" | grep -v control-plane | sort); do
+    kubectl label node "${node}" "tpu.google.com/fake-host-id=${i}" --overwrite
+    i=$((i + 1))
+  done
+fi
 
 kubectl cluster-info --context "kind-${CLUSTER_NAME}"
 echo "cluster ${CLUSTER_NAME} ready; next: ./install-dra-driver.sh"

@@ -11,6 +11,11 @@ CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra}"
 IMAGE="${IMAGE:-tpu-dra-driver}"
 TAG="${TAG:-latest}"
 FAKE_TOPOLOGY="${FAKE_TOPOLOGY:-2x2x1}"
+# Auto-match a multi-node cluster (create-cluster.sh WORKERS=N labels the
+# workers): the fake slice spans however many labeled workers exist.
+FAKE_HOSTS="${FAKE_HOSTS:-$(kubectl get nodes \
+  -l tpu.google.com/fake-host-id -o name 2>/dev/null | wc -l | tr -d ' ')}"
+[ "${FAKE_HOSTS}" -ge 1 ] 2>/dev/null || FAKE_HOSTS=1
 
 docker build -t "${IMAGE}:${TAG}" \
   -f "${REPO_ROOT}/deployments/container/Dockerfile" "${REPO_ROOT}"
@@ -21,9 +26,10 @@ if command -v helm >/dev/null; then
     "${REPO_ROOT}/deployments/helm/tpu-dra-driver" \
     --set image.repository="${IMAGE}" \
     --set image.tag="${TAG}" \
-    --set plugin.fakeTopology="${FAKE_TOPOLOGY}"
+    --set plugin.fakeTopology="${FAKE_TOPOLOGY}" \
+    --set plugin.fakeHosts="${FAKE_HOSTS}"
 else
-  # Raw-manifest fallback: same objects, fixed values.
+  # Raw-manifest fallback: same objects, fixed values (single host only).
   kubectl create namespace tpu-dra --dry-run=client -o yaml | kubectl apply -f -
   kubectl apply -f "${REPO_ROOT}/deployments/manifests/"
 fi

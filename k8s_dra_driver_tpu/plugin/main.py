@@ -79,6 +79,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fake-topology", default=_env("FAKE_TOPOLOGY", ""),
                    help="serve a fake chip backend with this topology, e.g. 2x2x1")
     p.add_argument("--fake-generation", default=_env("FAKE_GENERATION", "v5p"))
+    p.add_argument("--fake-hosts", type=int,
+                   default=int(_env("FAKE_HOSTS", "1") or 1),
+                   help="hosts the fake slice spans; each node's position "
+                        "comes from its tpu.google.com/fake-host-id label "
+                        "(multi-node kind, the nvkind analog) [FAKE_HOSTS]")
     p.add_argument("--http-port", type=int, default=int(_env("HTTP_PORT", "0")),
                    help="metrics/health endpoint port; 0 disables [HTTP_PORT]")
     p.add_argument("--log-level", default=_env("LOG_LEVEL", "INFO"))
@@ -109,10 +114,16 @@ def resolve_roots(args):
     return dev_root, ctr
 
 
-def make_chiplib(args, dev_root: str) -> ChipLib:
+FAKE_HOST_ID_LABEL = "tpu.google.com/fake-host-id"
+
+
+def make_chiplib(args, dev_root: str, fake_host_id: int = 0) -> ChipLib:
     if args.fake_topology:
         return FakeChipLib(
-            generation=args.fake_generation, topology=args.fake_topology
+            generation=args.fake_generation,
+            topology=args.fake_topology,
+            host_id=fake_host_id,
+            hosts_per_slice=max(args.fake_hosts, 1),
         )
     return RealChipLib(
         ChipLibConfig(dev_root=dev_root, sysfs_root=args.sysfs_root)
@@ -125,6 +136,33 @@ def lookup_node_uid(client, node_name: str) -> str:
     except Exception:
         logger.warning("could not resolve node UID for %s", node_name)
         return ""
+
+
+def lookup_fake_host_id(client, node_name: str) -> int:
+    """This node's position in a multi-node fake slice, from its node
+    label (a DaemonSet cannot vary env per node; the real backend reads
+    TPU_WORKER_ID from the platform instead). Absent label = host 0 —
+    loudly, because two unlabeled nodes would both publish host 0's
+    coordinate block (duplicate devices, missing remainder)."""
+    if client is None:
+        return 0
+    try:
+        labels = (
+            client.get(NODES, node_name)["metadata"].get("labels") or {}
+        )
+        if FAKE_HOST_ID_LABEL not in labels:
+            logger.warning(
+                "--fake-hosts > 1 but node %s carries no %s label; "
+                "defaulting to host 0 — label each worker 0..N-1 or the "
+                "published slice will be wrong",
+                node_name, FAKE_HOST_ID_LABEL,
+            )
+            return 0
+        return int(labels[FAKE_HOST_ID_LABEL] or 0)
+    except Exception:
+        logger.warning("could not resolve %s for %s; using host 0",
+                       FAKE_HOST_ID_LABEL, node_name)
+        return 0
 
 
 def main(argv=None) -> int:
@@ -143,9 +181,23 @@ def main(argv=None) -> int:
         node_uid = lookup_node_uid(kube_client, args.node_name)
 
     dev_root, driver_root_ctr = resolve_roots(args)
+    fake_host_id = 0
+    if args.fake_topology and args.fake_hosts > 1:
+        from ..tpulib.topology import MeshShape
+
+        n_chips = MeshShape.parse(args.fake_topology).num_chips
+        if n_chips % args.fake_hosts != 0:
+            logger.error(
+                "--fake-hosts=%d does not divide the %d chips of "
+                "--fake-topology=%s; the remainder would silently "
+                "vanish from the published slice",
+                args.fake_hosts, n_chips, args.fake_topology,
+            )
+            return 2
+        fake_host_id = lookup_fake_host_id(kube_client, args.node_name)
     config = DriverConfig(
         node_name=args.node_name,
-        chiplib=make_chiplib(args, dev_root),
+        chiplib=make_chiplib(args, dev_root, fake_host_id),
         kube_client=kube_client,
         driver_name=args.driver_name,
         cdi_root=args.cdi_root,

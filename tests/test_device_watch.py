@@ -99,6 +99,60 @@ class TestRefreshAllocatable:
         assert "tpu-3" not in base_names()
 
 
+class TestMultiNodeFakeSlice:
+    def test_host_id_from_node_label(self, tmp_path):
+        """Multi-node kind (the nvkind analog): a DaemonSet cannot vary
+        env per node, so each plugin derives its slice position from its
+        node's fake-host-id label — the two fake hosts then publish
+        DISJOINT coordinate blocks of one slice."""
+        import argparse
+
+        from k8s_dra_driver_tpu.kube import NODES, FakeKubeClient
+        from k8s_dra_driver_tpu.plugin.main import (
+            FAKE_HOST_ID_LABEL,
+            lookup_fake_host_id,
+            make_chiplib,
+        )
+
+        client = FakeKubeClient()
+        for i, name in enumerate(["worker-0", "worker-1"]):
+            client.create(NODES, {"metadata": {
+                "name": name, "uid": f"u-{i}",
+                "labels": {FAKE_HOST_ID_LABEL: str(i)},
+            }})
+        client.create(NODES, {"metadata": {"name": "plain", "uid": "u-p"}})
+
+        assert lookup_fake_host_id(client, "worker-0") == 0
+        assert lookup_fake_host_id(client, "worker-1") == 1
+        assert lookup_fake_host_id(client, "plain") == 0    # no label
+        assert lookup_fake_host_id(client, "ghost") == 0    # no node
+        assert lookup_fake_host_id(None, "worker-1") == 0   # --no-kube
+
+        args = argparse.Namespace(
+            fake_topology="2x2x1", fake_generation="v5e", fake_hosts=2,
+            sysfs_root="/sys",
+        )
+        coords = {}
+        for host in (0, 1):
+            lib = make_chiplib(args, "/", fake_host_id=host)
+            chips = lib.enumerate_chips()
+            assert lib.hosts_per_slice == 2 and len(chips) == 2
+            coords[host] = {str(c.coord) for c in chips}
+        assert coords[0].isdisjoint(coords[1])
+        assert len(coords[0] | coords[1]) == 4  # together: the full slice
+
+    def test_non_divisible_fake_hosts_refused(self):
+        """3 hosts cannot split 4 chips; the plugin must refuse loudly
+        rather than silently dropping the remainder chip."""
+        from k8s_dra_driver_tpu.plugin.main import main
+
+        rc = main([
+            "--node-name", "n", "--no-kube",
+            "--fake-topology", "2x2x1", "--fake-hosts", "3",
+        ])
+        assert rc == 2
+
+
 class TestWatchLoop:
     def test_hotplug_republishes(self, tmp_path):
         lib = FakeChipLib(generation="v5e", topology="2x2x1")
