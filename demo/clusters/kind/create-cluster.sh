@@ -43,7 +43,9 @@ kind create cluster \
 
 if [ "${WORKERS}" -gt 1 ]; then
   i=0
-  for node in $(kind get nodes --name "${CLUSTER_NAME}" | grep -v control-plane | sort); do
+  # sort -V: kind-worker10 must come after kind-worker9, or host ids
+  # (and with them the published slice coordinates) are misassigned.
+  for node in $(kind get nodes --name "${CLUSTER_NAME}" | grep -v control-plane | sort -V); do
     kubectl label node "${node}" "tpu.google.com/fake-host-id=${i}" --overwrite
     i=$((i + 1))
   done

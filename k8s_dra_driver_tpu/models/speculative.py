@@ -60,8 +60,15 @@ def speculative_generate(
     two configs to share a vocabulary.
     """
     b, s = prompt.shape
-    assert b == 1, "speculative decoding rewinds one sequence's cache"
-    assert target_config.vocab_size == draft_config.vocab_size
+    if b != 1:
+        raise ValueError(
+            f"speculative decoding rewinds one sequence's cache (B=1); got B={b}"
+        )
+    if target_config.vocab_size != draft_config.vocab_size:
+        raise ValueError(
+            "target and draft must share a vocabulary: "
+            f"{target_config.vocab_size} != {draft_config.vocab_size}"
+        )
     # Headroom: a round may write k+1 positions beyond the committed
     # length before rewinding.
     max_len = s + max_new_tokens + k + 1

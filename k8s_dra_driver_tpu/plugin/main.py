@@ -138,13 +138,21 @@ def lookup_node_uid(client, node_name: str) -> str:
         return ""
 
 
-def lookup_fake_host_id(client, node_name: str) -> int:
+def lookup_fake_host_id(client, node_name: str, fake_hosts: int = 1) -> int:
     """This node's position in a multi-node fake slice, from its node
     label (a DaemonSet cannot vary env per node; the real backend reads
     TPU_WORKER_ID from the platform instead). Absent label = host 0 —
     loudly, because two unlabeled nodes would both publish host 0's
     coordinate block (duplicate devices, missing remainder)."""
     if client is None:
+        if fake_hosts > 1:
+            logger.warning(
+                "--fake-hosts=%d with no kube client: node %s cannot read "
+                "its %s label and defaults to host 0 — every such node "
+                "publishes host 0's coordinate block (duplicate devices, "
+                "missing remainder)",
+                fake_hosts, node_name, FAKE_HOST_ID_LABEL,
+            )
         return 0
     try:
         labels = (
@@ -194,7 +202,9 @@ def main(argv=None) -> int:
                 args.fake_hosts, n_chips, args.fake_topology,
             )
             return 2
-        fake_host_id = lookup_fake_host_id(kube_client, args.node_name)
+        fake_host_id = lookup_fake_host_id(
+            kube_client, args.node_name, args.fake_hosts
+        )
     config = DriverConfig(
         node_name=args.node_name,
         chiplib=make_chiplib(args, dev_root, fake_host_id),

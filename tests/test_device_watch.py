@@ -141,6 +141,22 @@ class TestMultiNodeFakeSlice:
         assert coords[0].isdisjoint(coords[1])
         assert len(coords[0] | coords[1]) == 4  # together: the full slice
 
+    def test_no_kube_multi_host_warns_loudly(self, caplog):
+        """--no-kube with --fake-hosts > 1 cannot resolve a host id; the
+        host-0 default must be loud (two such nodes would both publish
+        host 0's coordinate block)."""
+        import logging
+
+        from k8s_dra_driver_tpu.plugin.main import lookup_fake_host_id
+
+        with caplog.at_level(logging.WARNING):
+            assert lookup_fake_host_id(None, "w-1", fake_hosts=2) == 0
+        assert any("fake-hosts" in r.message for r in caplog.records)
+        caplog.clear()
+        with caplog.at_level(logging.WARNING):
+            assert lookup_fake_host_id(None, "w-1", fake_hosts=1) == 0
+        assert not caplog.records
+
     def test_non_divisible_fake_hosts_refused(self):
         """3 hosts cannot split 4 chips; the plugin must refuse loudly
         rather than silently dropping the remainder chip."""
