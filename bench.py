@@ -83,6 +83,9 @@ def run_bench(preset, batch, seq, peak_flops, remat_policy="flash_qkv",
             # 0 is a meaningful value (whole-sequence routing), so only an
             # UNSET env keeps the preset default.
             config = dataclasses.replace(config, router_group=int(group))
+        impl = os.environ.get("TPU_DRA_BENCH_MOE_IMPL")
+        if impl is not None:
+            config = dataclasses.replace(config, moe_impl=impl)
     # The model consumes `seq` positions (inputs are tokens[:, :-1]), so
     # seq may equal max_seq_len exactly — every preset's max_seq_len is a
     # valid flash-blockable length, unlike the odd max_seq_len - 1.
@@ -166,7 +169,13 @@ def run_bench(preset, batch, seq, peak_flops, remat_policy="flash_qkv",
         "vs_baseline": round(mfu / 0.50, 4),
         "detail": {
             **(
-                {"moe_group": effective_router_group(config, seq)}
+                {
+                    "moe_group": effective_router_group(config, seq),
+                    "moe_impl": (
+                        "grouped" if config.moe_impl == "auto"
+                        else config.moe_impl
+                    ),
+                }
                 if model == "moe" else {}
             ),
             "tokens_per_s": round(n_tokens / dt, 1),

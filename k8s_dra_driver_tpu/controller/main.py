@@ -13,7 +13,7 @@ import logging
 import sys
 
 from ..utils.cli import env as _env
-from ..utils.cli import install_signal_stop, make_kube_client
+from ..utils.cli import add_kube_client_flags, install_signal_stop, make_kube_client
 from ..utils.metrics import Gauge, MetricsServer, Registry
 from .slice_manager import IciSliceManager
 
@@ -40,6 +40,7 @@ def build_parser() -> argparse.ArgumentParser:
                    default=int(_env("HTTP_PORT", "8080")),
                    help="metrics/health endpoint port; 0 disables")
     p.add_argument("--kubeconfig", default=_env("KUBECONFIG", ""))
+    add_kube_client_flags(p)
     p.add_argument("--cleanup-on-exit", action="store_true",
                    help="delete published ResourceSlices on shutdown. Only "
                         "for decommissioning: a rolling restart must NOT "
@@ -66,7 +67,9 @@ def main(argv=None) -> int:
         metrics.start()
         logger.info("metrics on :%d/metrics", metrics.port)
 
-    client = make_kube_client(args.kubeconfig)
+    client = make_kube_client(
+        args.kubeconfig, qps=args.kube_api_qps, burst=args.kube_api_burst
+    )
 
     manager = None
     if "ici" in args.device_classes.split(","):

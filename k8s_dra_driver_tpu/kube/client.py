@@ -34,6 +34,7 @@ import urllib.parse
 import urllib.request
 from typing import Callable, Iterator, Optional
 
+from ..utils.backoff import Backoff, TokenBucket
 from .errors import (
     AlreadyExistsError,
     ApiError,
@@ -124,11 +125,19 @@ class Watch:
     def __init__(self):
         self._q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
         self._stopped = threading.Event()
+        # Optional teardown hook (e.g. closing a streaming HTTP response
+        # so a blocked reader thread unblocks immediately).
+        self._on_stop: Optional[Callable[[], None]] = None
 
     def stop(self) -> None:
         if not self._stopped.is_set():
             self._stopped.set()
             self._q.put(None)
+            if self._on_stop is not None:
+                try:
+                    self._on_stop()
+                except Exception:
+                    pass
 
     @property
     def stopped(self) -> bool:
@@ -342,6 +351,11 @@ class FakeKubeClient(KubeClient):
 SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
 
+class _RelistNeeded(Exception):
+    """Internal: the watch history was compacted (410 Gone) — resume
+    requires a fresh list."""
+
+
 @dataclasses.dataclass
 class RestConfig:
     host: str
@@ -401,14 +415,32 @@ class RestConfig:
 class RealKubeClient(KubeClient):
     """REST client over stdlib urllib; JSON wire format.
 
-    Watches poll with list + resourceVersion comparison rather than streaming
-    chunked watch — adequate for the controller's 10-minute-resync informer
-    pattern (imex.go:233) without an async HTTP stack.
+    Watches stream over chunked ``?watch=true`` HTTP (the informer
+    pattern, imex.go:233-287): list to seed, then consume newline-
+    delimited watch events with resourceVersion resume, bookmark
+    handling, and relist-on-410. ``watch_mode="poll"`` keeps the old
+    list-diff poller as a fallback for API servers without watch
+    support. All verbs pass a client-side QPS/burst token bucket
+    (client-go flowcontrol analog, pkg/flags/kubeclient.go:49-64 —
+    same defaults: QPS 5, burst 10; qps<=0 disables).
     """
 
-    def __init__(self, config: Optional[RestConfig] = None, poll_interval: float = 10.0):
+    def __init__(
+        self,
+        config: Optional[RestConfig] = None,
+        poll_interval: float = 10.0,
+        qps: float = 5.0,
+        burst: int = 10,
+        watch_mode: str = "stream",
+    ):
+        if watch_mode not in ("stream", "poll"):
+            raise ValueError(
+                f"watch_mode must be 'stream' or 'poll', got {watch_mode!r}"
+            )
         self.config = config or RestConfig.auto()
         self.poll_interval = poll_interval
+        self.watch_mode = watch_mode
+        self._limiter = TokenBucket(qps=qps, burst=burst)
         self._ssl_ctx = self._make_ssl_ctx()
         self._watch_threads: list[threading.Thread] = []
         self._watches: list[Watch] = []
@@ -458,6 +490,7 @@ class RealKubeClient(KubeClient):
         return url
 
     def _request(self, method: str, url: str, body: dict | None = None) -> dict:
+        self._limiter.acquire()
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(url, data=data, method=method)
         req.add_header("Accept", "application/json")
@@ -490,15 +523,23 @@ class RealKubeClient(KubeClient):
     def get(self, gvr: GVR, name: str, namespace: str = "") -> dict:
         return self._request("GET", self._url(gvr, namespace, name))
 
+    def _list_raw(
+        self,
+        gvr: GVR,
+        namespace: str = "",
+        label_selector: str | None = None,
+    ) -> dict:
+        """Full list response (items + list metadata.resourceVersion)."""
+        q = {"labelSelector": label_selector} if label_selector else None
+        return self._request("GET", self._url(gvr, namespace, query=q))
+
     def list(
         self,
         gvr: GVR,
         namespace: str = "",
         label_selector: str | None = None,
     ) -> list[dict]:
-        q = {"labelSelector": label_selector} if label_selector else None
-        out = self._request("GET", self._url(gvr, namespace, query=q))
-        return out.get("items", [])
+        return self._list_raw(gvr, namespace, label_selector).get("items", [])
 
     def create(self, gvr: GVR, obj: dict, namespace: str = "") -> dict:
         return self._request("POST", self._url(gvr, namespace), obj)
@@ -517,16 +558,192 @@ class RealKubeClient(KubeClient):
         namespace: str = "",
         label_selector: str | None = None,
     ) -> Watch:
+        if self.watch_mode == "stream":
+            return self._watch_stream(gvr, namespace, label_selector)
+        return self._watch_poll(gvr, namespace, label_selector)
+
+    # -- streaming watch ---------------------------------------------------
+
+    def _relist(self, gvr, namespace, label_selector, known, w):
+        """List, diff against ``known`` (name -> resourceVersion), emit
+        the delta, and return the list resourceVersion to resume from.
+
+        Used both to seed a fresh watch (known={}) and to recover from a
+        410 Gone (the server compacted history past our resumeRV): the
+        informer relist — consumers see a consistent event stream either
+        way.
+        """
+        out = self._list_raw(gvr, namespace, label_selector)
+        seen: dict[str, str] = {}
+        for obj in out.get("items", []):
+            name = obj["metadata"]["name"]
+            rv = obj["metadata"].get("resourceVersion", "")
+            seen[name] = rv
+            if name not in known:
+                w._emit(WatchEvent("ADDED", obj))
+            elif known[name] != rv:
+                w._emit(WatchEvent("MODIFIED", obj))
+        for name in set(known) - set(seen):
+            w._emit(WatchEvent(
+                "DELETED", {"metadata": {"name": name, "namespace": namespace}}
+            ))
+        known.clear()
+        known.update(seen)
+        list_rv = (out.get("metadata") or {}).get("resourceVersion", "")
+        if not list_rv and seen:
+            # Servers always set list RV; belt-and-braces fallback.
+            list_rv = max(seen.values(), key=lambda v: int(v or 0))
+        return list_rv
+
+    def _watch_stream(self, gvr, namespace, label_selector) -> Watch:
+        w = Watch()
+
+        def _stream():
+            known: dict[str, str] = {}
+            rv = ""
+            backoff = Backoff(initial=0.2, cap=max(self.poll_interval, 1.0))
+            while not w.stopped:
+                try:
+                    if not rv:
+                        rv = self._relist(gvr, namespace, label_selector, known, w)
+                    rv = self._consume_stream(
+                        gvr, namespace, label_selector, rv, known, w
+                    )
+                    backoff.reset()
+                except _RelistNeeded:
+                    rv = ""          # 410: resume via fresh list
+                    backoff.reset()
+                except Exception as e:
+                    if w.stopped:
+                        break
+                    delay = backoff.next_delay()
+                    logger.warning(
+                        "watch stream %s failed (%s); reconnecting in %.1fs",
+                        gvr.resource, e, delay,
+                    )
+                    w._stopped.wait(delay)
+
+        t = threading.Thread(
+            target=_stream, daemon=True, name=f"watch-{gvr.resource}"
+        )
+        t.start()
+        self._watch_threads.append(t)
+        self._watches.append(w)
+        return w
+
+    def _consume_stream(self, gvr, namespace, label_selector, rv, known, w):
+        """One chunked ``?watch=true`` connection: emit events until the
+        server closes it (timeoutSeconds) or an error ends it. Returns
+        the resourceVersion to resume from; raises _RelistNeeded on 410.
+        """
+        query = {
+            "watch": "true",
+            "allowWatchBookmarks": "true",
+            "resourceVersion": rv,
+            # Server closes the stream after this long; we then resume
+            # from the last seen RV (a cheap request, not a relist).
+            "timeoutSeconds": "300",
+        }
+        if label_selector:
+            query["labelSelector"] = label_selector
+        url = self._url(gvr, namespace, query=query)
+        self._limiter.acquire()
+        if w.stopped:
+            return rv
+        req = urllib.request.Request(url, method="GET")
+        req.add_header("Accept", "application/json")
+        if self.config.token:
+            req.add_header("Authorization", f"Bearer {self.config.token}")
+        try:
+            resp = urllib.request.urlopen(req, context=self._ssl_ctx, timeout=330)
+        except urllib.error.HTTPError as e:
+            if e.code == 410:
+                raise _RelistNeeded() from e
+            raise
+        with resp:
+            self._set_live_response(w, resp)
+            for line in resp:
+                if w.stopped:
+                    break
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    logger.warning(
+                        "watch %s: undecodable event line", gvr.resource
+                    )
+                    continue
+                ev_type = ev.get("type", "")
+                obj = ev.get("object") or {}
+                if ev_type == "BOOKMARK":
+                    rv = (obj.get("metadata") or {}).get("resourceVersion", rv)
+                    continue
+                if ev_type == "ERROR":
+                    if obj.get("code") == 410:
+                        raise _RelistNeeded()
+                    raise ApiError(
+                        f"watch error event: {obj.get('message', obj)}",
+                        code=obj.get("code", 500),
+                    )
+                name = (obj.get("metadata") or {}).get("name", "")
+                rv = (obj.get("metadata") or {}).get("resourceVersion", rv)
+                if ev_type == "DELETED":
+                    known.pop(name, None)
+                elif name:
+                    known[name] = rv
+                w._emit(WatchEvent(ev_type, obj))
+        return rv
+
+    @staticmethod
+    def _set_live_response(w: Watch, resp) -> None:
+        """Point the watch's stop-hook at the live HTTP connection so
+        ``stop()`` can sever it out from under a blocked reader.
+
+        Must be a socket ``shutdown()``, not ``resp.close()``: close
+        acquires the BufferedReader lock the blocked ``readline`` is
+        holding — a deadlock. shutdown() is safe cross-thread and wakes
+        the reader with EOF; the reader thread then closes the response
+        itself.
+        """
+        import socket as _socket
+
+        def _sever():
+            try:
+                resp.fp.raw._sock.shutdown(_socket.SHUT_RDWR)
+            except Exception:
+                pass
+
+        w._on_stop = _sever
+        # stop() may have run between connect and hook installation — it
+        # would have severed nothing; sever here so the reader never
+        # blocks on a connection nobody can cancel.
+        if w.stopped:
+            _sever()
+
+    # -- poll fallback -----------------------------------------------------
+
+    def _watch_poll(self, gvr, namespace, label_selector) -> Watch:
         w = Watch()
 
         def _poll():
             known: dict[str, str] = {}  # name -> resourceVersion
+            backoff = Backoff(initial=self.poll_interval,
+                              cap=max(60.0, self.poll_interval))
             while not w.stopped:
                 try:
                     items = self.list(gvr, namespace, label_selector)
-                except Exception as e:  # transient API failures: keep polling
-                    logger.warning("watch poll %s failed: %s", gvr.resource, e)
-                    items = None
+                    backoff.reset()
+                except Exception as e:  # transient API failures: back off
+                    delay = backoff.next_delay()
+                    logger.warning(
+                        "watch poll %s failed (%s); retrying in %.1fs",
+                        gvr.resource, e, delay,
+                    )
+                    w._stopped.wait(delay)
+                    continue  # backoff IS the retry delay; skip the
+                    # steady-state poll sleep at the loop bottom
                 if items is not None:
                     seen = {}
                     for obj in items:

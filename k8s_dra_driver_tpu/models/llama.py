@@ -246,9 +246,13 @@ def _mlp_block(x, layer, config: LlamaConfig):
 # ``remat_policy="none"`` (or remat=False) disables remat entirely.
 REMAT_POLICIES = {
     "full": None,
-    "flash": ("flash_out", "attn_o"),
-    "flash_qkv": ("flash_out", "flash_qkv", "attn_o"),
-    "flash_mlp": ("flash_out", "attn_o", "mlp_prod"),
+    # "moe_routing" marks the MoE permutation index maps (models/moe.py)
+    # — tiny int32 arrays whose recompute is a serialized TPU scatter/
+    # sort; saving them is ~free and skips that in the backward pass.
+    # Harmless for the dense trunk (the name never appears there).
+    "flash": ("flash_out", "attn_o", "moe_routing"),
+    "flash_qkv": ("flash_out", "flash_qkv", "attn_o", "moe_routing"),
+    "flash_mlp": ("flash_out", "attn_o", "mlp_prod", "moe_routing"),
 }
 
 

@@ -22,6 +22,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.norms import rmsnorm
@@ -52,7 +53,21 @@ class MoeConfig(LlamaConfig):
     # group instead of per sequence (GShard's G knob). The v5e sweep:
     # whole-seq 33.1% -> G=256 37.8% -> G=128 39.1% active-param MFU at
     # 8x160m b8/s2048; 256 is the default (wider capacity margin).
+    # Einsum-path only; the grouped path is dropless (no capacity).
     router_group: int = 256
+    # MLP dispatch implementation:
+    # - "binned": sort-by-expert realized as a scatter into per-
+    #   (group, expert) capacity slots + dense per-expert matmuls —
+    #   IDENTICAL routing/drop semantics to "einsum" (bit-equal up to
+    #   matmul order) at a fraction of the cost: no O(T*E*C*H) one-hot
+    #   dispatch/combine matmuls, no [.., E, C] one-hot temporaries.
+    # - "dropless": token-sort + lax.ragged_dot (megablocks-style); no
+    #   capacity, nothing drops, exactly the active-expert FLOPs.
+    # - "einsum": the GShard one-hot formulation (carries expert-
+    #   sharded meshes: the dispatched activations get an "expert"
+    #   sharding constraint so XLA inserts the all-to-alls).
+    # - "auto": binned on a single device, einsum under a mesh.
+    moe_impl: str = "auto"
 
     def num_params(self) -> int:
         h, m, v, l = self.hidden, self.mlp_hidden, self.vocab_size, self.n_layers
@@ -173,6 +188,33 @@ def _capacity(config: MoeConfig, seq: int) -> int:
     return max(1, int(c.capacity_factor * c.top_k * seq / c.n_experts))
 
 
+def _topk_masks(probs: jax.Array, config: MoeConfig):
+    """Iterative-argmax top-k: per-choice one-hots + gates + Switch aux.
+
+    Shared by every MLP impl so expert choice, tie-breaking, and the
+    load-balancing aux are identical across them (the equivalence tests
+    rely on this). probs: [..., E]; masks/gates lists of length top_k.
+    """
+    c = config
+    e = c.n_experts
+    masks, gates = [], []
+    remaining = probs
+    for _ in range(c.top_k):
+        idx = jnp.argmax(remaining, axis=-1)
+        m = jax.nn.one_hot(idx, e, dtype=probs.dtype)
+        gates.append(jnp.sum(remaining * m, axis=-1))
+        masks.append(m)
+        remaining = remaining * (1.0 - m)
+
+    # Load-balancing aux (Switch eq. 4): frac of tokens whose FIRST choice
+    # is e  ×  mean router prob of e, summed and scaled by E.
+    token_axes = tuple(range(probs.ndim - 1))
+    frac = jnp.mean(masks[0], axis=token_axes)             # [E]
+    mean_prob = jnp.mean(probs, axis=token_axes)           # [E]
+    aux = e * jnp.sum(frac * mean_prob)
+    return masks, gates, aux
+
+
 def _route(probs: jax.Array, config: MoeConfig, cap: int):
     """Static-shape top-k routing with per-expert capacity.
 
@@ -183,20 +225,7 @@ def _route(probs: jax.Array, config: MoeConfig, cap: int):
     """
     c = config
     e = c.n_experts
-    masks, gates = [], []
-    remaining = probs
-    for _ in range(c.top_k):
-        idx = jnp.argmax(remaining, axis=-1)               # [B, S]
-        m = jax.nn.one_hot(idx, e, dtype=probs.dtype)      # [B, S, E]
-        gates.append(jnp.sum(remaining * m, axis=-1))      # [B, S]
-        masks.append(m)
-        remaining = remaining * (1.0 - m)
-
-    # Load-balancing aux (Switch eq. 4): frac of tokens whose FIRST choice
-    # is e  ×  mean router prob of e, summed and scaled by E.
-    frac = jnp.mean(masks[0], axis=(0, 1))                 # [E]
-    mean_prob = jnp.mean(probs, axis=(0, 1))               # [E]
-    aux = e * jnp.sum(frac * mean_prob)
+    masks, gates, aux = _topk_masks(probs, c)
 
     denom = sum(gates) + 1e-9
     dispatch = jnp.zeros(probs.shape + (cap,), probs.dtype)
@@ -214,10 +243,244 @@ def _route(probs: jax.Array, config: MoeConfig, cap: int):
     return dispatch, combine, aux
 
 
+@jax.custom_vjp
+def _gather_rows(x, idx, bwd_idx):
+    """Row gather ``y[i] = x[idx[i]]`` (out-of-bounds -> zero row) whose
+    VJP is ALSO a gather, via the precomputed inverse map ``bwd_idx``
+    [J, len(x)]: dx = sum_j dy[bwd_idx[j]] (OOB -> 0).
+
+    XLA differentiates gathers into scatter-adds, which serialize on
+    TPU; in the MoE dispatch/combine permutations every inverse map is
+    known at trace time (a token occupies at most top_k slots; a slot
+    holds at most one pair), so both directions stay dense VPU gathers.
+    """
+    return jnp.take(x, idx, axis=0, mode="fill", fill_value=0)
+
+
+def _gather_rows_fwd(x, idx, bwd_idx):
+    return _gather_rows(x, idx, bwd_idx), bwd_idx
+
+
+def _gather_rows_bwd(res, dy):
+    bwd_idx = res
+    dx = sum(
+        jnp.take(dy, bwd_idx[j], axis=0, mode="fill", fill_value=0)
+        for j in range(bwd_idx.shape[0])
+    )
+    return dx, None, None
+
+
+_gather_rows.defvjp(_gather_rows_fwd, _gather_rows_bwd)
+
+
+def _moe_block_binned(x, layer, config: MoeConfig):
+    """Sorted capacity-binned sparse MLP: the einsum path's exact
+    routing/drop semantics at a fraction of its cost.
+
+    The GShard one-hot formulation pays twice for dispatch: the
+    O(T*E*C*H) dispatch/combine MATMULS, and the [*, E, C] one-hot
+    temporaries they stream (bwd under remat recomputes them). But the
+    sort-by-expert a grouped matmul needs is already computed by the
+    capacity cumsum: (expert, slot-position) IS the sorted address. So
+    dispatch becomes an integer scatter of row ids into per-
+    (group, expert) capacity bins + one row gather; the expert FFN runs
+    as dense per-expert batched matmuls over [E, rows, H] (pure MXU,
+    standard bwd); combine is one row gather weighted by the gates.
+    Padding waste (capacity_factor - 1) remains — that is the price of
+    the static shapes that make this jit/shard like the dense trunk.
+
+    Identical drops, gates, and tie-breaking to "einsum" (shared
+    _topk_masks + the same cumsum priority): outputs match bit-for-bit
+    up to matmul reduction order — tests pin it at tight capacity.
+    """
+    c = config
+    b, s, h = x.shape
+    e, k, m = c.n_experts, c.top_k, c.mlp_hidden
+    xn = rmsnorm(x, layer["ln_mlp"], c.norm_eps)
+    g = effective_router_group(c, s)
+    cap = _capacity(c, g)
+    bg = b * (s // g)
+    xn = xn.reshape(bg, g, h)
+
+    logits = jnp.einsum(
+        "bsh,he->bse", xn.astype(jnp.float32), layer["wr"]
+    )
+    probs = jax.nn.softmax(logits, axis=-1)
+    masks, gates, aux = _topk_masks(probs, c)              # [Bg, G, E] each
+    denom = sum(gates) + 1e-9
+
+    # Slot addressing: choice k queues behind choices < k (the _route
+    # priority), position via the same cumsum — no [.., E, C] one-hots.
+    count = jnp.zeros((bg, 1, e), probs.dtype)
+    group_ids = jnp.arange(bg, dtype=jnp.int32)[:, None]   # [Bg, 1]
+    slot_l, valid_l, gatew_l = [], [], []
+    for mk, gk in zip(masks, gates):
+        pos = jnp.cumsum(mk, axis=1) - mk + count          # [Bg, G, E]
+        count = count + jnp.sum(mk, axis=1, keepdims=True)
+        pos_tok = jnp.sum(pos * mk, axis=-1).astype(jnp.int32)   # [Bg, G]
+        e_tok = jnp.argmax(mk, axis=-1).astype(jnp.int32)        # [Bg, G]
+        # Expert-major bins so dim 0 of the gathered rows is the expert.
+        slot_l.append((e_tok * bg + group_ids) * cap + pos_tok)
+        valid_l.append(pos_tok < cap)
+        gatew_l.append(gk / denom)
+
+    t = bg * g
+    nslots = e * bg * cap
+    # Pair indexing is k-major: pair (j, token) lives at j*t + token.
+    pair_slot = jnp.where(
+        jnp.stack(valid_l), jnp.stack(slot_l), nslots
+    ).reshape(k, t).astype(jnp.int32)                      # OOB = dropped
+    flat_gate = jnp.stack(gatew_l).reshape(k * t)
+    flat_pair = jnp.arange(k * t, dtype=jnp.int32)
+
+    # Inverse map (ONE integer scatter, outside the differentiable
+    # path): slot -> flat pair id; slot -> token derives from it (pair
+    # p = j*t + token). checkpoint_name: TPU scatters serialize, so the
+    # remat policies save this map instead of recomputing it in bwd.
+    scatter_to = pair_slot.reshape(k * t)
+    slot_pair = checkpoint_name(
+        jnp.full((nslots,), k * t, jnp.int32).at[scatter_to].set(
+            flat_pair, mode="drop"
+        ),
+        "moe_routing",
+    )
+    slot_token = jnp.where(slot_pair < k * t, slot_pair % t, t)
+
+    # Dispatch: one row gather (empty slots -> zero rows); its VJP sums
+    # each token's <= top_k slot rows — gathers both ways, no scatter.
+    xf = xn.reshape(t, h)
+    xe = _gather_rows(xf, slot_token, pair_slot).reshape(e, bg * cap, h)
+
+    gu = jnp.einsum("erh,ehum->erum", xe, q_dequant(layer["w_gateup"], xe.dtype))
+    gate_act = jax.nn.silu(gu[..., 0, :].astype(jnp.float32))
+    up = gu[..., 1, :].astype(jnp.float32)
+    ye = jnp.einsum(
+        "erm,emh->erh", (gate_act * up).astype(x.dtype),
+        q_dequant(layer["w_down"], x.dtype),
+    )
+
+    # Combine: each pair reads its slot row (dropped pairs -> 0); VJP is
+    # the slot -> pair gather.
+    y_pair = _gather_rows(
+        ye.reshape(nslots, h), scatter_to, slot_pair[None]
+    ).astype(jnp.float32) * flat_gate[:, None]
+    out = jnp.sum(y_pair.reshape(k, t, h), axis=0)
+    return x + out.reshape(b, s, h).astype(x.dtype), aux
+
+
+def _moe_block_dropless(x, layer, config: MoeConfig):
+    """Dropless sparse MLP (megablocks-style): top-k route, sort the
+    token-expert pairs by expert, run the experts as two grouped ragged
+    matmuls, then inverse-permute and sum the k contributions per token.
+
+    tpu-first: `lax.ragged_dot` keeps every expert matmul on the MXU at
+    exactly the active-parameter FLOPs — no capacity padding (the einsum
+    path wastes capacity_factor-1 of its expert compute on empty slots)
+    and no O(T*E*C*H) one-hot dispatch/combine matmuls. The data motion
+    is two gathers + one inverse-permutation of [T*k, H] rows and an
+    O(T*k log T*k) integer sort — bandwidth, not FLOPs. Shapes stay
+    fully static (sort/gather/ragged_dot are all fixed-size); only the
+    group_sizes VALUES are data-dependent, which ragged_dot is built
+    for. No tokens drop, so `capacity_factor`/`router_group` do not
+    apply on this path.
+    """
+    c = config
+    b, s, h = x.shape
+    e, k, m = c.n_experts, c.top_k, c.mlp_hidden
+    xn = rmsnorm(x, layer["ln_mlp"], c.norm_eps)
+    t = b * s
+    xf = xn.reshape(t, h)
+
+    logits = jnp.einsum("th,he->te", xf.astype(jnp.float32), layer["wr"])
+    probs = jax.nn.softmax(logits, axis=-1)
+    masks, gate_l, aux = _topk_masks(probs, c)        # [T, E] / [T] each
+    denom = sum(gate_l) + 1e-9
+    gates = jnp.stack(gate_l, axis=1) / denom[:, None]          # [T, k]
+    experts = jnp.stack(
+        [jnp.argmax(mk, axis=-1) for mk in masks], axis=1
+    )                                                 # [T, k]
+
+    flat_e = experts.reshape(t * k).astype(jnp.int32)
+    # Sort + inverse permutation (int ops outside the differentiable
+    # path; named so remat policies save them instead of re-sorting):
+    # inv[p] = sorted position of flat pair p (token-major).
+    order = checkpoint_name(jnp.argsort(flat_e), "moe_routing")
+    token_of = order // k                             # source token per row
+    group_sizes = jnp.bincount(flat_e, length=e).astype(jnp.int32)
+    inv = checkpoint_name(
+        jnp.zeros((t * k,), jnp.int32).at[order].set(
+            jnp.arange(t * k, dtype=jnp.int32)
+        ),
+        "moe_routing",
+    )
+    # Gather-VJP both ways (_gather_rows): dxf[token] sums its k sorted
+    # rows, found via inv — never a TPU scatter-add.
+    xs = _gather_rows(xf, token_of, inv.reshape(t, k).T)  # [T*k, H]
+
+    # Grouped matmuls over the sorted rows: the megablox Pallas kernel
+    # on TPU (tuned tiling, custom VJP = two more grouped matmuls),
+    # lax.ragged_dot elsewhere (CPU tests; its TPU lowering is slower
+    # than the kernel). Either way: exactly the active-expert FLOPs.
+    if jax.default_backend() == "tpu":
+        from jax.experimental.pallas.ops.tpu.megablox import gmm
+
+        def grouped_dot(lhs, rhs):
+            return gmm(
+                lhs, rhs, group_sizes,
+                preferred_element_type=lhs.dtype,
+                tiling=(512, 512, 512),
+            )
+    else:
+        def grouped_dot(lhs, rhs):
+            return jax.lax.ragged_dot(lhs, rhs, group_sizes)
+
+    # (2, m) flattens u-major: [:, :m] is the gate half, [:, m:] the up.
+    w_gu = q_dequant(layer["w_gateup"], xs.dtype).reshape(e, h, 2 * m)
+    gu = grouped_dot(xs, w_gu)                        # [T*k, 2m]
+    gate = jax.nn.silu(gu[:, :m].astype(jnp.float32))
+    up = gu[:, m:].astype(jnp.float32)
+    ys = grouped_dot(
+        (gate * up).astype(x.dtype),
+        q_dequant(layer["w_down"], x.dtype),
+    )                                                 # [T*k, H]
+
+    yw = ys.astype(jnp.float32) * jnp.take(gates.reshape(t * k), order)[:, None]
+    # Unsort by gathering at inv; the VJP gathers back through order.
+    out = jnp.sum(
+        _gather_rows(yw, inv, order[None]).reshape(t, k, h), axis=1
+    )
+    return x + out.reshape(b, s, h).astype(x.dtype), aux
+
+
 def _moe_block(x, layer, config: MoeConfig, mesh: Optional[Mesh]):
     """Sparse MLP: route → dispatch einsum → per-expert fused gate/up +
-    down → combine einsum → residual. Returns (x, aux)."""
+    down → combine einsum → residual. Returns (x, aux).
+
+    Dispatches to the dropless grouped path (`_moe_block_grouped`) per
+    `config.moe_impl`; this einsum body is the GShard capacity-based
+    formulation that carries expert-sharded meshes."""
     c = config
+    impl = c.moe_impl
+    if impl == "auto":
+        impl = "einsum" if mesh is not None else "binned"
+    elif impl != "einsum" and mesh is not None:
+        # The sorted paths emit no sharding constraints and the megablox
+        # kernel is not shard-aware: silently dropping the mesh would
+        # mean no expert all-to-alls and wrong performance. Only the
+        # einsum path carries expert-sharded meshes today.
+        raise ValueError(
+            f"moe_impl={c.moe_impl!r} does not support a mesh; use "
+            "'einsum' (or 'auto', which selects it) for sharded runs"
+        )
+    if impl in ("binned", "grouped"):   # "grouped" = megablocks term
+        return _moe_block_binned(x, layer, c)
+    if impl == "dropless":
+        return _moe_block_dropless(x, layer, c)
+    if impl != "einsum":
+        raise ValueError(
+            f"unknown moe_impl {c.moe_impl!r}; valid: "
+            "auto, binned, dropless, einsum"
+        )
     b, s, h = x.shape
     xn = rmsnorm(x, layer["ln_mlp"], c.norm_eps)
     g = effective_router_group(c, s)

@@ -21,7 +21,7 @@ import sys
 from ..kube.client import NODES
 from ..tpulib.chiplib import ChipLib, ChipLibConfig, FakeChipLib, RealChipLib
 from ..utils.cli import env as _env
-from ..utils.cli import install_signal_stop, make_kube_client
+from ..utils.cli import add_kube_client_flags, install_signal_stop, make_kube_client
 from .driver import Driver, DriverConfig
 
 logger = logging.getLogger(__name__)
@@ -74,6 +74,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "same as --driver-root [DRIVER_ROOT_CTR_PATH]")
     p.add_argument("--kubeconfig", default=_env("KUBECONFIG", ""),
                    help="kubeconfig path (default: in-cluster) [KUBECONFIG]")
+    add_kube_client_flags(p)
     p.add_argument("--no-kube", action="store_true",
                    help="run without a Kubernetes API server (dev mode)")
     p.add_argument("--fake-topology", default=_env("FAKE_TOPOLOGY", ""),
@@ -185,7 +186,9 @@ def main(argv=None) -> int:
     kube_client = None
     node_uid = ""
     if not args.no_kube:
-        kube_client = make_kube_client(args.kubeconfig)
+        kube_client = make_kube_client(
+            args.kubeconfig, qps=args.kube_api_qps, burst=args.kube_api_burst
+        )
         node_uid = lookup_node_uid(kube_client, args.node_name)
 
     dev_root, driver_root_ctr = resolve_roots(args)

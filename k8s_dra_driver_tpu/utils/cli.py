@@ -16,9 +16,10 @@ def env(name: str, default: str = "") -> str:
     return os.environ.get(name, default)
 
 
-def make_kube_client(kubeconfig: str = ""):
+def make_kube_client(kubeconfig: str = "", qps: float = 5.0, burst: int = 10):
     """In-cluster config unless a kubeconfig is given
-    (NewClientSets analog, pkg/flags/kubeclient.go:70-106)."""
+    (NewClientSets analog, pkg/flags/kubeclient.go:70-106; QPS/burst
+    defaults mirror kubeclient.go:49-64)."""
     from ..kube.client import RealKubeClient, RestConfig
 
     cfg = (
@@ -26,7 +27,24 @@ def make_kube_client(kubeconfig: str = ""):
         if kubeconfig
         else RestConfig.auto()
     )
-    return RealKubeClient(cfg)
+    return RealKubeClient(cfg, qps=qps, burst=burst)
+
+
+def add_kube_client_flags(parser) -> None:
+    """--kube-api-qps/--kube-api-burst with env mirrors (the reference's
+    kube-client flag block, pkg/flags/kubeclient.go:40-68)."""
+    parser.add_argument(
+        "--kube-api-qps",
+        type=float,
+        default=float(env("KUBE_API_QPS", "5")),
+        help="client-side QPS limit toward the API server (<=0 disables)",
+    )
+    parser.add_argument(
+        "--kube-api-burst",
+        type=int,
+        default=int(env("KUBE_API_BURST", "10")),
+        help="client-side burst allowance toward the API server",
+    )
 
 
 def install_signal_stop() -> threading.Event:

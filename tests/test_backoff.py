@@ -1,0 +1,67 @@
+"""TokenBucket (client-go flowcontrol analog) and Backoff unit tests.
+
+Reference behaviors mirrored: QPS/burst client-side limiting
+(lengrongfu/k8s-dra-driver, pkg/flags/kubeclient.go:49-64) and
+transient-error retry delay (cmd/nvidia-dra-controller/imex.go:143-162).
+"""
+
+import time
+
+import pytest
+
+from k8s_dra_driver_tpu.utils.backoff import Backoff, TokenBucket
+
+
+class TestTokenBucket:
+    def test_burst_is_free_then_rate_limited(self):
+        tb = TokenBucket(qps=100, burst=5)
+        t0 = time.monotonic()
+        for _ in range(5):
+            tb.acquire()
+        burst_time = time.monotonic() - t0
+        assert burst_time < 0.04, burst_time
+        t0 = time.monotonic()
+        for _ in range(5):
+            tb.acquire()
+        limited_time = time.monotonic() - t0
+        assert limited_time >= 0.04, limited_time  # ~5 * 10ms
+
+    def test_try_acquire_nonblocking(self):
+        tb = TokenBucket(qps=1, burst=2)
+        assert tb.try_acquire()
+        assert tb.try_acquire()
+        assert not tb.try_acquire()  # bucket empty, must not block
+
+    def test_refill_caps_at_burst(self):
+        tb = TokenBucket(qps=1000, burst=3)
+        for _ in range(3):
+            assert tb.try_acquire()
+        time.sleep(0.05)  # 50 tokens worth of refill, capped at 3
+        grabbed = sum(tb.try_acquire() for _ in range(10))
+        assert grabbed == 3
+
+    def test_zero_qps_disables(self):
+        tb = TokenBucket(qps=0, burst=1)
+        t0 = time.monotonic()
+        for _ in range(1000):
+            tb.acquire()
+        assert time.monotonic() - t0 < 0.5
+
+    def test_burst_must_be_positive(self):
+        with pytest.raises(ValueError):
+            TokenBucket(qps=5, burst=0)
+
+
+class TestBackoff:
+    def test_exponential_with_cap(self):
+        b = Backoff(initial=1.0, cap=5.0, factor=2.0)
+        assert [b.next_delay() for _ in range(4)] == [1.0, 2.0, 4.0, 5.0]
+        assert b.next_delay() == 5.0  # stays at cap
+
+    def test_reset_restarts_sequence(self):
+        b = Backoff(initial=0.5, cap=10.0)
+        b.next_delay()
+        b.next_delay()
+        b.reset()
+        assert b.current == 0.0
+        assert b.next_delay() == 0.5

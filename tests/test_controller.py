@@ -153,6 +153,75 @@ class TestDomainLifecycle:
         assert DomainKey("a-b").pool_name != DomainKey("a", "b").pool_name
 
 
+class TestOutageRecovery:
+    def test_publish_retries_through_api_outage(self):
+        """The reconciler keeps retrying with a delay while the API
+        server errors on every slice verb, and converges once it heals
+        (transient-error retry, imex.go:143-162 analog)."""
+        from k8s_dra_driver_tpu.kube.errors import ApiError
+
+        client = FakeKubeClient()
+        outage = {"remaining": 6, "seen": 0}
+
+        def inject(verb, gvr, name):
+            if gvr.resource == RESOURCE_SLICES.resource:
+                outage["seen"] += 1
+                if outage["remaining"] > 0:
+                    outage["remaining"] -= 1
+                    return ApiError("api server down", code=500)
+            return None
+
+        client.fault_injector = inject
+        client.create(NODES, node("n1", "slice-a"))
+        mgr = IciSliceManager(client)
+        mgr.slice_controller.resync_seconds = 0.05  # fast retry in test
+        mgr.start()
+        try:
+            assert wait_for(
+                lambda: outage["remaining"] == 0
+                and client.list(RESOURCE_SLICES)
+            ), f"never recovered: {outage}"
+            assert mgr.slice_controller.sync_errors >= 1
+            slices = client.list(RESOURCE_SLICES)
+            assert len(slices[0]["spec"]["devices"]) == CHANNELS_PER_POOL
+        finally:
+            client.fault_injector = None
+            mgr.stop()
+
+    def test_node_events_resume_after_outage(self):
+        """Node events arriving while publishes fail are not lost: the
+        desired state accumulates and lands once the API heals."""
+        from k8s_dra_driver_tpu.kube.errors import ApiError
+
+        client = FakeKubeClient()
+        down = {"on": True}
+
+        def inject(verb, gvr, name):
+            if down["on"] and gvr.resource == RESOURCE_SLICES.resource \
+                    and verb in ("create", "update", "delete", "list"):
+                return ApiError("api server down", code=500)
+            return None
+
+        mgr = IciSliceManager(client)
+        mgr.slice_controller.resync_seconds = 0.05
+        mgr.start()
+        client.fault_injector = inject
+        try:
+            client.create(NODES, node("n1", "slice-a"))
+            client.create(NODES, node("n2", "slice-b"))
+            time.sleep(0.2)     # publishes failing throughout
+            down["on"] = False  # heal
+            assert wait_for(
+                lambda: len({
+                    s["spec"]["pool"]["name"]
+                    for s in client.list(RESOURCE_SLICES)
+                }) == 2
+            ), client.list(RESOURCE_SLICES)
+        finally:
+            client.fault_injector = None
+            mgr.stop()
+
+
 class TestOffsetRecovery:
     def test_restart_preserves_channel_numbering(self):
         client = FakeKubeClient()

@@ -77,11 +77,13 @@ class TestForward:
         assert np.isfinite(np.array(logits)).all()
         assert np.isfinite(float(aux))
 
-    def test_single_expert_equals_dense(self):
+    @pytest.mark.parametrize("impl", ["einsum", "binned", "dropless"])
+    def test_single_expert_equals_dense(self, impl):
         """E=1/top_k=1 with capacity >= S reduces exactly to the dense
-        trunk with the same weights (router prob is 1)."""
+        trunk with the same weights (router prob is 1) — on BOTH MLP
+        dispatch implementations."""
         cfg = dataclasses.replace(
-            CFG, n_experts=1, top_k=1, capacity_factor=1.0,
+            CFG, n_experts=1, top_k=1, capacity_factor=1.0, moe_impl=impl,
         )
         params = init_params(cfg, jax.random.PRNGKey(0))
         dense_params = {
@@ -103,7 +105,9 @@ class TestForward:
     def test_router_group_matches_whole_sequence_at_full_capacity(self):
         """With capacity ample enough that nothing drops, grouped routing
         picks the same experts/gates as whole-sequence routing."""
-        base = dataclasses.replace(CFG, capacity_factor=4.0)
+        base = dataclasses.replace(
+            CFG, capacity_factor=4.0, moe_impl="einsum"
+        )
         grouped = dataclasses.replace(base, router_group=16)
         params = init_params(base, jax.random.PRNGKey(0))
         t = tokens()
@@ -127,6 +131,126 @@ class TestForward:
         # The router receives gradient (it is on the differentiable path
         # through the combine weights and the aux loss).
         assert float(jnp.sum(jnp.abs(grads["layers"]["wr"]))) > 0
+
+
+class TestSortedImpls:
+    """The binned (capacity, sorted-scatter + dense grouped matmul) and
+    dropless (token-sort + ragged_dot) dispatch paths."""
+
+    @pytest.mark.parametrize("impl", ["binned", "dropless"])
+    def test_matches_einsum_when_nothing_drops(self, impl):
+        """With capacity ample enough that the einsum path drops nothing,
+        every implementation computes the same function."""
+        einsum_cfg = dataclasses.replace(
+            CFG, capacity_factor=8.0, router_group=0, moe_impl="einsum"
+        )
+        other_cfg = dataclasses.replace(einsum_cfg, moe_impl=impl)
+        params = init_params(einsum_cfg, jax.random.PRNGKey(0))
+        t = tokens()
+        o1, aux1 = forward(params, t, einsum_cfg)
+        o2, aux2 = forward(params, t, other_cfg)
+        np.testing.assert_allclose(
+            np.array(o1), np.array(o2), atol=3e-5, rtol=3e-5
+        )
+        np.testing.assert_allclose(float(aux1), float(aux2), rtol=1e-5)
+
+    @pytest.mark.parametrize("group", [0, 16])
+    def test_binned_matches_einsum_exactly_with_drops(self, group):
+        """binned IS the einsum formulation (same cumsum priority, same
+        drops, same gates) computed via scatter/gather — outputs agree
+        even at a capacity tight enough to drop most pairs, and with
+        per-group routing."""
+        einsum_cfg = dataclasses.replace(
+            CFG, capacity_factor=0.25, router_group=group, moe_impl="einsum"
+        )
+        binned_cfg = dataclasses.replace(einsum_cfg, moe_impl="binned")
+        params = init_params(einsum_cfg, jax.random.PRNGKey(0))
+        t = tokens()
+        o1, _ = forward(params, t, einsum_cfg)
+        o2, _ = forward(params, t, binned_cfg)
+        np.testing.assert_allclose(
+            np.array(o1), np.array(o2), atol=3e-5, rtol=3e-5
+        )
+
+    @pytest.mark.parametrize("impl", ["binned", "dropless"])
+    def test_grads_match_einsum_when_nothing_drops(self, impl):
+        """The sorted paths route gradients through custom-VJP gathers
+        (inverse index maps); at ample capacity they compute the same
+        function as einsum, so autodiff of einsum is the ground truth
+        for every parameter's gradient."""
+        einsum_cfg = dataclasses.replace(
+            CFG, capacity_factor=8.0, router_group=0, moe_impl="einsum"
+        )
+        other_cfg = dataclasses.replace(einsum_cfg, moe_impl=impl)
+        params = init_params(einsum_cfg, jax.random.PRNGKey(0))
+        t = jax.random.randint(
+            jax.random.PRNGKey(3), (2, 65), 0, CFG.vocab_size
+        )
+        g1 = jax.grad(lambda p: loss_fn(p, t, einsum_cfg))(params)
+        g2 = jax.grad(lambda p: loss_fn(p, t, other_cfg))(params)
+        flat1 = jax.tree_util.tree_leaves_with_path(g1)
+        flat2 = jax.tree_util.tree_leaves(g2)
+        for (path, a), b in zip(flat1, flat2):
+            np.testing.assert_allclose(
+                np.array(a), np.array(b), atol=2e-4, rtol=2e-3,
+                err_msg=jax.tree_util.keystr(path),
+            )
+
+    def test_dropless_keeps_overflow_tokens(self):
+        """Where a tight capacity makes the einsum path drop expert
+        contributions, the dropless path keeps them — outputs must
+        differ, and the dropless output must equal the ample-capacity
+        einsum output (the ground truth with no drops)."""
+        tight = dataclasses.replace(
+            CFG, capacity_factor=0.25, router_group=0, moe_impl="einsum"
+        )
+        ample = dataclasses.replace(tight, capacity_factor=8.0)
+        dropless = dataclasses.replace(tight, moe_impl="dropless")
+        params = init_params(tight, jax.random.PRNGKey(0))
+        t = tokens()
+        o_tight, _ = forward(params, t, tight)
+        o_ample, _ = forward(params, t, ample)
+        o_dropless, _ = forward(params, t, dropless)
+        np.testing.assert_allclose(
+            np.array(o_dropless), np.array(o_ample), atol=3e-5, rtol=3e-5
+        )
+        assert float(jnp.max(jnp.abs(o_dropless - o_tight))) > 1e-4
+
+    @pytest.mark.parametrize("impl", ["binned", "dropless"])
+    def test_loss_and_grads_finite(self, impl):
+        cfg = dataclasses.replace(CFG, moe_impl=impl)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        t = jax.random.randint(
+            jax.random.PRNGKey(3), (2, 65), 0, cfg.vocab_size
+        )
+        loss, grads = jax.jit(jax.value_and_grad(
+            lambda p: loss_fn(p, t, cfg, remat=True)
+        ))(params)
+        assert np.isfinite(float(loss))
+        for leaf in jax.tree_util.tree_leaves(grads):
+            assert np.isfinite(np.array(leaf)).all()
+        # Router and every expert weight are on the differentiable path.
+        assert float(jnp.sum(jnp.abs(grads["layers"]["wr"]))) > 0
+        assert float(jnp.sum(jnp.abs(grads["layers"]["w_gateup"]))) > 0
+
+    def test_auto_selects_by_mesh(self, devices):
+        """auto = binned single-device, einsum under a mesh — the
+        einsum path must still carry the expert-sharded train config
+        unchanged (loss agrees with the unsharded binned loss)."""
+        mesh = build_mesh(MeshConfig(data=2, expert=4), devices=devices[:8])
+        # Ample capacity so the einsum path drops nothing and the only
+        # difference left is the impl auto-selection itself.
+        cfg = dataclasses.replace(CFG, capacity_factor=8.0)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        t = jax.random.randint(
+            jax.random.PRNGKey(3), (2, 65), 0, cfg.vocab_size
+        )
+        unsharded = float(loss_fn(params, t, cfg))          # grouped
+        sharded = shard_pytree(params, mesh, param_specs(cfg))
+        meshed = float(jax.jit(
+            lambda p, tk: loss_fn(p, tk, cfg, mesh=mesh)
+        )(sharded, t))                                       # einsum
+        assert abs(unsharded - meshed) < 5e-4
 
 
 class TestMoeTrainStep:
@@ -157,15 +281,19 @@ class TestExpertParallel:
         mesh = build_mesh(
             MeshConfig(data=2, expert=4), devices=devices[:8]
         )
-        params = init_params(CFG, jax.random.PRNGKey(0))
+        # Sharding invariance of the einsum path: same impl on both
+        # sides (mesh=None auto-selects grouped, which is a different
+        # function when capacity drops tokens).
+        cfg = dataclasses.replace(CFG, moe_impl="einsum")
+        params = init_params(cfg, jax.random.PRNGKey(0))
         t = jax.random.randint(
-            jax.random.PRNGKey(3), (2, 65), 0, CFG.vocab_size
+            jax.random.PRNGKey(3), (2, 65), 0, cfg.vocab_size
         )
-        ref = float(loss_fn(params, t, CFG))
+        ref = float(loss_fn(params, t, cfg))
 
-        sharded = shard_pytree(params, mesh, param_specs(CFG))
+        sharded = shard_pytree(params, mesh, param_specs(cfg))
         loss = jax.jit(
-            lambda p, tk: loss_fn(p, tk, CFG, mesh=mesh)
+            lambda p, tk: loss_fn(p, tk, cfg, mesh=mesh)
         )(sharded, t)
         assert abs(float(loss) - ref) < 1e-4
 
@@ -175,16 +303,17 @@ class TestExpertParallel:
         mesh = build_mesh(
             MeshConfig(expert=2, sequence=2, tensor=2), devices=devices[:8]
         )
-        params = init_params(CFG, jax.random.PRNGKey(0))
-        sharded = shard_pytree(params, mesh, param_specs(CFG))
+        cfg = dataclasses.replace(CFG, moe_impl="einsum")
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        sharded = shard_pytree(params, mesh, param_specs(cfg))
         t = jax.random.randint(
-            jax.random.PRNGKey(3), (2, 65), 0, CFG.vocab_size
+            jax.random.PRNGKey(3), (2, 65), 0, cfg.vocab_size
         )
-        ref = float(loss_fn(params, t, CFG))
+        ref = float(loss_fn(params, t, cfg))
         loss, grads = jax.jit(
             jax.value_and_grad(
                 lambda p: loss_fn(
-                    p, t, CFG, mesh=mesh, use_ring=True, remat=True
+                    p, t, cfg, mesh=mesh, use_ring=True, remat=True
                 )
             )
         )(sharded)

@@ -8,7 +8,9 @@ mapping and the poll-based watch are exercised over real HTTP.
 """
 
 import json
+import queue
 import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -34,6 +36,12 @@ class StubApiServer:
         self.rv = 0
         self.requests: list[tuple[str, str]] = []  # (method, path)
         self.auth_headers: list[str] = []
+        # Streaming-watch state: one queue per live watch connection.
+        self.watch_queues: list[queue.Queue] = []
+        self.watch_rvs: list[str] = []   # resourceVersion each watch resumed from
+        self.watch_410_once = False      # next watch request gets 410 Gone
+        self.mute = False                # drop broadcasts (simulated lag)
+        self.closing = False
         stub = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -72,8 +80,10 @@ class StubApiServer:
                     if obj is None:
                         return self._status(404, "NotFound", rest)
                     return self._send(200, obj)
-                items = list(stub.objects.values())
                 q = urllib.parse.parse_qs(url.query)
+                if q.get("watch", ["false"])[0] == "true":
+                    return self._watch(q)
+                items = list(stub.objects.values())
                 sel = q.get("labelSelector", [""])[0]
                 if sel:
                     k, _, v = sel.partition("=")
@@ -81,8 +91,43 @@ class StubApiServer:
                         o for o in items
                         if o["metadata"].get("labels", {}).get(k) == v
                     ]
-                return self._send(200, {"kind": "ResourceSliceList",
-                                        "items": items})
+                return self._send(200, {
+                    "kind": "ResourceSliceList",
+                    "metadata": {"resourceVersion": str(stub.rv)},
+                    "items": items,
+                })
+
+            def _watch(self, q):
+                """Chunked newline-delimited watch events, real API-server
+                style: the connection stays open and mutations stream."""
+                if stub.watch_410_once:
+                    stub.watch_410_once = False
+                    return self._status(410, "Expired",
+                                        "too old resource version")
+                # Register the queue BEFORE announcing the connection via
+                # watch_rvs: a test that waits for the connection and then
+                # broadcasts must not race the registration.
+                qq: queue.Queue = queue.Queue()
+                stub.watch_queues.append(qq)
+                stub.watch_rvs.append(q.get("resourceVersion", [""])[0])
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                try:
+                    while not stub.closing:
+                        try:
+                            ev = qq.get(timeout=0.05)
+                        except queue.Empty:
+                            continue
+                        if ev is None:     # server-side end of this stream
+                            break
+                        self.wfile.write((json.dumps(ev) + "\n").encode())
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                finally:
+                    if qq in stub.watch_queues:
+                        stub.watch_queues.remove(qq)
 
             def do_POST(self):
                 self._record()
@@ -95,6 +140,7 @@ class StubApiServer:
                 stub.rv += 1
                 obj["metadata"]["resourceVersion"] = str(stub.rv)
                 stub.objects[name] = obj
+                stub.broadcast({"type": "ADDED", "object": obj})
                 self._send(201, obj)
 
             def do_PUT(self):
@@ -112,6 +158,7 @@ class StubApiServer:
                 stub.rv += 1
                 obj["metadata"]["resourceVersion"] = str(stub.rv)
                 stub.objects[name] = obj
+                stub.broadcast({"type": "MODIFIED", "object": obj})
                 self._send(200, obj)
 
             def do_DELETE(self):
@@ -119,7 +166,8 @@ class StubApiServer:
                 name = self.path[len(self.prefix):].strip("/")
                 if name not in stub.objects:
                     return self._status(404, "NotFound", name)
-                del stub.objects[name]
+                gone = stub.objects.pop(name)
+                stub.broadcast({"type": "DELETED", "object": gone})
                 self._send(200, {"kind": "Status", "status": "Success"})
 
             def log_message(self, *args):
@@ -128,12 +176,33 @@ class StubApiServer:
         self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
         self.port = self._server.server_address[1]
 
+    def broadcast(self, event: dict) -> None:
+        """Push a watch event to every live watch connection."""
+        if self.mute:
+            return
+        for q in list(self.watch_queues):
+            q.put(event)
+
+    def end_watch_streams(self) -> None:
+        """Server-side close of all live watch connections (the
+        timeoutSeconds expiry a real API server performs)."""
+        for q in list(self.watch_queues):
+            q.put(None)
+
+    def wait_watch_connections(self, n: int, deadline_s: float = 5.0) -> None:
+        deadline = time.monotonic() + deadline_s
+        while len(self.watch_rvs) < n and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(self.watch_rvs) >= n, self.watch_rvs
+
     def start(self):
         threading.Thread(
             target=self._server.serve_forever, daemon=True
         ).start()
 
     def stop(self):
+        self.closing = True
+        self.end_watch_streams()
         self._server.shutdown()
         self._server.server_close()
 
@@ -142,9 +211,12 @@ class StubApiServer:
 def api():
     stub = StubApiServer()
     stub.start()
+    # qps=0: functional tests should not sleep in the throttle; the
+    # throttle has its own test below.
     client = RealKubeClient(
         RestConfig(host=f"http://127.0.0.1:{stub.port}", token="tok-123"),
         poll_interval=0.05,
+        qps=0,
     )
     yield stub, client
     # Close the client FIRST: orphaned poll threads outliving the stub
@@ -221,49 +293,166 @@ class TestRealClientWatch:
         watch diffs list snapshots, so an update+delete landing inside one
         poll window legitimately coalesces to DELETED only — the sequence
         is only observable when mutations land in separate poll cycles."""
-        import time
-
-        stub, client = api
+        stub, client_stream = api
+        client = RealKubeClient(
+            RestConfig(host=f"http://127.0.0.1:{stub.port}"),
+            poll_interval=0.05, qps=0, watch_mode="poll",
+        )
         client.create(RESOURCE_SLICES, mkslice("s1"))
         w = client.watch(RESOURCE_SLICES)
-        events = []
-
-        def consume():
-            for ev in w.events():
-                events.append((ev.type, ev.object["metadata"]["name"]))
-
-        t = threading.Thread(target=consume, daemon=True)
-        t.start()
-
-        def wait_for(ev, deadline_s=5.0):
-            deadline = time.monotonic() + deadline_s
-            while ev not in events and time.monotonic() < deadline:
-                time.sleep(0.01)
-            assert ev in events, events
-
+        events, t = collect_events(w)
         try:
-            wait_for(("ADDED", "s1"))
+            wait_for(events, ("ADDED", "s1"))
             obj = client.get(RESOURCE_SLICES, "s1")
             obj["spec"]["pool"]["generation"] = 2
             client.update(RESOURCE_SLICES, obj)
-            wait_for(("MODIFIED", "s1"))
+            wait_for(events, ("MODIFIED", "s1"))
             client.delete(RESOURCE_SLICES, "s1")
-            wait_for(("DELETED", "s1"))
+            wait_for(events, ("DELETED", "s1"))
         finally:
             w.stop()
+            client.close()
         t.join(timeout=5)
         assert not t.is_alive()
 
     def test_watch_survives_server_errors(self, api):
-        """Transient API failures must not kill the poll loop."""
-        import time
-
+        """Transient API failures must not kill the watch loop (it backs
+        off and reconnects)."""
         stub, client = api
         w = client.watch(RESOURCE_SLICES)
         time.sleep(0.1)
-        stub.stop()  # poll now fails
+        stub.stop()  # stream now fails
         time.sleep(0.15)
         # Restart on the same port is racy; instead just assert the thread
         # is still alive and the watch is not stopped.
         assert not w.stopped
         w.stop()
+
+
+def collect_events(w):
+    """Start a consumer thread appending (type, name) tuples."""
+    events = []
+
+    def consume():
+        for ev in w.events():
+            events.append((ev.type, ev.object["metadata"].get("name", "")))
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    return events, t
+
+
+def wait_for(events, ev, deadline_s=5.0):
+    deadline = time.monotonic() + deadline_s
+    while ev not in events and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert ev in events, events
+
+
+class TestStreamingWatch:
+    """The chunked ?watch=true informer path (imex.go:233-287 analog)."""
+
+    def test_seed_then_streamed_events(self, api):
+        stub, client = api
+        client.create(RESOURCE_SLICES, mkslice("seed"))
+        w = client.watch(RESOURCE_SLICES)
+        events, t = collect_events(w)
+        try:
+            wait_for(events, ("ADDED", "seed"))       # from the seed list
+            stub.wait_watch_connections(1)
+            client.create(RESOURCE_SLICES, mkslice("live"))
+            wait_for(events, ("ADDED", "live"))       # streamed, no relist
+            obj = client.get(RESOURCE_SLICES, "live")
+            obj["spec"]["pool"]["generation"] = 2
+            client.update(RESOURCE_SLICES, obj)
+            wait_for(events, ("MODIFIED", "live"))
+            client.delete(RESOURCE_SLICES, "live")
+            wait_for(events, ("DELETED", "live"))
+            # The stream carried the mutations: exactly one list request
+            # (the seed) was needed.
+            lists = [p for m, p in stub.requests
+                     if m == "GET" and "watch=true" not in p
+                     and p.rstrip("/").endswith("resourceslices")]
+            assert len(lists) == 1, stub.requests
+        finally:
+            w.stop()
+        t.join(timeout=5)
+        assert not t.is_alive()
+
+    def test_resume_from_bookmark_rv(self, api):
+        """BOOKMARK events advance the resume resourceVersion without
+        emitting; the next (re)connect resumes from the bookmarked RV."""
+        stub, client = api
+        w = client.watch(RESOURCE_SLICES)
+        events, t = collect_events(w)
+        try:
+            stub.wait_watch_connections(1)
+            stub.broadcast({
+                "type": "BOOKMARK",
+                "object": {"metadata": {"resourceVersion": "42"}},
+            })
+            time.sleep(0.1)
+            stub.end_watch_streams()     # server-side timeout expiry
+            stub.wait_watch_connections(2)
+            assert stub.watch_rvs[1] == "42"
+            assert events == []          # bookmarks never surface
+        finally:
+            w.stop()
+        t.join(timeout=5)
+
+    def test_410_gone_triggers_relist_with_diff(self, api):
+        """History compaction: the reconnect gets 410 Gone, the client
+        relists and emits the delta against its known set."""
+        stub, client = api
+        client.create(RESOURCE_SLICES, mkslice("a"))
+        w = client.watch(RESOURCE_SLICES)
+        events, t = collect_events(w)
+        try:
+            wait_for(events, ("ADDED", "a"))
+            stub.wait_watch_connections(1)
+            # While "disconnected": a new object appears and the old one
+            # dies; the watch stream never carries either event.
+            stub.mute = True
+            client.create(RESOURCE_SLICES, mkslice("b"))
+            client.delete(RESOURCE_SLICES, "a")
+            stub.watch_410_once = True
+            stub.end_watch_streams()
+            wait_for(events, ("ADDED", "b"))
+            wait_for(events, ("DELETED", "a"))
+        finally:
+            w.stop()
+        t.join(timeout=5)
+
+    def test_watch_label_selector_passed(self, api):
+        stub, client = api
+        w = client.watch(RESOURCE_SLICES, label_selector="scope=x")
+        try:
+            stub.wait_watch_connections(1)
+            watch_reqs = [p for m, p in stub.requests if "watch=true" in p]
+            assert any("labelSelector=scope%3Dx" in p for p in watch_reqs)
+        finally:
+            w.stop()
+
+
+class TestClientThrottle:
+    def test_qps_burst_limits_request_rate(self, api):
+        """11 requests at qps=50/burst=5: the first 5 ride the burst,
+        the next 6 must wait ~20ms each — total >= ~120ms."""
+        stub, client_unlimited = api
+        client = RealKubeClient(
+            RestConfig(host=f"http://127.0.0.1:{stub.port}"),
+            qps=50, burst=5,
+        )
+        t0 = time.monotonic()
+        for _ in range(11):
+            client.list(RESOURCE_SLICES)
+        elapsed = time.monotonic() - t0
+        assert elapsed >= 0.1, elapsed
+        client.close()
+
+    def test_unlimited_when_qps_zero(self, api):
+        stub, client = api      # fixture client is qps=0
+        t0 = time.monotonic()
+        for _ in range(20):
+            client.list(RESOURCE_SLICES)
+        assert time.monotonic() - t0 < 2.0
