@@ -2,7 +2,7 @@
 IMAGE ?= tpu-dra-driver
 TAG ?= latest
 
-.PHONY: all native test image lint clean
+.PHONY: all native test image lint clean e2e-kind
 
 all: native
 
@@ -23,6 +23,13 @@ lint:
 
 image:
 	docker build -t $(IMAGE):$(TAG) -f deployments/container/Dockerfile .
+
+# The real-control-plane gate: kind + helm + the REAL scheduler
+# allocating tpu-test1 end-to-end, cross-checked against the sim
+# allocator. Needs docker/kind/kubectl/helm; exits 3 (skip) without
+# them. Writes a transcript next to the script.
+e2e-kind:
+	demo/clusters/kind/e2e.sh
 
 clean:
 	$(MAKE) -C k8s_dra_driver_tpu/native clean

@@ -7,96 +7,150 @@ prompts is N steady-state decode steps with the tunnel round-trip and
 prompt processing cancelled. Decode is HBM-bound — every step streams
 all weights except the embedding table, which is only gathered — so the
 roofline companion is non_embed_params_bytes / HBM_bandwidth.
-Remote compiles are minutes per program — this tool compiles exactly two.
+Remote compiles are minutes per program — this tool compiles exactly two
+(and `enable_compile_cache()` makes later runs of the same shapes load
+from the persistent cache instead of recompiling).
+
+Knobs (script mode): TPU_DRA_DECODE_PRESET (e.g. 160m-gqa, 1b),
+TPU_DRA_DECODE_PROMPT (long-context cache costs), TPU_DRA_DECODE_QUANT
+("int8" = weights, "int8-kv" = KV cache, "int8,int8-kv" = both).
 """
 import os
 import time
 
 import jax
 
-from k8s_dra_driver_tpu.models.decode import generate, prefill
-from k8s_dra_driver_tpu.models.llama import PRESETS, init_params
-from k8s_dra_driver_tpu.models.quant import quantize_params
+HBM_BW = 810e9  # v5e
 
-# The 1b preset's generate program takes >15 min in the remote compiler
-# (while_loop + layer scan + 128k-vocab head in one program); 160m keeps
-# the tool usable (~2 min/program) and the per-step roofline comparison
-# is the same shape. Knobs: TPU_DRA_DECODE_PRESET (e.g. 160m-gqa),
-# TPU_DRA_DECODE_PROMPT (long-context cache costs), TPU_DRA_DECODE_QUANT
-# ("int8" = weights, "int8-kv" = KV cache, "int8,int8-kv" = both).
-PRESET = os.environ.get("TPU_DRA_DECODE_PRESET", "160m")
-BATCH = 8
-PROMPT = int(os.environ.get("TPU_DRA_DECODE_PROMPT", "128"))
-N = 96
-_quant_modes = set(
-    m.strip() for m in os.environ.get("TPU_DRA_DECODE_QUANT", "").split(",")
-    if m.strip()
-)
-QUANT = "int8" in _quant_modes
-QUANT_KV = "int8-kv" in _quant_modes
 
-config = PRESETS[PRESET]
-params = jax.jit(lambda k: init_params(config, k))(jax.random.PRNGKey(0))
-if QUANT:
-    params = jax.jit(quantize_params)(params)
-
-prompts = [
-    jax.random.randint(
-        jax.random.PRNGKey(10 + i), (BATCH, PROMPT), 0, config.vocab_size
+def enable_compile_cache(path: str = "") -> None:
+    """Persistent compilation cache: the 1b generate program costs many
+    minutes in the remote compiler; cached, it loads in seconds on every
+    later run (bench.py calls this so round-over-round benches pay the
+    compile once)."""
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        path or os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                               os.path.join(os.path.dirname(__file__),
+                                            ".jax_cache")),
     )
-    for i in range(8)
-]
-jax.block_until_ready(prompts)
-
-# Both programs size their KV cache identically so prefill cost matches.
-gen = jax.jit(
-    lambda p: generate(params, p, config, N, quantize_cache=QUANT_KV)
-)
-pre = jax.jit(
-    lambda p: prefill(params, p, config, PROMPT + N, quantize_cache=QUANT_KV)
-)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 10)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 
-def run(fn, prompt, out_of):
+def run_decode_bench(
+    preset: str = "160m",
+    batch: int = 8,
+    prompt_len: int = 128,
+    n_steps: int = 96,
+    quant: bool = False,
+    quant_kv: bool = False,
+) -> dict:
+    """One decode measurement -> a bench.py-style metric dict."""
+    from k8s_dra_driver_tpu.models.decode import generate, prefill
+    from k8s_dra_driver_tpu.models.llama import PRESETS, init_params
+    from k8s_dra_driver_tpu.models.quant import quantize_params
+
+    config = PRESETS[preset]
+    params = jax.jit(lambda k: init_params(config, k))(jax.random.PRNGKey(0))
+    if quant:
+        params = jax.jit(quantize_params)(params)
+
+    prompts = [
+        jax.random.randint(
+            jax.random.PRNGKey(10 + i), (batch, prompt_len), 0,
+            config.vocab_size,
+        )
+        for i in range(8)
+    ]
+    jax.block_until_ready(prompts)
+
+    # Both programs size their KV cache identically so prefill cost
+    # matches and the difference isolates the decode steps. Params are
+    # ARGUMENTS, not a closure: closed-over arrays are captured as
+    # constants in the lowered program (gigabytes embedded in the HLO),
+    # which is what made the 1b generate compile take >15 min remotely.
+    gen = jax.jit(
+        lambda w, p: generate(w, p, config, n_steps,
+                              quantize_cache=quant_kv)
+    )
+    pre = jax.jit(
+        lambda w, p: prefill(w, p, config, prompt_len + n_steps,
+                             quantize_cache=quant_kv)
+    )
+
+    def run(fn, prompt, out_of):
+        t0 = time.perf_counter()
+        out = fn(params, prompt)
+        float(out_of(out))  # forces execution through remote runtimes
+        return time.perf_counter() - t0
+
     t0 = time.perf_counter()
-    out = fn(prompt)
-    float(out_of(out))  # forces execution through remote runtimes
-    return time.perf_counter() - t0
+    run(gen, prompts[6], lambda o: o[0, -1])
+    gen_compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    run(pre, prompts[7], lambda o: o[0][0, 0])
+    pre_compile_s = time.perf_counter() - t0
+
+    diffs = sorted(
+        run(gen, prompts[2 * i], lambda o: o[0, -1])
+        - run(pre, prompts[2 * i + 1], lambda o: o[0][0, 0])
+        for i in range(3)
+    )
+    step = diffs[1] / n_steps  # median
+
+    # Embedding rows are gathered, not streamed; everything else (incl.
+    # the lm_head matmul) is read in full every step. The cache read
+    # grows with the filled length; charge the mean over the span.
+    streamed = config.num_params() - config.vocab_size * config.hidden
+    w_bytes = 1 if quant else 2  # int8 vs bf16 (scales negligible)
+    mean_len = prompt_len + n_steps / 2
+    cache_elems = (
+        2 * config.n_layers * batch * config.n_kv_heads
+        * mean_len * config.head_dim
+    )
+    c_bytes = 1 if quant_kv else 2
+    roofline_s = (streamed * w_bytes + cache_elems * c_bytes) / HBM_BW
+    tags = "".join(
+        t for t, on in (("-int8", quant), ("-kvq", quant_kv)) if on
+    )
+    return {
+        "metric": f"llama3_{preset}{tags}_decode_toks_b{batch}_p{prompt_len}",
+        "value": round(batch / step, 1),
+        "unit": "tokens_per_s",
+        # Fraction of the HBM roofline achieved (1.0 = bandwidth-bound
+        # and perfect); the serving analog of vs_baseline.
+        "vs_baseline": round(roofline_s / step, 4),
+        "detail": {
+            "step_ms": round(step * 1e3, 3),
+            "hbm_roofline_ms": round(roofline_s * 1e3, 3),
+            "compile_s": round(gen_compile_s + pre_compile_s, 1),
+        },
+    }
 
 
-t0 = time.perf_counter()
-run(gen, prompts[6], lambda o: o[0, -1])
-print(f"generate compiled in {time.perf_counter()-t0:.0f}s", flush=True)
-t0 = time.perf_counter()
-run(pre, prompts[7], lambda o: o[0][0, 0])
-print(f"prefill compiled in {time.perf_counter()-t0:.0f}s", flush=True)
+def main():
+    enable_compile_cache()
+    quant_modes = set(
+        m.strip()
+        for m in os.environ.get("TPU_DRA_DECODE_QUANT", "").split(",")
+        if m.strip()
+    )
+    r = run_decode_bench(
+        preset=os.environ.get("TPU_DRA_DECODE_PRESET", "160m"),
+        batch=8,
+        prompt_len=int(os.environ.get("TPU_DRA_DECODE_PROMPT", "128")),
+        quant="int8" in quant_modes,
+        quant_kv="int8-kv" in quant_modes,
+    )
+    print(
+        f"decode {r['metric']}: {r['detail']['step_ms']} ms/step, "
+        f"{r['value']} tok/s aggregate "
+        f"(HBM roofline ~{r['detail']['hbm_roofline_ms']} ms/step, "
+        f"{r['vs_baseline']:.0%} of roofline)",
+        flush=True,
+    )
 
-diffs = sorted(
-    run(gen, prompts[2 * i], lambda o: o[0, -1])
-    - run(pre, prompts[2 * i + 1], lambda o: o[0][0, 0])
-    for i in range(3)
-)
-step = diffs[1] / N  # median
-# Embedding rows are gathered, not streamed; everything else (incl. the
-# lm_head matmul) is read in full every step. The cache read grows with
-# the filled length; charge the mean over the measured decode span.
-streamed = config.num_params() - config.vocab_size * config.hidden
-w_bytes = 1 if QUANT else 2  # int8 vs bf16 (scales negligible)
-mean_len = PROMPT + N / 2
-cache_elems = (
-    2 * config.n_layers * BATCH * config.n_kv_heads
-    * mean_len * config.head_dim
-)
-c_bytes = 1 if QUANT_KV else 2
-hbm_roofline_ms = (
-    (streamed * w_bytes + cache_elems * c_bytes) / 810e9 * 1e3  # v5e HBM BW
-)
-tags = "".join(
-    t for t, on in (("-int8", QUANT), ("-kvq", QUANT_KV)) if on
-)
-print(
-    f"decode {PRESET}{tags} b{BATCH} prompt{PROMPT}: "
-    f"{step*1e3:.2f} ms/step, {BATCH/step:.0f} tok/s aggregate "
-    f"(HBM roofline ~{hbm_roofline_ms:.2f} ms/step)",
-    flush=True,
-)
+
+if __name__ == "__main__":
+    main()

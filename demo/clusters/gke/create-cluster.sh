@@ -1,0 +1,63 @@
+#!/usr/bin/env bash
+# Create a GKE cluster with DRA enabled and a TPU node pool, ready for
+# the tpu.google.com DRA driver.
+# Role of the reference's demo/clusters/gke/create-cluster.sh (which
+# builds a GPU alpha cluster + driver-installer DaemonSets); the TPU
+# path is simpler: GKE installs libtpu on TPU node images itself, so
+# the only prep is the cluster API surface and the pool labels.
+set -euo pipefail
+
+PROJECT="${PROJECT:-$(gcloud config list --format 'value(core.project)' 2>/dev/null)}"
+if [ -z "${PROJECT}" ]; then
+  echo "no project set; run: gcloud config set project <id>" >&2
+  exit 1
+fi
+
+CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra-cluster}"
+REGION="${REGION:-us-central2}"
+NODE_LOCATION="${NODE_LOCATION:-us-central2-b}"
+# TPU pool shape. v5e single-host: ct5lp-hightpu-4t + topology 2x2.
+# Multi-host slice (the ICI gang-scheduling demo): topology 2x4 or
+# bigger spans hosts; every host of the slice lands in one node pool
+# and GKE labels each with its slice metadata.
+TPU_MACHINE_TYPE="${TPU_MACHINE_TYPE:-ct5lp-hightpu-4t}"
+TPU_TOPOLOGY="${TPU_TOPOLOGY:-2x2}"
+NUM_NODES="${NUM_NODES:-1}"
+
+# DRA needs the resource.k8s.io API group served:
+# - 1.31: alpha clusters only (v1alpha3, feature gate DynamicResourceAllocation)
+# - 1.32+: --enable-kubernetes-unstable-apis can serve v1beta1 on
+#   standard clusters. Match helm plugin.apiVersions to the kubelet
+#   generation (docs/operations.md "Version skew").
+gcloud container clusters create "${CLUSTER_NAME}" \
+  --quiet \
+  --project "${PROJECT}" \
+  --region "${REGION}" \
+  --node-locations "${NODE_LOCATION}" \
+  --enable-kubernetes-alpha \
+  --no-enable-autorepair \
+  --no-enable-autoupgrade \
+  --num-nodes 1
+
+# The TPU pool. gke-no-default-tpu-device-plugin keeps GKE's bundled
+# device plugin from claiming the chips (the DRA driver owns them — the
+# analog of the reference's gke-no-default-nvidia-gpu-device-plugin
+# label); tpu.google.com/chips=true is what the driver DaemonSet
+# selects on (helm values-gke.yaml).
+gcloud container node-pools create tpu-pool \
+  --quiet \
+  --project "${PROJECT}" \
+  --cluster "${CLUSTER_NAME}" \
+  --region "${REGION}" \
+  --node-locations "${NODE_LOCATION}" \
+  --machine-type "${TPU_MACHINE_TYPE}" \
+  --tpu-topology "${TPU_TOPOLOGY}" \
+  --num-nodes "${NUM_NODES}" \
+  --no-enable-autoupgrade \
+  --no-enable-autorepair \
+  --node-labels=gke-no-default-tpu-device-plugin=true,tpu.google.com/chips=true
+
+gcloud container clusters get-credentials "${CLUSTER_NAME}" \
+  --project "${PROJECT}" --region "${REGION}"
+
+echo "cluster ${CLUSTER_NAME} ready; next: ./install-dra-driver.sh"

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Tear down the GKE demo cluster (reference analog:
+# demo/clusters/gke/delete-cluster.sh).
+set -euo pipefail
+
+PROJECT="${PROJECT:-$(gcloud config list --format 'value(core.project)' 2>/dev/null)}"
+CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra-cluster}"
+REGION="${REGION:-us-central2}"
+
+gcloud container clusters delete "${CLUSTER_NAME}" \
+  --quiet --project "${PROJECT}" --region "${REGION}"

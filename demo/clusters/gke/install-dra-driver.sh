@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Install the tpu.google.com DRA driver into a GKE cluster via the
+# values-gke.yaml overlay (reference analog:
+# demo/clusters/gke/install-dra-driver.sh).
+set -euo pipefail
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+CHART="${SCRIPT_DIR}/../../../deployments/helm/tpu-dra-driver"
+
+# Push deployments/container's image somewhere the cluster can pull.
+: "${IMAGE_REGISTRY:?set IMAGE_REGISTRY, e.g. us-docker.pkg.dev/<proj>/<repo>}"
+: "${IMAGE_NAME:=tpu-dra-driver}"
+: "${IMAGE_TAG:=latest}"
+# GKE labels TPU pools with the accelerator flavor; the DaemonSet's
+# nodeSelector must match YOUR pool (v5e: tpu-v5-lite-podslice,
+# v5p: tpu-v5p-slice, v4: tpu-v4-podslice).
+: "${GKE_TPU_ACCELERATOR:=tpu-v5-lite-podslice}"
+# k8s 1.31 registers DRA plugins as "1.0.0"; 1.32+ wants
+# "v1beta1.DRAPlugin" (see docs/operations.md "Version skew").
+: "${PLUGIN_API_VERSIONS:=1.0.0}"
+
+helm upgrade -i --create-namespace --namespace tpu-dra tpu-dra-driver \
+  "${CHART}" \
+  -f "${CHART}/values-gke.yaml" \
+  --set image.repository="${IMAGE_REGISTRY}/${IMAGE_NAME}" \
+  --set image.tag="${IMAGE_TAG}" \
+  --set "plugin.nodeSelector.cloud\.google\.com/gke-tpu-accelerator=${GKE_TPU_ACCELERATOR}" \
+  --set "plugin.apiVersions={${PLUGIN_API_VERSIONS}}" \
+  --set "plugin.tolerations[0].key=google.com/tpu" \
+  --set "plugin.tolerations[0].operator=Exists" \
+  --set "plugin.tolerations[0].effect=NoSchedule"
+
+kubectl -n tpu-dra rollout status ds/tpu-dra-driver-plugin --timeout=180s || true
+echo "check: kubectl get resourceslices -o wide"

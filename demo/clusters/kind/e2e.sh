@@ -1,0 +1,76 @@
+#!/usr/bin/env bash
+# The kind e2e gate: helm-install the driver with a fake topology into a
+# real kind cluster, wait for the REAL API server to carry our
+# ResourceSlices, schedule tpu-test1 through the REAL structured-
+# parameters scheduler, verify the pod saw the driver-injected TPU env,
+# and cross-check the allocation against the in-repo sim allocator.
+#
+# Everything end-to-end in the repo otherwise runs against FakeKubeClient
+# + ReferenceAllocator; this is the gate that proves the real control
+# plane accepts what we publish (reference equivalent: the manual kind
+# demo, demo/clusters/kind/scripts/create-kind-cluster.sh:27-32).
+#
+# Requires: docker, kind, kubectl, helm. Exits 3 ("skip") when absent so
+# CI without docker records a skip, not a failure. A transcript is
+# written next to this script.
+set -euo pipefail
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+REPO_ROOT="$(cd "${SCRIPT_DIR}/../../.." && pwd)"
+export CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra-e2e}"
+KEEP_CLUSTER="${KEEP_CLUSTER:-0}"
+
+for tool in docker kind kubectl helm; do
+  if ! command -v "$tool" >/dev/null 2>&1; then
+    echo "SKIP: $tool not available; the kind e2e gate needs docker+kind+kubectl+helm" >&2
+    exit 3
+  fi
+done
+
+TRANSCRIPT="${SCRIPT_DIR}/e2e-transcript-$(date +%Y%m%d-%H%M%S).log"
+exec > >(tee "${TRANSCRIPT}") 2>&1
+echo "=== kind e2e gate; transcript: ${TRANSCRIPT}"
+
+cleanup() {
+  if [ "${KEEP_CLUSTER}" != "1" ]; then
+    "${SCRIPT_DIR}/delete-cluster.sh" || true
+  fi
+}
+trap cleanup EXIT
+
+echo "=== 1/5 create cluster (DRA feature gates + CDI)"
+"${SCRIPT_DIR}/create-cluster.sh"
+
+echo "=== 2/5 build + load + install driver (fake 2x2 topology)"
+"${SCRIPT_DIR}/install-dra-driver.sh"
+
+echo "=== 3/5 wait for ResourceSlices from the REAL API server"
+deadline=$(( $(date +%s) + 180 ))
+while true; do
+  count="$(kubectl get resourceslices -o json 2>/dev/null \
+    | python3 -c 'import json,sys; d=json.load(sys.stdin); print(sum(len(s["spec"].get("devices",[])) for s in d["items"] if s["spec"].get("driver")=="tpu.google.com"))' \
+    || echo 0)"
+  if [ "${count}" -ge 4 ]; then
+    echo "real API server carries ${count} tpu.google.com devices"
+    break
+  fi
+  if [ "$(date +%s)" -ge "${deadline}" ]; then
+    echo "FAIL: no tpu.google.com ResourceSlices appeared" >&2
+    kubectl get resourceslices -o yaml || true
+    kubectl -n tpu-dra get pods -o wide || true
+    kubectl -n tpu-dra logs -l app.kubernetes.io/name=tpu-dra-driver --tail=50 || true
+    exit 1
+  fi
+  sleep 3
+done
+kubectl get resourceslices -o wide
+
+echo "=== 4/5 schedule tpu-test1 through the REAL scheduler"
+"${SCRIPT_DIR}/run-demo.sh"
+
+echo "=== 5/5 cross-check the real allocation against the sim allocator"
+kubectl get resourceslices -o json > /tmp/e2e-slices.json
+kubectl -n tpu-test1 get resourceclaim -o json > /tmp/e2e-claims.json
+python3 "${REPO_ROOT}/tools/sim_check_allocation.py" \
+  /tmp/e2e-slices.json /tmp/e2e-claims.json
+
+echo "=== e2e-kind PASSED"

@@ -307,12 +307,14 @@ def claim_visibility_env(
         by_uuid.setdefault(core.parent.uuid, core.parent)
     env = chip_visibility_env(list(by_uuid.values()))
     if cores:
-        core_ids = ",".join(
-            f"{c.parent.index}:{c.core_index}"
-            for c in sorted(cores, key=lambda c: (c.parent.index, c.core_index))
+        # A multi-core partition profile exposes EVERY core it spans.
+        pairs = sorted(
+            (c.parent.index, core)
+            for c in cores
+            for core in c.spanned_cores()
         )
-        env["TPU_VISIBLE_CORES"] = core_ids
-        env["TPU_PROCESS_BOUNDS"] = f"1,1,{len(cores)}"
+        env["TPU_VISIBLE_CORES"] = ",".join(f"{i}:{j}" for i, j in pairs)
+        env["TPU_PROCESS_BOUNDS"] = f"1,1,{len(pairs)}"
         env["TPU_MEGACORE"] = "0"  # cores addressed independently, not fused
     return env
 

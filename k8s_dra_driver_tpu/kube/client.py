@@ -744,25 +744,24 @@ class RealKubeClient(KubeClient):
                     w._stopped.wait(delay)
                     continue  # backoff IS the retry delay; skip the
                     # steady-state poll sleep at the loop bottom
-                if items is not None:
-                    seen = {}
-                    for obj in items:
-                        name = obj["metadata"]["name"]
-                        rv = obj["metadata"].get("resourceVersion", "")
-                        seen[name] = rv
-                        if name not in known:
-                            w._emit(WatchEvent("ADDED", obj))
-                        elif known[name] != rv:
-                            w._emit(WatchEvent("MODIFIED", obj))
-                    for name in set(known) - set(seen):
-                        w._emit(
-                            WatchEvent(
-                                "DELETED",
-                                {"metadata": {"name": name, "namespace": namespace}},
-                            )
+                seen = {}
+                for obj in items:
+                    name = obj["metadata"]["name"]
+                    rv = obj["metadata"].get("resourceVersion", "")
+                    seen[name] = rv
+                    if name not in known:
+                        w._emit(WatchEvent("ADDED", obj))
+                    elif known[name] != rv:
+                        w._emit(WatchEvent("MODIFIED", obj))
+                for name in set(known) - set(seen):
+                    w._emit(
+                        WatchEvent(
+                            "DELETED",
+                            {"metadata": {"name": name, "namespace": namespace}},
                         )
-                    known.clear()
-                    known.update(seen)
+                    )
+                known.clear()
+                known.update(seen)
                 w._stopped.wait(self.poll_interval)
 
         t = threading.Thread(target=_poll, daemon=True, name=f"watch-{gvr.resource}")

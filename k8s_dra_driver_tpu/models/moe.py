@@ -56,17 +56,21 @@ class MoeConfig(LlamaConfig):
     # Einsum-path only; the grouped path is dropless (no capacity).
     router_group: int = 256
     # MLP dispatch implementation:
+    # - "einsum": the GShard one-hot formulation. On TPU the one-hot
+    #   dispatch/combine lower to MXU matmuls and OUTRUN sorted-gather
+    #   dispatch (profiled ~0.1 ms/layer vs row gathers at ~30x below
+    #   memcpy bandwidth on v5e); also the only path that carries
+    #   expert-sharded meshes (the dispatched activations get an
+    #   "expert" sharding constraint so XLA inserts the all-to-alls).
     # - "binned": sort-by-expert realized as a scatter into per-
     #   (group, expert) capacity slots + dense per-expert matmuls —
     #   IDENTICAL routing/drop semantics to "einsum" (bit-equal up to
-    #   matmul order) at a fraction of the cost: no O(T*E*C*H) one-hot
-    #   dispatch/combine matmuls, no [.., E, C] one-hot temporaries.
-    # - "dropless": token-sort + lax.ragged_dot (megablocks-style); no
-    #   capacity, nothing drops, exactly the active-expert FLOPs.
-    # - "einsum": the GShard one-hot formulation (carries expert-
-    #   sharded meshes: the dispatched activations get an "expert"
-    #   sharding constraint so XLA inserts the all-to-alls).
-    # - "auto": binned on a single device, einsum under a mesh.
+    #   matmul order), no one-hot temporaries; wins where gathers are
+    #   cheap relative to matmul (not v5e).
+    # - "dropless": token-sort + grouped matmul (megablocks-style;
+    #   megablox kernel on TPU); no capacity, nothing drops, exactly
+    #   the active-expert FLOPs — the quality option.
+    # - "auto": einsum (fastest measured on-chip, and mesh-capable).
     moe_impl: str = "auto"
 
     def num_params(self) -> int:
@@ -112,6 +116,15 @@ MOE_PRESETS: dict[str, MoeConfig] = {
     "8x7b": MoeConfig(
         vocab_size=32000, hidden=4096, n_layers=32, n_heads=32, n_kv_heads=8,
         mlp_hidden=14336, max_seq_len=8192, rope_theta=1e6,
+        n_experts=8, top_k=2,
+    ),
+    # Mixtral-8x7B per-layer shapes at the depth that fits one 16G chip
+    # (the moe analog of the dense "8b-L8" proxy: MFU is set by the
+    # per-layer geometry — d=128 heads, m=14336 experts — not depth;
+    # L=2 already exceeds 16G with gradients resident).
+    "8x7b-L1": MoeConfig(
+        vocab_size=32000, hidden=4096, n_layers=1, n_heads=32, n_kv_heads=8,
+        mlp_hidden=14336, max_seq_len=2048, rope_theta=1e6,
         n_experts=8, top_k=2,
     ),
 }
@@ -312,46 +325,41 @@ def _moe_block_binned(x, layer, config: MoeConfig):
     # Slot addressing: choice k queues behind choices < k (the _route
     # priority), position via the same cumsum — no [.., E, C] one-hots.
     count = jnp.zeros((bg, 1, e), probs.dtype)
-    group_ids = jnp.arange(bg, dtype=jnp.int32)[:, None]   # [Bg, 1]
-    slot_l, valid_l, gatew_l = [], [], []
+    e_l, pos_l, valid_l, gatew_l = [], [], [], []
     for mk, gk in zip(masks, gates):
         pos = jnp.cumsum(mk, axis=1) - mk + count          # [Bg, G, E]
         count = count + jnp.sum(mk, axis=1, keepdims=True)
-        pos_tok = jnp.sum(pos * mk, axis=-1).astype(jnp.int32)   # [Bg, G]
-        e_tok = jnp.argmax(mk, axis=-1).astype(jnp.int32)        # [Bg, G]
-        # Expert-major bins so dim 0 of the gathered rows is the expert.
-        slot_l.append((e_tok * bg + group_ids) * cap + pos_tok)
-        valid_l.append(pos_tok < cap)
+        pos_l.append(jnp.sum(pos * mk, axis=-1).astype(jnp.int32))  # [Bg, G]
+        e_l.append(jnp.argmax(mk, axis=-1).astype(jnp.int32))       # [Bg, G]
+        valid_l.append(pos_l[-1] < cap)
         gatew_l.append(gk / denom)
+    e_tok = jnp.stack(e_l)                                 # [k, Bg, G]
+    pos_tok = jnp.stack(pos_l)
+    valid = jnp.stack(valid_l)
+    gates_w = jnp.stack(gatew_l)                           # [k, Bg, G] f32
 
+    # Global expert-major slots, one int scatter for the inverse map,
+    # custom-VJP row gathers (bwd = more gathers, never a scatter-add).
     t = bg * g
     nslots = e * bg * cap
-    # Pair indexing is k-major: pair (j, token) lives at j*t + token.
-    pair_slot = jnp.where(
-        jnp.stack(valid_l), jnp.stack(slot_l), nslots
-    ).reshape(k, t).astype(jnp.int32)                      # OOB = dropped
-    flat_gate = jnp.stack(gatew_l).reshape(k * t)
-    flat_pair = jnp.arange(k * t, dtype=jnp.int32)
-
-    # Inverse map (ONE integer scatter, outside the differentiable
-    # path): slot -> flat pair id; slot -> token derives from it (pair
-    # p = j*t + token). checkpoint_name: TPU scatters serialize, so the
-    # remat policies save this map instead of recomputing it in bwd.
+    group_ids = jnp.arange(bg, dtype=jnp.int32)[None, :, None]
+    slot_global = (e_tok * bg + group_ids) * cap + pos_tok
+    pair_slot = jnp.where(valid, slot_global, nslots).reshape(k, t)
     scatter_to = pair_slot.reshape(k * t)
     slot_pair = checkpoint_name(
         jnp.full((nslots,), k * t, jnp.int32).at[scatter_to].set(
-            flat_pair, mode="drop"
+            jnp.arange(k * t, dtype=jnp.int32), mode="drop"
         ),
         "moe_routing",
     )
     slot_token = jnp.where(slot_pair < k * t, slot_pair % t, t)
-
-    # Dispatch: one row gather (empty slots -> zero rows); its VJP sums
-    # each token's <= top_k slot rows — gathers both ways, no scatter.
     xf = xn.reshape(t, h)
     xe = _gather_rows(xf, slot_token, pair_slot).reshape(e, bg * cap, h)
 
-    gu = jnp.einsum("erh,ehum->erum", xe, q_dequant(layer["w_gateup"], xe.dtype))
+    gu = checkpoint_name(
+        jnp.einsum("erh,ehum->erum", xe, q_dequant(layer["w_gateup"], xe.dtype)),
+        "moe_gu",
+    )
     gate_act = jax.nn.silu(gu[..., 0, :].astype(jnp.float32))
     up = gu[..., 1, :].astype(jnp.float32)
     ye = jnp.einsum(
@@ -359,11 +367,9 @@ def _moe_block_binned(x, layer, config: MoeConfig):
         q_dequant(layer["w_down"], x.dtype),
     )
 
-    # Combine: each pair reads its slot row (dropped pairs -> 0); VJP is
-    # the slot -> pair gather.
     y_pair = _gather_rows(
         ye.reshape(nslots, h), scatter_to, slot_pair[None]
-    ).astype(jnp.float32) * flat_gate[:, None]
+    ).astype(jnp.float32) * gates_w.reshape(k * t)[:, None]
     out = jnp.sum(y_pair.reshape(k, t, h), axis=0)
     return x + out.reshape(b, s, h).astype(x.dtype), aux
 
@@ -452,17 +458,28 @@ def _moe_block_dropless(x, layer, config: MoeConfig):
     return x + out.reshape(b, s, h).astype(x.dtype), aux
 
 
-def _moe_block(x, layer, config: MoeConfig, mesh: Optional[Mesh]):
+def _moe_block(x, layer, config: MoeConfig, mesh: Optional[Mesh],
+               shard_batch: bool = True):
     """Sparse MLP: route → dispatch einsum → per-expert fused gate/up +
     down → combine einsum → residual. Returns (x, aux).
 
-    Dispatches to the dropless grouped path (`_moe_block_grouped`) per
-    `config.moe_impl`; this einsum body is the GShard capacity-based
-    formulation that carries expert-sharded meshes."""
+    Dispatches per `config.moe_impl`; this einsum body is the GShard
+    capacity-based formulation that carries expert-sharded meshes.
+    ``shard_batch=False`` drops the data/fsdp axes from the dispatch
+    constraint — required inside a partially-manual pipeline shard_map,
+    where those axes are manual and may not appear in GSPMD constraints.
+    """
     c = config
     impl = c.moe_impl
     if impl == "auto":
-        impl = "einsum" if mesh is not None else "binned"
+        # einsum everywhere: on TPU the one-hot dispatch/combine run as
+        # MXU matmuls (~0.1 ms/layer profiled at 8x160m b8) and beat the
+        # sorted paths, whose row gathers lower ~30x below memcpy
+        # bandwidth on v5e (37.8% vs 36.5%/29.9% active MFU); under a
+        # mesh it is also the only expert-sharded path. "binned" (same
+        # drop semantics, gather dispatch) and "dropless" (no drops,
+        # megablox grouped matmul) remain explicit opt-ins.
+        impl = "einsum"
     elif impl != "einsum" and mesh is not None:
         # The sorted paths emit no sharding constraints and the megablox
         # kernel is not shard-aware: silently dropping the mesh would
@@ -498,15 +515,19 @@ def _moe_block(x, layer, config: MoeConfig, mesh: Optional[Mesh]):
     # [E, B, C, H]: expert-major so the "expert" mesh axis shards dim 0.
     xe = jnp.einsum("bsec,bsh->ebch", dispatch.astype(xn.dtype), xn)
     if mesh is not None and "expert" in mesh.shape:
+        batch_spec = ("data", "fsdp") if shard_batch else None
         xe = jax.lax.with_sharding_constraint(
             xe, jax.sharding.NamedSharding(
-                mesh, P("expert", ("data", "fsdp"), None, None)
+                mesh, P("expert", batch_spec, None, None)
             )
         )
     # q_dequant is the int8-serving seam (models/quant.py): identity for
     # float weights, fused dequant for QuantTensor expert stacks.
-    gu = jnp.einsum(
-        "ebch,ehum->ebcum", xe, q_dequant(layer["w_gateup"], xe.dtype)
+    gu = checkpoint_name(
+        jnp.einsum(
+            "ebch,ehum->ebcum", xe, q_dequant(layer["w_gateup"], xe.dtype)
+        ),
+        "moe_gu",
     )
     gate = jax.nn.silu(gu[..., 0, :].astype(jnp.float32))
     up = gu[..., 1, :].astype(jnp.float32)
@@ -553,6 +574,76 @@ def forward(
     if return_hidden:
         return x, aux
     return q_matmul(x, params["lm_head"]).astype(jnp.float32), aux
+
+
+def forward_pipelined(
+    params: dict,
+    tokens: jax.Array,                  # [B, S] int32
+    config: MoeConfig,
+    mesh: Mesh,
+    n_microbatches: int = 2,
+    return_hidden: bool = False,
+):
+    """Causal MoE LM forward as a GPipe pipeline over the mesh "pipe"
+    axis, COMPOSED with expert/tensor sharding: the pipeline shard_map
+    is manual only over pipe + batch axes (parallel/pipeline.py
+    ``manual_only=False``), so the einsum MLP's "expert" sharding
+    constraints still reach GSPMD inside each stage. The Switch aux loss
+    rides the pipeline as a per-sample activation channel (GPipe moves
+    activations; a scalar carry would not survive the microbatch
+    schedule). Returns (hidden_or_logits, aux).
+    """
+    from ..parallel.pipeline import pipeline, stage_params
+
+    c = config
+    n_stages = mesh.shape.get("pipe", 1)
+    if c.n_layers % n_stages:
+        raise ValueError(
+            f"{c.n_layers} layers do not split over {n_stages} stages"
+        )
+    s = tokens.shape[1]
+    x = q_lookup(params["embed"], tokens, c.dtype)
+    cos, sin = rope_frequencies(c.head_dim, s, c.rope_theta, dtype=jnp.float32)
+    staged = stage_params(params["layers"], n_stages)
+
+    def stage_fn(stage_layers, act):
+        def body(carry, layer):
+            h, aux = carry
+            h = _attention_block(h, layer, c, cos, sin, None, False)
+            h, aux_l = _moe_block(h, layer, c, mesh, shard_batch=False)
+            return (h, aux + aux_l), None
+
+        (h, aux), _ = jax.lax.scan(
+            body, (act["x"], jnp.zeros((), jnp.float32)), stage_layers
+        )
+        # Spread the stage's aux over the microbatch rows so it moves
+        # with the activations.
+        return {"x": h, "aux": act["aux"] + aux / act["aux"].shape[0]}
+
+    out = pipeline(
+        stage_fn,
+        staged,
+        {"x": x, "aux": jnp.zeros((tokens.shape[0],), jnp.float32)},
+        mesh=mesh,
+        n_microbatches=n_microbatches,
+        manual_only=False,
+    )
+    # Each (batch shard x microbatch) contributed its own per-layer aux
+    # mean over its local tokens; averaging over all contributions
+    # recovers the whole-batch statistic (exactly for the load
+    # fractions, approximately for the frac x mean-prob product —
+    # equal-sized shards keep the bias negligible). The batch axes are
+    # MANUAL inside the pipeline shard_map, so each shard's rows carry
+    # that shard's full aux — dividing by the shard count keeps the
+    # term invariant to the dp/fsdp degree.
+    batch_shards = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
+    aux = jnp.sum(out["aux"]) / (
+        c.n_layers * n_microbatches * batch_shards
+    )
+    h = rmsnorm(out["x"], params["final_norm"], c.norm_eps)
+    if return_hidden:
+        return h, aux
+    return q_matmul(h, params["lm_head"]).astype(jnp.float32), aux
 
 
 def loss_fn(

@@ -35,63 +35,98 @@ def stage_params(layer_stack: Any, n_stages: int) -> Any:
 
 
 def pipeline(
-    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_fn: Callable[[Any, Any], Any],
     staged: Any,                      # [n_stages, L/P, ...] pytree
-    x: jax.Array,                     # [B, ...]
+    x: Any,                           # [B, ...] array or pytree of them
     *,
     mesh: Mesh,
     n_microbatches: int,
     axis_name: str = "pipe",
     batch_axes: Optional[tuple] = ("data", "fsdp"),
-) -> jax.Array:
-    """Run ``stage_fn`` (same-shape activation transform, e.g. a scan over
-    this stage's transformer layers) as a P-stage pipeline. Returns the
-    transformed batch."""
-    b = x.shape[0]
+    manual_only: bool = True,
+) -> Any:
+    """Run ``stage_fn`` (same-structure activation transform, e.g. a scan
+    over this stage's transformer layers) as a P-stage pipeline. Returns
+    the transformed batch.
+
+    ``x`` may be a pytree of same-leading-dim arrays (e.g. hidden states
+    plus an auxiliary-loss channel); every leaf rides the same GPipe
+    schedule and ppermute hops. With ``manual_only=False`` the shard_map
+    is manual ONLY over the pipe + batch axes and leaves every other
+    mesh axis (tensor, expert, sequence) automatic, so ``stage_fn`` may
+    contain ordinary GSPMD sharding constraints — that is how pp
+    composes with tp/ep in a single step.
+    """
+    tree_map = jax.tree_util.tree_map
+    leaves = jax.tree_util.tree_leaves(x)
+    b = leaves[0].shape[0]
     m = n_microbatches
     assert b % m == 0, f"batch {b} not divisible into {m} microbatches"
-    xs = x.reshape(m, b // m, *x.shape[1:])
+    xs = tree_map(lambda a: a.reshape(m, b // m, *a.shape[1:]), x)
 
     def local(staged_local, xs_local):
         idx = jax.lax.axis_index(axis_name)
         p = jax.lax.psum(1, axis_name)
-        me = jax.tree_util.tree_map(lambda a: a[0], staged_local)
+        me = tree_map(lambda a: a[0], staged_local)
         shift = [(i, (i + 1) % p) for i in range(p)]
 
         def step(t, carry):
             buf, outs = carry
             # Stage 0 draws microbatch t from the input queue; later
             # stages consume what the previous stage handed over.
-            inp = jnp.where(idx == 0, xs_local[jnp.clip(t, 0, m - 1)], buf)
+            tt = jnp.clip(t, 0, m - 1)
+            inp = tree_map(
+                lambda q, bu: jnp.where(idx == 0, q[tt], bu), xs_local, buf
+            )
             y = stage_fn(me, inp)
             # The last stage finishes microbatch t - (P-1) at step t.
             j = t - (p - 1)
             write = jnp.logical_and(idx == p - 1, j >= 0)
-            outs = jnp.where(
-                write, outs.at[jnp.clip(j, 0, m - 1)].set(y), outs
+            jc = jnp.clip(j, 0, m - 1)
+            outs = tree_map(
+                lambda o, yy: jnp.where(write, o.at[jc].set(yy), o),
+                outs, y,
             )
-            buf = jax.lax.ppermute(y, axis_name, shift)
+            buf = tree_map(
+                lambda yy: jax.lax.ppermute(yy, axis_name, shift), y
+            )
             return buf, outs
 
-        buf = jnp.zeros_like(xs_local[0])
-        outs = jnp.zeros_like(xs_local)
+        buf = tree_map(lambda q: jnp.zeros_like(q[0]), xs_local)
+        outs = tree_map(jnp.zeros_like, xs_local)
         _, outs = jax.lax.fori_loop(0, m + p - 1, step, (buf, outs))
         # Results live on the last stage; replicate along the pipe axis so
         # the out_spec needn't special-case it.
-        return jax.lax.psum(
-            jnp.where(idx == p - 1, outs, jnp.zeros_like(outs)), axis_name
+        return tree_map(
+            lambda o: jax.lax.psum(
+                jnp.where(idx == p - 1, o, jnp.zeros_like(o)), axis_name
+            ),
+            outs,
         )
 
-    spec_params = jax.tree_util.tree_map(
+    spec_params = tree_map(
         lambda a: P(axis_name, *([None] * (a.ndim - 1))), staged
     )
-    mb_spec = P(None, batch_axes, *([None] * (x.ndim - 1)))
+    # xs leaves are [m, b/m, ...]: microbatch dim unsharded, batch dim
+    # over the batch axes, trailing dims replicated.
+    mb_spec = tree_map(
+        lambda a: P(None, batch_axes, *([None] * (a.ndim - 2))), xs
+    )
+    kwargs = {}
+    if not manual_only:
+        manual = {axis_name} | (
+            set(batch_axes or ()) & set(mesh.axis_names)
+        )
+        kwargs["axis_names"] = frozenset(manual)
     fn = shard_map(
         local,
         mesh=mesh,
         in_specs=(spec_params, mb_spec),
         out_specs=mb_spec,
         check_vma=False,
+        **kwargs,
     )
     out = fn(staged, xs)
-    return out.reshape(b, *x.shape[1:])
+    return tree_map(
+        lambda o, orig: o.reshape(b, *orig.shape[1:]), out, x
+    )

@@ -17,6 +17,7 @@ from __future__ import annotations
 import argparse
 import logging
 import sys
+from typing import Optional
 
 from ..kube.client import NODES
 from ..tpulib.chiplib import ChipLib, ChipLibConfig, FakeChipLib, RealChipLib
@@ -54,10 +55,11 @@ def build_parser() -> argparse.ArgumentParser:
                    default=_env("DEVICE_CLASSES", "chip,tensorcore,ici"),
                    help="comma-separated device classes to serve [DEVICE_CLASSES]")
     p.add_argument("--plugin-api-versions",
-                   default=_env("PLUGIN_API_VERSIONS", "1.0.0"),
-                   help="comma-separated versions advertised to the kubelet "
-                        "plugin watcher: '1.0.0' for k8s 1.31, "
-                        "'v1beta1.DRAPlugin' for 1.32+ (both DRA gRPC "
+                   default=_env("PLUGIN_API_VERSIONS", "auto"),
+                   help="versions advertised to the kubelet plugin "
+                        "watcher: 'auto' probes the node's kubeletVersion "
+                        "(1.31 -> '1.0.0', 1.32+ -> 'v1beta1.DRAPlugin'); "
+                        "or a comma-separated explicit list (both DRA gRPC "
                         "services are always served) [PLUGIN_API_VERSIONS]")
     p.add_argument("--dev-root", default=_env("DEV_ROOT", ""),
                    help="host root containing /dev; defaults to the driver "
@@ -131,45 +133,95 @@ def make_chiplib(args, dev_root: str, fake_host_id: int = 0) -> ChipLib:
     )
 
 
-def lookup_node_uid(client, node_name: str) -> str:
+def resolve_registration_versions(
+    spec: str, node: Optional[dict], node_name: str
+) -> tuple:
+    """Registration version strings to advertise on the kubelet plugin
+    watcher socket.
+
+    "auto" probes the node's kubeletVersion (from the Node object the
+    plugin fetched at startup anyway — no extra API round-trip) and
+    picks the scheme that generation understands: 1.31 semver-parses
+    the list so it gets exactly ("1.0.0",); 1.32+ selects the DRA gRPC
+    service by name so it gets ("v1beta1.DRAPlugin", "1.0.0"). Removes
+    the deploy-time foot-gun where helm plugin.apiVersions had to be
+    flipped by hand per cluster generation (registration fails outright
+    when held wrong). Probe failures fall back to the 1.31-safe list,
+    loudly.
+    """
+    versions = tuple(v.strip() for v in spec.split(",") if v.strip())
+    if versions != ("auto",):
+        return versions
+    fallback = ("1.0.0",)
     try:
-        return client.get(NODES, node_name)["metadata"].get("uid", "")
+        raw = node["status"]["nodeInfo"]["kubeletVersion"]  # e.g. "v1.32.1"
+        major, minor = raw.lstrip("v").split(".")[:2]
+        new_scheme = (int(major), int(minor)) >= (1, 32)
     except Exception:
+        logger.warning(
+            "could not probe kubeletVersion for %s; advertising the "
+            "k8s 1.31 scheme %s", node_name, fallback,
+        )
+        return fallback
+    chosen = ("v1beta1.DRAPlugin", "1.0.0") if new_scheme else fallback
+    logger.info(
+        "kubelet %s on %s: advertising registration versions %s",
+        raw, node_name, chosen,
+    )
+    return chosen
+
+
+def fetch_node(client, node_name: str) -> Optional[dict]:
+    """The plugin's own Node object, fetched ONCE at startup; uid,
+    kubeletVersion, and fake-host labels all derive from it (three
+    separate GETs would triple the API load of a DaemonSet rollout)."""
+    if client is None:
+        return None
+    try:
+        return client.get(NODES, node_name)
+    except Exception:
+        logger.warning("could not fetch node %s", node_name)
+        return None
+
+
+def lookup_node_uid(node: Optional[dict], node_name: str) -> str:
+    if node is None:
         logger.warning("could not resolve node UID for %s", node_name)
         return ""
+    return node["metadata"].get("uid", "")
 
 
-def lookup_fake_host_id(client, node_name: str, fake_hosts: int = 1) -> int:
+def lookup_fake_host_id(
+    node: Optional[dict], node_name: str, fake_hosts: int = 1
+) -> int:
     """This node's position in a multi-node fake slice, from its node
     label (a DaemonSet cannot vary env per node; the real backend reads
     TPU_WORKER_ID from the platform instead). Absent label = host 0 —
     loudly, because two unlabeled nodes would both publish host 0's
     coordinate block (duplicate devices, missing remainder)."""
-    if client is None:
+    if node is None:
         if fake_hosts > 1:
             logger.warning(
-                "--fake-hosts=%d with no kube client: node %s cannot read "
-                "its %s label and defaults to host 0 — every such node "
-                "publishes host 0's coordinate block (duplicate devices, "
-                "missing remainder)",
-                fake_hosts, node_name, FAKE_HOST_ID_LABEL,
+                "--fake-hosts=%d but node %s could not be read (no kube "
+                "client, or the fetch failed); defaulting to host 0 — "
+                "every such node publishes host 0's coordinate block "
+                "(duplicate devices, missing remainder)",
+                fake_hosts, node_name,
             )
         return 0
-    try:
-        labels = (
-            client.get(NODES, node_name)["metadata"].get("labels") or {}
+    labels = node["metadata"].get("labels") or {}
+    if FAKE_HOST_ID_LABEL not in labels:
+        logger.warning(
+            "--fake-hosts > 1 but node %s carries no %s label; "
+            "defaulting to host 0 — label each worker 0..N-1 or the "
+            "published slice will be wrong",
+            node_name, FAKE_HOST_ID_LABEL,
         )
-        if FAKE_HOST_ID_LABEL not in labels:
-            logger.warning(
-                "--fake-hosts > 1 but node %s carries no %s label; "
-                "defaulting to host 0 — label each worker 0..N-1 or the "
-                "published slice will be wrong",
-                node_name, FAKE_HOST_ID_LABEL,
-            )
-            return 0
+        return 0
+    try:
         return int(labels[FAKE_HOST_ID_LABEL] or 0)
-    except Exception:
-        logger.warning("could not resolve %s for %s; using host 0",
+    except ValueError:
+        logger.warning("malformed %s on %s; using host 0",
                        FAKE_HOST_ID_LABEL, node_name)
         return 0
 
@@ -184,12 +236,14 @@ def main(argv=None) -> int:
         return 2
 
     kube_client = None
+    node_obj = None
     node_uid = ""
     if not args.no_kube:
         kube_client = make_kube_client(
             args.kubeconfig, qps=args.kube_api_qps, burst=args.kube_api_burst
         )
-        node_uid = lookup_node_uid(kube_client, args.node_name)
+        node_obj = fetch_node(kube_client, args.node_name)
+        node_uid = lookup_node_uid(node_obj, args.node_name)
 
     dev_root, driver_root_ctr = resolve_roots(args)
     fake_host_id = 0
@@ -206,7 +260,7 @@ def main(argv=None) -> int:
             )
             return 2
         fake_host_id = lookup_fake_host_id(
-            kube_client, args.node_name, args.fake_hosts
+            node_obj, args.node_name, args.fake_hosts
         )
     config = DriverConfig(
         node_name=args.node_name,
@@ -221,8 +275,8 @@ def main(argv=None) -> int:
         driver_root_ctr_path=driver_root_ctr,
         device_classes=frozenset(args.device_classes.split(",")),
         node_uid=node_uid,
-        registration_versions=tuple(
-            v.strip() for v in args.plugin_api_versions.split(",") if v.strip()
+        registration_versions=resolve_registration_versions(
+            args.plugin_api_versions, node_obj, args.node_name
         ),
     )
     driver = Driver(config)

@@ -159,13 +159,19 @@ class ChipLib(abc.ABC):
         return devices
 
     def enumerate_core_partitions(self, chip: ChipInfo) -> list[TensorCoreInfo]:
-        """Sub-chip partitions for a chip (role of MIG profile/placement
-        enumeration, nvlib.go:244-295)."""
-        spec = GENERATIONS.get(chip.generation)
-        if spec is None or not spec.partitionable or chip.cores < 2:
+        """Sub-chip partitions for a chip: every placement of every
+        profile the generation supports (role of MIG profile/placement
+        enumeration, nvlib.go:244-295). Counter consumption keeps
+        overlapping placements and whole-chip claims mutually exclusive.
+        """
+        from .deviceinfo import partition_profiles
+
+        if chip.cores < 2:
             return []
         return [
-            TensorCoreInfo(parent=chip, core_index=i) for i in range(chip.cores)
+            TensorCoreInfo(parent=chip, core_index=start, profile=prof)
+            for prof in partition_profiles(chip.generation)
+            for start in prof.placements(chip.cores)
         ]
 
     def enumerate_ici_channels(

@@ -152,29 +152,95 @@ class ChipInfo:
         return dev
 
 
+@dataclasses.dataclass(frozen=True)
+class PartitionProfile:
+    """A sub-chip partition SHAPE (role of the reference's MIG profile
+    records, nvlib.go:244-295): how many cores one instance consumes,
+    what fraction of the chip's HBM it takes, and which placement start
+    positions it may occupy. The placement/counter machinery is profile-
+    generic even though current TPU generations ship only the single
+    whole-core profile — a future asymmetric profile (e.g. one core with
+    half the chip's HBM) is a table entry, not a code change.
+    """
+
+    name: str                       # e.g. "1c"; "1c.halfhbm"; "2c"
+    cores: int = 1                  # cores consumed per instance
+    # HBM consumed as a fraction (num, den) of the parent chip's HBM;
+    # None = proportional to cores/total_cores.
+    hbm_fraction: Optional[tuple[int, int]] = None
+
+    def placements(self, total_cores: int) -> list[int]:
+        """Valid start cores for this profile on a chip with
+        ``total_cores`` (aligned, non-overlapping — MIG placement sets)."""
+        if self.cores > total_cores:
+            return []
+        return list(range(0, total_cores - self.cores + 1, self.cores))
+
+    def hbm_share(self, parent_hbm: int, total_cores: int) -> int:
+        if self.hbm_fraction is not None:
+            num, den = self.hbm_fraction
+            return parent_hbm * num // den
+        return parent_hbm * self.cores // max(total_cores, 1)
+
+
+# The single-core profile every multi-core generation supports (v4/v5p
+# chips run two independent TensorCore programs when not fused in
+# megacore mode).
+ONE_CORE_PROFILE = PartitionProfile(name="1c", cores=1)
+
+
+def partition_profiles(generation: str) -> list[PartitionProfile]:
+    """Profiles a generation supports (reference: the per-arch MIG
+    profile enumeration, nvlib.go:244-295). One table entry today;
+    the seam future profiles plug into."""
+    spec = GENERATIONS.get(generation)
+    if spec is None or not spec.partitionable:
+        return []
+    return [ONE_CORE_PROFILE]
+
+
 @dataclasses.dataclass
 class TensorCoreInfo:
     """A sub-chip TensorCore partition (reference MigDeviceInfo,
     deviceinfo.go:45-56).
 
     Where MIG slices a GPU into profiles with memory slices, TPU sub-chip
-    partitioning hands out individual TensorCores of a multi-core chip: on
-    v4/v5p each chip has two cores that can run independent programs when not
-    fused in megacore mode.  Each core partition is advertised as a
-    first-class device that consumes a share of its parent chip's counters.
+    partitioning hands out placements of a ``PartitionProfile`` on a
+    multi-core chip. Each partition is advertised as a first-class device
+    that consumes its profile's share of the parent chip's counters, so
+    the scheduler can never double-book a chip as both whole and
+    partitioned, nor overlap two placements.
     """
 
     parent: ChipInfo
-    core_index: int                 # 0..cores-1 within the parent chip
-    profile: str = "1c"             # partition profile name ("1c" = one core)
+    core_index: int                 # placement start core within the chip
+    profile: PartitionProfile = ONE_CORE_PROFILE
 
     @property
     def uuid(self) -> str:
-        return f"{self.parent.uuid}-core-{self.core_index}"
+        # Profile-qualified so placements of different profiles at the
+        # same start core never collide; "1c" keeps the historical form.
+        if self.profile.name == "1c":
+            return f"{self.parent.uuid}-core-{self.core_index}"
+        return (
+            f"{self.parent.uuid}-{self.profile.name}-{self.core_index}"
+        )
+
+    def spanned_cores(self) -> list[int]:
+        """The physical core indices this placement occupies."""
+        return list(
+            range(self.core_index, self.core_index + self.profile.cores)
+        )
 
     def canonical_name(self) -> str:
-        # reference: fmt "gpu-%d-mig-%d-%d-%d" deviceinfo.go:80-88
-        return f"tpu-{self.parent.index}-core-{self.core_index}"
+        # reference: fmt "gpu-%d-mig-%d-%d-%d" deviceinfo.go:80-88. The
+        # 1c profile keeps the historical "tpu-N-core-M" names; other
+        # profiles carry their profile name MIG-style.
+        if self.profile.name == "1c":
+            return f"tpu-{self.parent.index}-core-{self.core_index}"
+        return (
+            f"tpu-{self.parent.index}-{self.profile.name}-{self.core_index}"
+        )
 
     def canonical_index(self) -> str:
         return f"{self.parent.index}:{self.core_index}"
@@ -183,10 +249,12 @@ class TensorCoreInfo:
         return [self.uuid]
 
     def get_device(self) -> dict[str, Any]:
-        hbm_share = self.parent.hbm_bytes // max(self.parent.cores, 1)
+        total = max(self.parent.cores, 1)
+        hbm_share = self.profile.hbm_share(self.parent.hbm_bytes, total)
         spec = GENERATIONS.get(self.parent.generation)
         flops_share = (
-            int(spec.peak_bf16_flops) // max(self.parent.cores, 1) if spec else 0
+            int(spec.peak_bf16_flops) * self.profile.cores // total
+            if spec else 0
         )
         dev = {
             "name": self.canonical_name(),
@@ -197,7 +265,8 @@ class TensorCoreInfo:
                     "parentUuid": _attr(self.parent.uuid),
                     "parentIndex": _attr(self.parent.index),
                     "index": _attr(self.core_index),
-                    "profile": _attr(self.profile),
+                    "profile": _attr(self.profile.name),
+                    "profileCores": _attr(self.profile.cores),
                     "generation": _attr(self.parent.generation),
                     "coord": _attr(str(self.parent.coord)),
                     "sliceId": _attr(self.parent.slice_id),
@@ -206,19 +275,20 @@ class TensorCoreInfo:
                 },
                 "capacity": {
                     "hbm": {"value": str(hbm_share)},
-                    "tensorcores": {"value": "1"},
+                    "tensorcores": {"value": str(self.profile.cores)},
                     "peakBf16Flops": {"value": str(flops_share)},
                 },
             },
         }
-        # consumesCounters ties core partitions of one chip together so the
-        # scheduler cannot double-book a chip as both whole and partitioned
-        # (role of MIG memory-slice capacities, deviceinfo.go:184-198).
+        # consumesCounters ties every partition of one chip together so
+        # the scheduler cannot double-book a chip as both whole and
+        # partitioned, nor overlap placements (role of MIG memory-slice
+        # capacities, deviceinfo.go:184-198).
         dev["basic"]["consumesCounters"] = [
             {
                 "counterSet": f"chip-{self.parent.index}-counters",
                 "counters": {
-                    "cores": {"value": "1"},
+                    "cores": {"value": str(self.profile.cores)},
                     "hbm": {"value": str(hbm_share)},
                 },
             }

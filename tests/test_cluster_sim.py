@@ -431,3 +431,113 @@ class TestClusterSim:
         )
         env = spec["containerEdits"]["env"]
         assert any(e.startswith("TPU_VISIBLE_CORES=") for e in env)
+
+
+class TestPartitionProfiles:
+    def test_synthetic_profile_allocates_with_counter_exclusivity(
+        self, tmp_path, monkeypatch
+    ):
+        """The partition machinery is table-driven (nvlib.go:244-295
+        analog): a synthetic two-core profile enumerates its placement,
+        allocates through the sim, and its counter consumption excludes
+        the whole chip and any 1c placement of the same chip — while a
+        different chip stays fully available."""
+        from k8s_dra_driver_tpu.tpulib import deviceinfo as di
+
+        synthetic = di.PartitionProfile(
+            name="2c", cores=2, hbm_fraction=(1, 2)
+        )
+        monkeypatch.setattr(
+            di, "partition_profiles",
+            lambda gen: [di.ONE_CORE_PROFILE, synthetic],
+        )
+        client = FakeKubeClient()
+        client.create(
+            NODES,
+            {"metadata": {"name": "node-a", "uid": "u-a",
+                          "labels": {SLICE_LABEL: "s"}}},
+        )
+        cfg = DriverConfig(
+            node_name="node-a",
+            chiplib=FakeChipLib(
+                generation="v5p", topology="2x1x1", slice_id="s"
+            ),
+            kube_client=client,
+            cdi_root=str(tmp_path / "cdi"),
+            plugin_root=str(tmp_path / "plugin"),
+            registrar_root=str(tmp_path / "reg"),
+            state_root=str(tmp_path / "state"),
+            node_uid="u-a",
+            cleanup_interval_seconds=0,
+        )
+        d = Driver(cfg)
+        d.start()
+        try:
+            assert wait_for(lambda: any(
+                dev["name"] == "tpu-0-2c-0"
+                for s in client.list(RESOURCE_SLICES)
+                for dev in s["spec"].get("devices", [])
+            )), [dev["name"] for s in client.list(RESOURCE_SLICES)
+                 for dev in s["spec"].get("devices", [])]
+            # The synthetic profile advertises its own shares: half the
+            # chip HBM, both cores.
+            dev2c = next(
+                dev for s in client.list(RESOURCE_SLICES)
+                for dev in s["spec"].get("devices", [])
+                if dev["name"] == "tpu-0-2c-0"
+            )
+            assert dev2c["basic"]["capacity"]["tensorcores"]["value"] == "2"
+            counters = dev2c["basic"]["consumesCounters"][0]["counters"]
+            assert counters["cores"]["value"] == "2"
+
+            alloc = ReferenceAllocator(client)
+            sel_2c_chip0 = {"p": [Selector("profile", "eq", "2c"),
+                                  Selector("parentIndex", "eq", 0)]}
+            alloc.allocate(
+                make_claim_obj(
+                    "pp-1", "two-core",
+                    [{"name": "p",
+                      "deviceClassName": "tensorcore.tpu.google.com"}],
+                ),
+                selectors=sel_2c_chip0,
+            )
+            # Chip 0 is fully consumed: whole chip AND 1c both refuse.
+            with pytest.raises(AllocationError):
+                alloc.allocate(
+                    make_claim_obj(
+                        "pp-2", "whole",
+                        [{"name": "c", "deviceClassName": "tpu.google.com"}],
+                    ),
+                    selectors={"c": [Selector("index", "eq", 0)]},
+                )
+            with pytest.raises(AllocationError):
+                alloc.allocate(
+                    make_claim_obj(
+                        "pp-3", "one-core",
+                        [{"name": "p",
+                          "deviceClassName": "tensorcore.tpu.google.com"}],
+                    ),
+                    selectors={"p": [Selector("profile", "eq", "1c"),
+                                     Selector("parentIndex", "eq", 0)]},
+                )
+            # Chip 1 is untouched: its 2c placement still allocates.
+            alloc.allocate(
+                make_claim_obj(
+                    "pp-4", "two-core-b",
+                    [{"name": "p",
+                      "deviceClassName": "tensorcore.tpu.google.com"}],
+                ),
+                selectors={"p": [Selector("profile", "eq", "2c"),
+                                 Selector("parentIndex", "eq", 1)]},
+            )
+            # Releasing the 2c frees chip 0 entirely.
+            alloc.deallocate("pp-1")
+            alloc.allocate(
+                make_claim_obj(
+                    "pp-5", "whole-after",
+                    [{"name": "c", "deviceClassName": "tpu.google.com"}],
+                ),
+                selectors={"c": [Selector("index", "eq", 0)]},
+            )
+        finally:
+            d.shutdown()

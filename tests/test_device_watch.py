@@ -114,6 +114,8 @@ class TestMultiNodeFakeSlice:
             make_chiplib,
         )
 
+        from k8s_dra_driver_tpu.plugin.main import fetch_node
+
         client = FakeKubeClient()
         for i, name in enumerate(["worker-0", "worker-1"]):
             client.create(NODES, {"metadata": {
@@ -122,10 +124,15 @@ class TestMultiNodeFakeSlice:
             }})
         client.create(NODES, {"metadata": {"name": "plain", "uid": "u-p"}})
 
-        assert lookup_fake_host_id(client, "worker-0") == 0
-        assert lookup_fake_host_id(client, "worker-1") == 1
-        assert lookup_fake_host_id(client, "plain") == 0    # no label
-        assert lookup_fake_host_id(client, "ghost") == 0    # no node
+        def hid(node_name):
+            return lookup_fake_host_id(
+                fetch_node(client, node_name), node_name
+            )
+
+        assert hid("worker-0") == 0
+        assert hid("worker-1") == 1
+        assert hid("plain") == 0                            # no label
+        assert hid("ghost") == 0                            # no node
         assert lookup_fake_host_id(None, "worker-1") == 0   # --no-kube
 
         args = argparse.Namespace(
@@ -149,6 +156,7 @@ class TestMultiNodeFakeSlice:
 
         from k8s_dra_driver_tpu.plugin.main import lookup_fake_host_id
 
+        # node=None: --no-kube, or the startup node fetch failed.
         with caplog.at_level(logging.WARNING):
             assert lookup_fake_host_id(None, "w-1", fake_hosts=2) == 0
         assert any("fake-hosts" in r.message for r in caplog.records)
