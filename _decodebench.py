@@ -1,5 +1,6 @@
 """Decode (serving) throughput on the chip: KV-cache autoregressive
-tokens/s for the HBM-sized Llama preset.
+tokens/s for the dense Llama presets AND the Mixtral MoE presets (both
+families share the cache/decode machinery, models/decode._mlp_or_moe).
 
 Timing: ``generate`` (prefill + N-step while_loop decode) and ``prefill``
 alone are each ONE compiled program; their time difference over distinct
@@ -11,9 +12,10 @@ Remote compiles are minutes per program — this tool compiles exactly two
 (and `enable_compile_cache()` makes later runs of the same shapes load
 from the persistent cache instead of recompiling).
 
-Knobs (script mode): TPU_DRA_DECODE_PRESET (e.g. 160m-gqa, 1b),
-TPU_DRA_DECODE_PROMPT (long-context cache costs), TPU_DRA_DECODE_QUANT
-("int8" = weights, "int8-kv" = KV cache, "int8,int8-kv" = both).
+Knobs (script mode): TPU_DRA_DECODE_PRESET (e.g. 160m-gqa, 1b, or a
+MoE preset like 8x160m), TPU_DRA_DECODE_PROMPT (long-context cache
+costs), TPU_DRA_DECODE_QUANT ("int8" = weights, "int8-kv" = KV cache,
+"int8,int8-kv" = both).
 """
 import os
 import time
@@ -49,10 +51,21 @@ def run_decode_bench(
     """One decode measurement -> a bench.py-style metric dict."""
     from k8s_dra_driver_tpu.models.decode import generate, prefill
     from k8s_dra_driver_tpu.models.llama import PRESETS, init_params
+    from k8s_dra_driver_tpu.models.moe import MOE_PRESETS
+    from k8s_dra_driver_tpu.models.moe import init_params as moe_init_params
     from k8s_dra_driver_tpu.models.quant import quantize_params
 
-    config = PRESETS[preset]
-    params = jax.jit(lambda k: init_params(config, k))(jax.random.PRNGKey(0))
+    # Dense and Mixtral families share the cache/decode machinery
+    # (models/decode._mlp_or_moe); MoE presets serve through the same
+    # tool (e.g. TPU_DRA_DECODE_PRESET=8x160m).
+    is_moe = preset in MOE_PRESETS
+    if is_moe:
+        config = MOE_PRESETS[preset]
+        init = moe_init_params
+    else:
+        config = PRESETS[preset]
+        init = init_params
+    params = jax.jit(lambda k: init(config, k))(jax.random.PRNGKey(0))
     if quant:
         params = jax.jit(quantize_params)(params)
 
@@ -114,8 +127,9 @@ def run_decode_bench(
     tags = "".join(
         t for t, on in (("-int8", quant), ("-kvq", quant_kv)) if on
     )
+    family = "mixtral" if is_moe else "llama3"
     return {
-        "metric": f"llama3_{preset}{tags}_decode_toks_b{batch}_p{prompt_len}",
+        "metric": f"{family}_{preset}{tags}_decode_toks_b{batch}_p{prompt_len}",
         "value": round(batch / step, 1),
         "unit": "tokens_per_s",
         # Fraction of the HBM roofline achieved (1.0 = bandwidth-bound

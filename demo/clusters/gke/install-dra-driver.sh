@@ -18,16 +18,15 @@ CHART="${SCRIPT_DIR}/../../../deployments/helm/tpu-dra-driver"
 # "v1beta1.DRAPlugin" (see docs/operations.md "Version skew").
 : "${PLUGIN_API_VERSIONS:=1.0.0}"
 
+# The google.com/tpu taint toleration comes from values-gke.yaml (one
+# source of truth); only per-install knobs are --set here.
 helm upgrade -i --create-namespace --namespace tpu-dra tpu-dra-driver \
   "${CHART}" \
   -f "${CHART}/values-gke.yaml" \
   --set image.repository="${IMAGE_REGISTRY}/${IMAGE_NAME}" \
   --set image.tag="${IMAGE_TAG}" \
   --set "plugin.nodeSelector.cloud\.google\.com/gke-tpu-accelerator=${GKE_TPU_ACCELERATOR}" \
-  --set "plugin.apiVersions={${PLUGIN_API_VERSIONS}}" \
-  --set "plugin.tolerations[0].key=google.com/tpu" \
-  --set "plugin.tolerations[0].operator=Exists" \
-  --set "plugin.tolerations[0].effect=NoSchedule"
+  --set "plugin.apiVersions={${PLUGIN_API_VERSIONS}}"
 
 kubectl -n tpu-dra rollout status ds/tpu-dra-driver-plugin --timeout=180s || true
 echo "check: kubectl get resourceslices -o wide"

@@ -124,7 +124,7 @@ MOE_PRESETS: dict[str, MoeConfig] = {
     # L=2 already exceeds 16G with gradients resident).
     "8x7b-L1": MoeConfig(
         vocab_size=32000, hidden=4096, n_layers=1, n_heads=32, n_kv_heads=8,
-        mlp_hidden=14336, max_seq_len=2048, rope_theta=1e6,
+        mlp_hidden=14336, max_seq_len=8192, rope_theta=1e6,
         n_experts=8, top_k=2,
     ),
 }
