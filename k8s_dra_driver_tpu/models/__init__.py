@@ -31,7 +31,12 @@ __all__ = [
 ]
 
 from . import moe
-from .checkpoint import latest_step, restore_checkpoint, save_checkpoint
+from .checkpoint import (
+    latest_step,
+    restore_checkpoint,
+    restore_template,
+    save_checkpoint,
+)
 from .decode import KVCache, QuantKVCache, decode_step, generate, prefill
 from .quant import QuantTensor, quantize_params, quantize_specs
 from .speculative import speculative_generate
@@ -49,5 +54,6 @@ __all__ += [
     "speculative_generate",
     "save_checkpoint",
     "restore_checkpoint",
+    "restore_template",
     "latest_step",
 ]

@@ -56,13 +56,40 @@ def latest_step(directory: str) -> Optional[int]:
     return step
 
 
+def restore_template(skeleton: Any, mesh: Any) -> Any:
+    """Build the restore template for ``mesh`` from a state skeleton
+    (e.g. a freshly built TrainState on the NEW allocation's mesh).
+
+    Mesh-sharded leaves keep their layout; everything else — scalar
+    optimizer leaves like adamw step counts, whose jitted init leaves
+    them on a single device — lands replicated on the mesh, so a
+    restored state is immediately consumable by a train step jitted for
+    that mesh (mixed single-device/mesh shardings are rejected by jit).
+    This is the elastic-resume seam: preempted on one slice, resumed on
+    whatever layout the next DRA allocation provides.
+    """
+    import jax
+
+    def leaf(x):
+        sh = x.sharding
+        if not isinstance(sh, jax.sharding.NamedSharding):
+            sh = jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec()
+            )
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
+
+    return jax.tree.map(leaf, skeleton)
+
+
 def restore_checkpoint(
     directory: str,
     template: Any,
     step: Optional[int] = None,
 ) -> Any:
     """Restore into the shardings/structure of ``template`` (an abstract or
-    concrete TrainState — restoring onto a different mesh re-shards)."""
+    concrete TrainState — restoring onto a different mesh re-shards;
+    build the template with ``restore_template`` for a mesh-consistent
+    layout)."""
     import orbax.checkpoint as ocp
 
     mgr = _manager(os.path.abspath(directory))

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 
 from .decode import _forward_with_cache, prefill
 from .llama import LlamaConfig
+from .moe import MoeConfig
 
 
 def _rewind(cache, length):
@@ -73,6 +74,21 @@ def speculative_generate(
     # length before rewinding.
     max_len = s + max_new_tokens + k + 1
 
+    # The chunked verification forward must reproduce the target's T=1
+    # decode EXACTLY. MoE capacity routing is capacity-immune at T=1 (a
+    # lone token always fits its experts' slots) but a T=k+1 chunk can
+    # overflow per-expert capacity and drop tokens the incremental
+    # target never would — silently changing outputs at the default
+    # capacity_factor. Dropless dispatch IS the T=1 semantics at any
+    # chunk width, restoring the greedy-equivalence guarantee. Prefill
+    # keeps the caller's config: generate()'s own prefill uses it too,
+    # so the two paths stay comparable from the same starting state.
+    verify_config = (
+        dataclasses.replace(target_config, moe_impl="dropless")
+        if isinstance(target_config, MoeConfig)
+        else target_config
+    )
+
     logits_t, cache_t = prefill(
         target_params, prompt, target_config, max_len,
         quantize_cache=quantize_cache,
@@ -112,7 +128,7 @@ def speculative_generate(
         )                                          # [1, k+1]
         positions = m - 1 + jnp.arange(k + 1)
         logits, cache_t = _forward_with_cache(
-            target_params, chunk, cache_t, target_config, positions
+            target_params, chunk, cache_t, verify_config, positions
         )
         y = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)  # [k+1]
 

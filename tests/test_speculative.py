@@ -115,3 +115,46 @@ class TestSpeculative:
             )
         )(target_params, draft, prompt)
         np.testing.assert_array_equal(np.asarray(out), quant_ref)
+
+
+class TestMoeTarget:
+    @pytest.mark.parametrize("capacity_factor", [1.25, 8.0])
+    def test_moe_target_dense_draft_exact(self, capacity_factor):
+        """The production speculative shape for sparse serving: a big MoE
+        target verified in chunks, a small dense draft proposing.
+
+        Exactness is non-trivial for MoE: T=1 decode is capacity-immune
+        but a T=k+1 verification chunk can overflow per-expert slots —
+        speculative_generate therefore runs the verify forward with
+        dropless dispatch, which IS the T=1 semantics at any chunk
+        width. The tight default capacity_factor=1.25 is the case that
+        drops tokens without that coercion (reproduced during review);
+        both capacities must be token-exact."""
+        import dataclasses
+
+        from k8s_dra_driver_tpu.models.decode import generate
+        from k8s_dra_driver_tpu.models.moe import MOE_PRESETS
+        from k8s_dra_driver_tpu.models.moe import init_params as moe_init
+
+        moe_cfg = dataclasses.replace(
+            MOE_PRESETS["tiny-moe"], capacity_factor=capacity_factor
+        )
+        draft_cfg = dataclasses.replace(
+            CONFIG, vocab_size=moe_cfg.vocab_size
+        )
+        target = moe_init(moe_cfg, jax.random.PRNGKey(0))
+        draft = init_params(draft_cfg, jax.random.PRNGKey(7))
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(2), (1, 8), 0, moe_cfg.vocab_size
+        )
+        reference = np.asarray(
+            jax.jit(
+                lambda p, t: generate(p, t, moe_cfg, N)
+            )(target, prompt)
+        )
+        out = jax.jit(
+            lambda tp, dp, t: speculative_generate(
+                tp, dp, t, moe_cfg, draft_cfg, N, k=3
+            )
+        )(target, draft, prompt)
+        np.testing.assert_array_equal(np.asarray(out), reference)
