@@ -192,13 +192,22 @@ def extra_metrics(peak_flops, remat_policy) -> list:
     so numbers stay comparable round-over-round: the dense 1b full model
     (r1/r2 series), the MoE 8x160m (r3 series), the Mixtral-geometry
     8x7b-L1, and a 1b decode datapoint (bandwidth-bound serving).
-    Failures are per-metric: one blown compile never hides the rest."""
+    Failures are per-metric: one blown compile never hides the rest, and
+    a wall-clock budget (TPU_DRA_BENCH_EXTRA_BUDGET_S) keeps a slow
+    chip/tunnel from starving the headline output entirely."""
     out = []
+    deadline = time.monotonic() + float(
+        os.environ.get("TPU_DRA_BENCH_EXTRA_BUDGET_S", "1800")
+    )
     for model, preset, batch, seq in (
         ("dense", "1b", 8, 2048),
         ("moe", "8x160m", 8, 2048),
         ("moe", "8x7b-L1", 4, 2048),
     ):
+        if time.monotonic() > deadline:
+            print(f"extra metric {model}/{preset} skipped: budget spent",
+                  file=sys.stderr)
+            continue
         try:
             r = run_bench(preset, batch, seq, peak_flops, remat_policy, model)
             r.pop("detail", None)
@@ -207,7 +216,10 @@ def extra_metrics(peak_flops, remat_policy) -> list:
             print(f"extra metric {model}/{preset} failed: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
     decode_preset = os.environ.get("TPU_DRA_BENCH_DECODE", "1b")
-    if decode_preset != "skip":
+    if decode_preset != "skip" and time.monotonic() > deadline:
+        print(f"decode metric {decode_preset} skipped: budget spent",
+              file=sys.stderr)
+    elif decode_preset != "skip":
         try:
             from _decodebench import run_decode_bench
 

@@ -57,26 +57,33 @@ def latest_step(directory: str) -> Optional[int]:
 
 
 def restore_template(skeleton: Any, mesh: Any) -> Any:
-    """Build the restore template for ``mesh`` from a state skeleton
-    (e.g. a freshly built TrainState on the NEW allocation's mesh).
+    """Build the restore template for ``mesh`` from a state skeleton —
+    either a freshly built TrainState on the NEW allocation's mesh, or
+    the OLD state itself (its specs transfer; the mesh is replaced).
 
-    Mesh-sharded leaves keep their layout; everything else — scalar
-    optimizer leaves like adamw step counts, whose jitted init leaves
-    them on a single device — lands replicated on the mesh, so a
-    restored state is immediately consumable by a train step jitted for
-    that mesh (mixed single-device/mesh shardings are rejected by jit).
-    This is the elastic-resume seam: preempted on one slice, resumed on
-    whatever layout the next DRA allocation provides.
+    Mesh-sharded leaves keep their PartitionSpec but are re-anchored to
+    ``mesh`` (a skeleton from a dead allocation must not pin restore to
+    its devices); everything else — scalar optimizer leaves like adamw
+    step counts, whose jitted init leaves them on a single device —
+    lands replicated, so a restored state is immediately consumable by
+    a train step jitted for that mesh (mixed single-device/mesh
+    shardings are rejected by jit). This is the elastic-resume seam:
+    preempted on one slice, resumed on whatever layout the next DRA
+    allocation provides.
     """
     import jax
 
     def leaf(x):
         sh = x.sharding
-        if not isinstance(sh, jax.sharding.NamedSharding):
-            sh = jax.sharding.NamedSharding(
-                mesh, jax.sharding.PartitionSpec()
-            )
-        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
+        spec = (
+            sh.spec
+            if isinstance(sh, jax.sharding.NamedSharding)
+            else jax.sharding.PartitionSpec()
+        )
+        return jax.ShapeDtypeStruct(
+            x.shape, x.dtype,
+            sharding=jax.sharding.NamedSharding(mesh, spec),
+        )
 
     return jax.tree.map(leaf, skeleton)
 
@@ -92,10 +99,16 @@ def restore_checkpoint(
     layout)."""
     import orbax.checkpoint as ocp
 
-    mgr = _manager(os.path.abspath(directory))
-    step = step if step is not None else mgr.latest_step()
+    # Probe BEFORE constructing the manager: _manager(create=True) would
+    # mkdir a typo'd path as a side effect of a failed restore.
     if step is None:
-        raise FileNotFoundError(f"no checkpoint found under {directory}")
-    out = mgr.restore(step, args=ocp.args.StandardRestore(template))
-    mgr.close()
-    return out
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint found under {directory}"
+            )
+    mgr = _manager(os.path.abspath(directory))
+    try:
+        return mgr.restore(step, args=ocp.args.StandardRestore(template))
+    finally:
+        mgr.close()

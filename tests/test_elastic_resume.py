@@ -111,10 +111,33 @@ class TestElasticResume:
             post_losses, ref_losses[N_STEPS_BEFORE:], rtol=2e-4, atol=2e-4
         )
 
+    def test_old_state_works_as_template_skeleton(self, tmp_path, devices):
+        """The natural call: pass the PREEMPTED state itself as the
+        skeleton with the new mesh — its PartitionSpecs transfer but
+        every leaf re-anchors to the new mesh (a template pinned to the
+        dead allocation's devices would be exactly the bug the helper
+        exists to prevent)."""
+        opt = make_optimizer(warmup_steps=1, total_steps=10)
+        mesh_a = build_mesh(MeshConfig(data=2), devices=devices[:2])
+        state = init_train_state(CFG, mesh_a, opt)
+        save_checkpoint(str(tmp_path / "ckpt"), state, step=0)
+
+        mesh_b = build_mesh(MeshConfig(data=2, fsdp=2),
+                            devices=devices[4:8])
+        template = restore_template(state, mesh_b)
+        restored = restore_checkpoint(str(tmp_path / "ckpt"), template)
+        for leaf in jax.tree.leaves(restored):
+            assert leaf.sharding.mesh == mesh_b
+
     def test_restore_rejects_missing_checkpoint(self, tmp_path):
+        import os
+
         from k8s_dra_driver_tpu.models.llama import init_params
 
         assert latest_step(str(tmp_path / "nope")) is None
         params = init_params(CFG, jax.random.PRNGKey(0))
+        missing = tmp_path / "nope2"
         with pytest.raises(FileNotFoundError):
-            restore_checkpoint(str(tmp_path / "nope2"), params)
+            restore_checkpoint(str(missing), params)
+        # The failed restore must not mkdir the typo'd path.
+        assert not os.path.exists(missing)
