@@ -431,10 +431,20 @@ def _moe_block_dropless(x, layer, config: MoeConfig):
         from jax.experimental.pallas.ops.tpu.megablox import gmm
 
         def grouped_dot(lhs, rhs):
+            # Tile sizes clamp to the problem so small models (tiny
+            # presets, narrow experts) stay legal; 512 is the v5e sweet
+            # spot for the production shapes. gmm masks remainder tiles
+            # on k/n but requires m % tm == 0 exactly, so the m tile
+            # must be a DIVISOR of the row count, not just a bound.
+            m = lhs.shape[0]
+            tm = min(512, m)
+            while m % tm:
+                tm -= 1
+            tiling = (tm, min(512, lhs.shape[1]), min(512, rhs.shape[2]))
             return gmm(
                 lhs, rhs, group_sizes,
                 preferred_element_type=lhs.dtype,
-                tiling=(512, 512, 512),
+                tiling=tiling,
             )
     else:
         def grouped_dot(lhs, rhs):
