@@ -14,9 +14,15 @@ CHART="${SCRIPT_DIR}/../../../deployments/helm/tpu-dra-driver"
 # nodeSelector must match YOUR pool (v5e: tpu-v5-lite-podslice,
 # v5p: tpu-v5p-slice, v4: tpu-v4-podslice).
 : "${GKE_TPU_ACCELERATOR:=tpu-v5-lite-podslice}"
-# k8s 1.31 registers DRA plugins as "1.0.0"; 1.32+ wants
-# "v1beta1.DRAPlugin" (see docs/operations.md "Version skew").
-: "${PLUGIN_API_VERSIONS:=1.0.0}"
+# Kubelet registration scheme: "auto" probes the node's kubeletVersion
+# and picks the right one per generation ("1.0.0" on 1.31,
+# "v1beta1.DRAPlugin" on 1.32+ — see docs/operations.md "Version
+# skew"). Pin explicitly only if the probe cannot work in your cluster.
+: "${PLUGIN_API_VERSIONS:=auto}"
+# REST dialect for the chart's DeviceClass objects: 1.32+ serves
+# resource.k8s.io/v1beta1 (values-gke.yaml default); set v1alpha3 for a
+# 1.31 alpha cluster. The binaries discover their own dialect at startup.
+: "${RESOURCE_API_VERSION:=v1beta1}"
 
 # The google.com/tpu taint toleration comes from values-gke.yaml (one
 # source of truth); only per-install knobs are --set here.
@@ -26,7 +32,8 @@ helm upgrade -i --create-namespace --namespace tpu-dra tpu-dra-driver \
   --set image.repository="${IMAGE_REGISTRY}/${IMAGE_NAME}" \
   --set image.tag="${IMAGE_TAG}" \
   --set "plugin.nodeSelector.cloud\.google\.com/gke-tpu-accelerator=${GKE_TPU_ACCELERATOR}" \
-  --set "plugin.apiVersions={${PLUGIN_API_VERSIONS}}"
+  --set "plugin.apiVersions={${PLUGIN_API_VERSIONS}}" \
+  --set "resourceApiVersion=${RESOURCE_API_VERSION}"
 
 kubectl -n tpu-dra rollout status ds/tpu-dra-driver-plugin --timeout=180s || true
 echo "check: kubectl get resourceslices -o wide"

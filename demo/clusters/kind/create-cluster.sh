@@ -5,6 +5,11 @@ set -euo pipefail
 SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
 
 CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra}"
+# resource.k8s.io dialect to enable in the apiserver: kind node images
+# <=1.31 know only v1alpha3, 1.32+ serve v1beta1 (and would refuse to
+# start with an unknown group-version enabled, so this cannot simply
+# list both). The driver discovers whichever is served at startup.
+RESOURCE_API_VERSION="${RESOURCE_API_VERSION:-v1alpha3}"
 # WORKERS>1 builds a multi-node cluster and labels each worker with its
 # position in a fake multi-host slice (the nvkind analog: the reference
 # partitions host GPUs among kind workers; here the fake slice spans
@@ -12,6 +17,14 @@ CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra}"
 WORKERS="${WORKERS:-1}"
 
 CONFIG="${SCRIPT_DIR}/kind-cluster-config.yaml"
+if [ "${WORKERS}" -le 1 ] && [ "${RESOURCE_API_VERSION}" != "v1alpha3" ]; then
+  # Single-node path with a 1.32+ node image: rewrite the checked-in
+  # config's runtime-config stanza to the requested dialect.
+  CONFIG="$(mktemp)"
+  trap 'rm -f "${CONFIG}"' EXIT
+  sed "s|resource.k8s.io/v1alpha3|resource.k8s.io/${RESOURCE_API_VERSION}|" \
+    "${SCRIPT_DIR}/kind-cluster-config.yaml" > "${CONFIG}"
+fi
 if [ "${WORKERS}" -gt 1 ]; then
   # Same cluster settings as the checked-in config, with N labeled
   # workers (every worker carries the chip + slice labels the plugin
@@ -29,7 +42,7 @@ if [ "${WORKERS}" -gt 1 ]; then
       printf '      tpu.google.com/slice-id: kind-slice-0\n'
     done
     printf 'featureGates:\n  DynamicResourceAllocation: true\n'
-    printf 'runtimeConfig:\n  resource.k8s.io/v1alpha3: "true"\n'
+    printf 'runtimeConfig:\n  resource.k8s.io/%s: "true"\n' "${RESOURCE_API_VERSION}"
     printf 'containerdConfigPatches:\n'
     printf '  - |-\n'
     printf '    [plugins."io.containerd.grpc.v1.cri"]\n'

@@ -107,11 +107,15 @@ class IciSliceManager:
         client: KubeClient,
         driver_name: str = "tpu.google.com",
         owner: Optional[dict] = None,
+        resource_api=None,
     ):
+        from ..kube.resourceapi import ResourceApi
+
         self.client = client
         self.driver_name = driver_name
         self.slice_controller = ResourceSliceController(
-            client, driver_name, scope=self.SCOPE, owner=owner
+            client, driver_name, scope=self.SCOPE, owner=owner,
+            api=resource_api or ResourceApi.discover(client),
         )
         self.offsets = OffsetAllocator()
         # DomainKey -> set of node names carrying the label

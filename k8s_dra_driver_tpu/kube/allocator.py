@@ -22,7 +22,8 @@ import threading
 from typing import Any, Optional
 
 from .cel import CelError, evaluate as cel_evaluate
-from .client import RESOURCE_SLICES, KubeClient
+from .client import KubeClient
+from .resourceapi import ResourceApi
 
 logger = logging.getLogger(__name__)
 
@@ -118,15 +119,19 @@ class ReferenceAllocator:
         client: KubeClient,
         driver_name: str = "tpu.google.com",
         device_classes: Optional[dict[str, list[str]]] = None,
+        resource_api: Optional[ResourceApi] = None,
     ):
         """``device_classes`` maps DeviceClass name → CEL selector
         expressions (from the class spec). When given, class membership is
         decided by evaluating those (the production mechanism); otherwise
         the built-in DEVICE_CLASS_TYPES name → type mapping applies.
+        ``resource_api`` selects the resource.k8s.io dialect slices are
+        read in (default: discover from the client).
         """
         self.client = client
         self.driver_name = driver_name
         self.device_classes = device_classes
+        self.api = resource_api or ResourceApi.discover(client)
         self._lock = threading.Lock()
         # (pool, device) -> claim uid holding it
         self._reservations: dict[tuple[str, str], str] = {}
@@ -145,8 +150,8 @@ class ReferenceAllocator:
         flattened (pool, node, device) inventory + shared-counter
         capacities keyed (pool, counter set, counter)."""
         slices = [
-            s
-            for s in self.client.list(RESOURCE_SLICES)
+            self.api.slice_from_wire(s)
+            for s in self.client.list(self.api.slices)
             if s["spec"].get("driver") == self.driver_name
         ]
         max_gen: dict[str, int] = {}

@@ -29,6 +29,7 @@ import queue
 import re
 import ssl
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -192,6 +193,13 @@ class KubeClient(abc.ABC):
 
     # -- conveniences shared by impls --------------------------------------
 
+    def api_group_versions(self, group: str) -> list[str]:
+        """Versions the server serves for an API group, preferred first
+        (k8s group discovery, GET ``/apis/<group>``). Empty when the group
+        is not served. Default: unknown — callers fall back to their
+        pinned default version."""
+        return []
+
     def close(self) -> None:
         """Release client resources (stop watches, join poll threads).
 
@@ -230,6 +238,14 @@ class FakeKubeClient(KubeClient):
         self._watches: list[tuple[str, str, Optional[str], Watch]] = []
         # Optional fault injection: callable(verb, gvr, name) -> Exception|None
         self.fault_injector: Optional[Callable[[str, GVR, str], Optional[Exception]]] = None
+        # group -> served versions (preferred first). Tests shrink this to
+        # impersonate one cluster generation: a 1.31 server is
+        # {"resource.k8s.io": ["v1alpha3"]}, a 1.32+ one ["v1beta1"].
+        # Requests addressed to an unserved group version 404, as the real
+        # API server's would.
+        self.served_api_versions: dict[str, list[str]] = {
+            "resource.k8s.io": ["v1beta1", "v1alpha3"],
+        }
 
     # -- helpers -----------------------------------------------------------
 
@@ -237,6 +253,14 @@ class FakeKubeClient(KubeClient):
         return (gvr.resource, namespace if gvr.namespaced else "", name)
 
     def _maybe_fault(self, verb: str, gvr: GVR, name: str):
+        if "/" in gvr.api_version:
+            group, _, version = gvr.api_version.partition("/")
+            served = self.served_api_versions.get(group)
+            if served is not None and version not in served:
+                raise NotFoundError(
+                    f"the server could not find the requested resource "
+                    f"({gvr.api_version} {gvr.resource}; served: {served})"
+                )
         if self.fault_injector is not None:
             err = self.fault_injector(verb, gvr, name)
             if err is not None:
@@ -335,6 +359,7 @@ class FakeKubeClient(KubeClient):
         namespace: str = "",
         label_selector: str | None = None,
     ) -> Watch:
+        self._maybe_fault("watch", gvr, "")
         w = Watch()
         with self._lock:
             # Seed with current state (informer-style list+watch).
@@ -342,6 +367,9 @@ class FakeKubeClient(KubeClient):
                 w._emit(WatchEvent("ADDED", obj))
             self._watches.append((gvr.resource, namespace, label_selector, w))
         return w
+
+    def api_group_versions(self, group: str) -> list[str]:
+        return list(self.served_api_versions.get(group, []))
 
 
 # ---------------------------------------------------------------------------
@@ -432,6 +460,8 @@ class RealKubeClient(KubeClient):
         qps: float = 5.0,
         burst: int = 10,
         watch_mode: str = "stream",
+        list_page_size: int = 500,
+        overload_retries: int = 4,
     ):
         if watch_mode not in ("stream", "poll"):
             raise ValueError(
@@ -440,6 +470,11 @@ class RealKubeClient(KubeClient):
         self.config = config or RestConfig.auto()
         self.poll_interval = poll_interval
         self.watch_mode = watch_mode
+        # Chunked lists (limit/continue, the informer pager's chunk size —
+        # client-go's default is 500); 0 fetches whole collections at once.
+        self.list_page_size = list_page_size
+        # How many times a verb retries a 429/503 before surfacing it.
+        self.overload_retries = overload_retries
         self._limiter = TokenBucket(qps=qps, burst=burst)
         self._ssl_ctx = self._make_ssl_ctx()
         self._watch_threads: list[threading.Thread] = []
@@ -490,6 +525,34 @@ class RealKubeClient(KubeClient):
         return url
 
     def _request(self, method: str, url: str, body: dict | None = None) -> dict:
+        """One API verb, with overload retries: 429/503 responses are
+        retried after the server's Retry-After (priority-and-fairness load
+        shedding tells clients exactly when to come back; ignoring it turns
+        one overloaded relist into a retry storm). Bounded — the error
+        surfaces after ``overload_retries`` attempts."""
+        attempts = 0
+        while True:
+            try:
+                return self._request_once(method, url, body)
+            except ApiError as e:
+                if (
+                    e.code not in (429, 503)
+                    or attempts >= self.overload_retries
+                ):
+                    raise
+                attempts += 1
+                delay = e.retry_after if e.retry_after is not None else min(
+                    0.5 * (2 ** attempts), 10.0
+                )
+                delay = min(delay, 30.0)
+                logger.warning(
+                    "%s %s got %d (attempt %d/%d); retrying in %.1fs",
+                    method, url.split("?")[0], e.code,
+                    attempts, self.overload_retries, delay,
+                )
+                time.sleep(delay)
+
+    def _request_once(self, method: str, url: str, body: dict | None = None) -> dict:
         self._limiter.acquire()
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(url, data=data, method=method)
@@ -518,10 +581,40 @@ class RealKubeClient(KubeClient):
                 if reason == "AlreadyExists":
                     raise AlreadyExistsError(msg) from e
                 raise ConflictError(msg) from e
-            raise ApiError(msg, code=e.code) from e
+            retry_after = None
+            raw = e.headers.get("Retry-After", "") if e.headers else ""
+            if raw:
+                try:
+                    retry_after = float(raw)
+                except ValueError:
+                    pass  # HTTP-date form: fall back to client pacing
+            raise ApiError(msg, code=e.code, retry_after=retry_after) from e
 
     def get(self, gvr: GVR, name: str, namespace: str = "") -> dict:
         return self._request("GET", self._url(gvr, namespace, name))
+
+    def api_group_versions(self, group: str) -> list[str]:
+        """Group discovery (GET /apis/<group>): served versions, the
+        server's preferredVersion first. Empty when the group is absent.
+        Deliberately skips the overload-retry loop: re-discovery runs from
+        latency-sensitive recovery paths (under the plugin's claim lock),
+        and a failed discovery is itself recoverable — fail fast."""
+        try:
+            payload = self._request_once(
+                "GET", f"{self.config.host.rstrip('/')}/apis/{group}"
+            )
+        except NotFoundError:
+            return []
+        preferred = (payload.get("preferredVersion") or {}).get("version", "")
+        versions = [
+            v.get("version", "")
+            for v in payload.get("versions", [])
+            if v.get("version")
+        ]
+        if preferred in versions:
+            versions.remove(preferred)
+            versions.insert(0, preferred)
+        return versions
 
     def _list_raw(
         self,
@@ -529,9 +622,50 @@ class RealKubeClient(KubeClient):
         namespace: str = "",
         label_selector: str | None = None,
     ) -> dict:
-        """Full list response (items + list metadata.resourceVersion)."""
-        q = {"labelSelector": label_selector} if label_selector else None
-        return self._request("GET", self._url(gvr, namespace, query=q))
+        """Full list response (items + list metadata.resourceVersion),
+        assembled from limit/continue chunks (the informer pager: one giant
+        list of hundreds of slices is exactly what falls over first at the
+        64-chip scale the allocator handles; chunking bounds each response).
+        The apiserver serves every chunk from the first chunk's snapshot,
+        so the assembled list is consistent and the final page's
+        resourceVersion is the resume point."""
+        base: dict = {}
+        if label_selector:
+            base["labelSelector"] = label_selector
+        if self.list_page_size > 0:
+            base["limit"] = str(self.list_page_size)
+        items: list[dict] = []
+        cont = ""
+        while True:
+            q = dict(base)
+            if cont:
+                q["continue"] = cont
+            try:
+                out = self._request(
+                    "GET", self._url(gvr, namespace, query=q or None)
+                )
+            except ApiError as e:
+                if e.code == 410 and cont:
+                    # Continue token outlived the etcd compaction window
+                    # (slow page sequence, e.g. under 429 throttling). The
+                    # pager contract: restart as one unpaged list —
+                    # partial pages are from a dead snapshot and must be
+                    # discarded, not stitched.
+                    logger.warning(
+                        "continue token for %s expired; retrying as one "
+                        "unpaged list", gvr.resource,
+                    )
+                    q = {k: v for k, v in base.items() if k != "limit"}
+                    return self._request(
+                        "GET", self._url(gvr, namespace, query=q or None)
+                    )
+                raise
+            items.extend(out.get("items", []))
+            cont = (out.get("metadata") or {}).get("continue", "")
+            if not cont:
+                break
+        out["items"] = items
+        return out
 
     def list(
         self,
@@ -591,8 +725,15 @@ class RealKubeClient(KubeClient):
         known.update(seen)
         list_rv = (out.get("metadata") or {}).get("resourceVersion", "")
         if not list_rv and seen:
-            # Servers always set list RV; belt-and-braces fallback.
-            list_rv = max(seen.values(), key=lambda v: int(v or 0))
+            # Servers always set list RV; belt-and-braces fallback. RVs are
+            # opaque per the API contract — only compare ones that look
+            # numeric (every real apiserver's are), and when none do,
+            # return "" so the next connect watches from "current" instead
+            # of poisoning the loop with a ValueError (which the outer
+            # watch loop would treat as a stream failure, relisting
+            # forever).
+            numeric = [v for v in seen.values() if v and v.isdigit()]
+            list_rv = max(numeric, key=int) if numeric else ""
         return list_rv
 
     def _watch_stream(self, gvr, namespace, label_selector) -> Watch:
@@ -710,10 +851,34 @@ class RealKubeClient(KubeClient):
         import socket as _socket
 
         def _sever():
-            try:
-                resp.fp.raw._sock.shutdown(_socket.SHUT_RDWR)
-            except Exception:
-                pass
+            # resp.fp.raw._sock is CPython's layering; reach it via getattr
+            # so other interpreters degrade observably instead of silently
+            # leaving the reader blocked until the socket timeout.
+            raw = getattr(getattr(resp, "fp", None), "raw", None)
+            sock = getattr(raw, "_sock", None)
+            if sock is not None:
+                try:
+                    sock.shutdown(_socket.SHUT_RDWR)
+                except Exception:
+                    pass
+                return
+            if raw is not None:
+                # SocketIO itself: close() on the raw layer does not take
+                # the BufferedReader lock, so it cannot deadlock the way
+                # resp.close() would.
+                logger.debug(
+                    "watch stop: no ._sock on %r; closing raw IO instead",
+                    type(raw).__name__,
+                )
+                try:
+                    raw.close()
+                except Exception:
+                    pass
+                return
+            logger.debug(
+                "watch stop: no severable socket on %r; reader unblocks "
+                "at the socket timeout", type(resp).__name__,
+            )
 
         w._on_stop = _sever
         # stop() may have run between connect and hook installation — it

@@ -15,10 +15,19 @@ class ApiError(Exception):
     code = 500
     reason = "InternalError"
 
-    def __init__(self, message: str = "", code: int | None = None):
+    def __init__(
+        self,
+        message: str = "",
+        code: int | None = None,
+        retry_after: float | None = None,
+    ):
         super().__init__(message or self.reason)
         if code is not None:
             self.code = code
+        # Server-suggested retry delay (the Retry-After header a real API
+        # server attaches to 429/503 under priority-and-fairness load
+        # shedding); None when the server sent none.
+        self.retry_after = retry_after
 
     @property
     def status(self) -> dict:

@@ -21,12 +21,15 @@ import logging
 import threading
 from typing import Optional
 
-from .client import RESOURCE_SLICES, GVR, KubeClient
+from .client import GVR, KubeClient
 from .errors import AlreadyExistsError, ConflictError, NotFoundError
+from .resourceapi import ResourceApi
 
 logger = logging.getLogger(__name__)
 
-API_VERSION = "resource.k8s.io/v1alpha3"
+# Canonical (in-memory) stamp; the served dialect is negotiated per
+# controller via ResourceApi and applied at the wire boundary.
+API_VERSION = "resource.k8s.io/v1beta1"
 
 # Devices per ResourceSlice (the reference publishes IMEX channels 128 per
 # slice, imex.go:43; upstream's limit is 128 devices/slice).
@@ -67,17 +70,23 @@ class ResourceSliceController:
         scope: str,
         owner: Optional[dict] = None,
         resync_seconds: float = 600.0,
-        gvr: GVR = RESOURCE_SLICES,
+        gvr: Optional[GVR] = None,
+        api: Optional[ResourceApi] = None,
     ):
         """``scope`` identifies THIS publisher (node name for node plugins,
         e.g. "controller" for the cluster controller); create/update/delete
-        only ever touches slices labeled with it."""
+        only ever touches slices labeled with it. ``api`` selects the served
+        resource.k8s.io dialect (default: discover it from the client —
+        never silently pin, that is the round-4 404-on-1.32 bug);
+        ``gvr`` overrides the collection address for tests."""
         self.client = client
         self.driver_name = driver_name
         self.scope = scope
         self.owner = owner  # ownerReference dict (node or pod), optional
         self.resync_seconds = resync_seconds
-        self.gvr = gvr
+        self.api = api or ResourceApi.discover(client)
+        self.gvr = gvr or self.api.slices
+        self._gvr_pinned = gvr is not None  # test override: never re-target
         self._desired = DriverResources()
         self._lock = threading.Lock()
         self._sync_lock = threading.Lock()  # one reconcile pass at a time
@@ -118,11 +127,38 @@ class ResourceSliceController:
     def sync_once(self) -> None:
         """One reconcile pass (exposed for tests and for callers that want
         synchronous publication before serving). Serialized against the
-        background reconciler."""
+        background reconciler.
+
+        A NotFoundError from the verbs may mean the served dialect changed
+        out from under us (startup discovery fell back during an apiserver
+        outage, or the control plane was upgraded in place): re-discover,
+        and when the answer differs, re-target and retry the pass — the
+        pod must not need a restart to recover."""
         with self._sync_lock:
             with self._lock:
                 desired = self._desired
-            self._sync(desired)
+            try:
+                self._sync(desired)
+            except NotFoundError:
+                if not self._rediscover():
+                    raise
+                self._sync(desired)
+
+    def _rediscover(self) -> bool:
+        """Re-run version discovery; returns True when the dialect moved
+        (and the controller now targets the new one)."""
+        if self._gvr_pinned:
+            return False
+        new = ResourceApi.try_discover(self.client)
+        if new is None or new.version == self.api.version:
+            return False
+        logger.warning(
+            "resource.k8s.io dialect changed %s -> %s; re-targeting "
+            "slice publication", self.api.version, new.version,
+        )
+        self.api = new
+        self.gvr = new.slices
+        return True
 
     # -- reconcile loop ----------------------------------------------------
 
@@ -186,9 +222,10 @@ class ResourceSliceController:
 
     def _list_driver_slices(self) -> list[dict]:
         """Slices published by THIS instance: same driver AND same scope
-        label — never another node's or the controller's slices."""
+        label — never another node's or the controller's slices. Returned
+        in canonical form so the reconcile diff runs in one shape."""
         return [
-            s
+            self.api.slice_from_wire(s)
             for s in self.client.list(
                 self.gvr, label_selector=f"{OWNER_LABEL}={self.scope}"
             )
@@ -251,7 +288,7 @@ class ResourceSliceController:
             existing = have.get(name)
             if existing is None:
                 try:
-                    self.client.create(self.gvr, sl)
+                    self.client.create(self.gvr, self.api.slice_to_wire(sl))
                 except AlreadyExistsError:
                     # Raced a concurrent writer; converge next pass.
                     self._trigger.set()
@@ -262,7 +299,7 @@ class ResourceSliceController:
                     "resourceVersion", ""
                 )
                 try:
-                    self.client.update(self.gvr, merged)
+                    self.client.update(self.gvr, self.api.slice_to_wire(merged))
                 except ConflictError:
                     # Raced another writer; next pass will converge.
                     self._trigger.set()

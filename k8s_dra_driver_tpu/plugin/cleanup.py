@@ -21,8 +21,9 @@ import os
 import threading
 from typing import Optional
 
-from ..kube.client import RESOURCE_CLAIMS, KubeClient
+from ..kube.client import KubeClient
 from ..kube.errors import NotFoundError
+from ..kube.resourceapi import ResourceApi
 from .device_state import DeviceState
 
 logger = logging.getLogger(__name__)
@@ -34,9 +35,13 @@ class OrphanCleaner:
         state: DeviceState,
         kube_client: Optional[KubeClient] = None,
         interval_seconds: float = 600.0,
+        resource_api: Optional[ResourceApi] = None,
     ):
         self.state = state
         self.kube_client = kube_client
+        self.claims_gvr = (
+            resource_api or ResourceApi.discover(kube_client)
+        ).claims
         self.interval = interval_seconds
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -153,7 +158,7 @@ class OrphanCleaner:
                 continue
             try:
                 obj = self.kube_client.get(
-                    RESOURCE_CLAIMS, pc.name, namespace=pc.namespace
+                    self.claims_gvr, pc.name, namespace=pc.namespace
                 )
                 if obj["metadata"].get("uid", "") == uid:
                     continue  # still live

@@ -17,8 +17,9 @@ import time
 from typing import Optional
 
 from ..cdi.spec import CDIHandler
-from ..kube.client import RESOURCE_CLAIMS, KubeClient
+from ..kube.client import KubeClient
 from ..kube.protos import dra_v1alpha4_pb2 as drapb
+from ..kube.resourceapi import ResourceApi
 from ..kube.resourceslice import DriverResources, Pool
 from ..tpulib.chiplib import ChipLib
 from ..utils.metrics import Counter, Histogram, Registry
@@ -51,6 +52,10 @@ class DriverConfig:
     # Versions advertised on the registration socket: ("1.0.0",) for k8s
     # 1.31 kubelets, ("v1beta1.DRAPlugin",) for 1.32+ (see kubeletplugin).
     registration_versions: tuple = ("1.0.0",)
+    # Served resource.k8s.io REST dialect; None = discover at startup
+    # (1.31 serves v1alpha3, 1.32+ serves v1beta1 — the gRPC and REST
+    # generations are probed independently because managed clusters skew).
+    resource_api: Optional[ResourceApi] = None
     cleanup_interval_seconds: float = 600.0  # 0 disables the orphan cleaner
     # Device-inventory watch: re-enumerate (woken early by the chip
     # library's inotify, where available) and republish on change. 0
@@ -73,8 +78,15 @@ class DriverConfig:
 class Driver(NodeServicer):
     """NewDriver analog (driver.go:38-84)."""
 
+    # Floor between NotFound-triggered dialect re-discoveries (_fetch_claim).
+    REDISCOVER_INTERVAL_S = 30.0
+
     def __init__(self, config: DriverConfig, registry: Optional[Registry] = None):
         self.config = config
+        self.resource_api = config.resource_api or ResourceApi.discover(
+            config.kube_client
+        )
+        self._last_rediscover = float("-inf")
         self._lock = threading.Lock()  # serializes claim ops (driver.go:32)
         # Node-plugin metrics — a gap in the reference, whose plugin exposes
         # none (SURVEY.md §5).
@@ -116,6 +128,7 @@ class Driver(NodeServicer):
             kube_client=config.kube_client,
             node_uid=config.node_uid,
             registration_versions=list(config.registration_versions),
+            resource_api=self.resource_api,
         )
 
     def start(self) -> None:
@@ -129,6 +142,7 @@ class Driver(NodeServicer):
             self.state,
             self.config.kube_client,
             interval_seconds=self.config.cleanup_interval_seconds,
+            resource_api=self.resource_api,
         )
         if self.config.cleanup_interval_seconds > 0:
             self.cleaner.start()
@@ -248,12 +262,45 @@ class Driver(NodeServicer):
             )
 
     def _fetch_claim(self, claim) -> dict:
-        """GET the ResourceClaim and verify identity (driver.go:120-131)."""
+        """GET the ResourceClaim and verify identity (driver.go:120-131).
+
+        A NotFound may mean the claim is gone — or that startup discovery
+        fell back to the wrong resource.k8s.io dialect while the apiserver
+        was unreachable: re-discover once and retry before treating it as
+        a missing claim, so a bad boot self-heals without a pod restart.
+        """
         if self.config.kube_client is None:
             raise RuntimeError("no kube client configured")
-        obj = self.config.kube_client.get(
-            RESOURCE_CLAIMS, claim.name, namespace=claim.namespace
-        )
+        from ..kube.errors import NotFoundError
+
+        try:
+            obj = self.config.kube_client.get(
+                self.resource_api.claims, claim.name, namespace=claim.namespace
+            )
+        except NotFoundError:
+            # Rate-limited (claims legitimately vanish all the time — each
+            # re-discovery is a synchronous GET under the claim lock) and
+            # fallback-free (try_discover: a FAILED discovery must not
+            # read as "the server moved dialects").
+            now = time.monotonic()
+            if now - self._last_rediscover < self.REDISCOVER_INTERVAL_S:
+                raise
+            self._last_rediscover = now
+            rediscovered = ResourceApi.try_discover(self.config.kube_client)
+            if (
+                rediscovered is None
+                or rediscovered.version == self.resource_api.version
+            ):
+                raise
+            logger.warning(
+                "resource.k8s.io dialect changed %s -> %s; re-targeting",
+                self.resource_api.version, rediscovered.version,
+            )
+            self.resource_api = rediscovered
+            obj = self.config.kube_client.get(
+                self.resource_api.claims, claim.name, namespace=claim.namespace
+            )
+        obj = self.resource_api.claim_from_wire(obj)
         uid = obj["metadata"].get("uid", "")
         if uid != claim.uid:
             raise RuntimeError(

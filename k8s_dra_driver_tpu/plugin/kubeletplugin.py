@@ -104,6 +104,7 @@ class KubeletPlugin:
         kube_client: Optional[KubeClient] = None,
         node_uid: str = "",
         registration_versions: Optional[list[str]] = None,
+        resource_api=None,
     ):
         self.node_server = node_server
         self.driver_name = driver_name
@@ -112,6 +113,9 @@ class KubeletPlugin:
         self.registrar_socket = registrar_socket
         self.kube_client = kube_client
         self.node_uid = node_uid
+        # Served resource.k8s.io dialect (ResourceApi.discover at startup);
+        # None = the pinned default, for kube-less dev mode.
+        self.resource_api = resource_api
         self.registration_versions = list(
             registration_versions or [REGISTRATION_VERSION]
         )
@@ -174,6 +178,7 @@ class KubeletPlugin:
                     self.driver_name,
                     scope=self.node_name,
                     owner=owner,
+                    api=self.resource_api,
                 )
                 self._slice_controller.start()
             self._slice_controller.update(resources)

@@ -29,9 +29,17 @@ from k8s_dra_driver_tpu.kube.errors import (
 
 
 class StubApiServer:
-    """Minimal resource.k8s.io API server over http.server."""
+    """Minimal resource.k8s.io API server over http.server.
 
-    def __init__(self):
+    ``served_versions`` selects the cluster generation impersonated: a
+    k8s 1.31 server is ("v1alpha3",), a 1.32+ one ("v1beta1",). Requests
+    addressed to an unserved version 404 and ``GET /apis/resource.k8s.io``
+    answers group discovery, so version negotiation is exercised end to
+    end over real HTTP.
+    """
+
+    def __init__(self, served_versions=("v1alpha3",)):
+        self.served_versions = tuple(served_versions)
         self.objects: dict[str, dict] = {}  # name -> obj (cluster-scoped)
         self.rv = 0
         self.requests: list[tuple[str, str]] = []  # (method, path)
@@ -42,24 +50,33 @@ class StubApiServer:
         self.watch_410_once = False      # next watch request gets 410 Gone
         self.mute = False                # drop broadcasts (simulated lag)
         self.closing = False
+        # Overload injection: the next N non-watch requests get 429 with
+        # this Retry-After (apiserver priority-and-fairness shedding).
+        self.inject_429 = 0
+        self.retry_after = "0.05"
+        self.page_limit_cap = 0          # clamp client limits (0 = honor them)
+        self.expire_continue = False     # 410 any continue-token request
         stub = self
 
         class Handler(BaseHTTPRequestHandler):
-            prefix = "/apis/resource.k8s.io/v1alpha3/resourceslices"
+            group_path = "/apis/resource.k8s.io"
 
-            def _send(self, code: int, obj: dict):
+            def _send(self, code: int, obj: dict, headers=()):
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _status(self, code: int, reason: str, msg: str = ""):
+            def _status(self, code: int, reason: str, msg: str = "",
+                        headers=()):
                 self._send(code, {
                     "kind": "Status", "apiVersion": "v1", "status": "Failure",
                     "reason": reason, "message": msg or reason, "code": code,
-                })
+                }, headers)
 
             def _record(self):
                 stub.requests.append((self.command, self.path))
@@ -69,12 +86,48 @@ class StubApiServer:
                 n = int(self.headers.get("Content-Length", "0"))
                 return json.loads(self.rfile.read(n)) if n else {}
 
+            def _shed(self) -> bool:
+                """One injected 429, real-apiserver style."""
+                if stub.inject_429 > 0:
+                    stub.inject_429 -= 1
+                    self._status(429, "TooManyRequests", "throttled",
+                                 headers=(("Retry-After", stub.retry_after),))
+                    return True
+                return False
+
+            def _resolve(self, path: str):
+                """(version, rest-of-path) for a resourceslices request, or
+                None when the path addresses an unserved version/resource."""
+                for v in stub.served_versions:
+                    prefix = f"{self.group_path}/{v}/resourceslices"
+                    if path == prefix or path.startswith(prefix + "/"):
+                        return v, path[len(prefix):].strip("/")
+                return None
+
             def do_GET(self):
                 self._record()
                 url = urllib.parse.urlparse(self.path)
-                if not url.path.startswith(self.prefix):
+                if url.path.rstrip("/") == self.group_path:
+                    # API group discovery (version negotiation seam).
+                    return self._send(200, {
+                        "kind": "APIGroup", "name": "resource.k8s.io",
+                        "versions": [
+                            {"groupVersion": f"resource.k8s.io/{v}",
+                             "version": v}
+                            for v in stub.served_versions
+                        ],
+                        "preferredVersion": {
+                            "groupVersion":
+                                f"resource.k8s.io/{stub.served_versions[0]}",
+                            "version": stub.served_versions[0],
+                        },
+                    })
+                resolved = self._resolve(url.path)
+                if resolved is None:
                     return self._status(404, "NotFound", self.path)
-                rest = url.path[len(self.prefix):].strip("/")
+                if self._shed():
+                    return
+                _, rest = resolved
                 if rest:
                     obj = stub.objects.get(rest)
                     if obj is None:
@@ -91,9 +144,26 @@ class StubApiServer:
                         o for o in items
                         if o["metadata"].get("labels", {}).get(k) == v
                     ]
+                # limit/continue chunking (continue token = start index;
+                # real tokens are opaque to clients either way).
+                if stub.expire_continue and q.get("continue", [""])[0]:
+                    return self._status(
+                        410, "Expired", "the provided continue parameter "
+                        "is too old")
+                md = {"resourceVersion": str(stub.rv)}
+                limit = int(q.get("limit", ["0"])[0] or 0)
+                if stub.page_limit_cap:
+                    limit = min(limit or stub.page_limit_cap,
+                                stub.page_limit_cap)
+                if limit and limit < len(items):
+                    start = int(q.get("continue", ["0"])[0] or 0)
+                    page = items[start:start + limit]
+                    if start + limit < len(items):
+                        md["continue"] = str(start + limit)
+                    items = page
                 return self._send(200, {
                     "kind": "ResourceSliceList",
-                    "metadata": {"resourceVersion": str(stub.rv)},
+                    "metadata": md,
                     "items": items,
                 })
 
@@ -131,6 +201,10 @@ class StubApiServer:
 
             def do_POST(self):
                 self._record()
+                if self._resolve(urllib.parse.urlparse(self.path).path) is None:
+                    return self._status(404, "NotFound", self.path)
+                if self._shed():
+                    return
                 obj = self._body()
                 name = obj["metadata"]["name"]
                 if name in stub.objects:
@@ -145,6 +219,10 @@ class StubApiServer:
 
             def do_PUT(self):
                 self._record()
+                if self._resolve(urllib.parse.urlparse(self.path).path) is None:
+                    return self._status(404, "NotFound", self.path)
+                if self._shed():
+                    return
                 obj = self._body()
                 name = obj["metadata"]["name"]
                 cur = stub.objects.get(name)
@@ -163,7 +241,12 @@ class StubApiServer:
 
             def do_DELETE(self):
                 self._record()
-                name = self.path[len(self.prefix):].strip("/")
+                resolved = self._resolve(urllib.parse.urlparse(self.path).path)
+                if resolved is None:
+                    return self._status(404, "NotFound", self.path)
+                if self._shed():
+                    return
+                name = resolved[1]
                 if name not in stub.objects:
                     return self._status(404, "NotFound", name)
                 gone = stub.objects.pop(name)
@@ -372,7 +455,7 @@ class TestStreamingWatch:
             # (the seed) was needed.
             lists = [p for m, p in stub.requests
                      if m == "GET" and "watch=true" not in p
-                     and p.rstrip("/").endswith("resourceslices")]
+                     and p.split("?")[0].rstrip("/").endswith("resourceslices")]
             assert len(lists) == 1, stub.requests
         finally:
             w.stop()
@@ -432,6 +515,186 @@ class TestStreamingWatch:
             assert any("labelSelector=scope%3Dx" in p for p in watch_reqs)
         finally:
             w.stop()
+
+
+class TestChunkedList:
+    def test_list_assembles_pages(self, api):
+        """limit/continue chunking: 5 objects at page size 2 arrive whole
+        across 3 requests (informer pager semantics)."""
+        stub, _ = api
+        for i in range(5):
+            stub.rv += 1
+            stub.objects[f"s{i}"] = mkslice(f"s{i}")
+        client = RealKubeClient(
+            RestConfig(host=f"http://127.0.0.1:{stub.port}"),
+            qps=0, list_page_size=2,
+        )
+        before = len(stub.requests)
+        names = [o["metadata"]["name"] for o in client.list(RESOURCE_SLICES)]
+        assert names == [f"s{i}" for i in range(5)]
+        assert len(stub.requests) - before == 3
+        assert any("continue=2" in p for _, p in stub.requests)
+        client.close()
+
+    def test_expired_continue_token_falls_back_to_unpaged(self, api):
+        """410 on a continue token (etcd compacted past the snapshot): the
+        pager restarts as ONE unpaged list — no stitched half-snapshots,
+        no surfaced error (client-go pager contract)."""
+        stub, _ = api
+        for i in range(5):
+            stub.rv += 1
+            stub.objects[f"s{i}"] = mkslice(f"s{i}")
+        client = RealKubeClient(
+            RestConfig(host=f"http://127.0.0.1:{stub.port}"),
+            qps=0, list_page_size=2,
+        )
+        stub.expire_continue = True
+        names = [o["metadata"]["name"] for o in client.list(RESOURCE_SLICES)]
+        assert names == [f"s{i}" for i in range(5)]
+        # The recovery request carried neither limit nor continue.
+        last = stub.requests[-1][1]
+        assert "limit=" not in last and "continue=" not in last
+        client.close()
+
+    def test_page_size_zero_disables_chunking(self, api):
+        stub, _ = api
+        stub.objects["s0"] = mkslice("s0")
+        client = RealKubeClient(
+            RestConfig(host=f"http://127.0.0.1:{stub.port}"),
+            qps=0, list_page_size=0,
+        )
+        client.list(RESOURCE_SLICES)
+        assert all("limit=" not in p for m, p in stub.requests if m == "GET")
+        client.close()
+
+
+class TestOverloadRetry:
+    def test_429_retried_with_retry_after(self, api):
+        """A 429 with Retry-After is retried, not surfaced: the list
+        succeeds on the second attempt."""
+        stub, client = api
+        stub.objects["s0"] = mkslice("s0")
+        stub.rv += 1
+        stub.inject_429 = 1
+        t0 = time.monotonic()
+        names = [o["metadata"]["name"] for o in client.list(RESOURCE_SLICES)]
+        assert names == ["s0"]
+        assert time.monotonic() - t0 >= 0.04   # honored Retry-After 0.05
+        codes_429 = [p for m, p in stub.requests]  # both attempts recorded
+        assert len([p for p in codes_429 if "resourceslices" in p]) >= 2
+
+    def test_429_storm_eventually_surfaces(self, api):
+        stub, client = api
+        client.overload_retries = 2
+        stub.inject_429 = 99
+        from k8s_dra_driver_tpu.kube.errors import ApiError
+        with pytest.raises(ApiError) as exc:
+            client.list(RESOURCE_SLICES)
+        assert exc.value.code == 429
+        assert exc.value.retry_after == 0.05
+        stub.inject_429 = 0
+
+    def test_429_on_write_retried(self, api):
+        stub, client = api
+        stub.inject_429 = 1
+        created = client.create(RESOURCE_SLICES, mkslice("w1"))
+        assert created["metadata"]["name"] == "w1"
+        assert "w1" in stub.objects
+
+
+class TestVersionBilingual:
+    """The REST layer on a 1.32+ server (serves ONLY v1beta1): discovery
+    picks v1beta1 and slices land in the v1beta1 dialect — the round-4
+    gap where every write 404ed on exactly those clusters."""
+
+    @pytest.fixture
+    def beta_api(self):
+        stub = StubApiServer(served_versions=("v1beta1",))
+        stub.start()
+        client = RealKubeClient(
+            RestConfig(host=f"http://127.0.0.1:{stub.port}"),
+            poll_interval=0.05, qps=0,
+        )
+        yield stub, client
+        client.close()
+        stub.stop()
+
+    def test_discovery_picks_v1beta1(self, beta_api):
+        from k8s_dra_driver_tpu.kube.resourceapi import ResourceApi
+        stub, client = beta_api
+        assert client.api_group_versions("resource.k8s.io") == ["v1beta1"]
+        assert ResourceApi.discover(client).version == "v1beta1"
+
+    def test_discovery_picks_v1alpha3_on_131_server(self, api):
+        from k8s_dra_driver_tpu.kube.resourceapi import ResourceApi
+        stub, client = api           # default stub serves only v1alpha3
+        assert ResourceApi.discover(client).version == "v1alpha3"
+
+    def test_v1alpha3_write_404s_on_beta_server(self, beta_api):
+        """The exact round-4 failure mode, now detected: a client pinned
+        to v1alpha3 cannot write to a 1.32+ server."""
+        stub, client = beta_api
+        with pytest.raises(NotFoundError):
+            client.create(RESOURCE_SLICES, mkslice("s1"))
+
+    def test_slices_published_in_served_dialect(self, beta_api):
+        """End to end: controller -> REST -> v1beta1-only server. The wire
+        object keeps the DeviceCapacity wrapper and the v1beta1 stamp."""
+        from k8s_dra_driver_tpu.kube.resourceapi import ResourceApi
+        from k8s_dra_driver_tpu.kube.resourceslice import (
+            DriverResources, Pool, ResourceSliceController,
+        )
+        stub, client = beta_api
+        api_ = ResourceApi.discover(client)
+        ctrl = ResourceSliceController(
+            client, "tpu.google.com", scope="n0", api=api_,
+        )
+        dev = {"name": "tpu0", "basic": {
+            "attributes": {"type": {"string": "chip"}},
+            "capacity": {"hbm": {"value": "95"}},
+        }}
+        ctrl.update(DriverResources(pools={
+            "n0": Pool(devices=[dev], node_name="n0"),
+        }))
+        ctrl.sync_once()
+        (wire,) = stub.objects.values()
+        assert wire["apiVersion"] == "resource.k8s.io/v1beta1"
+        cap = wire["spec"]["devices"][0]["basic"]["capacity"]
+        assert cap == {"hbm": {"value": "95"}}
+        # Idempotent resync: no spurious update.
+        rv = wire["metadata"]["resourceVersion"]
+        ctrl.sync_once()
+        (wire2,) = stub.objects.values()
+        assert wire2["metadata"]["resourceVersion"] == rv
+
+    def test_slices_published_in_v1alpha3_dialect(self, api):
+        """Same flow on a 1.31 server: capacities unwrap to bare quantity
+        strings (v1alpha3 types.go:220)."""
+        from k8s_dra_driver_tpu.kube.resourceapi import ResourceApi
+        from k8s_dra_driver_tpu.kube.resourceslice import (
+            DriverResources, Pool, ResourceSliceController,
+        )
+        stub, client = api
+        api_ = ResourceApi.discover(client)
+        assert api_.version == "v1alpha3"
+        ctrl = ResourceSliceController(
+            client, "tpu.google.com", scope="n0", api=api_,
+        )
+        dev = {"name": "tpu0", "basic": {
+            "attributes": {"type": {"string": "chip"}},
+            "capacity": {"hbm": {"value": "95"}},
+        }}
+        ctrl.update(DriverResources(pools={
+            "n0": Pool(devices=[dev], node_name="n0"),
+        }))
+        ctrl.sync_once()
+        (wire,) = stub.objects.values()
+        assert wire["apiVersion"] == "resource.k8s.io/v1alpha3"
+        assert wire["spec"]["devices"][0]["basic"]["capacity"] == {"hbm": "95"}
+        rv = wire["metadata"]["resourceVersion"]
+        ctrl.sync_once()      # diff runs in canonical space: no churn
+        (wire2,) = stub.objects.values()
+        assert wire2["metadata"]["resourceVersion"] == rv
 
 
 class TestClientThrottle:

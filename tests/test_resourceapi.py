@@ -1,0 +1,388 @@
+"""resource.k8s.io version negotiation (kube/resourceapi.py).
+
+Round-4 verdict #1: the GVRs were hardcoded to v1alpha3, so every
+ResourceSlice write/watch 404ed on k8s 1.32+ clusters (which serve
+v1beta1). These tests pin the negotiation layer: discovery picks the
+newest supported served dialect, conversion maps the one structural
+delta (device capacity: v1beta1 DeviceCapacity ``{"value": ...}`` vs
+v1alpha3 bare quantity strings — reference shape:
+/root/reference/vendor/k8s.io/api/resource/v1alpha3/types.go:220), and
+the full publish→allocate loop works against a server of either
+generation. The REST-over-HTTP halves live in test_real_client.py
+(TestVersionBilingual).
+"""
+
+import pytest
+
+from k8s_dra_driver_tpu.kube import (
+    RESOURCE_SLICES,
+    FakeKubeClient,
+    NotFoundError,
+    ResourceApi,
+)
+from k8s_dra_driver_tpu.kube.resourceslice import (
+    DriverResources,
+    Pool,
+    ResourceSliceController,
+)
+
+
+def canonical_slice(name="s0"):
+    return {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceSlice",
+        "metadata": {"name": name},
+        "spec": {
+            "driver": "tpu.google.com",
+            "pool": {"name": "p", "generation": 1, "resourceSliceCount": 1},
+            "nodeName": "n0",
+            "devices": [
+                {
+                    "name": "tpu0",
+                    "basic": {
+                        "attributes": {"type": {"string": "chip"}},
+                        "capacity": {
+                            "hbm": {"value": "103079215104"},
+                            "tensorcores": {"value": "2"},
+                        },
+                        "consumesCounters": [
+                            {
+                                "counterSet": "chip-0-counters",
+                                "counters": {"cores": {"value": "2"}},
+                            }
+                        ],
+                    },
+                }
+            ],
+            "sharedCounters": [
+                {
+                    "name": "chip-0-counters",
+                    "counters": {"cores": {"value": "2"}},
+                }
+            ],
+        },
+    }
+
+
+class TestConversion:
+    def test_v1beta1_to_wire_is_identity_plus_stamp(self):
+        api = ResourceApi("v1beta1")
+        wire = api.slice_to_wire(canonical_slice())
+        assert wire["apiVersion"] == "resource.k8s.io/v1beta1"
+        assert wire["spec"] == canonical_slice()["spec"]
+
+    def test_v1alpha3_to_wire_unwraps_capacity(self):
+        api = ResourceApi("v1alpha3")
+        wire = api.slice_to_wire(canonical_slice())
+        assert wire["apiVersion"] == "resource.k8s.io/v1alpha3"
+        cap = wire["spec"]["devices"][0]["basic"]["capacity"]
+        assert cap == {"hbm": "103079215104", "tensorcores": "2"}
+        # Counter sets are the 1.33-era extension: identical in both
+        # dialects, never rewritten.
+        assert wire["spec"]["sharedCounters"] == (
+            canonical_slice()["spec"]["sharedCounters"]
+        )
+        assert wire["spec"]["devices"][0]["basic"]["consumesCounters"] == (
+            canonical_slice()["spec"]["devices"][0]["basic"]["consumesCounters"]
+        )
+
+    def test_to_wire_does_not_mutate_input(self):
+        api = ResourceApi("v1alpha3")
+        obj = canonical_slice()
+        api.slice_to_wire(obj)
+        assert obj == canonical_slice()
+
+    def test_from_wire_round_trips(self):
+        for version in ("v1alpha3", "v1beta1"):
+            api = ResourceApi(version)
+            back = api.slice_from_wire(api.slice_to_wire(canonical_slice()))
+            assert back["spec"] == canonical_slice()["spec"], version
+
+    def test_from_wire_idempotent_on_canonical(self):
+        api = ResourceApi("v1alpha3")
+        once = api.slice_from_wire(canonical_slice())
+        assert once["spec"] == canonical_slice()["spec"]
+
+    def test_devices_without_capacity_pass_through(self):
+        api = ResourceApi("v1alpha3")
+        obj = {
+            "apiVersion": "x",
+            "spec": {"devices": [{"name": "d", "basic": {"attributes": {}}}]},
+        }
+        assert api.slice_to_wire(obj)["spec"] == obj["spec"]
+
+    def test_claim_conversion_restamps_only(self):
+        api = ResourceApi("v1alpha3")
+        claim = {
+            "apiVersion": "resource.k8s.io/v1beta1",
+            "kind": "ResourceClaim",
+            "spec": {"devices": {"requests": [{"name": "r"}]}},
+        }
+        wire = api.claim_to_wire(claim)
+        assert wire["apiVersion"] == "resource.k8s.io/v1alpha3"
+        assert wire["spec"] is claim["spec"]
+
+    def test_unsupported_version_rejected(self):
+        with pytest.raises(ValueError):
+            ResourceApi("v1beta2")
+
+
+class TestDiscovery:
+    def test_prefers_v1beta1_when_both_served(self):
+        client = FakeKubeClient()   # default: serves both
+        assert ResourceApi.discover(client).version == "v1beta1"
+
+    def test_picks_the_only_served_version(self):
+        client = FakeKubeClient()
+        client.served_api_versions["resource.k8s.io"] = ["v1alpha3"]
+        assert ResourceApi.discover(client).version == "v1alpha3"
+        client.served_api_versions["resource.k8s.io"] = ["v1beta1"]
+        assert ResourceApi.discover(client).version == "v1beta1"
+
+    def test_no_client_falls_back_to_default(self):
+        assert ResourceApi.discover(None).version == "v1alpha3"
+
+    def test_unknown_group_falls_back_loudly(self, caplog):
+        client = FakeKubeClient()
+        client.served_api_versions["resource.k8s.io"] = []
+        with caplog.at_level("WARNING"):
+            api = ResourceApi.discover(client)
+        assert api.version == "v1alpha3"
+        assert any("none of which" in r.message for r in caplog.records)
+
+    def test_discovery_failure_falls_back(self):
+        class Exploding(FakeKubeClient):
+            def api_group_versions(self, group):
+                raise RuntimeError("apiserver down")
+
+        assert ResourceApi.discover(Exploding()).version == "v1alpha3"
+
+    def test_try_discover_returns_none_on_failure(self):
+        """Re-discovery must never report a fallback as a real answer — a
+        failed probe returning v1alpha3 would re-target a correctly
+        negotiated v1beta1 driver onto a dialect the server never served."""
+        class Exploding(FakeKubeClient):
+            def api_group_versions(self, group):
+                raise RuntimeError("discovery RBAC-denied")
+
+        assert ResourceApi.try_discover(Exploding()) is None
+        assert ResourceApi.try_discover(None) is None
+        ok = FakeKubeClient()
+        ok.served_api_versions["resource.k8s.io"] = ["v1beta1"]
+        assert ResourceApi.try_discover(ok).version == "v1beta1"
+        ok.served_api_versions["resource.k8s.io"] = []
+        assert ResourceApi.try_discover(ok) is None
+
+
+class TestFakeServedVersions:
+    """FakeKubeClient impersonates one cluster generation: requests to an
+    unserved resource.k8s.io version 404 the way a real apiserver's would."""
+
+    def test_v1alpha3_gvr_404s_on_beta_only_fake(self):
+        client = FakeKubeClient()
+        client.served_api_versions["resource.k8s.io"] = ["v1beta1"]
+        with pytest.raises(NotFoundError):
+            client.create(RESOURCE_SLICES, canonical_slice())
+        with pytest.raises(NotFoundError):
+            client.list(RESOURCE_SLICES)
+        with pytest.raises(NotFoundError):
+            client.watch(RESOURCE_SLICES)
+
+    def test_non_resource_groups_unaffected(self):
+        from k8s_dra_driver_tpu.kube import NODES
+        client = FakeKubeClient()
+        client.served_api_versions["resource.k8s.io"] = ["v1beta1"]
+        client.create(NODES, {"metadata": {"name": "n0"}})
+        assert client.get(NODES, "n0")["metadata"]["name"] == "n0"
+
+
+class TestPublishAllocateAcrossDialects:
+    """The whole loop — plugin publishes, sim allocator consumes — on a
+    server of either generation."""
+
+    @pytest.mark.parametrize("served", [["v1alpha3"], ["v1beta1"]])
+    def test_publish_then_allocate(self, served):
+        from k8s_dra_driver_tpu.kube.allocator import ReferenceAllocator
+
+        client = FakeKubeClient()
+        client.served_api_versions["resource.k8s.io"] = list(served)
+        api = ResourceApi.discover(client)
+        assert api.version == served[0]
+
+        ctrl = ResourceSliceController(
+            client, "tpu.google.com", scope="n0", api=api,
+        )
+        sl = canonical_slice()
+        ctrl.update(DriverResources(pools={
+            "n0": Pool(
+                devices=sl["spec"]["devices"],
+                shared_counters=sl["spec"]["sharedCounters"],
+                node_name="n0",
+            )
+        }))
+        ctrl.sync_once()
+        (wire,) = client.list(api.slices)
+        assert wire["apiVersion"] == f"resource.k8s.io/{served[0]}"
+        cap = wire["spec"]["devices"][0]["basic"]["capacity"]
+        if served[0] == "v1alpha3":
+            assert cap["hbm"] == "103079215104"      # bare quantity
+        else:
+            assert cap["hbm"] == {"value": "103079215104"}
+
+        allocator = ReferenceAllocator(client)
+        assert allocator.api.version == served[0]
+        claim = {
+            "metadata": {"name": "c", "namespace": "d", "uid": "u1"},
+            "spec": {"devices": {"requests": [
+                {"name": "r0", "deviceClassName": "tpu.google.com"},
+            ]}},
+        }
+        out = allocator.allocate(claim)
+        results = out["status"]["allocation"]["devices"]["results"]
+        assert [r["device"] for r in results] == ["tpu0"]
+
+    def test_controller_rediscovers_on_dialect_flip(self):
+        """Control plane upgraded in place (or startup discovery fell back
+        wrong during an outage): the publisher re-targets on the
+        whole-collection 404 instead of erroring until a pod restart."""
+        client = FakeKubeClient()
+        client.served_api_versions["resource.k8s.io"] = ["v1alpha3"]
+        ctrl = ResourceSliceController(client, "tpu.google.com", scope="n0")
+        assert ctrl.api.version == "v1alpha3"
+        sl = canonical_slice()
+        ctrl.update(DriverResources(pools={
+            "n0": Pool(devices=sl["spec"]["devices"], node_name="n0"),
+        }))
+        ctrl.sync_once()
+        client.served_api_versions["resource.k8s.io"] = ["v1beta1"]
+        ctrl.sync_once()
+        assert ctrl.api.version == "v1beta1"
+        # Unchanged content: no rewrite needed (a real apiserver converts
+        # stored objects on read). The next content change must land in
+        # the new dialect.
+        sl2 = canonical_slice()
+        sl2["spec"]["devices"][0]["basic"]["capacity"]["hbm"] = {
+            "value": "42"
+        }
+        ctrl.update(DriverResources(pools={
+            "n0": Pool(devices=sl2["spec"]["devices"], node_name="n0"),
+        }))
+        ctrl.sync_once()
+        (wire,) = client.list(ResourceApi("v1beta1").slices)
+        assert wire["apiVersion"] == "resource.k8s.io/v1beta1"
+        cap = wire["spec"]["devices"][0]["basic"]["capacity"]
+        assert cap["hbm"] == {"value": "42"}
+
+    def test_driver_fetch_claim_rediscovers_on_dialect_flip(self):
+        from k8s_dra_driver_tpu.plugin.driver import Driver, DriverConfig
+        from k8s_dra_driver_tpu.tpulib.chiplib import FakeChipLib
+
+        client = FakeKubeClient()
+        client.served_api_versions["resource.k8s.io"] = ["v1alpha3"]
+        import tempfile
+        with tempfile.TemporaryDirectory() as td:
+            driver = Driver(DriverConfig(
+                node_name="n0",
+                chiplib=FakeChipLib(generation="v5e", topology="1x1x1"),
+                kube_client=client,
+                cdi_root=f"{td}/cdi", plugin_root=f"{td}/plugin",
+                registrar_root=f"{td}/registrar", state_root=f"{td}/state",
+            ))
+            assert driver.resource_api.version == "v1alpha3"
+            client.served_api_versions["resource.k8s.io"] = ["v1beta1"]
+            api = ResourceApi("v1beta1")
+            client.create(api.claims, {
+                "apiVersion": api.api_version, "kind": "ResourceClaim",
+                "metadata": {"name": "c0", "namespace": "d", "uid": "u0"},
+                "spec": {"devices": {"requests": []}},
+            }, namespace="d")
+
+            class FakeGrpcClaim:
+                name, namespace, uid = "c0", "d", "u0"
+
+            obj = driver._fetch_claim(FakeGrpcClaim())
+            assert obj["metadata"]["uid"] == "u0"
+            assert driver.resource_api.version == "v1beta1"
+
+    def test_driver_missing_claim_does_not_flip_dialect(self):
+        """A genuinely-deleted claim (the common case) surfaces NotFound
+        and leaves the negotiated dialect alone — even when the
+        re-discovery probe itself fails (RBAC denies group discovery)."""
+        from k8s_dra_driver_tpu.plugin.driver import Driver, DriverConfig
+        from k8s_dra_driver_tpu.tpulib.chiplib import FakeChipLib
+
+        class DiscoveryDenied(FakeKubeClient):
+            def __init__(self):
+                super().__init__()
+                self.discovery_calls = 0
+                self.allow_discovery = True
+
+            def api_group_versions(self, group):
+                self.discovery_calls += 1
+                if not self.allow_discovery:
+                    raise RuntimeError("403 on group discovery")
+                return super().api_group_versions(group)
+
+        client = DiscoveryDenied()
+        client.served_api_versions["resource.k8s.io"] = ["v1beta1"]
+        import tempfile
+        with tempfile.TemporaryDirectory() as td:
+            driver = Driver(DriverConfig(
+                node_name="n0",
+                chiplib=FakeChipLib(generation="v5e", topology="1x1x1"),
+                kube_client=client,
+                cdi_root=f"{td}/cdi", plugin_root=f"{td}/plugin",
+                registrar_root=f"{td}/registrar", state_root=f"{td}/state",
+            ))
+            assert driver.resource_api.version == "v1beta1"
+            client.allow_discovery = False
+
+            class Ghost:
+                name, namespace, uid = "ghost", "d", "u9"
+
+            with pytest.raises(NotFoundError):
+                driver._fetch_claim(Ghost())
+            assert driver.resource_api.version == "v1beta1"
+            # Rate limit: an immediate second miss skips the probe.
+            calls = client.discovery_calls
+            with pytest.raises(NotFoundError):
+                driver._fetch_claim(Ghost())
+            assert client.discovery_calls == calls
+
+    def test_driver_fetch_claim_uses_discovered_dialect(self):
+        """Driver claim GETs go to the served version's path: a claim
+        stored by a v1beta1-only server is found, not 404ed."""
+        from k8s_dra_driver_tpu.plugin.driver import Driver, DriverConfig
+        from k8s_dra_driver_tpu.tpulib.chiplib import FakeChipLib
+
+        client = FakeKubeClient()
+        client.served_api_versions["resource.k8s.io"] = ["v1beta1"]
+        api = ResourceApi.discover(client)
+        client.create(api.claims, {
+            "apiVersion": api.api_version,
+            "kind": "ResourceClaim",
+            "metadata": {"name": "c0", "namespace": "d", "uid": "uid-c0"},
+            "spec": {"devices": {"requests": []}},
+        }, namespace="d")
+
+        import tempfile
+        with tempfile.TemporaryDirectory() as td:
+            config = DriverConfig(
+                node_name="n0",
+                chiplib=FakeChipLib(generation="v5e", topology="1x1x1"),
+                kube_client=client,
+                cdi_root=f"{td}/cdi",
+                plugin_root=f"{td}/plugin",
+                registrar_root=f"{td}/registrar",
+                state_root=f"{td}/state",
+            )
+            driver = Driver(config)
+            assert driver.resource_api.version == "v1beta1"
+
+            class FakeGrpcClaim:
+                name = "c0"
+                namespace = "d"
+                uid = "uid-c0"
+
+            obj = driver._fetch_claim(FakeGrpcClaim())
+            assert obj["metadata"]["uid"] == "uid-c0"
