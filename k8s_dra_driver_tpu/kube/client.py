@@ -385,11 +385,73 @@ class _RelistNeeded(Exception):
 
 
 @dataclasses.dataclass
+class ExecAuthConfig:
+    """users[].user.exec from a kubeconfig: an external credential plugin
+    (gcloud's gke-gcloud-auth-plugin, aws-iam-authenticator, ...). The
+    client-go ExecCredential protocol: run the command, read an
+    ExecCredential JSON from stdout, use status.token or the client
+    cert/key it returns."""
+
+    command: str
+    args: list = dataclasses.field(default_factory=list)
+    env: dict = dataclasses.field(default_factory=dict)
+    api_version: str = "client.authentication.k8s.io/v1"
+
+    def run(self) -> dict:
+        """Execute the plugin; returns the ExecCredential ``status``."""
+        import subprocess
+
+        env = dict(os.environ)
+        env.update(self.env)
+        # The protocol's handshake: tell the plugin which apiVersion we
+        # speak and that no interactive terminal is available.
+        env["KUBERNETES_EXEC_INFO"] = json.dumps({
+            "kind": "ExecCredential",
+            "apiVersion": self.api_version,
+            "spec": {"interactive": False},
+        })
+        out = subprocess.run(
+            [self.command, *self.args],
+            env=env, capture_output=True, text=True, timeout=60,
+        )
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"exec credential plugin {self.command!r} failed "
+                f"(rc={out.returncode}): {out.stderr.strip()[:500]}"
+            )
+        try:
+            cred = json.loads(out.stdout)
+        except ValueError as e:
+            raise RuntimeError(
+                f"exec credential plugin {self.command!r} printed "
+                "non-JSON output"
+            ) from e
+        if cred.get("kind") != "ExecCredential":
+            raise RuntimeError(
+                f"exec credential plugin {self.command!r} returned kind "
+                f"{cred.get('kind')!r}, want ExecCredential"
+            )
+        return cred.get("status") or {}
+
+
+def _b64_pem(data: str) -> str:
+    import base64
+
+    return base64.b64decode(data).decode()
+
+
+@dataclasses.dataclass
 class RestConfig:
     host: str
     token: str = ""
     ca_file: str = ""
+    ca_data: str = ""            # PEM (kubeconfig certificate-authority-data)
     insecure: bool = False
+    client_cert_file: str = ""
+    client_key_file: str = ""
+    client_cert_data: str = ""   # PEM (kubeconfig client-certificate-data)
+    client_key_data: str = ""    # PEM (kubeconfig client-key-data)
+    exec_auth: Optional[ExecAuthConfig] = None
 
     @classmethod
     def in_cluster(cls) -> "RestConfig":
@@ -407,8 +469,11 @@ class RestConfig:
 
     @classmethod
     def from_kubeconfig(cls, path: str = "") -> "RestConfig":
-        """Minimal kubeconfig loader (current-context, token/insecure only;
-        role of clientcmd loading, pkg/flags/kubeclient.go:85-89)."""
+        """Kubeconfig loader (current-context; role of clientcmd,
+        pkg/flags/kubeclient.go:85-89). Understands every auth shape the
+        clusters this repo's own scripts create actually emit: bearer
+        tokens, client cert/key as files OR inline base64 ``*-data``
+        (kind writes these), and exec credential plugins (GKE)."""
         import yaml
 
         path = path or os.environ.get(
@@ -426,11 +491,30 @@ class RestConfig:
         user = next(
             u["user"] for u in cfg["users"] if u["name"] == ctx["user"]
         )
+        exec_auth = None
+        if "exec" in user:
+            ex = user["exec"] or {}
+            exec_auth = ExecAuthConfig(
+                command=ex.get("command", ""),
+                args=list(ex.get("args") or []),
+                env={
+                    e["name"]: e["value"] for e in (ex.get("env") or [])
+                },
+                api_version=ex.get(
+                    "apiVersion", "client.authentication.k8s.io/v1"
+                ),
+            )
         return cls(
             host=cluster["server"],
             token=user.get("token", ""),
             ca_file=cluster.get("certificate-authority", ""),
+            ca_data=_b64_pem(cluster.get("certificate-authority-data", "")),
             insecure=bool(cluster.get("insecure-skip-tls-verify", False)),
+            client_cert_file=user.get("client-certificate", ""),
+            client_key_file=user.get("client-key", ""),
+            client_cert_data=_b64_pem(user.get("client-certificate-data", "")),
+            client_key_data=_b64_pem(user.get("client-key-data", "")),
+            exec_auth=exec_auth,
         )
 
     @classmethod
@@ -476,6 +560,11 @@ class RealKubeClient(KubeClient):
         # How many times a verb retries a 429/503 before surfacing it.
         self.overload_retries = overload_retries
         self._limiter = TokenBucket(qps=qps, burst=burst)
+        self._auth_lock = threading.Lock()
+        self._exec_expiry: Optional[float] = None  # epoch seconds, or None
+        self._cred_files: list[str] = []  # materialized cert/key temp files
+        if self.config.exec_auth is not None:
+            self._refresh_exec_credentials()
         self._ssl_ctx = self._make_ssl_ctx()
         self._watch_threads: list[threading.Thread] = []
         self._watches: list[Watch] = []
@@ -493,6 +582,12 @@ class RealKubeClient(KubeClient):
             t.join(timeout=5)
         self._watches.clear()
         self._watch_threads.clear()
+        for path in self._cred_files:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        self._cred_files.clear()
 
     def __enter__(self) -> "RealKubeClient":
         return self
@@ -507,10 +602,115 @@ class RealKubeClient(KubeClient):
             ctx = ssl.create_default_context()
             ctx.check_hostname = False
             ctx.verify_mode = ssl.CERT_NONE
-            return ctx
-        if self.config.ca_file:
-            return ssl.create_default_context(cafile=self.config.ca_file)
-        return ssl.create_default_context()
+        elif self.config.ca_data:
+            ctx = ssl.create_default_context(cadata=self.config.ca_data)
+        elif self.config.ca_file:
+            ctx = ssl.create_default_context(cafile=self.config.ca_file)
+        else:
+            ctx = ssl.create_default_context()
+        cert_file, key_file = self._client_chain_files()
+        if cert_file:
+            # mTLS: the client certificate IS the identity on kind/GKE
+            # admin kubeconfigs (clientcmd analog: kubeclient.go:85-89).
+            ctx.load_cert_chain(cert_file, key_file or None)
+        return ctx
+
+    def _client_chain_files(self) -> tuple[str, str]:
+        """Client cert/key as file paths. Inline ``*-data`` PEM (what kind
+        writes, and what exec plugins return) is materialized into 0600
+        temp files — the ssl module loads chains from files only. Files
+        from a previous materialization are removed first: load_cert_chain
+        copies them into the context, so a superseded pair is pure leakage
+        (one rotated key pair per exec refresh, forever)."""
+        cfg = self.config
+        for path in self._cred_files:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        self._cred_files.clear()
+        if cfg.client_cert_data:
+            import tempfile
+
+            def _write(pem: str, suffix: str) -> str:
+                fd, path = tempfile.mkstemp(prefix="kubecred-", suffix=suffix)
+                os.write(fd, pem.encode())
+                os.close(fd)
+                os.chmod(path, 0o600)
+                self._cred_files.append(path)
+                return path
+
+            cert = _write(cfg.client_cert_data, ".crt")
+            key = (
+                _write(cfg.client_key_data, ".key")
+                if cfg.client_key_data else ""
+            )
+            return cert, key
+        return cfg.client_cert_file, cfg.client_key_file
+
+    # -- exec credential plugins -------------------------------------------
+
+    def _refresh_exec_credentials(self) -> None:
+        """Run the kubeconfig's exec plugin and absorb its ExecCredential:
+        bearer token and/or client cert rotation."""
+        status = self.config.exec_auth.run()
+        if status.get("token"):
+            self.config.token = status["token"]
+        if status.get("clientCertificateData"):
+            self.config.client_cert_data = status["clientCertificateData"]
+            self.config.client_key_data = status.get("clientKeyData", "")
+        exp = status.get("expirationTimestamp")
+        self._exec_expiry = None
+        if exp:
+            import datetime
+
+            try:
+                self._exec_expiry = datetime.datetime.fromisoformat(
+                    exp.replace("Z", "+00:00")
+                ).timestamp()
+            except ValueError:
+                logger.warning(
+                    "exec plugin returned unparseable expirationTimestamp "
+                    "%r; credentials will not auto-refresh", exp,
+                )
+
+    def _maybe_refresh_exec(self) -> None:
+        """Re-run the exec plugin shortly before its credential expires
+        (client-go refreshes on expiry too; without this, long-lived
+        watches outlive a GKE token within the hour).
+
+        A FAILED refresh must not abort the caller's verb: the refresh
+        fires 60s early precisely so the cached token is still good, so
+        log, defer the next attempt (no once-per-request plugin stalls
+        under the auth lock), and proceed with what we have. If the
+        cached token really is dead, the 401 path below forces the issue.
+        """
+        if self.config.exec_auth is None or self._exec_expiry is None:
+            return
+        if time.time() <= self._exec_expiry - 60:
+            return
+        with self._auth_lock:
+            if time.time() <= self._exec_expiry - 60:
+                return
+            try:
+                self._refresh_exec_credentials()
+                self._ssl_ctx = self._make_ssl_ctx()
+            except Exception as e:
+                logger.warning(
+                    "exec credential refresh failed (%s); keeping cached "
+                    "credentials and retrying in 30s", e,
+                )
+                self._exec_expiry = time.time() + 90  # next try in ~30s
+
+    def _force_refresh_exec(self) -> None:
+        """401-triggered re-exec (client-go re-runs the plugin on
+        Unauthorized): the only refresh path when the plugin never
+        returns an expirationTimestamp. Failures propagate — with the
+        server rejecting the cached token, there is nothing to fall
+        back to."""
+        with self._auth_lock:
+            self._refresh_exec_credentials()
+            self._ssl_ctx = self._make_ssl_ctx()
 
     def _url(self, gvr: GVR, namespace: str, name: str = "", query: dict | None = None) -> str:
         parts = [self.config.host.rstrip("/"), gvr.path_prefix.lstrip("/")]
@@ -531,10 +731,26 @@ class RealKubeClient(KubeClient):
         one overloaded relist into a retry storm). Bounded — the error
         surfaces after ``overload_retries`` attempts."""
         attempts = 0
+        reauthed = False
         while True:
             try:
                 return self._request_once(method, url, body)
             except ApiError as e:
+                if (
+                    e.code == 401
+                    and self.config.exec_auth is not None
+                    and not reauthed
+                ):
+                    # Token died without (or despite) an expiry hint:
+                    # re-exec the plugin once and retry (client-go's
+                    # Unauthorized handling).
+                    reauthed = True
+                    logger.warning(
+                        "%s %s got 401; re-running exec credential plugin",
+                        method, url.split("?")[0],
+                    )
+                    self._force_refresh_exec()
+                    continue
                 if (
                     e.code not in (429, 503)
                     or attempts >= self.overload_retries
@@ -553,6 +769,7 @@ class RealKubeClient(KubeClient):
                 time.sleep(delay)
 
     def _request_once(self, method: str, url: str, body: dict | None = None) -> dict:
+        self._maybe_refresh_exec()
         self._limiter.acquire()
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(url, data=data, method=method)
@@ -788,6 +1005,7 @@ class RealKubeClient(KubeClient):
         if label_selector:
             query["labelSelector"] = label_selector
         url = self._url(gvr, namespace, query=query)
+        self._maybe_refresh_exec()
         self._limiter.acquire()
         if w.stopped:
             return rv

@@ -35,13 +35,24 @@ class OrphanCleaner:
         state: DeviceState,
         kube_client: Optional[KubeClient] = None,
         interval_seconds: float = 600.0,
-        resource_api: Optional[ResourceApi] = None,
+        resource_api=None,
+        on_dialect_change=None,
     ):
+        """``resource_api`` may be a ResourceApi or a zero-arg callable
+        returning one (the Driver passes ``lambda: self.resource_api`` so
+        the cleaner always sees the LIVE negotiated dialect — a stale
+        captured GVR plus a wrong-dialect 404 would read as "claim
+        deleted" and mass-unprepare running pods). ``on_dialect_change``
+        is invoked with the re-discovered ResourceApi when the cleaner
+        detects the served dialect moved."""
         self.state = state
         self.kube_client = kube_client
-        self.claims_gvr = (
-            resource_api or ResourceApi.discover(kube_client)
-        ).claims
+        if resource_api is None:
+            resource_api = ResourceApi.discover(kube_client)
+        self._api_source = (
+            resource_api if callable(resource_api) else (lambda: resource_api)
+        )
+        self.on_dialect_change = on_dialect_change
         self.interval = interval_seconds
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -152,18 +163,35 @@ class OrphanCleaner:
     def _unprepare_deleted_claims(self, prepared: dict) -> None:
         from .prepared import PreparedClaim
 
+        api = self._api_source()
+        dialect_checked = False
         for uid, rec in list(prepared.items()):
             pc = PreparedClaim.from_dict(rec)
             if not pc.namespace or not pc.name:
                 continue
             try:
                 obj = self.kube_client.get(
-                    self.claims_gvr, pc.name, namespace=pc.namespace
+                    api.claims, pc.name, namespace=pc.namespace
                 )
                 if obj["metadata"].get("uid", "") == uid:
                     continue  # still live
             except NotFoundError:
-                pass
+                # A 404 is ambiguous: the claim is gone — or the server
+                # stopped serving OUR dialect and EVERY claim would 404,
+                # which must not read as "unprepare everything". Verify
+                # the dialect once per pass before trusting any 404.
+                if not dialect_checked:
+                    dialect_checked = True
+                    current = ResourceApi.try_discover(self.kube_client)
+                    if current is not None and current.version != api.version:
+                        logger.warning(
+                            "served resource.k8s.io dialect is %s but the "
+                            "cleaner was using %s; aborting this cleanup "
+                            "pass", current.version, api.version,
+                        )
+                        if self.on_dialect_change is not None:
+                            self.on_dialect_change(current)
+                        return
             except Exception:
                 logger.exception(
                     "could not verify claim %s/%s; skipping", pc.namespace, pc.name

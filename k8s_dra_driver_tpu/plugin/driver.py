@@ -142,7 +142,8 @@ class Driver(NodeServicer):
             self.state,
             self.config.kube_client,
             interval_seconds=self.config.cleanup_interval_seconds,
-            resource_api=self.resource_api,
+            resource_api=lambda: self.resource_api,
+            on_dialect_change=self._adopt_resource_api,
         )
         if self.config.cleanup_interval_seconds > 0:
             self.cleaner.start()
@@ -207,6 +208,14 @@ class Driver(NodeServicer):
                         self.publish_resources()
             except Exception:
                 logger.exception("device inventory refresh failed")
+
+    def _adopt_resource_api(self, api: ResourceApi) -> None:
+        """Take a re-discovered dialect observed by a sibling component
+        (the orphan cleaner), so the next claim fetch uses it directly."""
+        logger.warning(
+            "adopting re-discovered resource.k8s.io dialect %s", api.version
+        )
+        self.resource_api = api
 
     def publish_resources(self) -> None:
         """Publish node-local devices (driver.go:69-80 analog; ICI channels

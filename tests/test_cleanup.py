@@ -125,3 +125,59 @@ class TestOrphanCleanup:
         time.sleep(0.2)
         cleaner.stop()
         assert cleaner.passes >= 1
+
+
+class TestDialectSafety:
+    def test_wrong_dialect_404_does_not_mass_unprepare(self, tmp_path):
+        """Startup discovery fell back to v1alpha3 but the server serves
+        only v1beta1: every claim GET 404s. That must abort the pass (and
+        report the real dialect), NOT unprepare every running pod's
+        devices."""
+        from k8s_dra_driver_tpu.kube import ResourceApi
+
+        state, _ = make_state(tmp_path)
+        client = FakeKubeClient()
+        client.served_api_versions["resource.k8s.io"] = ["v1beta1"]
+        api = ResourceApi("v1beta1")
+        claim = make_claim("uid-1", ["tpu-0"], name="c1", namespace="ns")
+        client.create(api.claims, claim, namespace="ns")
+        state.prepare(claim)
+
+        observed = []
+        cleaner = OrphanCleaner(
+            state, kube_client=client,
+            resource_api=ResourceApi("v1alpha3"),   # the stale fallback
+            on_dialect_change=observed.append,
+        )
+        cleaner.clean_once()
+        assert "uid-1" in state.checkpoint.read()    # NOT unprepared
+        assert cleaner.unprepared_deleted == 0
+        assert [a.version for a in observed] == ["v1beta1"]
+
+    def test_live_api_source_heals_next_pass(self, tmp_path):
+        """With a callable api source (how the Driver wires it), the pass
+        after a dialect adoption verifies claims in the right dialect and
+        unprepares ONLY genuinely-deleted ones."""
+        from k8s_dra_driver_tpu.kube import ResourceApi
+
+        state, _ = make_state(tmp_path)
+        client = FakeKubeClient()
+        client.served_api_versions["resource.k8s.io"] = ["v1beta1"]
+        api_holder = {"api": ResourceApi("v1alpha3")}
+        beta = ResourceApi("v1beta1")
+        live = make_claim("uid-live", ["tpu-0"], name="c-live", namespace="ns")
+        dead = make_claim("uid-dead", ["tpu-1"], name="c-dead", namespace="ns")
+        client.create(beta.claims, live, namespace="ns")
+        state.prepare(live)
+        state.prepare(dead)
+
+        cleaner = OrphanCleaner(
+            state, kube_client=client,
+            resource_api=lambda: api_holder["api"],
+            on_dialect_change=lambda a: api_holder.update(api=a),
+        )
+        cleaner.clean_once()     # aborts, adopts v1beta1
+        assert set(state.checkpoint.read()) == {"uid-live", "uid-dead"}
+        cleaner.clean_once()     # correct dialect: prunes only the dead one
+        assert set(state.checkpoint.read()) == {"uid-live"}
+        assert cleaner.unprepared_deleted == 1

@@ -54,6 +54,7 @@ class StubApiServer:
         # this Retry-After (apiserver priority-and-fairness shedding).
         self.inject_429 = 0
         self.retry_after = "0.05"
+        self.require_token = ""          # 401 unless this bearer token sent
         self.page_limit_cap = 0          # clamp client limits (0 = honor them)
         self.expire_continue = False     # 410 any continue-token request
         stub = self
@@ -87,11 +88,17 @@ class StubApiServer:
                 return json.loads(self.rfile.read(n)) if n else {}
 
             def _shed(self) -> bool:
-                """One injected 429, real-apiserver style."""
+                """One injected 429 (real-apiserver style) or a 401 when
+                token auth is enforced and the bearer is wrong/stale."""
                 if stub.inject_429 > 0:
                     stub.inject_429 -= 1
                     self._status(429, "TooManyRequests", "throttled",
                                  headers=(("Retry-After", stub.retry_after),))
+                    return True
+                if stub.require_token and self.headers.get(
+                    "Authorization", ""
+                ) != f"Bearer {stub.require_token}":
+                    self._status(401, "Unauthorized", "token rejected")
                     return True
                 return False
 
