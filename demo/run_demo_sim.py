@@ -141,6 +141,8 @@ def run_spec(path, client, alloc, stub, cdi_root) -> int:
     for name, ns, devices in spec_claims(path):
         uid = f"uid-{ns}-{name}"
         claim = {
+            "apiVersion": "resource.k8s.io/v1beta1",
+            "kind": "ResourceClaim",
             "metadata": {"name": name, "namespace": ns, "uid": uid},
             "spec": {"devices": devices},
         }

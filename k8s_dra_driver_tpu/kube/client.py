@@ -246,6 +246,12 @@ class FakeKubeClient(KubeClient):
         self.served_api_versions: dict[str, list[str]] = {
             "resource.k8s.io": ["v1beta1", "v1alpha3"],
         }
+        # Apply upstream structural validation (kube/schema.py) to every
+        # resource.k8s.io write, the way a real apiserver would (422).
+        # The hermetic answer to "FakeKubeClient happily stores shapes a
+        # real cluster rejects". Off-switch for tests that deliberately
+        # store minimal stubs.
+        self.validate_schemas = True
 
     # -- helpers -----------------------------------------------------------
 
@@ -265,6 +271,21 @@ class FakeKubeClient(KubeClient):
             err = self.fault_injector(verb, gvr, name)
             if err is not None:
                 raise err
+
+    def _maybe_validate(self, gvr: GVR, obj: dict):
+        if not self.validate_schemas:
+            return
+        if not gvr.api_version.startswith("resource.k8s.io/"):
+            return
+        from .errors import InvalidError
+        from .schema import SchemaError, validate_for_resource
+
+        try:
+            # Dispatch on the collection, as the real apiserver does — an
+            # object omitting 'kind' must not bypass validation.
+            validate_for_resource(gvr.resource, obj)
+        except SchemaError as e:
+            raise InvalidError(str(e)) from e
 
     def _notify(self, gvr: GVR, ev_type: str, obj: dict):
         ns = (obj.get("metadata") or {}).get("namespace", "")
@@ -309,6 +330,7 @@ class FakeKubeClient(KubeClient):
     def create(self, gvr: GVR, obj: dict, namespace: str = "") -> dict:
         name = obj["metadata"]["name"]
         self._maybe_fault("create", gvr, name)
+        self._maybe_validate(gvr, obj)
         with self._lock:
             key = self._key(gvr, namespace or obj["metadata"].get("namespace", ""), name)
             if key in self._store:
@@ -326,6 +348,7 @@ class FakeKubeClient(KubeClient):
     def update(self, gvr: GVR, obj: dict, namespace: str = "") -> dict:
         name = obj["metadata"]["name"]
         self._maybe_fault("update", gvr, name)
+        self._maybe_validate(gvr, obj)
         with self._lock:
             key = self._key(gvr, namespace or obj["metadata"].get("namespace", ""), name)
             existing = self._store.get(key)

@@ -161,6 +161,9 @@ class TestAllocationModeAll:
         """A misconfigured (invalid) device is unallocatable, but it must
         not inflate All's target count and doom the healthy remainder."""
         client = FakeKubeClient()
+        # The corrupt slice below is exactly what schema validation
+        # rejects; this test is about surviving one that predates it.
+        client.validate_schemas = False
         lib = FakeChipLib(generation="v5e", topology="2x1x1")
         client.create(NODES, {"metadata": {"name": "node-a", "uid": "u"}})
         allocatable = lib.enumerate_all_possible_devices({"chip"})

@@ -122,6 +122,8 @@ def grpc_unprepare(driver, claim):
 
 def make_claim_obj(uid, name, requests, constraints=None, config=None):
     return {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
         "metadata": {"name": name, "namespace": "sim", "uid": uid},
         "spec": {
             "devices": {
@@ -297,6 +299,9 @@ class TestClusterSim:
             }
 
         client = FakeKubeClient()
+        # The undeclared-counter slice is exactly what schema validation
+        # rejects; this test is about surviving one that predates it.
+        client.validate_schemas = False
         client.create(RESOURCE_SLICES, {
             "apiVersion": "resource.k8s.io/v1beta1",
             "kind": "ResourceSlice",

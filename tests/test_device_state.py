@@ -39,7 +39,9 @@ def make_claim(
     name="claim-1",
     namespace="default",
 ):
-    """Build a v1alpha3 ResourceClaim in wire form with an allocation."""
+    """Build a ResourceClaim in wire form with an allocation — fully
+    schema-conformant (kube/schema.py), since the fake apiserver now
+    validates resource.k8s.io writes the way a real one would."""
     results = []
     for i, dev in enumerate(devices):
         results.append(
@@ -50,8 +52,19 @@ def make_claim(
                 "device": dev,
             }
         )
+    request_names = sorted({r["request"] for r in results} or {"req-0"})
     return {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
         "metadata": {"name": name, "namespace": namespace, "uid": uid},
+        "spec": {
+            "devices": {
+                "requests": [
+                    {"name": rn, "deviceClassName": "tpu.google.com"}
+                    for rn in request_names
+                ]
+            }
+        },
         "status": {
             "allocation": {
                 "devices": {"results": results, "config": configs or []}

@@ -17,6 +17,14 @@ from k8s_dra_driver_tpu.kube import (
 )
 
 
+def fake():
+    """Client for CRUD-mechanics tests: deliberately-minimal objects, so
+    the apiserver-analog schema gate (covered in test_schema.py) is off."""
+    c = FakeKubeClient()
+    c.validate_schemas = False
+    return c
+
+
 def mk(name, labels=None, namespace=None, **extra):
     md = {"name": name}
     if labels:
@@ -44,7 +52,7 @@ class TestSelectors:
 
 class TestFakeCrud:
     def test_create_get_roundtrip(self):
-        c = FakeKubeClient()
+        c = fake()
         created = c.create(RESOURCE_SLICES, mk("s1", spec={"driver": "tpu"}))
         assert created["metadata"]["resourceVersion"] == "1"
         got = c.get(RESOURCE_SLICES, "s1")
@@ -52,16 +60,16 @@ class TestFakeCrud:
 
     def test_get_missing_raises(self):
         with pytest.raises(NotFoundError):
-            FakeKubeClient().get(RESOURCE_SLICES, "nope")
+            fake().get(RESOURCE_SLICES, "nope")
 
     def test_double_create_conflicts(self):
-        c = FakeKubeClient()
+        c = fake()
         c.create(RESOURCE_SLICES, mk("s1"))
         with pytest.raises(AlreadyExistsError):
             c.create(RESOURCE_SLICES, mk("s1"))
 
     def test_update_bumps_rv_and_checks_conflict(self):
-        c = FakeKubeClient()
+        c = fake()
         obj = c.create(RESOURCE_SLICES, mk("s1"))
         obj["spec"] = {"x": 1}
         updated = c.update(RESOURCE_SLICES, obj)
@@ -72,7 +80,7 @@ class TestFakeCrud:
             c.update(RESOURCE_SLICES, obj)
 
     def test_namespacing(self):
-        c = FakeKubeClient()
+        c = fake()
         c.create(RESOURCE_CLAIMS, mk("claim", namespace="a"), namespace="a")
         c.create(RESOURCE_CLAIMS, mk("claim", namespace="b"), namespace="b")
         assert len(c.list(RESOURCE_CLAIMS)) == 2
@@ -81,7 +89,7 @@ class TestFakeCrud:
         assert len(c.list(RESOURCE_CLAIMS)) == 1
 
     def test_list_label_filtering(self):
-        c = FakeKubeClient()
+        c = fake()
         c.create(NODES, mk("n1", labels={"tpu.google.com/slice-id": "s1"}))
         c.create(NODES, mk("n2", labels={"tpu.google.com/slice-id": "s2"}))
         c.create(NODES, mk("n3"))
@@ -92,14 +100,14 @@ class TestFakeCrud:
         ] == ["n2"]
 
     def test_apply_create_then_update(self):
-        c = FakeKubeClient()
+        c = fake()
         c.apply(RESOURCE_SLICES, mk("s1", spec={"v": 1}))
         out = c.apply(RESOURCE_SLICES, mk("s1", spec={"v": 2}))
         assert out["spec"] == {"v": 2}
         assert len(c.list(RESOURCE_SLICES)) == 1
 
     def test_fault_injection(self):
-        c = FakeKubeClient()
+        c = fake()
         c.fault_injector = lambda verb, gvr, name: (
             ConflictError("boom") if verb == "create" else None
         )
@@ -109,7 +117,7 @@ class TestFakeCrud:
 
 class TestFakeWatch:
     def test_watch_seed_and_stream(self):
-        c = FakeKubeClient()
+        c = fake()
         c.create(NODES, mk("n1", labels={"x": "1"}))
         w = c.watch(NODES, label_selector="x=1")
         c.create(NODES, mk("n2", labels={"x": "1"}))
@@ -124,7 +132,7 @@ class TestFakeWatch:
         w.stop()
 
     def test_watch_stop_unblocks(self):
-        c = FakeKubeClient()
+        c = fake()
         w = c.watch(NODES)
         t = threading.Thread(target=lambda: list(w.events()))
         t.start()

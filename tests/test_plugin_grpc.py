@@ -52,7 +52,12 @@ def add_claim(client, uid, devices, name="claim-1", namespace="default"):
         for d in devices
     ]
     claim = {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
         "metadata": {"name": name, "namespace": namespace, "uid": uid},
+        "spec": {"devices": {"requests": [
+            {"name": "req-0", "deviceClassName": "tpu.google.com"},
+        ]}},
         "status": {"allocation": {"devices": {"results": results, "config": []}}},
     }
     client.create(RESOURCE_CLAIMS, claim, namespace=namespace)
@@ -265,8 +270,14 @@ class TestPrepareOverGrpc:
         driver.start()
         try:
             claim = {
+                "apiVersion": "resource.k8s.io/v1beta1",
+                "kind": "ResourceClaim",
                 "metadata": {"name": "gang", "namespace": "default",
                              "uid": "uid-ch"},
+                "spec": {"devices": {"requests": [
+                    {"name": "req-0",
+                     "deviceClassName": "ici.tpu.google.com"},
+                ]}},
                 "status": {"allocation": {"devices": {"results": [
                     {"request": "req-0", "driver": DRIVER, "pool": "node-a",
                      "device": d}

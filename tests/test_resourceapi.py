@@ -251,7 +251,9 @@ class TestPublishAllocateAcrossDialects:
         assert ctrl.api.version == "v1alpha3"
         sl = canonical_slice()
         ctrl.update(DriverResources(pools={
-            "n0": Pool(devices=sl["spec"]["devices"], node_name="n0"),
+            "n0": Pool(devices=sl["spec"]["devices"],
+                       shared_counters=sl["spec"]["sharedCounters"],
+                       node_name="n0"),
         }))
         ctrl.sync_once()
         client.served_api_versions["resource.k8s.io"] = ["v1beta1"]
@@ -265,7 +267,9 @@ class TestPublishAllocateAcrossDialects:
             "value": "42"
         }
         ctrl.update(DriverResources(pools={
-            "n0": Pool(devices=sl2["spec"]["devices"], node_name="n0"),
+            "n0": Pool(devices=sl2["spec"]["devices"],
+                       shared_counters=sl2["spec"]["sharedCounters"],
+                       node_name="n0"),
         }))
         ctrl.sync_once()
         (wire,) = client.list(ResourceApi("v1beta1").slices)

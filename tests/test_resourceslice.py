@@ -94,8 +94,13 @@ class TestSync:
     def test_foreign_driver_slices_untouched(self):
         client = FakeKubeClient()
         client.create(RESOURCE_SLICES, {
+            "apiVersion": "resource.k8s.io/v1alpha3",
+            "kind": "ResourceSlice",
             "metadata": {"name": "other"},
-            "spec": {"driver": "gpu.nvidia.com", "devices": []},
+            "spec": {"driver": "gpu.nvidia.com", "nodeName": "n",
+                     "pool": {"name": "p", "generation": 1,
+                              "resourceSliceCount": 1},
+                     "devices": []},
         })
         ctl, _ = make_controller(client)
         ctl.update(DriverResources())

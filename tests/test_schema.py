@@ -1,0 +1,322 @@
+"""Schema conformance for everything the driver publishes (kube/schema.py).
+
+Round-4 verdict #2: with the kind gate unrunnable (no docker), nothing
+proved the emitted objects would survive real API-server validation.
+This suite applies the upstream validation contract (transcribed from
+the reference's vendored types.go — see kube/schema.py header) to every
+object class the driver emits, in both served dialects, plus the
+injected-defect cases the verdict named (attribute domain > 63 chars,
+bad domain) that must fail CI.
+
+FakeKubeClient also applies these rules to every resource.k8s.io write
+(client.py _maybe_validate), so the whole existing suite doubles as a
+conformance sweep; this file pins the contract itself.
+"""
+
+import copy
+import glob
+import os
+
+import pytest
+import yaml
+
+from k8s_dra_driver_tpu.kube import FakeKubeClient, InvalidError, ResourceApi
+from k8s_dra_driver_tpu.kube.schema import (
+    SchemaError,
+    validate,
+    validate_resource_claim,
+    validate_resource_slice,
+)
+from k8s_dra_driver_tpu.kube.resourceslice import (
+    DriverResources,
+    Pool,
+    ResourceSliceController,
+)
+from k8s_dra_driver_tpu.tpulib.chiplib import FakeChipLib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def published_slices(version, topology="2x2x1", generation="v5p"):
+    """Slices exactly as the node plugin publishes them: FakeChipLib
+    devices through the real controller, read back in wire form."""
+    client = FakeKubeClient()
+    client.served_api_versions["resource.k8s.io"] = [version]
+    api = ResourceApi.discover(client)
+    lib = FakeChipLib(generation=generation, topology=topology)
+    allocatable = lib.enumerate_all_possible_devices(
+        {"chip", "tensorcore"}
+    )
+    devices = [d.get_device() for d in allocatable.values()]
+    counter_sets = sorted(
+        {
+            cc["counterSet"]
+            for d in devices
+            for cc in d.get("basic", {}).get("consumesCounters", [])
+        }
+    )
+    shared = [
+        {
+            "name": cs,
+            "counters": {
+                "cores": {"value": "2"},
+                "hbm": {"value": "103079215104"},
+            },
+        }
+        for cs in counter_sets
+    ]
+    ctrl = ResourceSliceController(client, "tpu.google.com", scope="n0",
+                                   api=api)
+    ctrl.update(DriverResources(pools={
+        "n0": Pool(devices=devices, shared_counters=shared, node_name="n0"),
+    }))
+    ctrl.sync_once()
+    return client.list(api.slices)
+
+
+class TestPublishedObjectsConform:
+    @pytest.mark.parametrize("version", ["v1alpha3", "v1beta1"])
+    def test_node_plugin_slices_validate(self, version):
+        slices = published_slices(version)
+        assert slices
+        for s in slices:
+            validate_resource_slice(s)   # raises on any violation
+
+    @pytest.mark.parametrize("version", ["v1alpha3", "v1beta1"])
+    def test_ici_controller_slices_validate(self, version):
+        """Network pools from the cluster controller (nodeSelector form)."""
+        from k8s_dra_driver_tpu.controller.slice_manager import IciSliceManager
+        from k8s_dra_driver_tpu.kube import NODES
+
+        client = FakeKubeClient()
+        client.served_api_versions["resource.k8s.io"] = [version]
+        for i in range(2):
+            client.create(NODES, {"metadata": {
+                "name": f"host-{i}",
+                "labels": {"tpu.google.com/slice-id": "slice-a"},
+            }})
+        mgr = IciSliceManager(client, "tpu.google.com")
+        mgr.start()
+        try:
+            import time
+
+            deadline = time.monotonic() + 5
+            slices = []
+            while time.monotonic() < deadline:
+                slices = client.list(ResourceApi(version).slices)
+                if slices:
+                    break
+                time.sleep(0.05)
+            assert slices, "controller published nothing"
+            for s in slices:
+                validate_resource_slice(s)
+        finally:
+            mgr.stop()
+
+    @pytest.mark.parametrize("version", ["v1alpha3", "v1beta1"])
+    def test_sim_allocated_claim_validates(self, version):
+        """The claim status the scheduler sim writes back."""
+        from k8s_dra_driver_tpu.kube.allocator import ReferenceAllocator
+
+        client = FakeKubeClient()
+        client.served_api_versions["resource.k8s.io"] = [version]
+        api = ResourceApi.discover(client)
+        lib = FakeChipLib(generation="v5e", topology="2x2x1")
+        devices = [
+            d.get_device()
+            for d in lib.enumerate_all_possible_devices({"chip"}).values()
+        ]
+        ctrl = ResourceSliceController(client, "tpu.google.com", scope="n0",
+                                       api=api)
+        ctrl.update(DriverResources(pools={
+            "n0": Pool(devices=devices, node_name="n0"),
+        }))
+        ctrl.sync_once()
+        claim = {
+            "apiVersion": api.api_version,
+            "kind": "ResourceClaim",
+            "metadata": {"name": "c0", "namespace": "d", "uid": "u0"},
+            "spec": {"devices": {"requests": [{
+                "name": "r0", "deviceClassName": "tpu.google.com",
+                "count": 2,
+            }]}},
+        }
+        out = ReferenceAllocator(client).allocate(claim, node_name="n0")
+        validate_resource_claim(out)
+        # And the fake (as the apiserver) accepts the write.
+        client.create(api.claims, out, namespace="d")
+
+
+class TestShippedSpecsConform:
+    def collect_docs(self):
+        paths = (
+            glob.glob(os.path.join(REPO, "demo/specs/**/*.yaml"),
+                      recursive=True)
+            + glob.glob(os.path.join(REPO, "deployments/manifests/*.yaml"))
+        )
+        assert paths
+        for path in paths:
+            with open(path) as f:
+                for doc in yaml.safe_load_all(f):
+                    if doc:
+                        yield path, doc
+
+    def test_every_shipped_resource_object_validates(self):
+        """ResourceClaim / ResourceClaimTemplate / DeviceClass docs in
+        demo/specs and deployments/manifests all pass the apiserver
+        contract (Pods/Jobs etc. are out of scope)."""
+        checked = 0
+        for path, doc in self.collect_docs():
+            if doc.get("kind") in ("ResourceClaim", "ResourceClaimTemplate",
+                                   "DeviceClass"):
+                try:
+                    validate(doc)
+                except SchemaError as e:
+                    pytest.fail(f"{os.path.relpath(path, REPO)}: {e}")
+                checked += 1
+        assert checked >= 10, checked
+
+
+def valid_slice(version="v1beta1"):
+    (s,) = published_slices(version, topology="1x1x1", generation="v5e")
+    return s
+
+
+class TestInjectedDefectsRejected:
+    """The verdict's 'Done' criterion: a bad attribute name (> 63-char
+    domain, bad domain) — and each neighboring defect class — fails."""
+
+    def test_attribute_domain_over_63_chars(self):
+        s = valid_slice()
+        attrs = s["spec"]["devices"][0]["basic"]["attributes"]
+        attrs[("x" * 64) + ".example.com/attr"] = {"string": "v"}
+        with pytest.raises(SchemaError, match="exceeds 63"):
+            validate_resource_slice(s)
+
+    def test_attribute_bad_domain(self):
+        s = valid_slice()
+        attrs = s["spec"]["devices"][0]["basic"]["attributes"]
+        attrs["Not_A_Domain!/attr"] = {"string": "v"}
+        with pytest.raises(SchemaError, match="invalid DNS-1123"):
+            validate_resource_slice(s)
+
+    def test_attribute_identifier_over_32_chars(self):
+        s = valid_slice()
+        attrs = s["spec"]["devices"][0]["basic"]["attributes"]
+        attrs["a" * 33] = {"string": "v"}
+        with pytest.raises(SchemaError, match="exceeds 32"):
+            validate_resource_slice(s)
+
+    def test_attribute_two_union_fields(self):
+        s = valid_slice()
+        attrs = s["spec"]["devices"][0]["basic"]["attributes"]
+        attrs["broken"] = {"string": "v", "int": 1}
+        with pytest.raises(SchemaError, match="exactly one"):
+            validate_resource_slice(s)
+
+    def test_attribute_string_over_64_chars(self):
+        s = valid_slice()
+        attrs = s["spec"]["devices"][0]["basic"]["attributes"]
+        attrs["long"] = {"string": "v" * 65}
+        with pytest.raises(SchemaError, match="exceeds 64"):
+            validate_resource_slice(s)
+
+    def test_capacity_shape_must_match_dialect(self):
+        beta = valid_slice("v1beta1")
+        caps = beta["spec"]["devices"][0]["basic"]["capacity"]
+        key = next(iter(caps))
+        caps[key] = "95"                       # bare string in v1beta1
+        with pytest.raises(SchemaError, match="value.*quantity|must be"):
+            validate_resource_slice(beta)
+        alpha = valid_slice("v1alpha3")
+        caps = alpha["spec"]["devices"][0]["basic"]["capacity"]
+        key = next(iter(caps))
+        caps[key] = {"value": "95"}            # wrapped in v1alpha3
+        with pytest.raises(SchemaError, match="bare quantity"):
+            validate_resource_slice(alpha)
+
+    def test_bad_quantity(self):
+        s = valid_slice()
+        s["spec"]["devices"][0]["basic"]["capacity"]["hbm"] = {
+            "value": "ninety-five"
+        }
+        with pytest.raises(SchemaError, match="invalid quantity"):
+            validate_resource_slice(s)
+
+    def test_node_fields_exactly_one(self):
+        s = valid_slice()
+        s["spec"]["nodeSelector"] = {"nodeSelectorTerms": [{}]}
+        with pytest.raises(SchemaError, match="exactly one of"):
+            validate_resource_slice(s)
+        del s["spec"]["nodeSelector"]
+        del s["spec"]["nodeName"]
+        with pytest.raises(SchemaError, match="exactly one of"):
+            validate_resource_slice(s)
+
+    def test_too_many_devices(self):
+        s = valid_slice()
+        dev = s["spec"]["devices"][0]
+        s["spec"]["devices"] = [
+            dict(dev, name=f"tpu-{i}") for i in range(129)
+        ]
+        with pytest.raises(SchemaError, match="exceeds 128"):
+            validate_resource_slice(s)
+
+    def test_duplicate_device_names(self):
+        s = valid_slice()
+        s["spec"]["devices"] = s["spec"]["devices"] * 2
+        with pytest.raises(SchemaError, match="duplicate"):
+            validate_resource_slice(s)
+
+    def test_undeclared_counter_set(self):
+        s = valid_slice()
+        s["spec"]["devices"][0]["basic"]["consumesCounters"] = [{
+            "counterSet": "ghost", "counters": {"x": {"value": "1"}},
+        }]
+        with pytest.raises(SchemaError, match="not declared"):
+            validate_resource_slice(s)
+
+    def test_claim_count_with_mode_all(self):
+        claim = {
+            "apiVersion": "resource.k8s.io/v1beta1",
+            "kind": "ResourceClaim",
+            "metadata": {"name": "c"},
+            "spec": {"devices": {"requests": [{
+                "name": "r0", "deviceClassName": "tpu.google.com",
+                "allocationMode": "All", "count": 3,
+            }]}},
+        }
+        with pytest.raises(SchemaError, match="must be unset"):
+            validate_resource_claim(claim)
+
+    def test_claim_constraint_must_be_fully_qualified(self):
+        claim = {
+            "apiVersion": "resource.k8s.io/v1beta1",
+            "kind": "ResourceClaim",
+            "metadata": {"name": "c"},
+            "spec": {"devices": {
+                "requests": [{"name": "r0",
+                              "deviceClassName": "tpu.google.com"}],
+                "constraints": [{"requests": ["r0"],
+                                 "matchAttribute": "sliceId"}],
+            }},
+        }
+        with pytest.raises(SchemaError, match="fully qualified"):
+            validate_resource_claim(claim)
+
+    def test_fake_client_rejects_as_apiserver_would(self):
+        """End to end: the defective write gets the 422-analog, not
+        silent storage."""
+        client = FakeKubeClient()
+        s = valid_slice()
+        s["spec"]["devices"][0]["basic"]["attributes"][
+            ("y" * 70) + ".example.com/attr"
+        ] = {"string": "v"}
+        with pytest.raises(InvalidError, match="exceeds 63"):
+            client.create(ResourceApi("v1beta1").slices, s)
+
+    def test_unsupported_api_version_rejected(self):
+        s = valid_slice()
+        s["apiVersion"] = "resource.k8s.io/v1beta2"
+        with pytest.raises(SchemaError, match="not a supported"):
+            validate_resource_slice(s)
