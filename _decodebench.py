@@ -105,12 +105,16 @@ def run_decode_bench(
     run(pre, prompts[7], lambda o: o[0][0, 0])
     pre_compile_s = time.perf_counter() - t0
 
+    n_repeats = max(1, int(os.environ.get("TPU_DRA_BENCH_REPEATS", "3")))
     diffs = sorted(
-        run(gen, prompts[2 * i], lambda o: o[0, -1])
-        - run(pre, prompts[2 * i + 1], lambda o: o[0][0, 0])
-        for i in range(3)
+        run(gen, prompts[(2 * i) % len(prompts)], lambda o: o[0, -1])
+        - run(pre, prompts[(2 * i + 1) % len(prompts)],
+              lambda o: o[0][0, 0])
+        for i in range(n_repeats)
     )
-    step = diffs[1] / n_steps  # median
+    step = diffs[len(diffs) // 2] / n_steps  # median
+    toks = sorted(batch * n_steps / d for d in diffs)
+    spread = (toks[-1] - toks[0]) / 2
 
     # Embedding rows are gathered, not streamed; everything else (incl.
     # the lm_head matmul) is read in full every step. The cache read
@@ -135,6 +139,10 @@ def run_decode_bench(
         # Fraction of the HBM roofline achieved (1.0 = bandwidth-bound
         # and perfect); the serving analog of vs_baseline.
         "vs_baseline": round(roofline_s / step, 4),
+        # Median-of-n with observed run-to-run spread (tok/s), so the
+        # recorded number carries its own noise floor.
+        "repeats": n_repeats,
+        "spread": round(spread, 1),
         "detail": {
             "step_ms": round(step * 1e3, 3),
             "hbm_roofline_ms": round(roofline_s * 1e3, 3),

@@ -151,22 +151,51 @@ def run_bench(preset, batch, seq, peak_flops, remat_policy="flash_qkv",
         chained_loss = float(loss)
         return time.perf_counter() - t0, chained_loss
 
-    t_short, _ = run_chain(n1)
-    t_long, loss = run_chain(n2)
-    dt = (t_long - t_short) / (n2 - n1)
+    # Repeats (round-4 verdict #6): the chip is time-shared and single
+    # measurements drift ±10% run to run; report the median over
+    # independent slope measurements WITH the observed spread, so
+    # round-over-round comparisons know what is noise.
+    repeats = max(1, int(os.environ.get(
+        "TPU_DRA_BENCH_REPEATS", "1" if tiny else "3"
+    )))
+    dts = []
+    loss = None
+    for _ in range(repeats):
+        t_short, _ = run_chain(n1)
+        t_long, loss = run_chain(n2)
+        dts.append((t_long - t_short) / (n2 - n1))
 
     n_tokens = batch * seq
     # fwd 2N + bwd 4N matmul FLOPs/token + attention quadratic term; for
     # MoE, N counts ACTIVE params (top_k experts), the MFU convention.
-    achieved = config.flops_per_token(seq) * n_tokens / dt
-    mfu = achieved / peak_flops
+    flops_tok = config.flops_per_token(seq)
+    mfus = sorted(flops_tok * n_tokens / d / peak_flops for d in dts)
+    mfu = mfus[len(mfus) // 2]
+    dt = sorted(dts)[len(dts) // 2]
+    achieved = flops_tok * n_tokens / dt
+    spread = (mfus[-1] - mfus[0]) / 2
 
     family = "mixtral" if model == "moe" else "llama3"
-    return {
+    result = {
         "metric": f"{family}_{preset}_train_mfu_b{batch}_s{seq}",
         "value": round(mfu, 4),
         "unit": "mfu_fraction",
         "vs_baseline": round(mfu / 0.50, 4),
+        "repeats": repeats,
+        "spread": round(spread, 4),
+        **(
+            {
+                # Honest active-MFU (round-4 verdict weak #2): the embed
+                # LOOKUP does no matmul work, but the 6N convention
+                # credits its v*h parameters — ~40% of credited FLOPs at
+                # L=1 geometries. Machine-readable here, not just prose.
+                "value_ex_embed": round(
+                    mfu * (flops_tok - 6 * config.vocab_size
+                           * config.hidden) / flops_tok, 4
+                ),
+            }
+            if model == "moe" else {}
+        ),
         "detail": {
             **(
                 {
@@ -183,8 +212,10 @@ def run_bench(preset, batch, seq, peak_flops, remat_policy="flash_qkv",
             "loss": float(loss),
             "device": str(jax.devices()[0].device_kind),
             "achieved_tflops": round(achieved / 1e12, 2),
+            "mfu_all": [round(v, 4) for v in mfus],
         },
     }
+    return result
 
 
 def extra_metrics(peak_flops, remat_policy) -> list:
@@ -216,19 +247,32 @@ def extra_metrics(peak_flops, remat_policy) -> list:
             print(f"extra metric {model}/{preset} failed: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
     decode_preset = os.environ.get("TPU_DRA_BENCH_DECODE", "1b")
-    if decode_preset != "skip" and time.monotonic() > deadline:
-        print(f"decode metric {decode_preset} skipped: budget spent",
-              file=sys.stderr)
-    elif decode_preset != "skip":
-        try:
-            from _decodebench import run_decode_bench
+    if decode_preset != "skip":
+        # The serving continuity series (round-4 verdict #8): baseline
+        # decode plus the int8-weights, int8-KV, and Mixtral points that
+        # previously lived only in prose — machine-detectable regressions
+        # round over round. Each point is budget- and failure-isolated.
+        decode_points = [
+            dict(preset=decode_preset),
+            dict(preset=decode_preset, quant=True),
+            dict(preset=decode_preset, quant_kv=True),
+            dict(preset=decode_preset, quant=True, quant_kv=True),
+            dict(preset="8x160m"),
+        ]
+        for kwargs in decode_points:
+            if time.monotonic() > deadline:
+                print(f"decode metric {kwargs} skipped: budget spent",
+                      file=sys.stderr)
+                continue
+            try:
+                from _decodebench import run_decode_bench
 
-            r = run_decode_bench(preset=decode_preset)
-            r.pop("detail", None)
-            out.append(r)
-        except Exception as e:
-            print(f"decode metric failed: {type(e).__name__}: {e}",
-                  file=sys.stderr)
+                r = run_decode_bench(**kwargs)
+                r.pop("detail", None)
+                out.append(r)
+            except Exception as e:
+                print(f"decode metric {kwargs} failed: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
     return out
 
 

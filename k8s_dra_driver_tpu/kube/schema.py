@@ -77,6 +77,12 @@ class SchemaError(ValueError):
 # -- primitive validators ----------------------------------------------------
 
 
+def _is_int(value) -> bool:
+    """True for int64-shaped values. bool is a subclass of int in
+    Python; a JSON true is NOT an integer to the apiserver."""
+    return isinstance(value, int) and not isinstance(value, bool)
+
+
 def _dict_items(value, path, issues):
     """Iterate a list-of-objects field defensively: a non-list value or a
     non-dict element is a schema issue (422), never a Python crash out of
@@ -285,11 +291,10 @@ def validate_resource_slice(obj: dict) -> None:
         else:
             for seg in pname.split("/"):
                 _dns_subdomain(seg, "spec.pool.name segment", issues)
-        if not isinstance(pool.get("generation"), int):
+        if not _is_int(pool.get("generation")):
             issues.append("spec.pool.generation: required integer")
-        if not isinstance(pool.get("resourceSliceCount"), int) or (
-            isinstance(pool.get("resourceSliceCount"), int)
-            and pool["resourceSliceCount"] < 1
+        if not _is_int(pool.get("resourceSliceCount")) or (
+            pool["resourceSliceCount"] < 1
         ):
             issues.append("spec.pool.resourceSliceCount: required, >= 1")
 
@@ -417,7 +422,7 @@ def _validate_claim_spec(spec, path, issues):
             issues.append(f"{p}.allocationMode: invalid {mode!r}")
         count = req.get("count")
         if count is not None:
-            if not isinstance(count, int) or count < 1:
+            if not _is_int(count) or count < 1:
                 issues.append(f"{p}.count: must be a positive integer")
             if mode == "All":
                 issues.append(f"{p}.count: must be unset with "
@@ -466,6 +471,7 @@ def _validate_claim_spec(spec, path, issues):
         for rname in cfg.get("requests") or []:
             if rname not in req_names:
                 issues.append(f"{p}.requests: {rname!r} names no request")
+    return req_names
 
 
 def validate_resource_claim(obj: dict) -> None:
@@ -474,7 +480,7 @@ def validate_resource_claim(obj: dict) -> None:
     spec = obj.get("spec")
     if not isinstance(spec, dict):
         raise SchemaError("ResourceClaim", issues + ["spec: required"])
-    _validate_claim_spec(spec, "spec", issues)
+    req_names = _validate_claim_spec(spec, "spec", issues)
 
     status = _map_items(obj.get("status"), "status", issues)
     alloc = _map_items(status.get("allocation"), "status.allocation", issues)
@@ -489,11 +495,6 @@ def validate_resource_claim(obj: dict) -> None:
             f"status.allocation.devices.results: exceeds "
             f"{MAX_ALLOCATION_RESULTS}"
         )
-    req_names = {
-        r.get("name")
-        for r in (spec.get("devices") or {}).get("requests") or []
-        if isinstance(r, dict)
-    }
     for i, res in results:
         p = f"status.allocation.devices.results[{i}]"
         if res.get("request") not in req_names:
@@ -531,10 +532,17 @@ def validate_device_class(obj: dict) -> None:
     if not isinstance(spec, dict):
         raise SchemaError("DeviceClass", issues + ["spec: required"])
     _cel_selectors(spec.get("selectors"), "spec.selectors", issues)
-    for i, cfg in enumerate(spec.get("config") or []):
-        opaque = (cfg or {}).get("opaque")
-        if opaque is not None and not opaque.get("driver"):
-            issues.append(f"spec.config[{i}].opaque.driver: required")
+    for i, cfg in _dict_items(spec.get("config"), "spec.config", issues):
+        opaque = cfg.get("opaque")
+        if opaque is not None:
+            # DeviceConfiguration is shared between claim and class
+            # config upstream: driver AND parameters are required.
+            _dns_subdomain(
+                opaque.get("driver", ""), f"spec.config[{i}].opaque.driver",
+                issues,
+            )
+            if "parameters" not in opaque:
+                issues.append(f"spec.config[{i}].opaque.parameters: required")
     if issues:
         raise SchemaError("DeviceClass", issues)
 

@@ -100,13 +100,19 @@ def restore_checkpoint(
     import orbax.checkpoint as ocp
 
     # Probe BEFORE constructing the manager: _manager(create=True) would
-    # mkdir a typo'd path as a side effect of a failed restore.
+    # mkdir a typo'd path as a side effect of a failed restore — also
+    # with an EXPLICIT step (round-4 advisor), where the failed restore
+    # would otherwise leave the same phantom directory behind.
     if step is None:
         step = latest_step(directory)
         if step is None:
             raise FileNotFoundError(
                 f"no checkpoint found under {directory}"
             )
+    elif latest_step(directory) is None:
+        raise FileNotFoundError(
+            f"no checkpoint found under {directory} (asked for step {step})"
+        )
     mgr = _manager(os.path.abspath(directory))
     try:
         return mgr.restore(step, args=ocp.args.StandardRestore(template))

@@ -75,14 +75,18 @@ def speculative_generate(
     max_len = s + max_new_tokens + k + 1
 
     # The chunked verification forward must reproduce the target's T=1
-    # decode EXACTLY. MoE capacity routing is capacity-immune at T=1 (a
-    # lone token always fits its experts' slots) but a T=k+1 chunk can
+    # decode. MoE capacity routing is capacity-immune at T=1 (a lone
+    # token always fits its experts' slots) but a T=k+1 chunk can
     # overflow per-expert capacity and drop tokens the incremental
     # target never would — silently changing outputs at the default
-    # capacity_factor. Dropless dispatch IS the T=1 semantics at any
-    # chunk width, restoring the greedy-equivalence guarantee. Prefill
-    # keeps the caller's config: generate()'s own prefill uses it too,
-    # so the two paths stay comparable from the same starting state.
+    # capacity_factor. Dropless dispatch restores the T=1 ROUTING
+    # semantics at any chunk width: the same experts fire with the same
+    # gates, so the guarantee is equivalence up to matmul reduction
+    # order (dropless grouped matmuls vs the T=1 einsum accumulate in a
+    # different order; greedy argmax can flip only on logits tied to
+    # within float tolerance). Prefill keeps the caller's config:
+    # generate()'s own prefill uses it too, so the two paths stay
+    # comparable from the same starting state.
     verify_config = (
         dataclasses.replace(target_config, moe_impl="dropless")
         if isinstance(target_config, MoeConfig)

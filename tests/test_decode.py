@@ -151,3 +151,19 @@ class TestOrbaxCheckpoint:
         np.testing.assert_allclose(
             np.array(restored["embed"]), np.array(params["embed"])
         )
+
+    def test_typod_path_fails_without_mkdir_side_effect(self, tmp_path):
+        """A restore from a nonexistent directory must fail loudly and
+        leave NO phantom directory behind — with and without an explicit
+        step (round-4 advisor: the explicit-step path used to mkdir the
+        typo'd path before failing)."""
+        import os
+        import pytest
+
+        from k8s_dra_driver_tpu.models.checkpoint import restore_checkpoint
+
+        typo = str(tmp_path / "no-such-ckpt")
+        for step in (None, 7):
+            with pytest.raises(FileNotFoundError, match="no checkpoint"):
+                restore_checkpoint(typo, template={}, step=step)
+            assert not os.path.exists(typo), f"step={step} mkdir'd the path"

@@ -234,10 +234,10 @@ class TestSortedImpls:
         assert float(jnp.sum(jnp.abs(grads["layers"]["wr"]))) > 0
         assert float(jnp.sum(jnp.abs(grads["layers"]["w_gateup"]))) > 0
 
-    def test_auto_is_einsum_and_sorted_impls_refuse_meshes(self, devices):
-        """auto resolves to einsum with and without a mesh (the sorted
-        paths lose on TPU and cannot shard); an explicit sorted impl
-        under a mesh must refuse rather than silently drop shardings."""
+    def test_auto_is_einsum_and_binned_refuses_expert_meshes(self, devices):
+        """auto resolves to einsum with and without a mesh; binned under
+        an EXPERT-sharded mesh must refuse rather than silently drop the
+        expert shardings (its semantics are einsum's — use that)."""
         mesh = build_mesh(MeshConfig(data=2, expert=4), devices=devices[:8])
         cfg = dataclasses.replace(CFG, capacity_factor=8.0)
         params = init_params(cfg, jax.random.PRNGKey(0))
@@ -252,10 +252,120 @@ class TestSortedImpls:
             lambda p, tk: loss_fn(p, tk, cfg, mesh=mesh)
         )(sharded, t))
         assert abs(unsharded - meshed) < 5e-4
-        for impl in ("binned", "dropless"):
-            bad = dataclasses.replace(cfg, moe_impl=impl)
-            with pytest.raises(ValueError, match="does not support a mesh"):
-                forward(params, t, bad, mesh=mesh)
+        bad = dataclasses.replace(cfg, moe_impl="binned")
+        with pytest.raises(ValueError, match="expert-sharded"):
+            forward(params, t, bad, mesh=mesh)
+
+    @pytest.mark.parametrize("impl", ["binned", "dropless"])
+    def test_sorted_impls_run_on_expertless_meshes(self, devices, impl):
+        """A mesh WITHOUT an expert axis (pure data parallel) needs no
+        expert all-to-alls: the sorted bodies are plain GSPMD programs
+        and must shard like any other op (round-4 advisor)."""
+        mesh = build_mesh(MeshConfig(data=2), devices=devices[:2])
+        cfg = dataclasses.replace(CFG, moe_impl=impl, capacity_factor=8.0)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        t = tokens()
+        unsharded = float(loss_fn(params, t, cfg))
+        sharded = shard_pytree(params, mesh, param_specs(cfg))
+        meshed = float(jax.jit(
+            lambda p, tk: loss_fn(p, tk, cfg, mesh=mesh)
+        )(sharded, t))
+        assert abs(unsharded - meshed) < 5e-4
+
+
+class TestDroplessExpertParallel:
+    """moe_impl='dropless' under an expert-sharded mesh (round-4 verdict
+    ask #5): shard_map sort + grouped matmul per expert shard, combined
+    by one psum — output pinned against single-device dropless."""
+
+    def test_matches_single_device_dropless(self, devices):
+        mesh = build_mesh(MeshConfig(expert=4), devices=devices[:4])
+        cfg = dataclasses.replace(CFG, moe_impl="dropless")
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        t = tokens()
+        ref, ref_aux = forward(params, t, cfg)              # single-device
+        sharded = shard_pytree(params, mesh, param_specs(cfg))
+        out, aux = jax.jit(
+            lambda p, tk: forward(p, tk, cfg, mesh=mesh)
+        )(sharded, t)
+        np.testing.assert_allclose(
+            np.array(out), np.array(ref), atol=3e-5, rtol=3e-5
+        )
+        assert abs(float(aux) - float(ref_aux)) < 1e-5
+
+    def test_composes_with_data_axis_and_skewed_routing(self, devices):
+        """dp x ep mesh, with a token distribution that concentrates on
+        one expert — the case that exercises the worst-case row buffer
+        (every pair lands on one shard) and would drop under capacity."""
+        mesh = build_mesh(MeshConfig(data=2, expert=4), devices=devices[:8])
+        cfg = dataclasses.replace(CFG, moe_impl="dropless")
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        # Bias the router hard toward expert 0.
+        wr = params["layers"]["wr"]
+        params["layers"]["wr"] = wr.at[..., 0].add(8.0)
+        t = tokens(b=4)
+        ref, _ = forward(params, t, cfg)
+        sharded = shard_pytree(params, mesh, param_specs(cfg))
+        out, _ = jax.jit(
+            lambda p, tk: forward(p, tk, cfg, mesh=mesh)
+        )(sharded, t)
+        # Data-axis GSPMD changes f32 reduction order, which can flip
+        # top-k for NEAR-TIED tokens (a different-but-equally-valid
+        # routing, not an error). Require token-level agreement for the
+        # overwhelming majority and boundedness everywhere.
+        diff = np.abs(np.array(out) - np.array(ref))
+        frac_off = float((diff.max(axis=-1) > 3e-5).mean())
+        assert frac_off <= 0.02, frac_off
+        assert float(diff.max()) < 1e-2
+
+    def test_gradients_match_single_device(self, devices):
+        mesh = build_mesh(MeshConfig(expert=4), devices=devices[:4])
+        cfg = dataclasses.replace(CFG, moe_impl="dropless")
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        t = tokens()
+        ref_loss, ref_grads = jax.value_and_grad(
+            lambda p: loss_fn(p, t, cfg)
+        )(params)
+        sharded = shard_pytree(params, mesh, param_specs(cfg))
+        loss, grads = jax.jit(jax.value_and_grad(
+            lambda p: loss_fn(p, t, cfg, mesh=mesh)
+        ))(sharded)
+        assert abs(float(loss) - float(ref_loss)) < 5e-5
+        for path, ref_leaf in jax.tree_util.tree_leaves_with_path(ref_grads):
+            leaf = np.array(
+                jax.tree_util.tree_leaves_with_path(grads)[
+                    [p for p, _ in
+                     jax.tree_util.tree_leaves_with_path(grads)].index(path)
+                ][1]
+            )
+            np.testing.assert_allclose(
+                leaf, np.array(ref_leaf), atol=5e-4, rtol=5e-3,
+                err_msg=str(path),
+            )
+
+    def test_refuses_pipeline_composition(self, devices):
+        mesh = build_mesh(
+            MeshConfig(data=2, expert=2, pipe=2), devices=devices[:8]
+        )
+        cfg = dataclasses.replace(CFG, moe_impl="dropless")
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        t = tokens(b=4)
+        with pytest.raises(ValueError, match="pipelined"):
+            # jit like every pipeline caller (eager shard_map with
+            # device-sharded inputs trips a jax-internal unmatch path
+            # before any user code runs).
+            jax.jit(
+                lambda p: forward_pipelined(
+                    p, t, cfg, mesh, n_microbatches=2
+                )
+            )(params)
+
+    def test_refuses_indivisible_expert_axis(self, devices):
+        mesh = build_mesh(MeshConfig(expert=3), devices=devices[:3])
+        cfg = dataclasses.replace(CFG, moe_impl="dropless")  # 4 experts
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="divide"):
+            forward(params, tokens(), cfg, mesh=mesh)
 
 
 class TestPipelinedMoe:
