@@ -168,15 +168,17 @@ def run_bench(preset, batch, seq, peak_flops, remat_policy="flash_qkv",
     n_tokens = batch * seq
     # fwd 2N + bwd 4N matmul FLOPs/token + attention quadratic term; for
     # MoE, N counts ACTIVE params (top_k experts), the MFU convention.
+    # ONE median dt is the source of truth — value, achieved_tflops, and
+    # step_ms all derive from the same run.
     flops_tok = config.flops_per_token(seq)
-    mfus = sorted(flops_tok * n_tokens / d / peak_flops for d in dts)
-    mfu = mfus[len(mfus) // 2]
     dt = sorted(dts)[len(dts) // 2]
     achieved = flops_tok * n_tokens / dt
+    mfu = achieved / peak_flops
+    mfus = sorted(flops_tok * n_tokens / d / peak_flops for d in dts)
     spread = (mfus[-1] - mfus[0]) / 2
 
     family = "mixtral" if model == "moe" else "llama3"
-    result = {
+    return {
         "metric": f"{family}_{preset}_train_mfu_b{batch}_s{seq}",
         "value": round(mfu, 4),
         "unit": "mfu_fraction",
@@ -215,7 +217,6 @@ def run_bench(preset, batch, seq, peak_flops, remat_policy="flash_qkv",
             "mfu_all": [round(v, 4) for v in mfus],
         },
     }
-    return result
 
 
 def extra_metrics(peak_flops, remat_policy) -> list:

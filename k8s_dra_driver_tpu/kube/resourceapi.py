@@ -7,8 +7,9 @@ kubeletplugin/draplugin.go:320-335) and so never faces version skew: a
 cluster either serves exactly that generation or the driver does not work.
 This driver instead discovers the served ``resource.k8s.io`` version at
 startup and speaks it on the wire, because the clusters it targets straddle
-the boundary: k8s 1.31 serves only ``v1alpha3``, 1.32+ serves ``v1beta1``
-(and typically not v1alpha3 at all).
+TWO boundaries: k8s 1.31 serves only ``v1alpha3``, 1.32 serves ``v1beta1``
+(and typically not v1alpha3 at all), and 1.33+ adds ``v1beta2`` with a
+reshaped Device and claim-request schema.
 
 Design: every object INSIDE the driver uses one canonical shape — the
 v1beta1 one, where device capacities are ``{"value": "<quantity>"}``
@@ -41,8 +42,21 @@ logger = logging.getLogger(__name__)
 
 GROUP = "resource.k8s.io"
 
-# Dialects this driver can speak, newest (preferred) first.
-SUPPORTED_VERSIONS = ("v1beta1", "v1alpha3")
+# Dialects this driver can speak, newest (preferred) first. The deltas:
+#
+# - v1alpha3 (k8s 1.31): device capacities are BARE quantity strings
+#   (types.go:220); devices wrap their payload in ``basic``.
+# - v1beta1 (k8s 1.32): capacities become DeviceCapacity
+#   ``{"value": ...}``; ``basic`` wrapper retained. This is the
+#   CANONICAL in-memory shape.
+# - v1beta2 (k8s 1.33): the ``basic`` wrapper is REMOVED (attributes/
+#   capacity/consumesCounters live directly on the Device), and claim
+#   requests nest their payload under ``exactly`` (ExactDeviceRequest)
+#   to make room for ``firstAvailable`` prioritized-list requests.
+SUPPORTED_VERSIONS = ("v1beta2", "v1beta1", "v1alpha3")
+
+# Canonical apiVersion stamp for in-memory objects.
+CANONICAL_VERSION = "v1beta1"
 
 # The version assumed when discovery is impossible (no client, or the
 # group is absent): the oldest supported one, matching the clusters the
@@ -163,42 +177,57 @@ class ResourceApi:
     def slice_to_wire(self, obj: dict) -> dict:
         """Canonical slice -> the served dialect.
 
-        v1beta1 IS the canonical shape, so only the apiVersion is stamped;
-        v1alpha3 additionally unwraps device capacities to bare quantity
-        strings (v1alpha3 types.go:220 ``map[QualifiedName]resource.Quantity``
-        vs v1beta1's DeviceCapacity struct).
+        v1beta1 IS the canonical shape, so only the apiVersion is
+        stamped; v1alpha3 additionally unwraps device capacities to bare
+        quantity strings (v1alpha3 types.go:220); v1beta2 removes the
+        ``basic`` device wrapper (attributes/capacity/consumesCounters
+        inline on the Device).
         """
         out = dict(obj)
         out["apiVersion"] = self.api_version
         if self.version == "v1alpha3":
             out["spec"] = _map_device_capacity(obj.get("spec", {}), _unwrap)
+        elif self.version == "v1beta2":
+            out["spec"] = _map_devices(obj.get("spec", {}), _flatten_device)
         return out
 
     def slice_from_wire(self, obj: dict) -> dict:
-        """Served dialect -> canonical. Tolerant of either capacity shape
+        """Served dialect -> canonical. Tolerant of every dialect's shape
         (idempotent on already-canonical objects), so fakes and mixed
         transcripts need no special-casing."""
         out = dict(obj)
-        out["apiVersion"] = f"{GROUP}/{SUPPORTED_VERSIONS[0]}"
-        out["spec"] = _map_device_capacity(obj.get("spec", {}), _wrap)
+        out["apiVersion"] = f"{GROUP}/{CANONICAL_VERSION}"
+        spec = _map_devices(obj.get("spec", {}), _nest_device)
+        out["spec"] = _map_device_capacity(spec, _wrap)
         return out
 
     # -- ResourceClaim / DeviceClass conversion ----------------------------
 
     def claim_to_wire(self, obj: dict) -> dict:
-        """Claims and classes are structurally identical across dialects;
-        restamp the apiVersion only."""
+        """Canonical claim -> the served dialect. v1alpha3/v1beta1 share
+        the claim structure (restamp only); v1beta2 nests each request's
+        payload under ``exactly`` (ExactDeviceRequest), the shape that
+        makes room for prioritized-list requests."""
+        out = dict(obj)
+        out["apiVersion"] = self.api_version
+        if self.version == "v1beta2":
+            out["spec"] = _map_requests(obj.get("spec"), _wrap_exactly)
+        return out
+
+    def class_to_wire(self, obj: dict) -> dict:
+        """DeviceClass is structurally identical across all three
+        dialects; restamp the apiVersion only."""
         out = dict(obj)
         out["apiVersion"] = self.api_version
         return out
 
-    class_to_wire = claim_to_wire
-
     def claim_from_wire(self, obj: dict) -> dict:
-        """Wire claim -> canonical: the canonical stamp, like
-        slice_from_wire (structure needs no reshaping)."""
+        """Wire claim -> canonical: flatten v1beta2 ``exactly`` wrappers
+        (tolerant; ``firstAvailable`` prioritized lists pass through
+        untouched — no older dialect can express them)."""
         out = dict(obj)
-        out["apiVersion"] = f"{GROUP}/{SUPPORTED_VERSIONS[0]}"
+        out["apiVersion"] = f"{GROUP}/{CANONICAL_VERSION}"
+        out["spec"] = _map_requests(obj.get("spec"), _unwrap_exactly)
         return out
 
 
@@ -214,6 +243,82 @@ def _unwrap(value):
     if isinstance(value, dict):
         return value.get("value", "")
     return value
+
+
+def _flatten_device(dev: dict) -> dict:
+    """Canonical device -> v1beta2: hoist the ``basic`` payload onto the
+    Device itself. Idempotent on already-flat devices."""
+    basic = dev.get("basic")
+    if not isinstance(basic, dict):
+        return dev
+    out = {k: v for k, v in dev.items() if k != "basic"}
+    out.update(basic)
+    return out
+
+
+_BASIC_FIELDS = ("attributes", "capacity", "consumesCounters")
+
+
+def _nest_device(dev: dict) -> dict:
+    """v1beta2 device -> canonical: re-nest the payload under ``basic``.
+    Idempotent on devices that already carry the wrapper."""
+    if "basic" in dev or not any(f in dev for f in _BASIC_FIELDS):
+        return dev
+    out = {k: v for k, v in dev.items() if k not in _BASIC_FIELDS}
+    out["basic"] = {f: dev[f] for f in _BASIC_FIELDS if f in dev}
+    return out
+
+
+def _map_devices(spec: dict, fn) -> dict:
+    devices = spec.get("devices")
+    if not devices or not isinstance(devices, list):
+        return spec
+    new_devices = [fn(d) if isinstance(d, dict) else d for d in devices]
+    if new_devices == devices:
+        return spec
+    out = dict(spec)
+    out["devices"] = new_devices
+    return out
+
+
+def _wrap_exactly(req: dict) -> dict:
+    """Canonical flat request -> v1beta2 {name, exactly: {...}}.
+    Requests already in v1beta2 form (exactly/firstAvailable) pass
+    through."""
+    if "exactly" in req or "firstAvailable" in req:
+        return req
+    payload = {k: v for k, v in req.items() if k != "name"}
+    out = {"name": req.get("name", "")}
+    if payload:
+        out["exactly"] = payload
+    return out
+
+
+def _unwrap_exactly(req: dict) -> dict:
+    """v1beta2 {name, exactly: {...}} -> canonical flat request."""
+    exactly = req.get("exactly")
+    if not isinstance(exactly, dict):
+        return req
+    out = {k: v for k, v in req.items() if k != "exactly"}
+    out.update(exactly)
+    return out
+
+
+def _map_requests(spec, fn) -> dict:
+    spec = spec if isinstance(spec, dict) else {}
+    devices = spec.get("devices")
+    if not isinstance(devices, dict):
+        return spec
+    requests = devices.get("requests")
+    if not requests or not isinstance(requests, list):
+        return spec
+    new_requests = [fn(r) if isinstance(r, dict) else r for r in requests]
+    if new_requests == requests:
+        return spec
+    out = dict(spec)
+    out["devices"] = dict(devices)
+    out["devices"]["requests"] = new_requests
+    return out
 
 
 def _map_device_capacity(spec: dict, fn) -> dict:

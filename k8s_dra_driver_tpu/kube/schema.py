@@ -59,7 +59,7 @@ _VERSION = re.compile(
     r"^[0-9]+\.[0-9]+\.[0-9]+(-[0-9A-Za-z.-]+)?(\+[0-9A-Za-z.-]+)?$"
 )
 
-SUPPORTED_VERSIONS = ("v1alpha3", "v1beta1")
+SUPPORTED_VERSIONS = ("v1alpha3", "v1beta1", "v1beta2")
 
 
 class SchemaError(ValueError):
@@ -325,10 +325,21 @@ def validate_resource_slice(obj: dict) -> None:
         if dev.get("name") in seen_devices:
             issues.append(f"{p}.name: duplicate {dev.get('name')!r}")
         seen_devices.add(dev.get("name"))
-        basic = dev.get("basic")
-        if not isinstance(basic, dict):
-            issues.append(f"{p}.basic: required")
-            continue
+        if version == "v1beta2":
+            # v1beta2 removed the wrapper: the payload lives on the
+            # Device itself, and a lingering 'basic' is wrong-dialect.
+            if "basic" in dev:
+                issues.append(
+                    f"{p}.basic: not a v1beta2 field (device payload is "
+                    "inline)"
+                )
+                continue
+            basic = dev
+        else:
+            basic = dev.get("basic")
+            if not isinstance(basic, dict):
+                issues.append(f"{p}.basic: required")
+                continue
         attrs = _map_items(basic.get("attributes"), f"{p}.attributes", issues)
         caps = _map_items(basic.get("capacity"), f"{p}.capacity", issues)
         if len(attrs) + len(caps) > MAX_ATTRS_AND_CAPS_PER_DEVICE:
@@ -383,7 +394,7 @@ def validate_resource_slice(obj: dict) -> None:
         declared.add(cs.get("name"))
         _counter_map(cs.get("counters"), f"{p}.counters", issues)
     for i, dev in devices:
-        basic = dev.get("basic")
+        basic = dev if version == "v1beta2" else dev.get("basic")
         if not isinstance(basic, dict):
             continue
         for j, cc in _dict_items(
@@ -400,7 +411,12 @@ def validate_resource_slice(obj: dict) -> None:
         raise SchemaError("ResourceSlice", issues)
 
 
-def _validate_claim_spec(spec, path, issues):
+_FLAT_REQUEST_FIELDS = (
+    "deviceClassName", "selectors", "allocationMode", "count", "adminAccess",
+)
+
+
+def _validate_claim_spec(spec, path, issues, version=None):
     devices = _map_items(spec.get("devices"), f"{path}.devices", issues)
     requests = _dict_items(
         devices.get("requests"), f"{path}.devices.requests", issues
@@ -414,6 +430,42 @@ def _validate_claim_spec(spec, path, issues):
         if req.get("name") in req_names:
             issues.append(f"{p}.name: duplicate {req.get('name')!r}")
         req_names.add(req.get("name"))
+        if version == "v1beta2":
+            # v1beta2 nests the payload: exactly one of exactly /
+            # firstAvailable; flat fields on the request itself are the
+            # older dialects' shape.
+            flat = [f for f in _FLAT_REQUEST_FIELDS if f in req]
+            if flat:
+                issues.append(
+                    f"{p}: fields {flat} must nest under 'exactly' in "
+                    "v1beta2"
+                )
+            nested = [f for f in ("exactly", "firstAvailable") if f in req]
+            if len(nested) != 1:
+                issues.append(
+                    f"{p}: exactly one of exactly/firstAvailable required"
+                )
+                continue
+            if nested == ["firstAvailable"]:
+                for j, sub in _dict_items(
+                    req["firstAvailable"], f"{p}.firstAvailable", issues
+                ):
+                    _dns_label(sub.get("name", ""),
+                               f"{p}.firstAvailable[{j}].name", issues)
+                    _dns_subdomain(
+                        sub.get("deviceClassName", ""),
+                        f"{p}.firstAvailable[{j}].deviceClassName", issues,
+                    )
+                    # Allocations from a prioritized list record
+                    # '<request>/<subrequest>' — those are the legal
+                    # names for status results / config references.
+                    req_names.add(f"{req.get('name')}/{sub.get('name')}")
+                continue
+            req = req["exactly"]
+            if not isinstance(req, dict):
+                issues.append(f"{p}.exactly: must be an object")
+                continue
+            p = f"{p}.exactly"
         _dns_subdomain(
             req.get("deviceClassName", ""), f"{p}.deviceClassName", issues
         )
@@ -476,11 +528,11 @@ def _validate_claim_spec(spec, path, issues):
 
 def validate_resource_claim(obj: dict) -> None:
     issues: list[str] = []
-    _check_type_meta(obj, "ResourceClaim", issues)
+    version = _check_type_meta(obj, "ResourceClaim", issues)
     spec = obj.get("spec")
     if not isinstance(spec, dict):
         raise SchemaError("ResourceClaim", issues + ["spec: required"])
-    req_names = _validate_claim_spec(spec, "spec", issues)
+    req_names = _validate_claim_spec(spec, "spec", issues, version)
 
     status = _map_items(obj.get("status"), "status", issues)
     alloc = _map_items(status.get("allocation"), "status.allocation", issues)
@@ -514,13 +566,13 @@ def validate_resource_claim(obj: dict) -> None:
 
 def validate_resource_claim_template(obj: dict) -> None:
     issues: list[str] = []
-    _check_type_meta(obj, "ResourceClaimTemplate", issues)
+    version = _check_type_meta(obj, "ResourceClaimTemplate", issues)
     inner = (obj.get("spec") or {}).get("spec")
     if not isinstance(inner, dict):
         raise SchemaError(
             "ResourceClaimTemplate", issues + ["spec.spec: required"]
         )
-    _validate_claim_spec(inner, "spec.spec", issues)
+    _validate_claim_spec(inner, "spec.spec", issues, version)
     if issues:
         raise SchemaError("ResourceClaimTemplate", issues)
 

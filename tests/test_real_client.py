@@ -674,6 +674,46 @@ class TestVersionBilingual:
         (wire2,) = stub.objects.values()
         assert wire2["metadata"]["resourceVersion"] == rv
 
+    def test_slices_published_in_v1beta2_dialect(self):
+        """A 1.33+ server (serves only v1beta2): discovery picks it and
+        the wire objects carry flattened devices."""
+        from k8s_dra_driver_tpu.kube.resourceapi import ResourceApi
+        from k8s_dra_driver_tpu.kube.resourceslice import (
+            DriverResources, Pool, ResourceSliceController,
+        )
+        stub = StubApiServer(served_versions=("v1beta2",))
+        stub.start()
+        client = RealKubeClient(
+            RestConfig(host=f"http://127.0.0.1:{stub.port}"),
+            poll_interval=0.05, qps=0,
+        )
+        try:
+            api_ = ResourceApi.discover(client)
+            assert api_.version == "v1beta2"
+            ctrl = ResourceSliceController(
+                client, "tpu.google.com", scope="n0", api=api_,
+            )
+            dev = {"name": "tpu0", "basic": {
+                "attributes": {"type": {"string": "chip"}},
+                "capacity": {"hbm": {"value": "95"}},
+            }}
+            ctrl.update(DriverResources(pools={
+                "n0": Pool(devices=[dev], node_name="n0"),
+            }))
+            ctrl.sync_once()
+            (wire,) = stub.objects.values()
+            assert wire["apiVersion"] == "resource.k8s.io/v1beta2"
+            (wdev,) = wire["spec"]["devices"]
+            assert "basic" not in wdev
+            assert wdev["capacity"] == {"hbm": {"value": "95"}}
+            rv = wire["metadata"]["resourceVersion"]
+            ctrl.sync_once()                  # canonical diff: no churn
+            (wire2,) = stub.objects.values()
+            assert wire2["metadata"]["resourceVersion"] == rv
+        finally:
+            client.close()
+            stub.stop()
+
     def test_slices_published_in_v1alpha3_dialect(self, api):
         """Same flow on a 1.31 server: capacities unwrap to bare quantity
         strings (v1alpha3 types.go:220)."""

@@ -124,7 +124,60 @@ class TestConversion:
 
     def test_unsupported_version_rejected(self):
         with pytest.raises(ValueError):
-            ResourceApi("v1beta2")
+            ResourceApi("v2")
+
+    def test_v1beta2_slice_round_trip(self):
+        """v1beta2 removes the device 'basic' wrapper: to_wire flattens,
+        from_wire re-nests; capacities stay DeviceCapacity-wrapped."""
+        api = ResourceApi("v1beta2")
+        wire = api.slice_to_wire(canonical_slice())
+        assert wire["apiVersion"] == "resource.k8s.io/v1beta2"
+        dev = wire["spec"]["devices"][0]
+        assert "basic" not in dev
+        assert dev["attributes"]["type"] == {"string": "chip"}
+        assert dev["capacity"]["hbm"] == {"value": "103079215104"}
+        assert dev["consumesCounters"][0]["counterSet"] == "chip-0-counters"
+        back = api.slice_from_wire(wire)
+        assert back["spec"] == canonical_slice()["spec"]
+
+    def test_v1beta2_claim_round_trip(self):
+        """v1beta2 nests request payloads under 'exactly'."""
+        api = ResourceApi("v1beta2")
+        claim = {
+            "apiVersion": "resource.k8s.io/v1beta1",
+            "kind": "ResourceClaim",
+            "metadata": {"name": "c"},
+            "spec": {"devices": {"requests": [
+                {"name": "r0", "deviceClassName": "tpu.google.com",
+                 "count": 2, "allocationMode": "ExactCount"},
+            ]}},
+        }
+        wire = api.claim_to_wire(claim)
+        (req,) = wire["spec"]["devices"]["requests"]
+        assert req == {"name": "r0", "exactly": {
+            "deviceClassName": "tpu.google.com",
+            "count": 2, "allocationMode": "ExactCount",
+        }}
+        back = api.claim_from_wire(wire)
+        assert back["spec"] == claim["spec"]
+
+    def test_first_available_passes_through(self):
+        api = ResourceApi("v1beta2")
+        claim = {
+            "apiVersion": "resource.k8s.io/v1beta2",
+            "kind": "ResourceClaim",
+            "metadata": {"name": "c"},
+            "spec": {"devices": {"requests": [
+                {"name": "r0", "firstAvailable": [
+                    {"name": "big", "deviceClassName": "tpu.google.com",
+                     "count": 4},
+                    {"name": "small", "deviceClassName": "tpu.google.com"},
+                ]},
+            ]}},
+        }
+        # Neither direction touches a prioritized-list request.
+        assert api.claim_to_wire(claim)["spec"] == claim["spec"]
+        assert api.claim_from_wire(claim)["spec"] == claim["spec"]
 
 
 class TestDiscovery:
@@ -138,6 +191,13 @@ class TestDiscovery:
         assert ResourceApi.discover(client).version == "v1alpha3"
         client.served_api_versions["resource.k8s.io"] = ["v1beta1"]
         assert ResourceApi.discover(client).version == "v1beta1"
+
+    def test_prefers_v1beta2_on_133_servers(self):
+        client = FakeKubeClient()
+        client.served_api_versions["resource.k8s.io"] = [
+            "v1beta2", "v1beta1",
+        ]
+        assert ResourceApi.discover(client).version == "v1beta2"
 
     def test_no_client_falls_back_to_default(self):
         assert ResourceApi.discover(None).version == "v1alpha3"
@@ -200,7 +260,7 @@ class TestPublishAllocateAcrossDialects:
     """The whole loop — plugin publishes, sim allocator consumes — on a
     server of either generation."""
 
-    @pytest.mark.parametrize("served", [["v1alpha3"], ["v1beta1"]])
+    @pytest.mark.parametrize("served", [["v1alpha3"], ["v1beta1"], ["v1beta2"]])
     def test_publish_then_allocate(self, served):
         from k8s_dra_driver_tpu.kube.allocator import ReferenceAllocator
 
@@ -223,11 +283,16 @@ class TestPublishAllocateAcrossDialects:
         ctrl.sync_once()
         (wire,) = client.list(api.slices)
         assert wire["apiVersion"] == f"resource.k8s.io/{served[0]}"
-        cap = wire["spec"]["devices"][0]["basic"]["capacity"]
-        if served[0] == "v1alpha3":
-            assert cap["hbm"] == "103079215104"      # bare quantity
+        dev = wire["spec"]["devices"][0]
+        if served[0] == "v1beta2":
+            assert "basic" not in dev                # flattened device
+            assert dev["capacity"]["hbm"] == {"value": "103079215104"}
+        elif served[0] == "v1alpha3":
+            assert dev["basic"]["capacity"]["hbm"] == "103079215104"
         else:
-            assert cap["hbm"] == {"value": "103079215104"}
+            assert dev["basic"]["capacity"]["hbm"] == {
+                "value": "103079215104"
+            }
 
         allocator = ReferenceAllocator(client)
         assert allocator.api.version == served[0]
@@ -307,6 +372,45 @@ class TestPublishAllocateAcrossDialects:
             obj = driver._fetch_claim(FakeGrpcClaim())
             assert obj["metadata"]["uid"] == "u0"
             assert driver.resource_api.version == "v1beta1"
+
+    def test_driver_fetch_claim_canonicalizes_v1beta2(self):
+        """A claim served in v1beta2 wire form ('exactly'-nested request
+        payloads) reaches DeviceState in canonical flat form."""
+        from k8s_dra_driver_tpu.plugin.driver import Driver, DriverConfig
+        from k8s_dra_driver_tpu.tpulib.chiplib import FakeChipLib
+
+        client = FakeKubeClient()
+        client.served_api_versions["resource.k8s.io"] = ["v1beta2"]
+        api = ResourceApi.discover(client)
+        assert api.version == "v1beta2"
+        client.create(api.claims, {
+            "apiVersion": api.api_version,
+            "kind": "ResourceClaim",
+            "metadata": {"name": "c0", "namespace": "d", "uid": "u0"},
+            "spec": {"devices": {"requests": [
+                {"name": "r0", "exactly": {
+                    "deviceClassName": "tpu.google.com", "count": 1,
+                }},
+            ]}},
+        }, namespace="d")
+        import tempfile
+        with tempfile.TemporaryDirectory() as td:
+            driver = Driver(DriverConfig(
+                node_name="n0",
+                chiplib=FakeChipLib(generation="v5e", topology="1x1x1"),
+                kube_client=client,
+                cdi_root=f"{td}/cdi", plugin_root=f"{td}/plugin",
+                registrar_root=f"{td}/registrar", state_root=f"{td}/state",
+            ))
+            assert driver.resource_api.version == "v1beta2"
+
+            class C:
+                name, namespace, uid = "c0", "d", "u0"
+
+            obj = driver._fetch_claim(C())
+            (req,) = obj["spec"]["devices"]["requests"]
+            assert req == {"name": "r0",
+                           "deviceClassName": "tpu.google.com", "count": 1}
 
     def test_driver_missing_claim_does_not_flip_dialect(self):
         """A genuinely-deleted claim (the common case) surfaces NotFound

@@ -75,14 +75,14 @@ def published_slices(version, topology="2x2x1", generation="v5p"):
 
 
 class TestPublishedObjectsConform:
-    @pytest.mark.parametrize("version", ["v1alpha3", "v1beta1"])
+    @pytest.mark.parametrize("version", ["v1alpha3", "v1beta1", "v1beta2"])
     def test_node_plugin_slices_validate(self, version):
         slices = published_slices(version)
         assert slices
         for s in slices:
             validate_resource_slice(s)   # raises on any violation
 
-    @pytest.mark.parametrize("version", ["v1alpha3", "v1beta1"])
+    @pytest.mark.parametrize("version", ["v1alpha3", "v1beta1", "v1beta2"])
     def test_ici_controller_slices_validate(self, version):
         """Network pools from the cluster controller (nodeSelector form)."""
         from k8s_dra_driver_tpu.controller.slice_manager import IciSliceManager
@@ -113,7 +113,7 @@ class TestPublishedObjectsConform:
         finally:
             mgr.stop()
 
-    @pytest.mark.parametrize("version", ["v1alpha3", "v1beta1"])
+    @pytest.mark.parametrize("version", ["v1alpha3", "v1beta1", "v1beta2"])
     def test_sim_allocated_claim_validates(self, version):
         """The claim status the scheduler sim writes back."""
         from k8s_dra_driver_tpu.kube.allocator import ReferenceAllocator
@@ -133,7 +133,7 @@ class TestPublishedObjectsConform:
         }))
         ctrl.sync_once()
         claim = {
-            "apiVersion": api.api_version,
+            "apiVersion": "resource.k8s.io/v1beta1",   # canonical
             "kind": "ResourceClaim",
             "metadata": {"name": "c0", "namespace": "d", "uid": "u0"},
             "spec": {"devices": {"requests": [{
@@ -141,10 +141,13 @@ class TestPublishedObjectsConform:
                 "count": 2,
             }]}},
         }
+        # The sim allocates in canonical shape; the WIRE form is what
+        # must conform (v1beta2 nests requests under 'exactly').
         out = ReferenceAllocator(client).allocate(claim, node_name="n0")
-        validate_resource_claim(out)
+        wire = api.claim_to_wire(out)
+        validate_resource_claim(wire)
         # And the fake (as the apiserver) accepts the write.
-        client.create(api.claims, out, namespace="d")
+        client.create(api.claims, wire, namespace="d")
 
 
 class TestShippedSpecsConform:
@@ -317,6 +320,57 @@ class TestInjectedDefectsRejected:
 
     def test_unsupported_api_version_rejected(self):
         s = valid_slice()
-        s["apiVersion"] = "resource.k8s.io/v1beta2"
+        s["apiVersion"] = "resource.k8s.io/v1beta3"
         with pytest.raises(SchemaError, match="not a supported"):
             validate_resource_slice(s)
+
+    def test_v1beta2_rejects_wrapped_devices_and_flat_requests_pass(self):
+        """Dialect mixing is caught both ways: a v1beta2 slice carrying
+        the old 'basic' wrapper fails, and a v1beta2 claim with flat
+        request fields (the older dialects' shape) fails."""
+        from k8s_dra_driver_tpu.kube import ResourceApi
+
+        s = valid_slice("v1beta1")
+        s["apiVersion"] = "resource.k8s.io/v1beta2"
+        with pytest.raises(SchemaError, match="not a v1beta2 field"):
+            validate_resource_slice(s)
+        claim = {
+            "apiVersion": "resource.k8s.io/v1beta2",
+            "kind": "ResourceClaim",
+            "metadata": {"name": "c"},
+            "spec": {"devices": {"requests": [
+                {"name": "r0", "deviceClassName": "tpu.google.com"},
+            ]}},
+        }
+        with pytest.raises(SchemaError, match="nest under 'exactly'"):
+            validate_resource_claim(claim)
+        # The conversion layer produces exactly what validates.
+        api = ResourceApi("v1beta2")
+        validate_resource_claim(api.claim_to_wire(claim))
+        validate_resource_slice(api.slice_to_wire(valid_slice("v1beta1")))
+
+    def test_v1beta2_first_available_subrequest_results_validate(self):
+        """Allocations from a prioritized list record
+        '<request>/<subrequest>' — the validator must accept exactly
+        those names and reject unknown ones."""
+        claim = {
+            "apiVersion": "resource.k8s.io/v1beta2",
+            "kind": "ResourceClaim",
+            "metadata": {"name": "c"},
+            "spec": {"devices": {"requests": [
+                {"name": "r0", "firstAvailable": [
+                    {"name": "big", "deviceClassName": "tpu.google.com",
+                     "count": 2},
+                    {"name": "small", "deviceClassName": "tpu.google.com"},
+                ]},
+            ]}},
+            "status": {"allocation": {"devices": {"results": [
+                {"request": "r0/big", "driver": "tpu.google.com",
+                 "pool": "n0", "device": "tpu-0"},
+            ]}}},
+        }
+        validate_resource_claim(claim)
+        claim["status"]["allocation"]["devices"]["results"][0][
+            "request"] = "r0/huge"
+        with pytest.raises(SchemaError, match="names no spec request"):
+            validate_resource_claim(claim)
