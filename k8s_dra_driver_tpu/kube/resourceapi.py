@@ -53,7 +53,12 @@ GROUP = "resource.k8s.io"
 #   capacity/consumesCounters live directly on the Device), and claim
 #   requests nest their payload under ``exactly`` (ExactDeviceRequest)
 #   to make room for ``firstAvailable`` prioritized-list requests.
-SUPPORTED_VERSIONS = ("v1beta2", "v1beta1", "v1alpha3")
+# - v1 (k8s 1.34, DRA GA): structurally v1beta2 — the GA promotion kept
+#   the flattened Device and exactly-nested request shapes.
+SUPPORTED_VERSIONS = ("v1", "v1beta2", "v1beta1", "v1alpha3")
+
+# Dialects whose wire shape is the flattened/exactly-nested one.
+_FLAT_DEVICE_VERSIONS = ("v1", "v1beta2")
 
 # Canonical apiVersion stamp for in-memory objects.
 CANONICAL_VERSION = "v1beta1"
@@ -187,7 +192,7 @@ class ResourceApi:
         out["apiVersion"] = self.api_version
         if self.version == "v1alpha3":
             out["spec"] = _map_device_capacity(obj.get("spec", {}), _unwrap)
-        elif self.version == "v1beta2":
+        elif self.version in _FLAT_DEVICE_VERSIONS:
             out["spec"] = _map_devices(obj.get("spec", {}), _flatten_device)
         return out
 
@@ -210,7 +215,7 @@ class ResourceApi:
         makes room for prioritized-list requests."""
         out = dict(obj)
         out["apiVersion"] = self.api_version
-        if self.version == "v1beta2":
+        if self.version in _FLAT_DEVICE_VERSIONS:
             out["spec"] = _map_requests(obj.get("spec"), _wrap_exactly)
         return out
 

@@ -59,7 +59,10 @@ _VERSION = re.compile(
     r"^[0-9]+\.[0-9]+\.[0-9]+(-[0-9A-Za-z.-]+)?(\+[0-9A-Za-z.-]+)?$"
 )
 
-SUPPORTED_VERSIONS = ("v1alpha3", "v1beta1", "v1beta2")
+SUPPORTED_VERSIONS = ("v1alpha3", "v1beta1", "v1beta2", "v1")
+
+# Dialects with the flattened-Device / exactly-nested-request shape.
+_FLAT_VERSIONS = ("v1beta2", "v1")
 
 
 class SchemaError(ValueError):
@@ -325,13 +328,13 @@ def validate_resource_slice(obj: dict) -> None:
         if dev.get("name") in seen_devices:
             issues.append(f"{p}.name: duplicate {dev.get('name')!r}")
         seen_devices.add(dev.get("name"))
-        if version == "v1beta2":
-            # v1beta2 removed the wrapper: the payload lives on the
+        if version in _FLAT_VERSIONS:
+            # v1beta2/v1 removed the wrapper: the payload lives on the
             # Device itself, and a lingering 'basic' is wrong-dialect.
             if "basic" in dev:
                 issues.append(
-                    f"{p}.basic: not a v1beta2 field (device payload is "
-                    "inline)"
+                    f"{p}.basic: not a {version} field (device payload "
+                    "is inline)"
                 )
                 continue
             basic = dev
@@ -394,7 +397,7 @@ def validate_resource_slice(obj: dict) -> None:
         declared.add(cs.get("name"))
         _counter_map(cs.get("counters"), f"{p}.counters", issues)
     for i, dev in devices:
-        basic = dev if version == "v1beta2" else dev.get("basic")
+        basic = dev if version in _FLAT_VERSIONS else dev.get("basic")
         if not isinstance(basic, dict):
             continue
         for j, cc in _dict_items(
@@ -430,15 +433,15 @@ def _validate_claim_spec(spec, path, issues, version=None):
         if req.get("name") in req_names:
             issues.append(f"{p}.name: duplicate {req.get('name')!r}")
         req_names.add(req.get("name"))
-        if version == "v1beta2":
-            # v1beta2 nests the payload: exactly one of exactly /
+        if version in _FLAT_VERSIONS:
+            # v1beta2/v1 nest the payload: exactly one of exactly /
             # firstAvailable; flat fields on the request itself are the
             # older dialects' shape.
             flat = [f for f in _FLAT_REQUEST_FIELDS if f in req]
             if flat:
                 issues.append(
                     f"{p}: fields {flat} must nest under 'exactly' in "
-                    "v1beta2"
+                    f"{version}"
                 )
             nested = [f for f in ("exactly", "firstAvailable") if f in req]
             if len(nested) != 1:

@@ -199,6 +199,15 @@ class TestDiscovery:
         ]
         assert ResourceApi.discover(client).version == "v1beta2"
 
+    def test_prefers_v1_on_ga_servers(self):
+        """k8s 1.34 GA'd DRA: v1 is preferred over every beta dialect
+        (structurally identical to v1beta2)."""
+        client = FakeKubeClient()
+        client.served_api_versions["resource.k8s.io"] = [
+            "v1", "v1beta2", "v1beta1",
+        ]
+        assert ResourceApi.discover(client).version == "v1"
+
     def test_no_client_falls_back_to_default(self):
         assert ResourceApi.discover(None).version == "v1alpha3"
 
@@ -260,7 +269,7 @@ class TestPublishAllocateAcrossDialects:
     """The whole loop — plugin publishes, sim allocator consumes — on a
     server of either generation."""
 
-    @pytest.mark.parametrize("served", [["v1alpha3"], ["v1beta1"], ["v1beta2"]])
+    @pytest.mark.parametrize("served", [["v1alpha3"], ["v1beta1"], ["v1beta2"], ["v1"]])
     def test_publish_then_allocate(self, served):
         from k8s_dra_driver_tpu.kube.allocator import ReferenceAllocator
 
@@ -284,7 +293,7 @@ class TestPublishAllocateAcrossDialects:
         (wire,) = client.list(api.slices)
         assert wire["apiVersion"] == f"resource.k8s.io/{served[0]}"
         dev = wire["spec"]["devices"][0]
-        if served[0] == "v1beta2":
+        if served[0] in ("v1beta2", "v1"):
             assert "basic" not in dev                # flattened device
             assert dev["capacity"]["hbm"] == {"value": "103079215104"}
         elif served[0] == "v1alpha3":

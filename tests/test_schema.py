@@ -75,14 +75,14 @@ def published_slices(version, topology="2x2x1", generation="v5p"):
 
 
 class TestPublishedObjectsConform:
-    @pytest.mark.parametrize("version", ["v1alpha3", "v1beta1", "v1beta2"])
+    @pytest.mark.parametrize("version", ["v1alpha3", "v1beta1", "v1beta2", "v1"])
     def test_node_plugin_slices_validate(self, version):
         slices = published_slices(version)
         assert slices
         for s in slices:
             validate_resource_slice(s)   # raises on any violation
 
-    @pytest.mark.parametrize("version", ["v1alpha3", "v1beta1", "v1beta2"])
+    @pytest.mark.parametrize("version", ["v1alpha3", "v1beta1", "v1beta2", "v1"])
     def test_ici_controller_slices_validate(self, version):
         """Network pools from the cluster controller (nodeSelector form)."""
         from k8s_dra_driver_tpu.controller.slice_manager import IciSliceManager
@@ -113,7 +113,7 @@ class TestPublishedObjectsConform:
         finally:
             mgr.stop()
 
-    @pytest.mark.parametrize("version", ["v1alpha3", "v1beta1", "v1beta2"])
+    @pytest.mark.parametrize("version", ["v1alpha3", "v1beta1", "v1beta2", "v1"])
     def test_sim_allocated_claim_validates(self, version):
         """The claim status the scheduler sim writes back."""
         from k8s_dra_driver_tpu.kube.allocator import ReferenceAllocator
