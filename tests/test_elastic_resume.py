@@ -9,7 +9,6 @@ lands (optimizer moments and step counter included), not merely
 "restore without crashing".
 """
 
-import dataclasses
 
 import jax
 import numpy as np

@@ -180,6 +180,7 @@ class TestChartValidation:
         ({"deviceClasses": ["chip", "gpu"]}, "invalid"),
         ({"controller": {"channelsPerSlice": 0}}, "positive"),
         ({"controller": {"channelsPerSlice": 4096}}, "<= 128"),
+        ({"resourceApiVersion": "v2"}, "resourceApiVersion"),
     ])
     def test_bad_values_fail_render(self, values, msg):
         with pytest.raises(TemplateFail, match=msg):

@@ -13,7 +13,6 @@ FakeKubeClient also applies these rules to every resource.k8s.io write
 conformance sweep; this file pins the contract itself.
 """
 
-import copy
 import glob
 import os
 
