@@ -7,9 +7,10 @@ kubeletplugin/draplugin.go:320-335) and so never faces version skew: a
 cluster either serves exactly that generation or the driver does not work.
 This driver instead discovers the served ``resource.k8s.io`` version at
 startup and speaks it on the wire, because the clusters it targets straddle
-TWO boundaries: k8s 1.31 serves only ``v1alpha3``, 1.32 serves ``v1beta1``
-(and typically not v1alpha3 at all), and 1.33+ adds ``v1beta2`` with a
-reshaped Device and claim-request schema.
+THREE boundaries: k8s 1.31 serves only ``v1alpha3``, 1.32 serves
+``v1beta1`` (and typically not v1alpha3 at all), 1.33 adds ``v1beta2``
+with a reshaped Device and claim-request schema, and 1.34 GAs that shape
+as ``v1``.
 
 Design: every object INSIDE the driver uses one canonical shape — the
 v1beta1 one, where device capacities are ``{"value": "<quantity>"}``
@@ -19,14 +20,14 @@ happens only at the wire boundary:
 - ``slice_to_wire``   canonical ResourceSlice -> served dialect
 - ``slice_from_wire`` served dialect -> canonical (tolerant: accepts either
   shape, so mixed-version transcripts and already-canonical fakes both work)
-- ``claim_to_wire`` / ``claim_from_wire`` — ResourceClaim and DeviceClass
-  are structurally identical across the two dialects; only the apiVersion
-  stamp differs.
+- ``claim_to_wire`` / ``claim_from_wire`` — restamp for v1alpha3/v1beta1
+  (identical claim structure); wrap/unwrap the ``exactly`` request
+  nesting for v1beta2/v1. DeviceClass is identical everywhere.
 
 ``sharedCounters`` / ``consumesCounters`` (the partitionable-devices
 extension this driver publishes for sub-chip TensorCore exclusivity) carry
-``{"value": ...}`` counters in BOTH dialects: neither v1alpha3 nor v1beta1
-defines them upstream — they are the 1.33-era shape, passed through
+``{"value": ...}`` counters in EVERY dialect: the older generations never
+defined them upstream — they are the 1.33-era shape, passed through
 untouched so the allocator sees one form.
 """
 
