@@ -439,6 +439,42 @@ if n > 1:
         client.close()
         assert not any(os.path.exists(f) for f in second)
 
+    def test_exec_plugin_returning_client_certs_drives_mtls(
+        self, tmp_path, pki
+    ):
+        """An exec plugin may mint CLIENT CERTIFICATES instead of a
+        token (ExecCredential status.clientCertificateData/KeyData):
+        the returned chain must reach the TLS handshake."""
+        cert_file = tmp_path / "minted.crt"
+        key_file = tmp_path / "minted.key"
+        cert_file.write_text(pki["client"][0])
+        key_file.write_text(pki["client"][1])
+        plugin = write_exec_plugin(tmp_path, f"""
+import json
+print(json.dumps({{
+    "kind": "ExecCredential",
+    "apiVersion": "client.authentication.k8s.io/v1",
+    "status": {{
+        "clientCertificateData": open({str(cert_file)!r}).read(),
+        "clientKeyData": open({str(key_file)!r}).read(),
+    }},
+}}))
+""")
+        server = TlsEchoServer(pki, tmp_path)
+        server.start()
+        try:
+            client = RealKubeClient(RestConfig(
+                host=f"https://127.0.0.1:{server.port}",
+                ca_data=pki["ca"],
+                exec_auth=ExecAuthConfig(command=plugin),
+            ), qps=0)
+            assert client.list(RESOURCE_SLICES) == []
+            assert server.peer_subjects[-1] == "kubernetes-admin"
+            assert server.auth_headers[-1] == ""    # no bearer: mTLS only
+            client.close()
+        finally:
+            server.stop()
+
     def test_exec_plugin_failure_is_loud(self, tmp_path):
         plugin = write_exec_plugin(
             tmp_path, "import sys; sys.stderr.write('no creds'); sys.exit(3)"
