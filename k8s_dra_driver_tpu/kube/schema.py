@@ -4,8 +4,8 @@ The round-4 verdict's residual risk: with the kind e2e gate unrunnable in
 this environment (no docker), nothing applied real API-server validation
 to the objects this driver emits — FakeKubeClient happily stored any
 shape. This module encodes the upstream validation contract for the
-object kinds the driver touches, in BOTH served dialects, so the fake can
-reject what a real apiserver would reject.
+object kinds the driver touches, in every served dialect (v1alpha3
+through v1), so the fake can reject what a real apiserver would reject.
 
 Rules and limits are transcribed from the reference's vendored API types
 (lengrongfu/k8s-dra-driver, vendor/k8s.io/api/resource/v1alpha3/types.go):
@@ -22,8 +22,10 @@ Rules and limits are transcribed from the reference's vendored API types
   DeviceSelectorsMaxSize / AllocationResultsMaxSize /
   ResourceClaimReservedForMaxSize = 32 (types.go:374-376,460,660,737)
 
-Dialect delta (kube/resourceapi.py): v1alpha3 capacities are bare
-quantity strings; v1beta1 wraps them as DeviceCapacity ``{"value": ...}``.
+Dialect deltas (kube/resourceapi.py): v1alpha3 capacities are bare
+quantity strings; v1beta1 wraps them as DeviceCapacity ``{"value": ...}``;
+v1beta2/v1 inline the device payload (no ``basic``) and nest claim-request
+payloads under ``exactly``.
 ``sharedCounters``/``consumesCounters`` (this driver's partitionable-
 devices extension) always use the wrapped Counter form.
 """

@@ -4,7 +4,7 @@ Round-4 verdict #2: with the kind gate unrunnable (no docker), nothing
 proved the emitted objects would survive real API-server validation.
 This suite applies the upstream validation contract (transcribed from
 the reference's vendored types.go — see kube/schema.py header) to every
-object class the driver emits, in both served dialects, plus the
+object class the driver emits, in every served dialect, plus the
 injected-defect cases the verdict named (attribute domain > 63 chars,
 bad domain) that must fail CI.
 
