@@ -2,7 +2,7 @@
 IMAGE ?= tpu-dra-driver
 TAG ?= latest
 
-.PHONY: all native test image lint clean e2e-kind
+.PHONY: all native test image lint verify-metrics clean e2e-kind
 
 all: native
 
@@ -19,6 +19,16 @@ lint:
 		ruff check k8s_dra_driver_tpu tests tools bench.py __graft_entry__.py; \
 	else \
 		python tools/lint.py; \
+	fi
+
+# Scrape a started debug server (worst-case registry: escaping, ±Inf,
+# aliases) and fail on malformed exposition lines. VERIFY_METRICS_URL=...
+# points it at a live plugin/controller instead.
+verify-metrics:
+	@if [ -n "$(VERIFY_METRICS_URL)" ]; then \
+		python tools/verify_metrics.py --url "$(VERIFY_METRICS_URL)"; \
+	else \
+		python tools/verify_metrics.py; \
 	fi
 
 image:

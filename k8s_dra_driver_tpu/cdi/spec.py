@@ -27,6 +27,7 @@ from typing import Any, Optional
 
 from ..tpulib.deviceinfo import AllocatableDevices, ChipInfo, TensorCoreInfo
 from ..utils.fs import atomic_write_json as _atomic_write_json
+from ..utils.tracing import child_span
 
 logger = logging.getLogger(__name__)
 
@@ -204,26 +205,28 @@ class CDIHandler:
         startup, so this is the injection point that survives the
         driver-installed-late race).
         """
-        devices = []
-        for name, edits in sorted(device_edits.items()):
-            devices.append(
-                {
-                    "name": f"{claim_uid}-{name}",
-                    "containerEdits": edits.to_cdi(),
-                }
-            )
-        spec = {
-            "cdiVersion": CDI_VERSION,
-            "kind": f"{self.vendor}/{self.claim_class}",
-            "devices": devices,
-        }
-        common = ContainerEdits(env=dict(common_env or {})).merge(
-            self._libtpu_edits()
-        ).to_cdi()
-        if common:
-            spec["containerEdits"] = common
-        path = self._claim_spec_path(claim_uid)
-        _atomic_write_json(path, spec)
+        with child_span("cdi-render", claim_uid=claim_uid) as sp:
+            devices = []
+            for name, edits in sorted(device_edits.items()):
+                devices.append(
+                    {
+                        "name": f"{claim_uid}-{name}",
+                        "containerEdits": edits.to_cdi(),
+                    }
+                )
+            spec = {
+                "cdiVersion": CDI_VERSION,
+                "kind": f"{self.vendor}/{self.claim_class}",
+                "devices": devices,
+            }
+            common = ContainerEdits(env=dict(common_env or {})).merge(
+                self._libtpu_edits()
+            ).to_cdi()
+            if common:
+                spec["containerEdits"] = common
+            path = self._claim_spec_path(claim_uid)
+            sp.set_tag("path", path).set_tag("devices", len(devices))
+            _atomic_write_json(path, spec)
         return path
 
     def delete_claim_spec_file(self, claim_uid: str) -> None:

@@ -12,9 +12,11 @@ import argparse
 import logging
 import sys
 
+from ..kube.events import EventRecorder
 from ..utils.cli import env as _env
 from ..utils.cli import add_kube_client_flags, install_signal_stop, make_kube_client
 from ..utils.metrics import Gauge, MetricsServer, Registry
+from ..utils.tracing import Tracer
 from .slice_manager import IciSliceManager
 
 logger = logging.getLogger(__name__)
@@ -46,8 +48,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "for decommissioning: a rolling restart must NOT "
                         "clean up, or channel offsets lose their recovery "
                         "source and domains get renumbered under live claims")
-    p.add_argument("--log-level", default=_env("LOG_LEVEL", "INFO"))
-    p.add_argument("--log-json", action="store_true")
+    p.add_argument("--log-level", default=_env("LOG_LEVEL", ""),
+                   help="log level; empty falls back to TPU_DRA_LOG_LEVEL "
+                        "then INFO [LOG_LEVEL]")
+    p.add_argument("--log-json", action="store_true",
+                   help="structured JSON logs (TPU_DRA_LOG_FORMAT=json "
+                        "is the env equivalent) [LOG_JSON]")
     return p
 
 
@@ -55,24 +61,44 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     from ..utils.logging import setup_logging
 
-    setup_logging(level=args.log_level, json_format=args.log_json)
+    # None lets the TPU_DRA_LOG_* env overrides apply; an explicit flag wins.
+    setup_logging(level=args.log_level or None,
+                  json_format=True if args.log_json else None)
 
     registry = Registry()
+    tracer = Tracer()
     domains_gauge = Gauge(
         "tpu_dra_ici_domains", "Known ICI slice domains", registry
     )
+    ici_enabled = "ici" in args.device_classes.split(",")
+
+    # Liveness must be served BEFORE any API-server round-trip: dialect
+    # discovery / the manager's seed list can stall for minutes against a
+    # slow apiserver, and a dead /healthz during that window crash-loops
+    # the pod. Readiness reports "starting" until the manager is up.
+    managed = {"manager": None}
+
+    def _slice_manager_ready():
+        if managed["manager"] is None:
+            return False, "slice manager starting"
+        return managed["manager"].healthy()
+
     metrics = None
     if args.http_port:
-        metrics = MetricsServer(registry, port=args.http_port)
+        metrics = MetricsServer(registry, port=args.http_port, tracer=tracer)
+        if ici_enabled:
+            metrics.add_readiness_check("slice-manager", _slice_manager_ready)
         metrics.start()
-        logger.info("metrics on :%d/metrics", metrics.port)
+        logger.info("metrics on :%d/metrics (+/readyz, /debug/traces)",
+                    metrics.port)
 
     client = make_kube_client(
-        args.kubeconfig, qps=args.kube_api_qps, burst=args.kube_api_burst
+        args.kubeconfig, qps=args.kube_api_qps, burst=args.kube_api_burst,
+        registry=registry,
     )
 
     manager = None
-    if "ici" in args.device_classes.split(","):
+    if ici_enabled:
         owner = None
         if args.pod_name and args.pod_uid:
             owner = {
@@ -81,8 +107,16 @@ def main(argv=None) -> int:
                 "name": args.pod_name,
                 "uid": args.pod_uid,
             }
-        manager = IciSliceManager(client, args.driver_name, owner=owner)
+        recorder = EventRecorder(
+            client, component="tpu-dra-controller",
+            namespace=args.namespace, registry=registry,
+        )
+        manager = IciSliceManager(
+            client, args.driver_name, owner=owner,
+            registry=registry, tracer=tracer, events=recorder,
+        )
         manager.start()
+        managed["manager"] = manager
         logger.info("ICI slice manager started")
 
     stop = install_signal_stop()

@@ -29,12 +29,15 @@ import threading
 from typing import Optional
 
 from ..kube.client import NODES, KubeClient, Watch
+from ..kube.events import EventRecorder, ObjectRef
 from ..kube.resourceslice import (
     DriverResources,
     Pool,
     ResourceSliceController,
 )
 from ..tpulib.deviceinfo import IciChannelInfo
+from ..utils.metrics import Counter, Gauge, Histogram, Registry
+from ..utils.tracing import Tracer
 
 logger = logging.getLogger(__name__)
 
@@ -108,6 +111,9 @@ class IciSliceManager:
         driver_name: str = "tpu.google.com",
         owner: Optional[dict] = None,
         resource_api=None,
+        registry: Optional[Registry] = None,
+        tracer: Optional[Tracer] = None,
+        events: Optional[EventRecorder] = None,
     ):
         from ..kube.resourceapi import ResourceApi
 
@@ -116,6 +122,28 @@ class IciSliceManager:
         self.slice_controller = ResourceSliceController(
             client, driver_name, scope=self.SCOPE, owner=owner,
             api=resource_api or ResourceApi.discover(client),
+        )
+        # Reconcile-loop observability — the reference controller emits
+        # nothing per reconcile; a wedged watch or thrashing republish was
+        # invisible until slices went stale.
+        reg = registry if registry is not None else Registry()
+        self.tracer = tracer or Tracer()
+        self.events = events  # Warning on the Node whose event failed
+        self._m_reconcile_seconds = Histogram(
+            "tpu_dra_reconcile_seconds",
+            "Node-event reconcile latency", reg,
+        )
+        self._m_reconciles = Counter(
+            "tpu_dra_reconciles_total",
+            "Node-event reconciles by outcome", reg,
+        )
+        self._m_published_pools = Gauge(
+            "tpu_dra_published_ici_pools",
+            "ICI channel pools currently published as ResourceSlices", reg,
+        )
+        self._m_domain_nodes = Gauge(
+            "tpu_dra_ici_domain_nodes",
+            "Nodes currently labeled into any ICI slice domain", reg,
         )
         self.offsets = OffsetAllocator()
         # DomainKey -> set of node names carrying the label
@@ -222,10 +250,30 @@ class IciSliceManager:
         for ev in self._watch.events():
             if self._stop.is_set():
                 return
+            node_name = (ev.object.get("metadata") or {}).get("name", "")
+            span = self.tracer.span(
+                "reconcile", tags={"event": ev.type, "node": node_name}
+            )
             try:
-                self._handle(ev.type, ev.object)
-            except Exception:
+                with span:
+                    self._handle(ev.type, ev.object)
+                self._m_reconciles.inc(outcome="ok")
+            except Exception as e:
+                self._m_reconciles.inc(outcome="error")
                 logger.exception("error handling node event")
+                if self.events is not None and node_name:
+                    # kubectl describe node must show why this node's
+                    # domain membership failed to reconcile.
+                    self.events.warning(
+                        ObjectRef.node(
+                            node_name,
+                            (ev.object.get("metadata") or {}).get("uid", ""),
+                        ),
+                        "ReconcileFailed",
+                        f"ICI slice reconcile for node event {ev.type} "
+                        f"failed: {e}",
+                    )
+            self._m_reconcile_seconds.observe(span.duration)
 
     def _handle(self, ev_type: str, node: dict) -> None:
         name = node["metadata"]["name"]
@@ -314,6 +362,8 @@ class IciSliceManager:
             if self.offsets.get(key) is None:
                 continue  # not admitted (capacity exhausted)
             pools[key.pool_name] = self._channel_pool(key)
+        self._m_published_pools.set(len(pools))
+        self._m_domain_nodes.set(len(self._node_domain))
         self.slice_controller.update(DriverResources(pools=pools))
 
     # -- introspection -----------------------------------------------------
@@ -321,3 +371,12 @@ class IciSliceManager:
     def domains(self) -> dict[DomainKey, set[str]]:
         with self._lock:
             return {k: set(v) for k, v in self._domains.items()}
+
+    def healthy(self):
+        """Readiness input for /readyz: the reconcile thread must be
+        consuming a live node watch."""
+        if self._thread is None or not self._thread.is_alive():
+            return False, "reconcile thread not running"
+        if self._watch is None or self._watch.stopped:
+            return False, "node watch stopped"
+        return True, "reconciling node events"

@@ -21,6 +21,8 @@ import logging
 import threading
 from typing import Any, Optional
 
+from ..utils.metrics import Counter, Registry
+from ..utils.tracing import child_span
 from .cel import CelError, evaluate as cel_evaluate
 from .client import KubeClient
 from .resourceapi import ResourceApi
@@ -120,19 +122,36 @@ class ReferenceAllocator:
         driver_name: str = "tpu.google.com",
         device_classes: Optional[dict[str, list[str]]] = None,
         resource_api: Optional[ResourceApi] = None,
+        registry: Optional[Registry] = None,
     ):
         """``device_classes`` maps DeviceClass name → CEL selector
         expressions (from the class spec). When given, class membership is
         decided by evaluating those (the production mechanism); otherwise
         the built-in DEVICE_CLASS_TYPES name → type mapping applies.
         ``resource_api`` selects the resource.k8s.io dialect slices are
-        read in (default: discover from the client).
+        read in (default: discover from the client). ``registry`` receives
+        the attempt/backtrack counters (a solver that starts thrashing
+        shows up as a backtrack-rate spike long before latency does).
         """
         self.client = client
         self.driver_name = driver_name
         self.device_classes = device_classes
         self.api = resource_api or ResourceApi.discover(client)
         self._lock = threading.Lock()
+        reg = registry if registry is not None else Registry()
+        self._m_attempts = Counter(
+            "tpu_dra_allocation_attempts_total",
+            "Claim allocation attempts by result",
+            reg,
+        )
+        self._m_backtracks = Counter(
+            "tpu_dra_allocation_backtracks_total",
+            "Device picks undone by the allocation solver",
+            reg,
+        )
+        # Steps undone during the current solve; folded into the counter
+        # once per allocate() (all access is under self._lock).
+        self._backtrack_steps = 0
         # (pool, device) -> claim uid holding it
         self._reservations: dict[tuple[str, str], str] = {}
         # (pool, counter set, counter) -> amount consumed by reservations.
@@ -229,16 +248,31 @@ class ReferenceAllocator:
         # they may land on reserved devices and neither reserve nor consume
         # counters themselves.
         admin_reqs = {r["name"] for r in requests if r.get("adminAccess")}
-        with self._lock:
+        with self._lock, child_span(
+            "allocator/allocate",
+            claim_uid=claim.get("metadata", {}).get("uid", ""),
+        ) as sp:
             devices, capacity = self._inventory()
             inventory = [
                 d
                 for d in devices
                 if (not node_name or not d["node"] or d["node"] == node_name)
             ]
-            results, picked_devs = self._solve(
-                requests, constraints, selectors, inventory, capacity
-            )
+            self._backtrack_steps = 0
+            try:
+                results, picked_devs = self._solve(
+                    requests, constraints, selectors, inventory, capacity
+                )
+            except Exception as e:
+                self._m_attempts.inc(result="error")
+                sp.set_error(str(e))
+                raise
+            finally:
+                if self._backtrack_steps:
+                    self._m_backtracks.inc(self._backtrack_steps)
+                sp.set_tag("backtracks", self._backtrack_steps)
+            self._m_attempts.inc(result="ok")
+            sp.set_tag("devices", len(picked_devs))
             uid = claim["metadata"]["uid"]
             for r, d in zip(results, picked_devs):
                 if r["request"] in admin_reqs:
@@ -428,6 +462,7 @@ class ReferenceAllocator:
                         return True
                     for _ in chosen:
                         picked.pop()
+                    self._backtrack_steps += len(chosen)
                     return False
                 start = cands.index(chosen[-1]) + 1 if chosen else 0
                 for d in cands[start:]:
@@ -449,6 +484,7 @@ class ReferenceAllocator:
                     if not admin:
                         unconsume(d)
                     chosen.pop()
+                    self._backtrack_steps += 1
                 return False
 
             return pick_n([])

@@ -21,6 +21,7 @@ from __future__ import annotations
 import abc
 import copy
 import dataclasses
+import http.client
 import itertools
 import json
 import logging
@@ -569,11 +570,28 @@ class RealKubeClient(KubeClient):
         watch_mode: str = "stream",
         list_page_size: int = 500,
         overload_retries: int = 4,
+        registry=None,
     ):
         if watch_mode not in ("stream", "poll"):
             raise ValueError(
                 f"watch_mode must be 'stream' or 'poll', got {watch_mode!r}"
             )
+        # API-traffic metrics (client-go rest_client_requests_total analog);
+        # a throwaway registry when none is given keeps call sites branchless.
+        from ..utils.metrics import Counter, Registry
+
+        reg = registry if registry is not None else Registry()
+        self._m_requests = Counter(
+            "tpu_dra_kube_api_requests_total",
+            "Kubernetes API requests by verb and outcome code",
+            reg,
+        )
+        self._m_retries = Counter(
+            "tpu_dra_kube_api_retries_total",
+            "Kubernetes API retries by trigger (overload code, reauth, "
+            "watch reconnect)",
+            reg,
+        )
         self.config = config or RestConfig.auto()
         self.poll_interval = poll_interval
         self.watch_mode = watch_mode
@@ -757,8 +775,11 @@ class RealKubeClient(KubeClient):
         reauthed = False
         while True:
             try:
-                return self._request_once(method, url, body)
+                out = self._request_once(method, url, body)
+                self._m_requests.inc(verb=method, code="2xx")
+                return out
             except ApiError as e:
+                self._m_requests.inc(verb=method, code=str(e.code))
                 if (
                     e.code == 401
                     and self.config.exec_auth is not None
@@ -773,6 +794,7 @@ class RealKubeClient(KubeClient):
                         method, url.split("?")[0],
                     )
                     self._force_refresh_exec()
+                    self._m_retries.inc(reason="reauth")
                     continue
                 if (
                     e.code not in (429, 503)
@@ -780,6 +802,7 @@ class RealKubeClient(KubeClient):
                 ):
                     raise
                 attempts += 1
+                self._m_retries.inc(reason=str(e.code))
                 delay = e.retry_after if e.retry_after is not None else min(
                     0.5 * (2 ** attempts), 10.0
                 )
@@ -806,7 +829,15 @@ class RealKubeClient(KubeClient):
                 payload = resp.read()
                 return json.loads(payload) if payload else {}
         except urllib.error.HTTPError as e:
-            msg = e.read().decode(errors="replace")
+            try:
+                msg = e.read().decode(errors="replace")
+            except (OSError, http.client.HTTPException):
+                # The server reset (ConnectionResetError) or truncated
+                # (IncompleteRead) the connection while we drained the
+                # error body; the status code alone still types the error —
+                # surfacing the read failure here would turn a clean 404
+                # into an untyped crash.
+                msg = ""
             if e.code == 404:
                 raise NotFoundError(msg) from e
             if e.code == 409:
@@ -998,6 +1029,7 @@ class RealKubeClient(KubeClient):
                     if w.stopped:
                         break
                     delay = backoff.next_delay()
+                    self._m_retries.inc(reason="watch-reconnect")
                     logger.warning(
                         "watch stream %s failed (%s); reconnecting in %.1fs",
                         gvr.resource, e, delay,

@@ -19,6 +19,7 @@ import json
 import os
 
 from ..utils.fs import atomic_write_json
+from ..utils.tracing import child_span
 
 CHECKPOINT_VERSION = "v1"
 
@@ -65,10 +66,12 @@ class CheckpointManager:
         return payload["preparedClaims"]
 
     def write(self, prepared_claims: dict[str, dict]) -> None:
-        payload = {
-            "version": CHECKPOINT_VERSION,
-            "preparedClaims": prepared_claims,
-            "checksum": "",
-        }
-        payload["checksum"] = _checksum(payload)
-        atomic_write_json(self.path, payload, indent=1)
+        with child_span("checkpoint-write") as sp:
+            sp.set_tag("claims", len(prepared_claims))
+            payload = {
+                "version": CHECKPOINT_VERSION,
+                "preparedClaims": prepared_claims,
+                "checksum": "",
+            }
+            payload["checksum"] = _checksum(payload)
+            atomic_write_json(self.path, payload, indent=1)

@@ -18,11 +18,14 @@ from typing import Optional
 
 from ..cdi.spec import CDIHandler
 from ..kube.client import KubeClient
+from ..kube.events import EventRecorder, ObjectRef
 from ..kube.protos import dra_v1alpha4_pb2 as drapb
 from ..kube.resourceapi import ResourceApi
 from ..kube.resourceslice import DriverResources, Pool
 from ..tpulib.chiplib import ChipLib
+from ..utils import tracing
 from ..utils.metrics import Counter, Histogram, Registry
+from ..utils.tracing import Tracer
 from .checkpoint import CheckpointManager
 from .device_state import DeviceState
 from .grpc_services import NodeServicer
@@ -81,7 +84,8 @@ class Driver(NodeServicer):
     # Floor between NotFound-triggered dialect re-discoveries (_fetch_claim).
     REDISCOVER_INTERVAL_S = 30.0
 
-    def __init__(self, config: DriverConfig, registry: Optional[Registry] = None):
+    def __init__(self, config: DriverConfig, registry: Optional[Registry] = None,
+                 tracer: Optional[Tracer] = None):
         self.config = config
         self.resource_api = config.resource_api or ResourceApi.discover(
             config.kube_client
@@ -91,11 +95,20 @@ class Driver(NodeServicer):
         # Node-plugin metrics — a gap in the reference, whose plugin exposes
         # none (SURVEY.md §5).
         self.registry = registry or Registry()
+        # Claim-lifecycle tracing: one root span per DRA RPC (wired into the
+        # gRPC layer via KubeletPlugin), child spans per prepare stage.
+        self.tracer = tracer or Tracer()
         self._m_prepares = Counter(
-            "tpu_dra_claim_prepares_total", "Claim prepare attempts", self.registry
+            "tpu_dra_claim_prepare_attempts_total", "Claim prepare attempts",
+            self.registry,
         )
+        self.registry.alias("tpu_dra_claim_prepares_total", self._m_prepares)
         self._m_unprepares = Counter(
-            "tpu_dra_claim_unprepares_total", "Claim unprepare attempts", self.registry
+            "tpu_dra_claim_unprepare_attempts_total",
+            "Claim unprepare attempts", self.registry,
+        )
+        self.registry.alias(
+            "tpu_dra_claim_unprepares_total", self._m_unprepares
         )
         self._m_prepare_latency = Histogram(
             "tpu_dra_claim_prepare_seconds", "Prepare latency", self.registry
@@ -105,6 +118,16 @@ class Driver(NodeServicer):
             "Device inventory changes republished",
             self.registry,
         )
+        # Failures (and recoveries) become kubectl-visible Events on the
+        # ResourceClaim; no-op without a kube client.
+        self.events = EventRecorder(
+            config.kube_client,
+            component=f"tpu-dra-plugin/{config.node_name}",
+            registry=self.registry,
+        )
+        # Readiness inputs: monotonic time of the last successful inventory
+        # enumeration (the DeviceState constructor below does the first).
+        self._last_inventory_ok = time.monotonic()
         self.state = DeviceState(
             chiplib=config.chiplib,
             cdi=CDIHandler(
@@ -129,6 +152,7 @@ class Driver(NodeServicer):
             node_uid=config.node_uid,
             registration_versions=list(config.registration_versions),
             resource_api=self.resource_api,
+            tracer=self.tracer,
         )
 
     def start(self) -> None:
@@ -201,11 +225,17 @@ class Driver(NodeServicer):
             if self._watch_stop.is_set():
                 break
             try:
-                if self.state.refresh_allocatable():
-                    self._m_inventory_refreshes.inc()
-                    logger.info("device inventory changed; republishing")
-                    if self.config.kube_client is not None:
-                        self.publish_resources()
+                changed = self.state.refresh_allocatable()
+                self._last_inventory_ok = time.monotonic()
+                if changed:
+                    # Trace only actual inventory changes: a root trace per
+                    # idle 30s tick would evict the claim traces the ring
+                    # buffer exists to keep.
+                    with self.tracer.span("inventory-refresh"):
+                        self._m_inventory_refreshes.inc()
+                        logger.info("device inventory changed; republishing")
+                        if self.config.kube_client is not None:
+                            self.publish_resources()
             except Exception:
                 logger.exception("device inventory refresh failed")
 
@@ -235,6 +265,48 @@ class Driver(NodeServicer):
         )
 
     # ------------------------------------------------------------------
+    # Readiness (consumed by MetricsServer.add_readiness_check)
+    # ------------------------------------------------------------------
+
+    def readiness_checks(self) -> dict:
+        """Named readiness probes for /readyz: serving ∧ fresh inventory ∧
+        writable checkpoint. A plugin failing any of these can still be
+        alive (liveness stays green) but must stop advertising ready."""
+        return {
+            "grpc-serving": self._check_grpc_serving,
+            "inventory-fresh": self._check_inventory_fresh,
+            "checkpoint-writable": self._check_checkpoint_writable,
+        }
+
+    def _check_grpc_serving(self):
+        if self.plugin.serving:
+            return True, "dra socket serving"
+        return False, "DRA gRPC server not started"
+
+    def _check_inventory_fresh(self):
+        interval = self.config.device_watch_interval_seconds
+        if interval <= 0:
+            return True, "device watch disabled"
+        age = time.monotonic() - self._last_inventory_ok
+        # Three missed resync rounds (plus debounce slack) means the watch
+        # loop is wedged or enumeration keeps failing.
+        limit = max(3 * interval, 90.0)
+        if age <= limit:
+            return True, f"last refresh {age:.0f}s ago"
+        return False, f"inventory stale: last refresh {age:.0f}s ago"
+
+    def _check_checkpoint_writable(self):
+        import os
+
+        # atomic_write_json writes a temp file beside the checkpoint and
+        # renames it over; only DIRECTORY writability matters — the
+        # existing file's own mode bits never gate a write.
+        probe = os.path.dirname(self.state.checkpoint.path)
+        if os.access(probe, os.W_OK):
+            return True, "checkpoint writable"
+        return False, f"checkpoint dir not writable: {probe}"
+
+    # ------------------------------------------------------------------
     # DRA node service (driver.go:94-152)
     # ------------------------------------------------------------------
 
@@ -246,18 +318,53 @@ class Driver(NodeServicer):
 
     def _prepare_claim(self, claim) -> drapb.NodePrepareResourceResponse:
         """nodePrepareResource analog (driver.go:116-139): per-claim errors
-        are returned in-band, never raised."""
-        with self._lock, self._m_prepare_latency.time():
-            try:
-                resource_claim = self._fetch_claim(claim)
-                devices = self.state.prepare(resource_claim)
-                self._m_prepares.inc(result="ok")
-            except Exception as e:
+        are returned in-band, never raised. The whole operation runs under
+        a claim-UID-tagged span (child of the RPC root span); its duration
+        feeds the prepare-latency histogram, so traces and metrics time
+        the same interval."""
+        claim_ref = ObjectRef.claim(
+            claim.name, claim.namespace, claim.uid,
+            api_version=self.resource_api.api_version,
+        )
+        with self._lock:
+            span = self.tracer.span(
+                "prepare", claim_uid=claim.uid,
+                tags={"claim": f"{claim.namespace}/{claim.name}"},
+            )
+            error: Optional[Exception] = None
+            with span:
+                try:
+                    with tracing.child_span("fetch-claim"):
+                        resource_claim = self._fetch_claim(claim)
+                    with tracing.child_span("allocate"):
+                        devices = self.state.prepare(resource_claim)
+                    logger.debug(
+                        "prepared claim %s: %d device(s)",
+                        claim.uid, len(devices),
+                    )
+                except Exception as e:
+                    error = e
+                    span.set_error(str(e))
+            self._m_prepare_latency.observe(span.duration)
+            if error is not None:
                 self._m_prepares.inc(result="error")
-                logger.exception("prepare of claim %s failed", claim.uid)
-                return drapb.NodePrepareResourceResponse(
-                    error=f"error preparing devices for claim {claim.uid}: {e}"
+                logger.error("prepare of claim %s failed", claim.uid,
+                             exc_info=error)
+                self.events.warning(
+                    claim_ref, "PrepareFailed",
+                    f"preparing devices on {self.config.node_name} failed: "
+                    f"{error}",
                 )
+                return drapb.NodePrepareResourceResponse(
+                    error=f"error preparing devices for claim {claim.uid}: "
+                          f"{error}"
+                )
+            self._m_prepares.inc(result="ok")
+            self.events.normal(
+                claim_ref, "Prepared",
+                f"prepared {len(devices)} device(s) on "
+                f"{self.config.node_name}",
+            )
             return drapb.NodePrepareResourceResponse(
                 devices=[
                     drapb.Device(
@@ -322,18 +429,32 @@ class Driver(NodeServicer):
         response = drapb.NodeUnprepareResourcesResponse()
         for claim in request.claims:
             with self._lock:
-                try:
-                    self.state.unprepare(claim.uid)
-                    self._m_unprepares.inc(result="ok")
-                    response.claims[claim.uid].CopyFrom(
-                        drapb.NodeUnprepareResourceResponse()
-                    )
-                except Exception as e:
-                    self._m_unprepares.inc(result="error")
-                    logger.exception("unprepare of claim %s failed", claim.uid)
-                    response.claims[claim.uid].CopyFrom(
-                        drapb.NodeUnprepareResourceResponse(
-                            error=f"error unpreparing claim {claim.uid}: {e}"
+                with self.tracer.span("unprepare",
+                                      claim_uid=claim.uid) as span:
+                    try:
+                        self.state.unprepare(claim.uid)
+                        self._m_unprepares.inc(result="ok")
+                        response.claims[claim.uid].CopyFrom(
+                            drapb.NodeUnprepareResourceResponse()
                         )
-                    )
+                    except Exception as e:
+                        span.set_error(str(e))
+                        self._m_unprepares.inc(result="error")
+                        logger.exception("unprepare of claim %s failed",
+                                         claim.uid)
+                        self.events.warning(
+                            ObjectRef.claim(
+                                claim.name, claim.namespace, claim.uid,
+                                api_version=self.resource_api.api_version,
+                            ),
+                            "UnprepareFailed",
+                            f"unpreparing on {self.config.node_name} "
+                            f"failed: {e}",
+                        )
+                        response.claims[claim.uid].CopyFrom(
+                            drapb.NodeUnprepareResourceResponse(
+                                error=f"error unpreparing claim "
+                                      f"{claim.uid}: {e}"
+                            )
+                        )
         return response

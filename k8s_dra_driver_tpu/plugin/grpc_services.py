@@ -11,12 +11,12 @@ dra/v1alpha4/api.proto and pluginregistration/v1/api.proto).
 from __future__ import annotations
 
 import logging
-import time
 
 import grpc
 
 from ..kube.protos import dra_v1alpha4_pb2 as drapb
 from ..kube.protos import pluginregistration_v1_pb2 as regpb
+from ..utils.tracing import Span, Tracer
 
 logger = logging.getLogger(__name__)
 
@@ -38,26 +38,34 @@ def _claim_uids(request) -> str:
     return ",".join(c.uid for c in claims)
 
 
-def _logged(service: str, method: str, fn):
-    """Per-RPC call logging at debug verbosity: method, claim UIDs, and
-    latency — the signal needed to debug a misbehaving kubelet. The
-    vendored reference framework logs every DRA RPC the same way at
+def _traced(service: str, method: str, fn, tracer: Tracer | None = None):
+    """Per-RPC root span + call logging at debug verbosity: method, claim
+    UIDs, and latency — the signal needed to debug a misbehaving kubelet.
+    The vendored reference framework logs every DRA RPC the same way at
     verbosity >=4 (vendor/k8s.io/dynamic-resource-allocation/
-    kubeletplugin/draplugin.go:89-94)."""
+    kubeletplugin/draplugin.go:89-94); here the timing is span-backed so
+    the same interval feeds logs, traces, and latency histograms. Without
+    a tracer the span is a no-op that still measures duration."""
 
     def wrapper(request, context):
-        start = time.monotonic()
-        logger.debug("gRPC %s/%s called: claims=%s",
-                     service, method, _claim_uids(request))
+        uids = _claim_uids(request)
+        logger.debug("gRPC %s/%s called: claims=%s", service, method, uids)
+        span = (
+            tracer.span(f"rpc/{method}",
+                        claim_uid=uids if uids != "-" else "",
+                        tags={"service": service})
+            if tracer is not None
+            else Span(None, f"rpc/{method}")
+        )
         try:
-            response = fn(request, context)
+            with span:
+                response = fn(request, context)
         except Exception as e:
             logger.debug("gRPC %s/%s failed after %.1fms: %s",
-                         service, method,
-                         (time.monotonic() - start) * 1e3, e)
+                         service, method, span.duration * 1e3, e)
             raise
         logger.debug("gRPC %s/%s succeeded in %.1fms",
-                     service, method, (time.monotonic() - start) * 1e3)
+                     service, method, span.duration * 1e3)
         return response
 
     return wrapper
@@ -78,18 +86,20 @@ class NodeServicer:
         raise NotImplementedError
 
 
-def add_node_servicer_to_server(servicer: NodeServicer, server: grpc.Server) -> None:
+def add_node_servicer_to_server(
+    servicer: NodeServicer, server: grpc.Server, tracer: Tracer | None = None
+) -> None:
     for service_name in DRA_SERVICE_NAMES:
         handlers = {
             "NodePrepareResources": grpc.unary_unary_rpc_method_handler(
-                _logged(service_name, "NodePrepareResources",
-                        servicer.NodePrepareResources),
+                _traced(service_name, "NodePrepareResources",
+                        servicer.NodePrepareResources, tracer),
                 request_deserializer=drapb.NodePrepareResourcesRequest.FromString,
                 response_serializer=drapb.NodePrepareResourcesResponse.SerializeToString,
             ),
             "NodeUnprepareResources": grpc.unary_unary_rpc_method_handler(
-                _logged(service_name, "NodeUnprepareResources",
-                        servicer.NodeUnprepareResources),
+                _traced(service_name, "NodeUnprepareResources",
+                        servicer.NodeUnprepareResources, tracer),
                 request_deserializer=drapb.NodeUnprepareResourcesRequest.FromString,
                 response_serializer=drapb.NodeUnprepareResourcesResponse.SerializeToString,
             ),
@@ -139,12 +149,12 @@ def add_registration_servicer_to_server(
 ) -> None:
     handlers = {
         "GetInfo": grpc.unary_unary_rpc_method_handler(
-            _logged(REGISTRATION_SERVICE_NAME, "GetInfo", servicer.GetInfo),
+            _traced(REGISTRATION_SERVICE_NAME, "GetInfo", servicer.GetInfo),
             request_deserializer=regpb.InfoRequest.FromString,
             response_serializer=regpb.PluginInfo.SerializeToString,
         ),
         "NotifyRegistrationStatus": grpc.unary_unary_rpc_method_handler(
-            _logged(REGISTRATION_SERVICE_NAME, "NotifyRegistrationStatus",
+            _traced(REGISTRATION_SERVICE_NAME, "NotifyRegistrationStatus",
                     servicer.NotifyRegistrationStatus),
             request_deserializer=regpb.RegistrationStatus.FromString,
             response_serializer=regpb.RegistrationStatusResponse.SerializeToString,

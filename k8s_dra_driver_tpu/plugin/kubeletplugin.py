@@ -105,6 +105,7 @@ class KubeletPlugin:
         node_uid: str = "",
         registration_versions: Optional[list[str]] = None,
         resource_api=None,
+        tracer=None,
     ):
         self.node_server = node_server
         self.driver_name = driver_name
@@ -119,6 +120,7 @@ class KubeletPlugin:
         self.registration_versions = list(
             registration_versions or [REGISTRATION_VERSION]
         )
+        self.tracer = tracer  # root spans for every DRA RPC when set
         self._dra_server: Optional[grpc.Server] = None
         self._reg_server: Optional[grpc.Server] = None
         self._slice_controller: Optional[ResourceSliceController] = None
@@ -130,7 +132,9 @@ class KubeletPlugin:
     def start(self) -> None:
         self._dra_server = _serve_uds(
             self.plugin_socket,
-            lambda s: add_node_servicer_to_server(self.node_server, s),
+            lambda s: add_node_servicer_to_server(
+                self.node_server, s, tracer=self.tracer
+            ),
         )
         self._reg_server = _serve_uds(
             self.registrar_socket,
@@ -182,6 +186,11 @@ class KubeletPlugin:
                 )
                 self._slice_controller.start()
             self._slice_controller.update(resources)
+
+    @property
+    def serving(self) -> bool:
+        """Whether the DRA gRPC server is up (readiness input)."""
+        return self._dra_server is not None
 
     def registration_status(self) -> Optional[dict]:
         return self._registration_status

@@ -89,9 +89,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "(multi-node kind, the nvkind analog) [FAKE_HOSTS]")
     p.add_argument("--http-port", type=int, default=int(_env("HTTP_PORT", "0")),
                    help="metrics/health endpoint port; 0 disables [HTTP_PORT]")
-    p.add_argument("--log-level", default=_env("LOG_LEVEL", "INFO"))
+    p.add_argument("--log-level", default=_env("LOG_LEVEL", ""),
+                   help="log level; empty falls back to TPU_DRA_LOG_LEVEL "
+                        "then INFO [LOG_LEVEL]")
     p.add_argument("--log-json", action="store_true",
-                   help="structured JSON logs [LOG_JSON]")
+                   help="structured JSON logs (TPU_DRA_LOG_FORMAT=json "
+                        "is the env equivalent) [LOG_JSON]")
     return p
 
 
@@ -229,18 +232,23 @@ def lookup_fake_host_id(
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     from ..utils.logging import setup_logging
+    from ..utils.metrics import Registry
 
-    setup_logging(level=args.log_level, json_format=args.log_json)
+    # None lets the TPU_DRA_LOG_* env overrides apply; an explicit flag wins.
+    setup_logging(level=args.log_level or None,
+                  json_format=True if args.log_json else None)
     if not args.node_name:
         logger.error("--node-name (or NODE_NAME) is required")
         return 2
 
+    registry = Registry()
     kube_client = None
     node_obj = None
     node_uid = ""
     if not args.no_kube:
         kube_client = make_kube_client(
-            args.kubeconfig, qps=args.kube_api_qps, burst=args.kube_api_burst
+            args.kubeconfig, qps=args.kube_api_qps, burst=args.kube_api_burst,
+            registry=registry,
         )
         node_obj = fetch_node(kube_client, args.node_name)
         node_uid = lookup_node_uid(node_obj, args.node_name)
@@ -279,15 +287,19 @@ def main(argv=None) -> int:
             args.plugin_api_versions, node_obj, args.node_name
         ),
     )
-    driver = Driver(config)
+    driver = Driver(config, registry=registry)
     driver.start()
     metrics = None
     if args.http_port:
         from ..utils.metrics import MetricsServer
 
-        metrics = MetricsServer(driver.registry, port=args.http_port)
+        metrics = MetricsServer(driver.registry, port=args.http_port,
+                                tracer=driver.tracer)
+        for name, check in driver.readiness_checks().items():
+            metrics.add_readiness_check(name, check)
         metrics.start()
-        logger.info("metrics on :%d/metrics", metrics.port)
+        logger.info("metrics on :%d/metrics (+/readyz, /debug/traces)",
+                    metrics.port)
     logger.info(
         "tpu-dra-plugin started: node=%s devices=%d",
         args.node_name,

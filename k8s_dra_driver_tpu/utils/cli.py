@@ -16,10 +16,12 @@ def env(name: str, default: str = "") -> str:
     return os.environ.get(name, default)
 
 
-def make_kube_client(kubeconfig: str = "", qps: float = 5.0, burst: int = 10):
+def make_kube_client(kubeconfig: str = "", qps: float = 5.0, burst: int = 10,
+                     registry=None):
     """In-cluster config unless a kubeconfig is given
     (NewClientSets analog, pkg/flags/kubeclient.go:70-106; QPS/burst
-    defaults mirror kubeclient.go:49-64)."""
+    defaults mirror kubeclient.go:49-64). ``registry`` receives the
+    client's API-request/retry counters when given."""
     from ..kube.client import RealKubeClient, RestConfig
 
     cfg = (
@@ -27,7 +29,7 @@ def make_kube_client(kubeconfig: str = "", qps: float = 5.0, burst: int = 10):
         if kubeconfig
         else RestConfig.auto()
     )
-    return RealKubeClient(cfg, qps=qps, burst=burst)
+    return RealKubeClient(cfg, qps=qps, burst=burst, registry=registry)
 
 
 def add_kube_client_flags(parser) -> None:

@@ -5,55 +5,108 @@ cmd/nvidia-dra-controller/main.go:194-241: prometheus handler + pprof mux) —
 extended to the node plugin too, which in the reference exposes no metrics
 at all (SURVEY.md §5 gap). stdlib-only: a tiny registry rendering the
 Prometheus text exposition format, served by http.server.
+
+Conventions enforced here (and by ``tools/lint.py`` / ``make
+verify-metrics``): metric names must match the exposition-format name
+grammar, first-party metrics carry the ``tpu_dra_`` prefix and a unit
+suffix, label values are escaped per the text-format spec, and non-finite
+values render as ``+Inf``/``-Inf``/``NaN`` (``repr(inf)`` is not parseable
+by Prometheus). Renamed metrics keep their old name rendering for one
+release via ``Registry.alias`` with a ``(deprecated)`` HELP marker.
 """
 
 from __future__ import annotations
 
+import math
+import re
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Callable, Optional
+
+# Prometheus text-exposition grammars (data model spec): metric names admit
+# colons (recording rules); label names do not.
+_METRIC_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
+_LABEL_NAME_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*\Z")
+
+
+def _validate_metric_name(name: str) -> str:
+    if not _METRIC_NAME_RE.match(name):
+        raise ValueError(
+            f"invalid metric name {name!r}: must match "
+            "[a-zA-Z_:][a-zA-Z0-9_:]*"
+        )
+    return name
+
+
+def _validate_label_names(labels: dict) -> None:
+    for k in labels:
+        if not _LABEL_NAME_RE.match(k):
+            raise ValueError(
+                f"invalid label name {k!r}: must match "
+                "[a-zA-Z_][a-zA-Z0-9_]*"
+            )
 
 
 class Counter:
     def __init__(self, name: str, help_: str, registry: "Registry"):
-        self.name = name
+        self.name = _validate_metric_name(name)
         self.help = help_
+        self.type = "counter"
         self._values: dict[tuple, float] = {}
         self._lock = threading.Lock()
         registry._register(self)
 
     def inc(self, amount: float = 1.0, **labels) -> None:
+        _validate_label_names(labels)
         key = tuple(sorted(labels.items()))
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
 
+    def value(self, **labels) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
     def render(self) -> list[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        return self.render_as(self.name, self.help)
+
+    def render_as(self, name: str, help_: str) -> list[str]:
+        out = [f"# HELP {name} {help_}", f"# TYPE {name} counter"]
         with self._lock:
             for key, val in sorted(self._values.items()):
-                out.append(f"{self.name}{_labels(key)} {_num(val)}")
+                out.append(f"{name}{_labels(key)} {_num(val)}")
         return out
 
 
 class Gauge:
     def __init__(self, name: str, help_: str, registry: "Registry"):
-        self.name = name
+        self.name = _validate_metric_name(name)
         self.help = help_
+        self.type = "gauge"
         self._values: dict[tuple, float] = {}
         self._lock = threading.Lock()
         registry._register(self)
 
     def set(self, value: float, **labels) -> None:
+        _validate_label_names(labels)
         key = tuple(sorted(labels.items()))
         with self._lock:
             self._values[key] = value
 
+    def value(self, **labels) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
     def render(self) -> list[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        return self.render_as(self.name, self.help)
+
+    def render_as(self, name: str, help_: str) -> list[str]:
+        out = [f"# HELP {name} {help_}", f"# TYPE {name} gauge"]
         with self._lock:
             for key, val in sorted(self._values.items()):
-                out.append(f"{self.name}{_labels(key)} {_num(val)}")
+                out.append(f"{name}{_labels(key)} {_num(val)}")
         return out
 
 
@@ -64,8 +117,9 @@ class Histogram:
 
     def __init__(self, name: str, help_: str, registry: "Registry",
                  buckets=DEFAULT_BUCKETS):
-        self.name = name
+        self.name = _validate_metric_name(name)
         self.help = help_
+        self.type = "histogram"
         self.buckets = tuple(sorted(buckets))
         self._counts = [0] * (len(self.buckets) + 1)
         self._sum = 0.0
@@ -98,38 +152,81 @@ class Histogram:
         return _Timer()
 
     def render(self) -> list[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        return self.render_as(self.name, self.help)
+
+    def render_as(self, name: str, help_: str) -> list[str]:
+        out = [f"# HELP {name} {help_}", f"# TYPE {name} histogram"]
         with self._lock:
             cum = 0
             for i, b in enumerate(self.buckets):
                 cum += self._counts[i]
-                out.append(f'{self.name}_bucket{{le="{_num(b)}"}} {cum}')
+                out.append(f'{name}_bucket{{le="{_num(b)}"}} {cum}')
             cum += self._counts[-1]
-            out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
-            out.append(f"{self.name}_sum {_num(self._sum)}")
-            out.append(f"{self.name}_count {self._n}")
+            out.append(f'{name}_bucket{{le="+Inf"}} {cum}')
+            out.append(f"{name}_sum {_num(self._sum)}")
+            out.append(f"{name}_count {self._n}")
         return out
+
+
+def _escape_label_value(v) -> str:
+    """Text-format label-value escaping: backslash, double-quote and
+    newline must be escaped or the scrape line is unparseable."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
 
 
 def _labels(key: tuple) -> str:
     if not key:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in key)
     return "{" + inner + "}"
 
 
 def _num(v: float) -> str:
-    return str(int(v)) if float(v).is_integer() else repr(float(v))
+    f = float(v)
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if math.isnan(f):
+        return "NaN"
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+class _DeprecatedAlias:
+    """Renders a metric once more under its pre-rename name, HELP-marked
+    deprecated, so dashboards survive one release of the rename
+    (docs/migration.md records the mapping)."""
+
+    def __init__(self, old_name: str, metric):
+        self.name = _validate_metric_name(old_name)
+        self.metric = metric
+
+    def render(self) -> list[str]:
+        return self.metric.render_as(
+            self.name,
+            f"{self.metric.help} (deprecated; renamed to {self.metric.name})",
+        )
 
 
 class Registry:
     def __init__(self):
         self._metrics: list = []
+        self._names: set[str] = set()
         self._lock = threading.Lock()
 
     def _register(self, metric) -> None:
         with self._lock:
+            if metric.name in self._names:
+                raise ValueError(f"duplicate metric name {metric.name!r}")
+            self._names.add(metric.name)
             self._metrics.append(metric)
+
+    def alias(self, old_name: str, metric) -> None:
+        """Keep ``old_name`` rendering (deprecated) for a renamed metric."""
+        self._register(_DeprecatedAlias(old_name, metric))
 
     def render(self) -> str:
         lines: list[str] = []
@@ -186,14 +283,27 @@ def _sample_profile(seconds: float, hz: float = 100.0) -> str:
 
 
 class MetricsServer:
-    """/metrics + /healthz + /version + /debug/{stacks,profile} on a
-    background HTTP server (SetupHTTPEndpoint analog, main.go:194-241,
-    incl. the pprof mux at main.go:216-224)."""
+    """/metrics + /healthz + /readyz + /version + /debug/{stacks,profile,
+    traces} on a background HTTP server (SetupHTTPEndpoint analog,
+    main.go:194-241, incl. the pprof mux at main.go:216-224).
 
-    def __init__(self, registry: Registry, host: str = "0.0.0.0", port: int = 0):
+    ``/healthz`` is liveness: the process flag flipped by ``set_healthy``.
+    ``/readyz`` is readiness: every check registered with
+    ``add_readiness_check`` must pass (the DaemonSet/Deployment
+    readinessProbe target — a plugin whose gRPC socket is down or whose
+    checkpoint dir is read-only must stop advertising ready, not die).
+    ``/debug/traces`` streams the tracer's finished claim traces as JSONL.
+    """
+
+    def __init__(self, registry: Registry, host: str = "0.0.0.0",
+                 port: int = 0, tracer=None):
         self.registry = registry
+        self.tracer = tracer
         registry_ref = registry
         health = self._health = {"ok": True}
+        self._ready_checks: dict[str, Callable] = {}
+        self._ready_lock = threading.Lock()
+        server_ref = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):
@@ -205,11 +315,22 @@ class MetricsServer:
                     body = (b"ok" if health["ok"] else b"unhealthy")
                     status = 200 if health["ok"] else 503
                     ctype = "text/plain"
+                elif self.path == "/readyz":
+                    body, status = server_ref._render_readiness()
+                    ctype = "text/plain"
                 elif self.path == "/version":
                     from ..version import version_string
 
                     body = (version_string() + "\n").encode()
                     ctype = "text/plain"
+                elif self.path == "/debug/traces":
+                    if server_ref.tracer is None:
+                        body = b"tracing not enabled\n"
+                        status = 404
+                        ctype = "text/plain"
+                    else:
+                        body = server_ref.tracer.export_jsonl().encode()
+                        ctype = "application/x-ndjson"
                 elif self.path == "/debug/stacks":
                     body = _dump_stacks().encode()
                     ctype = "text/plain"
@@ -254,6 +375,36 @@ class MetricsServer:
 
     def set_healthy(self, ok: bool) -> None:
         self._health["ok"] = ok
+
+    def add_readiness_check(self, name: str, check: Callable) -> None:
+        """Register a readiness check. ``check()`` returns ``(ok, detail)``
+        (a bare bool is accepted). A check that raises reads as not-ready
+        with the exception as the detail — readiness must fail closed.
+        Safe to call after ``start()`` (late registration during wiring)."""
+        with self._ready_lock:
+            self._ready_checks[name] = check
+
+    def _render_readiness(self) -> tuple[bytes, int]:
+        lines = []
+        all_ok = self._health["ok"]
+        if not self._health["ok"]:
+            lines.append("[-] healthz: unhealthy")
+        with self._ready_lock:
+            checks = sorted(self._ready_checks.items())
+        for name, check in checks:
+            try:
+                result = check()
+            except Exception as e:
+                result = (False, f"check raised: {e}")
+            if isinstance(result, tuple):
+                ok, detail = result
+            else:
+                ok, detail = bool(result), ""
+            all_ok = all_ok and ok
+            mark = "+" if ok else "-"
+            lines.append(f"[{mark}] {name}" + (f": {detail}" if detail else ""))
+        lines.append("ready" if all_ok else "not ready")
+        return ("\n".join(lines) + "\n").encode(), (200 if all_ok else 503)
 
     def stop(self) -> None:
         self._server.shutdown()

@@ -170,3 +170,42 @@ class TestAllocatorScale:
         assert len(
             with_hole["status"]["allocation"]["devices"]["results"]
         ) == 4
+
+    def test_attempt_and_backtrack_metrics(self):
+        """A registry-attached allocator (the tools/sim_check_allocation.py
+        wiring) reports solve outcomes and solver thrash on /metrics."""
+        from k8s_dra_driver_tpu.utils.metrics import Registry
+
+        client = FakeKubeClient()
+        publish_cluster(client)
+        registry = Registry()
+        alloc = ReferenceAllocator(client, driver_name=DRIVER,
+                                   registry=registry)
+        for i in range(16):
+            alloc.allocate(gang_claim(f"uid-{i:02d}", 4))
+        with pytest.raises(AllocationError):
+            alloc.allocate(gang_claim("uid-full", 4))
+        text = registry.render()
+        assert 'tpu_dra_allocation_attempts_total{result="ok"} 16' in text
+        assert 'tpu_dra_allocation_attempts_total{result="error"} 1' in text
+        assert "tpu_dra_allocation_backtracks_total" in text
+
+        # Backtrack accounting: a 2-chip gang restricted to two opposite
+        # corners of the mesh forces the solver to try and undo the
+        # non-contiguous pair before giving up.
+        from k8s_dra_driver_tpu.kube.allocator import Selector
+
+        frag = ReferenceAllocator(client, driver_name=DRIVER,
+                                  registry=Registry())
+        claim = {
+            "metadata": {"name": "frag", "namespace": "scale",
+                         "uid": "uid-frag"},
+            "spec": {"devices": {"requests": [{
+                "name": "pair", "deviceClassName": "tpu.google.com",
+                "count": 2,
+            }]}},
+        }
+        corners = Selector("coord", "in", ["0,0,0", "3,3,3"])
+        with pytest.raises(AllocationError):
+            frag.allocate(claim, selectors={"pair": [corners]})
+        assert frag._m_backtracks.value() > 0

@@ -1,0 +1,223 @@
+"""EventRecorder tests: async delivery, dedup/count aggregation,
+best-effort semantics, deterministic names, and controller
+reconcile-error events."""
+
+import threading
+
+from k8s_dra_driver_tpu.kube import EVENTS, FakeKubeClient
+from k8s_dra_driver_tpu.kube.errors import ApiError
+from k8s_dra_driver_tpu.kube.events import EventRecorder, ObjectRef
+from k8s_dra_driver_tpu.utils.metrics import Registry
+
+
+def recorder(client=None, **kw):
+    return EventRecorder(
+        client if client is not None else FakeKubeClient(),
+        component="test-component", **kw,
+    )
+
+
+CLAIM = ObjectRef.claim("my-claim", "ns-1", uid="uid-e1")
+
+
+class TestEmit:
+    def test_first_emit_creates_event(self):
+        client = FakeKubeClient()
+        rec = recorder(client)
+        rec.warning(CLAIM, "PrepareFailed", "chip went away")
+        assert rec.flush()
+        events = client.list(EVENTS, namespace="ns-1")
+        assert len(events) == 1
+        ev = events[0]
+        assert ev["type"] == "Warning"
+        assert ev["reason"] == "PrepareFailed"
+        assert ev["message"] == "chip went away"
+        assert ev["count"] == 1
+        assert ev["involvedObject"] == {
+            "apiVersion": "resource.k8s.io/v1beta1",
+            "kind": "ResourceClaim",
+            "name": "my-claim",
+            "namespace": "ns-1",
+            "uid": "uid-e1",
+        }
+        assert ev["source"]["component"] == "test-component"
+
+    def test_repeats_aggregate_count(self):
+        client = FakeKubeClient()
+        rec = recorder(client)
+        for _ in range(5):
+            rec.warning(CLAIM, "PrepareFailed", "chip went away")
+        assert rec.flush()
+        events = client.list(EVENTS, namespace="ns-1")
+        assert len(events) == 1
+        assert events[0]["count"] == 5
+
+    def test_varying_messages_still_aggregate(self):
+        """Dedup keys on (object, type, reason), NOT the message — raw
+        exception text varies per retry and must not flood etcd with
+        near-duplicate Events. The latest message wins."""
+        client = FakeKubeClient()
+        rec = recorder(client)
+        rec.warning(CLAIM, "PrepareFailed", "timeout after 1.2s")
+        rec.warning(CLAIM, "PrepareFailed", "timeout after 3.7s")
+        assert rec.flush()
+        events = client.list(EVENTS, namespace="ns-1")
+        assert len(events) == 1
+        assert events[0]["count"] == 2
+        assert events[0]["message"] == "timeout after 3.7s"
+
+    def test_distinct_reasons_and_types_get_distinct_events(self):
+        client = FakeKubeClient()
+        rec = recorder(client)
+        rec.warning(CLAIM, "PrepareFailed", "x")
+        rec.warning(CLAIM, "UnprepareFailed", "x")
+        rec.normal(CLAIM, "Prepared", "ok")
+        assert rec.flush()
+        assert len(client.list(EVENTS, namespace="ns-1")) == 3
+
+    def test_restart_aggregates_onto_existing_event(self):
+        """Deterministic names: a fresh recorder (new process) lands on
+        the same Event its predecessor created, via AlreadyExists."""
+        client = FakeKubeClient()
+        first = recorder(client)
+        first.warning(CLAIM, "PrepareFailed", "boom")
+        assert first.flush()
+        second = recorder(client)
+        second.warning(CLAIM, "PrepareFailed", "boom")
+        assert second.flush()
+        events = client.list(EVENTS, namespace="ns-1")
+        assert len(events) == 1
+        assert events[0]["count"] == 2
+
+    def test_no_client_is_noop(self):
+        rec = EventRecorder(None, component="c")
+        rec.warning(CLAIM, "X", "y")  # must not raise
+        assert rec.flush()
+
+    def test_emit_never_blocks_caller(self):
+        """The claim hot path runs under the driver's global lock; emits
+        must enqueue and return even when the API is stalled, dropping
+        (counted) once the bounded queue fills."""
+        client = FakeKubeClient()
+        release = threading.Event()
+
+        def stall(verb, gvr, name):
+            release.wait(10)
+            return None
+
+        client.fault_injector = stall
+        reg = Registry()
+        rec = recorder(client, registry=reg)
+        for i in range(EventRecorder.QUEUE_SIZE + 20):
+            rec.normal(ObjectRef.node(f"n-{i}"), "R", "m")  # returns at once
+        release.set()
+        assert rec._m_failures.value() >= 1  # overflow drops were counted
+
+    def test_api_errors_are_swallowed_and_counted(self):
+        client = FakeKubeClient()
+        client.fault_injector = lambda verb, gvr, name: (
+            ApiError("boom", code=500) if gvr is EVENTS else None
+        )
+        reg = Registry()
+        rec = recorder(client, registry=reg)
+        rec.warning(CLAIM, "PrepareFailed", "x")  # must not raise
+        assert rec.flush()
+        assert "tpu_dra_events_emit_failures_total 1" in reg.render()
+
+    def test_server_side_eviction_recreates(self):
+        client = FakeKubeClient()
+        rec = recorder(client)
+        rec.warning(CLAIM, "PrepareFailed", "x")
+        assert rec.flush()
+        # TTL eviction server-side: the cached key must not wedge emission.
+        ev = client.list(EVENTS, namespace="ns-1")[0]
+        client.delete(EVENTS, ev["metadata"]["name"], namespace="ns-1")
+        rec.warning(CLAIM, "PrepareFailed", "x")
+        assert rec.flush()
+        events = client.list(EVENTS, namespace="ns-1")
+        assert len(events) == 1
+        assert events[0]["count"] == 1  # recreated fresh
+
+    def test_cluster_scoped_ref_uses_recorder_namespace(self):
+        client = FakeKubeClient()
+        rec = recorder(client, namespace="tpu-dra")
+        rec.warning(ObjectRef.node("node-9"), "ReconcileFailed", "watch died")
+        assert rec.flush()
+        events = client.list(EVENTS, namespace="tpu-dra")
+        assert len(events) == 1
+        assert events[0]["involvedObject"]["kind"] == "Node"
+
+    def test_cache_bound(self):
+        client = FakeKubeClient()
+        rec = recorder(client)
+        for i in range(EventRecorder.MAX_CACHE + 10):
+            rec.normal(ObjectRef.node(f"n-{i}"), "R", "m")
+            if i % 100 == 0:
+                rec.flush()
+        assert rec.flush()
+        assert len(rec._seen) <= EventRecorder.MAX_CACHE
+
+    def test_concurrent_emits_single_event(self):
+        client = FakeKubeClient()
+        rec = recorder(client)
+        threads = [
+            threading.Thread(
+                target=rec.warning,
+                args=(CLAIM, "PrepareFailed", "racy"),
+            )
+            for _ in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert rec.flush()
+        events = client.list(EVENTS, namespace="ns-1")
+        assert len(events) == 1
+        # Single delivery worker serializes the writes: no lost counts.
+        assert events[0]["count"] == 8
+
+
+class TestControllerReconcileEvents:
+    def test_reconcile_error_emits_node_event(self):
+        from k8s_dra_driver_tpu.controller.slice_manager import (
+            SLICE_LABEL,
+            IciSliceManager,
+        )
+        from k8s_dra_driver_tpu.kube import NODES
+
+        client = FakeKubeClient()
+        reg = Registry()
+        rec = EventRecorder(client, component="tpu-dra-controller",
+                            namespace="default", registry=reg)
+        manager = IciSliceManager(client, registry=reg, events=rec)
+        manager.start()
+        # Sabotage publication (after the startup seed publish) so the
+        # next node-event reconcile fails.
+        manager.slice_controller.update = _raise
+        try:
+            client.create(NODES, {"metadata": {
+                "name": "node-x", "labels": {SLICE_LABEL: "slice-1"}}})
+            import time
+
+            deadline = time.monotonic() + 5
+            events = []
+            while time.monotonic() < deadline:
+                events = [
+                    e for e in client.list(EVENTS, namespace="default")
+                    if e["reason"] == "ReconcileFailed"
+                ]
+                if events:
+                    break
+                time.sleep(0.05)
+        finally:
+            manager.stop(cleanup=False)
+        assert len(events) == 1
+        assert events[0]["involvedObject"]["name"] == "node-x"
+        assert events[0]["type"] == "Warning"
+        text = reg.render()
+        assert 'tpu_dra_reconciles_total{outcome="error"}' in text
+
+
+def _raise(*a, **k):
+    raise RuntimeError("publish exploded")

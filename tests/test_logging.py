@@ -1,0 +1,96 @@
+"""setup_logging / JsonFormatter tests: env overrides, extra-field merge,
+and span correlation in log lines."""
+
+import json
+import logging
+
+import pytest
+
+from k8s_dra_driver_tpu.utils.logging import JsonFormatter, setup_logging
+from k8s_dra_driver_tpu.utils.tracing import Tracer
+
+
+@pytest.fixture(autouse=True)
+def _restore_root_logger():
+    root = logging.getLogger()
+    handlers, level = root.handlers[:], root.level
+    yield
+    root.handlers[:] = handlers
+    root.setLevel(level)
+
+
+def _record(msg="hello", **extra):
+    record = logging.LogRecord(
+        "test.logger", logging.INFO, __file__, 1, msg, (), None
+    )
+    for k, v in extra.items():
+        setattr(record, k, v)
+    return record
+
+
+class TestJsonFormatter:
+    def test_basic_fields(self):
+        out = json.loads(JsonFormatter().format(_record()))
+        assert out["msg"] == "hello"
+        assert out["level"] == "info"
+        assert out["logger"] == "test.logger"
+
+    def test_extra_fields_merged(self):
+        out = json.loads(JsonFormatter().format(
+            _record(claim="default/c1", devices=3)
+        ))
+        assert out["claim"] == "default/c1"
+        assert out["devices"] == 3
+
+    def test_extra_cannot_clobber_core_fields(self):
+        record = _record()
+        record.__dict__["ts"] = "spoofed"
+        out = json.loads(JsonFormatter().format(record))
+        assert out["ts"] != "spoofed"
+
+    def test_unserializable_extra_degrades_to_repr(self):
+        out = json.loads(JsonFormatter().format(_record(obj=object())))
+        assert "object object" in out["obj"]
+
+    def test_span_ids_injected(self):
+        t = Tracer()
+        with t.span("op", claim_uid="uid-log") as sp:
+            out = json.loads(JsonFormatter().format(_record()))
+        assert out["traceId"] == sp.trace_id
+        assert out["spanId"] == sp.span_id
+        assert out["claimUid"] == "uid-log"
+        # Outside the span: no trace fields.
+        out = json.loads(JsonFormatter().format(_record()))
+        assert "traceId" not in out
+
+
+class TestSetupLogging:
+    def _root_state(self):
+        root = logging.getLogger()
+        return root.level, isinstance(
+            root.handlers[0].formatter, JsonFormatter
+        )
+
+    def test_env_override_applies_when_unset(self, monkeypatch):
+        monkeypatch.setenv("TPU_DRA_LOG_LEVEL", "DEBUG")
+        monkeypatch.setenv("TPU_DRA_LOG_FORMAT", "json")
+        setup_logging()
+        level, is_json = self._root_state()
+        assert level == logging.DEBUG
+        assert is_json
+
+    def test_cli_args_beat_env(self, monkeypatch):
+        monkeypatch.setenv("TPU_DRA_LOG_LEVEL", "DEBUG")
+        monkeypatch.setenv("TPU_DRA_LOG_FORMAT", "json")
+        setup_logging(level="WARNING", json_format=False)
+        level, is_json = self._root_state()
+        assert level == logging.WARNING
+        assert not is_json
+
+    def test_defaults_without_env(self, monkeypatch):
+        monkeypatch.delenv("TPU_DRA_LOG_LEVEL", raising=False)
+        monkeypatch.delenv("TPU_DRA_LOG_FORMAT", raising=False)
+        setup_logging()
+        level, is_json = self._root_state()
+        assert level == logging.INFO
+        assert not is_json

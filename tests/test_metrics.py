@@ -1,5 +1,7 @@
 """Metrics registry + HTTP endpoint tests."""
 
+import math
+import sys
 import urllib.error
 import urllib.request
 
@@ -54,6 +56,120 @@ class TestRegistry:
         assert "t_count 1" in r.render()
 
 
+def _load_validator():
+    import os
+
+    tools = os.path.join(os.path.dirname(__file__), "..", "tools")
+    sys.path.insert(0, tools)
+    try:
+        from verify_metrics import validate_exposition
+    finally:
+        sys.path.pop(0)
+    return validate_exposition
+
+
+class TestExposition:
+    """Text-format correctness: the escaping and number-rendering rules a
+    real Prometheus scraper enforces."""
+
+    def test_label_value_escaping(self):
+        r = Registry()
+        c = Counter("esc_total", "Esc", r)
+        c.inc(path='a"b', root="c\\d", msg="line1\nline2")
+        text = r.render()
+        assert 'path="a\\"b"' in text
+        assert 'root="c\\\\d"' in text
+        assert 'msg="line1\\nline2"' in text
+
+    def test_nonfinite_values_render_prometheus_style(self):
+        r = Registry()
+        g = Gauge("nf", "NF", r)
+        g.set(math.inf, k="pos")
+        g.set(-math.inf, k="neg")
+        g.set(math.nan, k="nan")
+        text = r.render()
+        assert 'nf{k="pos"} +Inf' in text
+        assert 'nf{k="neg"} -Inf' in text
+        assert 'nf{k="nan"} NaN' in text
+        assert "inf" not in text.replace("+Inf", "").replace("-Inf", "")
+
+    def test_histogram_nonfinite_sum(self):
+        r = Registry()
+        h = Histogram("hnf", "HNF", r, buckets=(1.0,))
+        h.observe(math.inf)
+        assert "hnf_sum +Inf" in r.render()
+
+    def test_invalid_metric_name_rejected(self):
+        r = Registry()
+        for bad in ("9starts_with_digit", "has-dash", "has space", ""):
+            with pytest.raises(ValueError):
+                Counter(bad, "x", r)
+        with pytest.raises(ValueError):
+            Histogram("bad-name", "x", r)
+
+    def test_invalid_label_name_rejected(self):
+        r = Registry()
+        c = Counter("ok_total", "x", r)
+        with pytest.raises(ValueError):
+            c.inc(**{"bad-label": "v"})
+        g = Gauge("ok_gauge", "x", r)
+        with pytest.raises(ValueError):
+            g.set(1, **{"9bad": "v"})
+
+    def test_duplicate_registration_rejected(self):
+        r = Registry()
+        Counter("dup_total", "x", r)
+        with pytest.raises(ValueError):
+            Gauge("dup_total", "y", r)
+
+    def test_deprecated_alias_renders_both_names(self):
+        r = Registry()
+        c = Counter("tpu_dra_new_name_total", "New thing", r)
+        r.alias("tpu_dra_old_name_total", c)
+        c.inc(result="ok")
+        text = r.render()
+        assert 'tpu_dra_new_name_total{result="ok"} 1' in text
+        assert 'tpu_dra_old_name_total{result="ok"} 1' in text
+        assert ("# HELP tpu_dra_old_name_total New thing (deprecated; "
+                "renamed to tpu_dra_new_name_total)") in text
+        assert "# TYPE tpu_dra_old_name_total counter" in text
+
+    def test_full_scrape_parses_cleanly(self):
+        """End-to-end: a worst-case registry scraped over HTTP validates
+        against the tools/verify_metrics.py parser (escaping, ±Inf,
+        histogram +Inf bucket, TYPE lines for every sample)."""
+        validate_exposition = _load_validator()
+        r = Registry()
+        c = Counter("tpu_dra_scrape_total", "Scrape", r)
+        c.inc(path='we"ird\\label\nvalue')
+        Gauge("tpu_dra_temp", "Temp", r).set(math.inf)
+        h = Histogram("tpu_dra_lat_seconds", "Lat", r, buckets=(0.5,))
+        h.observe(2.0)
+        r.alias("tpu_dra_scrape_old_total", c)
+        srv = MetricsServer(r, host="127.0.0.1", port=0)
+        srv.start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics").read().decode()
+        finally:
+            srv.stop()
+        assert validate_exposition(body) == [], body
+        assert 'tpu_dra_lat_seconds_bucket{le="+Inf"} 1' in body
+
+    def test_validator_rejects_known_defects(self):
+        validate_exposition = _load_validator()
+        # The exact defects the renderer used to produce.
+        assert validate_exposition("# TYPE m gauge\nm inf\n")
+        assert validate_exposition(
+            '# TYPE m gauge\nm{a="un"quoted"} 1\n'
+        )
+        assert validate_exposition("orphan_sample 1\n")
+        assert validate_exposition(
+            "# TYPE h histogram\n"
+            'h_bucket{le="1"} 1\nh_sum 1\nh_count 1\n'
+        )  # no +Inf bucket
+
+
 class TestServer:
     def test_metrics_and_health_endpoints(self):
         r = Registry()
@@ -67,6 +183,88 @@ class TestServer:
             assert urllib.request.urlopen(f"{base}/healthz").read() == b"ok"
         finally:
             srv.stop()
+
+    def test_healthz_flips_with_set_healthy(self):
+        srv = MetricsServer(Registry(), host="127.0.0.1", port=0)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            assert urllib.request.urlopen(f"{base}/healthz").status == 200
+            srv.set_healthy(False)
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(f"{base}/healthz")
+            assert exc_info.value.code == 503
+            srv.set_healthy(True)
+            assert urllib.request.urlopen(f"{base}/healthz").status == 200
+        finally:
+            srv.stop()
+
+    def test_readyz_flips_with_checks(self):
+        ready = {"ok": True}
+        srv = MetricsServer(Registry(), host="127.0.0.1", port=0)
+        srv.add_readiness_check(
+            "flip", lambda: (ready["ok"], "detail-text"))
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            body = urllib.request.urlopen(f"{base}/readyz").read().decode()
+            assert "[+] flip: detail-text" in body
+            assert body.strip().endswith("ready")
+            ready["ok"] = False
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(f"{base}/readyz")
+            assert exc_info.value.code == 503
+            assert "[-] flip" in exc_info.value.read().decode()
+            ready["ok"] = True
+            assert urllib.request.urlopen(f"{base}/readyz").status == 200
+        finally:
+            srv.stop()
+
+    def test_readyz_check_that_raises_fails_closed(self):
+        srv = MetricsServer(Registry(), host="127.0.0.1", port=0)
+
+        def boom():
+            raise RuntimeError("probe exploded")
+
+        srv.add_readiness_check("boom", boom)
+        srv.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/readyz")
+            assert exc_info.value.code == 503
+            assert "probe exploded" in exc_info.value.read().decode()
+        finally:
+            srv.stop()
+
+    def test_debug_traces_route(self):
+        from k8s_dra_driver_tpu.utils.tracing import Tracer
+
+        tracer = Tracer()
+        with tracer.span("op", claim_uid="uid-m"):
+            pass
+        srv = MetricsServer(Registry(), host="127.0.0.1", port=0,
+                            tracer=tracer)
+        srv.start()
+        try:
+            import json
+
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/traces").read().decode()
+            trace = json.loads(body.splitlines()[0])
+            assert trace["claimUid"] == "uid-m"
+        finally:
+            srv.stop()
+        # Without a tracer the route 404s instead of lying with [].
+        srv2 = MetricsServer(Registry(), host="127.0.0.1", port=0)
+        srv2.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv2.port}/debug/traces")
+            assert exc_info.value.code == 404
+        finally:
+            srv2.stop()
 
     def test_version_and_debug_endpoints(self):
         """pprof-analog endpoints (reference: main.go:216-224) + version."""

@@ -1,0 +1,391 @@
+"""Claim-lifecycle tracing tests: span mechanics, contextvars propagation,
+ring-buffer bounds, JSONL round-trip, and the end-to-end acceptance path —
+one simulated NodePrepareResources produces one trace whose nested spans
+(rpc → prepare → allocate → cdi-render / checkpoint-write) all carry the
+claim UID, the same UID shows up in a JSON log line and a deduped
+Kubernetes Event, and both binaries' debug servers answer /metrics,
+/healthz, /readyz and /debug/traces."""
+
+import contextvars
+import json
+import logging
+import threading
+import urllib.error
+import urllib.request
+
+import grpc
+
+from k8s_dra_driver_tpu.kube import EVENTS, NODES, RESOURCE_CLAIMS, FakeKubeClient
+from k8s_dra_driver_tpu.kube.protos import dra_v1alpha4_pb2 as drapb
+from k8s_dra_driver_tpu.plugin.driver import Driver, DriverConfig
+from k8s_dra_driver_tpu.plugin.grpc_services import NodeStub
+from k8s_dra_driver_tpu.tpulib import FakeChipLib
+from k8s_dra_driver_tpu.utils import tracing
+from k8s_dra_driver_tpu.utils.tracing import Span, Tracer, child_span
+
+DRIVER = "tpu.google.com"
+
+
+class TestSpans:
+    def test_root_and_child_nesting(self):
+        t = Tracer()
+        with t.span("root", claim_uid="uid-1") as root:
+            with t.span("child") as child:
+                assert child.trace_id == root.trace_id
+                assert child.parent_id == root.span_id
+                # Claim-UID correlation is inherited, not re-declared.
+                assert child.claim_uid == "uid-1"
+            with child_span("leaf") as leaf:
+                assert leaf.trace_id == root.trace_id
+                assert leaf.claim_uid == "uid-1"
+        traces = t.traces()
+        assert len(traces) == 1
+        trace = traces[0]
+        assert trace["claimUid"] == "uid-1"
+        assert [s["name"] for s in trace["spans"]] == ["root", "child", "leaf"]
+
+    def test_current_span_restored_on_exit(self):
+        t = Tracer()
+        assert tracing.current_span() is None
+        with t.span("a") as a:
+            assert tracing.current_span() is a
+            with t.span("b") as b:
+                assert tracing.current_span() is b
+            assert tracing.current_span() is a
+        assert tracing.current_span() is None
+
+    def test_exception_marks_span_error(self):
+        t = Tracer()
+        try:
+            with t.span("boom"):
+                raise ValueError("broken chip")
+        except ValueError:
+            pass
+        trace = t.traces()[0]
+        assert trace["status"] == "error"
+        assert "broken chip" in trace["spans"][0]["error"]
+
+    def test_child_span_without_tracer_is_noop(self):
+        assert tracing.current_span() is None
+        with child_span("orphan", claim_uid="u") as sp:
+            assert sp.tracer is None
+            assert sp.trace_id == ""
+        # A no-op span still measures duration for uniform logging.
+        assert sp.duration >= 0.0
+
+    def test_null_span_measures_duration(self):
+        with Span(None, "timed") as sp:
+            pass
+        assert sp.duration >= 0.0
+
+    def test_contextvars_propagation_across_threads(self):
+        """A worker started under copy_context parents into the caller's
+        live span — the contract that makes thread-pool RPC handlers and
+        helper threads share one trace."""
+        t = Tracer()
+        seen = {}
+
+        def worker():
+            with t.span("worker-op") as sp:
+                seen["trace_id"] = sp.trace_id
+                seen["parent_id"] = sp.parent_id
+                seen["claim_uid"] = sp.claim_uid
+
+        with t.span("root", claim_uid="uid-t") as root:
+            ctx = contextvars.copy_context()
+            th = threading.Thread(target=ctx.run, args=(worker,))
+            th.start()
+            th.join()
+            assert seen["trace_id"] == root.trace_id
+            assert seen["parent_id"] == root.span_id
+            assert seen["claim_uid"] == "uid-t"
+
+    def test_plain_thread_starts_fresh_trace(self):
+        t = Tracer()
+        seen = {}
+
+        def worker():
+            with t.span("detached") as sp:
+                seen["parent_id"] = sp.parent_id
+
+        with t.span("root"):
+            th = threading.Thread(target=worker)
+            th.start()
+            th.join()
+        assert seen["parent_id"] == ""
+
+
+class TestRingBuffer:
+    def test_eviction_keeps_most_recent(self):
+        t = Tracer(max_traces=3)
+        for i in range(10):
+            with t.span(f"op-{i}"):
+                pass
+        roots = [tr["root"] for tr in t.traces()]
+        assert roots == ["op-7", "op-8", "op-9"]
+
+    def test_open_trace_bound(self):
+        t = Tracer()
+        # Roots that never finish must not accumulate unboundedly.
+        for i in range(t.MAX_OPEN_TRACES + 50):
+            sp = t.span(f"wedged-{i}")
+            sp.start = 1.0
+            t._finish(Span(t, "child", parent=sp))
+        assert len(t._open) <= t.MAX_OPEN_TRACES
+
+    def test_jsonl_round_trip(self):
+        t = Tracer()
+        with t.span("outer", claim_uid="uid-j"):
+            with t.span("inner"):
+                pass
+        lines = [ln for ln in t.export_jsonl().splitlines() if ln]
+        assert len(lines) == 1
+        trace = json.loads(lines[0])
+        assert trace["claimUid"] == "uid-j"
+        assert {s["name"] for s in trace["spans"]} == {"outer", "inner"}
+        # Parent links survive the round trip.
+        by_name = {s["name"]: s for s in trace["spans"]}
+        assert by_name["inner"]["parentId"] == by_name["outer"]["spanId"]
+
+    def test_find_trace_by_claim_uid(self):
+        t = Tracer()
+        with t.span("a", claim_uid="uid-1"):
+            pass
+        with t.span("b", claim_uid="uid-2"):
+            pass
+        assert t.find_trace("uid-2")["root"] == "b"
+        assert t.find_trace("uid-absent") is None
+
+
+def _mk_driver(tmp_path, client):
+    config = DriverConfig(
+        node_name="node-a",
+        chiplib=FakeChipLib(generation="v5p", topology="2x2x1"),
+        kube_client=client,
+        cdi_root=str(tmp_path / "cdi"),
+        plugin_root=str(tmp_path / "plugin"),
+        registrar_root=str(tmp_path / "registry"),
+        state_root=str(tmp_path / "state"),
+        node_uid="node-uid-1",
+    )
+    return Driver(config), config
+
+
+def _add_claim(client, uid, devices, name="claim-1", namespace="default"):
+    claim = {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {"name": name, "namespace": namespace, "uid": uid},
+        "spec": {"devices": {"requests": [
+            {"name": "req-0", "deviceClassName": "tpu.google.com"},
+        ]}},
+        "status": {"allocation": {"devices": {"results": [
+            {"request": "req-0", "driver": DRIVER, "pool": "node-a",
+             "device": d}
+            for d in devices
+        ], "config": []}}},
+    }
+    client.create(RESOURCE_CLAIMS, claim, namespace=namespace)
+
+
+class TestEndToEndClaimTrace:
+    def test_prepare_produces_nested_trace_log_and_event(self, tmp_path):
+        """The acceptance path: one NodePrepareResources over real gRPC →
+        one exported trace with ≥4 nested spans all tagged with the claim
+        UID; the same UID in a JSON log line and in a deduped Event."""
+        from k8s_dra_driver_tpu.utils.logging import JsonFormatter
+
+        client = FakeKubeClient()
+        client.create(NODES, {"metadata": {"name": "node-a",
+                                           "uid": "node-uid-1"}})
+        driver, config = _mk_driver(tmp_path, client)
+        driver.start()
+
+        # JSON log capture on the driver logger: lines inside the prepare
+        # span must carry its trace/claim ids.
+        records = []
+
+        class _Capture(logging.Handler):
+            def emit(self, record):
+                records.append(JsonFormatter().format(record))
+
+        cap = _Capture(level=logging.DEBUG)
+        lg = logging.getLogger("k8s_dra_driver_tpu.plugin.driver")
+        lg.addHandler(cap)
+        old_level = lg.level
+        lg.setLevel(logging.DEBUG)
+        try:
+            _add_claim(client, "uid-trace", ["tpu-0", "tpu-1"])
+            with grpc.insecure_channel(f"unix://{config.plugin_socket}") as ch:
+                stub = NodeStub(ch)
+                req = drapb.NodePrepareResourcesRequest(
+                    claims=[drapb.Claim(uid="uid-trace", name="claim-1",
+                                        namespace="default")]
+                )
+                assert stub.NodePrepareResources(req).claims[
+                    "uid-trace"].error == ""
+                # Second, idempotent prepare: dedups the Event to count=2.
+                assert stub.NodePrepareResources(req).claims[
+                    "uid-trace"].error == ""
+        finally:
+            lg.removeHandler(cap)
+            lg.setLevel(old_level)
+            driver.shutdown()
+
+        # -- trace: rpc → prepare → {fetch-claim, allocate → cdi/checkpoint}
+        # The second (idempotent) prepare hits the checkpoint cache and
+        # skips the render/write stages; assert on the first, full trace.
+        full = [
+            tr for tr in driver.tracer.traces()
+            if tr["claimUid"] == "uid-trace"
+            and any(s["name"] == "cdi-render" for s in tr["spans"])
+        ]
+        assert len(full) == 1
+        trace = full[0]
+        by_name = {s["name"]: s for s in trace["spans"]}
+        expected = {"rpc/NodePrepareResources", "prepare", "fetch-claim",
+                    "allocate", "cdi-render", "checkpoint-write"}
+        assert expected <= set(by_name), sorted(by_name)
+        assert len(trace["spans"]) >= 4
+        for name in expected:
+            assert by_name[name]["tags"].get("claim_uid") == "uid-trace", name
+        # Tags are FLAT — the documented /debug/traces schema has no
+        # nested "tags" key (jq '.spans[].tags.service' must work).
+        assert by_name["rpc/NodePrepareResources"]["tags"]["service"] \
+            == "v1alpha3.Node"
+        assert by_name["prepare"]["tags"]["claim"] == "default/claim-1"
+        assert all("tags" not in s["tags"] for s in trace["spans"])
+        assert by_name["prepare"]["parentId"] == \
+            by_name["rpc/NodePrepareResources"]["spanId"]
+        assert by_name["allocate"]["parentId"] == by_name["prepare"]["spanId"]
+        for leaf in ("cdi-render", "checkpoint-write"):
+            assert by_name[leaf]["parentId"] == by_name["allocate"]["spanId"]
+
+        # -- metrics: span-backed timing fed the latency histogram.
+        text = driver.registry.render()
+        assert "tpu_dra_claim_prepare_seconds_count 2" in text
+        assert 'tpu_dra_claim_prepare_attempts_total{result="ok"} 2' in text
+
+        # -- event: Normal/Prepared on the claim, deduped with count=2.
+        assert driver.events.flush()
+        events = client.list(EVENTS, namespace="default")
+        prepared = [e for e in events if e["reason"] == "Prepared"]
+        assert len(prepared) == 1
+        ev = prepared[0]
+        assert ev["involvedObject"]["uid"] == "uid-trace"
+        assert ev["count"] == 2
+        assert ev["type"] == "Normal"
+
+        # -- log: a JSON line inside the span carries the same claim UID.
+        # (driver logs at debug inside prepare via kube fetch path; assert
+        # on any record that was tagged with the trace)
+        tagged = [json.loads(r) for r in records if "claimUid" in r]
+        assert any(r["claimUid"] == "uid-trace" for r in tagged), records
+
+    def test_prepare_failure_emits_warning_event(self, tmp_path):
+        client = FakeKubeClient()
+        client.create(NODES, {"metadata": {"name": "node-a",
+                                           "uid": "node-uid-1"}})
+        driver, config = _mk_driver(tmp_path, client)
+        driver.start()
+        try:
+            _add_claim(client, "uid-bad", ["tpu-404"], name="bad")
+            with grpc.insecure_channel(f"unix://{config.plugin_socket}") as ch:
+                stub = NodeStub(ch)
+                req = drapb.NodePrepareResourcesRequest(
+                    claims=[drapb.Claim(uid="uid-bad", name="bad",
+                                        namespace="default")]
+                )
+                for _ in range(3):  # kubelet retry storm
+                    resp = stub.NodePrepareResources(req)
+                    assert "not allocatable" in resp.claims["uid-bad"].error
+            assert driver.events.flush()
+        finally:
+            driver.shutdown()
+        warnings = [
+            e for e in client.list(EVENTS, namespace="default")
+            if e["reason"] == "PrepareFailed"
+        ]
+        assert len(warnings) == 1  # deduped
+        assert warnings[0]["count"] == 3
+        assert warnings[0]["type"] == "Warning"
+        assert warnings[0]["involvedObject"]["uid"] == "uid-bad"
+        # The failed prepares also left error traces.
+        trace = driver.tracer.find_trace("uid-bad")
+        assert trace is not None
+        assert trace["status"] == "error"
+
+
+class TestDebugServers:
+    def test_all_routes_respond_on_plugin_and_controller_servers(self, tmp_path):
+        """/metrics, /healthz, /readyz, /debug/traces on BOTH binaries'
+        debug servers (the acceptance criterion's four routes)."""
+        from k8s_dra_driver_tpu.controller.slice_manager import IciSliceManager
+        from k8s_dra_driver_tpu.utils.metrics import MetricsServer, Registry
+
+        # Plugin-side server, wired the way plugin/main.py wires it.
+        client = FakeKubeClient()
+        client.create(NODES, {"metadata": {"name": "node-a",
+                                           "uid": "node-uid-1"}})
+        driver, _ = _mk_driver(tmp_path, client)
+        driver.start()
+        plugin_srv = MetricsServer(driver.registry, host="127.0.0.1",
+                                   port=0, tracer=driver.tracer)
+        for name, check in driver.readiness_checks().items():
+            plugin_srv.add_readiness_check(name, check)
+        plugin_srv.start()
+
+        # Controller-side server, wired the way controller/main.py wires it.
+        c_registry = Registry()
+        c_tracer = Tracer()
+        manager = IciSliceManager(FakeKubeClient(), DRIVER,
+                                  registry=c_registry, tracer=c_tracer)
+        manager.start()
+        ctrl_srv = MetricsServer(c_registry, host="127.0.0.1", port=0,
+                                 tracer=c_tracer)
+        ctrl_srv.add_readiness_check("slice-manager", manager.healthy)
+        ctrl_srv.start()
+        try:
+            for srv in (plugin_srv, ctrl_srv):
+                base = f"http://127.0.0.1:{srv.port}"
+                for route in ("/metrics", "/healthz", "/readyz",
+                              "/debug/traces"):
+                    resp = urllib.request.urlopen(base + route)
+                    assert resp.status == 200, (srv.port, route)
+            ready = urllib.request.urlopen(
+                f"http://127.0.0.1:{plugin_srv.port}/readyz"
+            ).read().decode()
+            assert "[+] grpc-serving" in ready
+            assert "[+] inventory-fresh" in ready
+            assert "[+] checkpoint-writable" in ready
+            assert ready.strip().endswith("ready")
+        finally:
+            plugin_srv.stop()
+            ctrl_srv.stop()
+            manager.stop()
+            driver.shutdown()
+
+    def test_readyz_fails_closed_after_shutdown(self, tmp_path):
+        from k8s_dra_driver_tpu.utils.metrics import MetricsServer
+
+        client = FakeKubeClient()
+        client.create(NODES, {"metadata": {"name": "node-a",
+                                           "uid": "node-uid-1"}})
+        driver, _ = _mk_driver(tmp_path, client)
+        driver.start()
+        srv = MetricsServer(driver.registry, host="127.0.0.1", port=0,
+                            tracer=driver.tracer)
+        for name, check in driver.readiness_checks().items():
+            srv.add_readiness_check(name, check)
+        srv.start()
+        try:
+            driver.shutdown()  # gRPC down → readiness must flip
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/readyz")
+                raise AssertionError("expected 503")
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+                assert "[-] grpc-serving" in e.read().decode()
+        finally:
+            srv.stop()

@@ -15,6 +15,15 @@ back to this checker, which approximates ruff's default F-rules:
 - E711: comparison to None with ==/!=
 - E712: comparison to True/False with ==/!=
 
+Plus one first-party rule with no ruff analog:
+
+- TPM01/02/03: every Counter/Gauge/Histogram instantiated under
+  ``k8s_dra_driver_tpu/`` must use the ``tpu_dra_`` name prefix, carry a
+  unit suffix matching its kind (``_total`` for counters, a unit like
+  ``_seconds``/``_bytes`` for histograms), and have non-empty help text —
+  the naming contract docs/observability.md documents and
+  ``make verify-metrics`` scrapes for.
+
 Exit status 1 when any finding is emitted, so `make lint` is a gate,
 not a suggestion.
 """
@@ -164,6 +173,58 @@ def check_misc(tree: ast.Module, path: Path) -> list[Finding]:
     return out
 
 
+_METRIC_CLASSES = {"Counter", "Gauge", "Histogram"}
+_METRIC_PREFIX = "tpu_dra_"
+# _total is a counter-only suffix (it would collide with histogram series
+# naming), so histograms get the unit suffixes without it.
+_HISTOGRAM_UNIT_SUFFIXES = ("_seconds", "_bytes", "_celsius", "_ratio")
+
+
+def check_metric_conventions(tree: ast.Module, path: Path) -> list[Finding]:
+    """First-party metric naming floor: every Counter/Gauge/Histogram
+    instantiation in driver code uses the tpu_dra_ prefix, a unit suffix
+    appropriate to its kind, and non-empty help text."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        cls = None
+        if isinstance(func, ast.Name) and func.id in _METRIC_CLASSES:
+            cls = func.id
+        elif (isinstance(func, ast.Attribute)
+                and func.attr in _METRIC_CLASSES):
+            cls = func.attr
+        if cls is None or not node.args:
+            continue
+        name_arg = node.args[0]
+        if not (isinstance(name_arg, ast.Constant)
+                and isinstance(name_arg.value, str)):
+            continue  # e.g. collections.Counter(), or a computed name
+        name = name_arg.value
+        if not name.startswith(_METRIC_PREFIX):
+            out.append(Finding(
+                path, node.lineno, "TPM01",
+                f"{cls} name {name!r} lacks the {_METRIC_PREFIX!r} prefix"))
+        if cls == "Counter" and not name.endswith("_total"):
+            out.append(Finding(
+                path, node.lineno, "TPM02",
+                f"Counter name {name!r} must end with '_total'"))
+        if cls == "Histogram" and not name.endswith(_HISTOGRAM_UNIT_SUFFIXES):
+            out.append(Finding(
+                path, node.lineno, "TPM02",
+                f"Histogram name {name!r} must carry a unit suffix "
+                f"({', '.join(_HISTOGRAM_UNIT_SUFFIXES)})"))
+        help_arg = node.args[1] if len(node.args) > 1 else None
+        if (isinstance(help_arg, ast.Constant)
+                and isinstance(help_arg.value, str)
+                and not help_arg.value.strip()):
+            out.append(Finding(
+                path, node.lineno, "TPM03",
+                f"{cls} {name!r} has empty help text"))
+    return out
+
+
 def lint_file(path: Path) -> list[Finding]:
     try:
         tree = ast.parse(path.read_text(), filename=str(path))
@@ -178,6 +239,10 @@ def lint_file(path: Path) -> list[Finding]:
     out += check_redefinitions(tree, path)
     out += check_function_bodies(tree, path)
     out += check_misc(tree, path)
+    # Metric naming applies to driver code only — tests and tools mint
+    # deliberately-odd names to exercise the renderer.
+    if "k8s_dra_driver_tpu" in path.parts:
+        out += check_metric_conventions(tree, path)
     return out
 
 

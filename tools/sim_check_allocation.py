@@ -22,6 +22,7 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 from k8s_dra_driver_tpu.kube import RESOURCE_SLICES, FakeKubeClient  # noqa: E402
 from k8s_dra_driver_tpu.kube.allocator import ReferenceAllocator  # noqa: E402
+from k8s_dra_driver_tpu.utils.metrics import Registry  # noqa: E402
 
 
 def main() -> int:
@@ -40,7 +41,8 @@ def main() -> int:
         client.create(RESOURCE_SLICES, s)
         for d in s.get("spec", {}).get("devices", []):
             published_devices.add(d["name"])
-    alloc = ReferenceAllocator(client)
+    registry = Registry()
+    alloc = ReferenceAllocator(client, registry=registry)
 
     checked = 0
     for claim in claims:
@@ -75,8 +77,9 @@ def main() -> int:
     if not checked:
         print("FAIL: no claims in input", file=sys.stderr)
         return 1
+    backtracks = alloc._m_backtracks.value()
     print(f"OK: sim agrees all {checked} claim(s) are satisfiable from "
-          "the real cluster's slices")
+          f"the real cluster's slices ({backtracks:g} solver backtracks)")
     return 0
 
 

@@ -1,0 +1,178 @@
+#!/usr/bin/env python3
+"""Prometheus text-exposition validator + debug-server smoke check.
+
+``make verify-metrics`` gate: start a debug server over a registry
+exercising every renderer edge case (label escaping, ±Inf/NaN values,
+histogram buckets, deprecated aliases), scrape it over real HTTP, and fail
+on any malformed exposition line. With ``--url`` it validates a running
+server instead (point it at a deployed plugin/controller ``/metrics``).
+
+The parser is deliberately strict about exactly the defects the renderer
+historically had: unescaped label values (backslash/quote/newline) and
+``repr(inf)`` numbers, both of which a real Prometheus scraper rejects.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_LABEL_NAME = r"[a-zA-Z_][a-zA-Z0-9_]*"
+# A label value: any run of chars where backslash, quote, and newline
+# appear only as \\ \" \n escapes.
+_LABEL_VALUE = r'(?:[^"\\\n]|\\\\|\\"|\\n)*'
+_LABELS = rf'\{{{_LABEL_NAME}="{_LABEL_VALUE}"(?:,{_LABEL_NAME}="{_LABEL_VALUE}")*\}}'
+_VALUE = r"(?:[+-]?(?:\d+(?:\.\d+)?(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?)|\+Inf|-Inf|NaN)"
+_SAMPLE_RE = re.compile(rf"({_NAME})(?:{_LABELS})?\s+{_VALUE}(?:\s+-?\d+)?\Z")
+_HELP_RE = re.compile(rf"# HELP ({_NAME}) (.+)\Z")
+_TYPE_RE = re.compile(rf"# TYPE ({_NAME}) (counter|gauge|histogram|summary|untyped)\Z")
+
+_HISTOGRAM_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _base_name(sample_name: str, types: dict[str, str]) -> str:
+    """Map histogram series names back to the declared metric."""
+    for suffix in _HISTOGRAM_SUFFIXES:
+        base = sample_name[: -len(suffix)] if sample_name.endswith(suffix) else ""
+        if base and types.get(base) == "histogram":
+            return base
+    return sample_name
+
+
+def validate_exposition(text: str) -> list[str]:
+    """All defects found in a /metrics payload; empty means clean."""
+    errors: list[str] = []
+    types: dict[str, str] = {}
+    histogram_inf_seen: dict[str, bool] = {}
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        if not line:
+            continue
+        if line.startswith("#"):
+            if line.startswith("# HELP "):
+                if not _HELP_RE.match(line):
+                    errors.append(f"line {lineno}: malformed HELP: {line!r}")
+            elif line.startswith("# TYPE "):
+                m = _TYPE_RE.match(line)
+                if not m:
+                    errors.append(f"line {lineno}: malformed TYPE: {line!r}")
+                    continue
+                name, mtype = m.groups()
+                if types.get(name, mtype) != mtype:
+                    errors.append(
+                        f"line {lineno}: conflicting TYPE for {name}"
+                    )
+                types[name] = mtype
+                if mtype == "histogram":
+                    histogram_inf_seen.setdefault(name, False)
+            # other comments are legal and ignored
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            errors.append(f"line {lineno}: malformed sample: {line!r}")
+            continue
+        base = _base_name(m.group(1), types)
+        if base not in types:
+            errors.append(
+                f"line {lineno}: sample {m.group(1)!r} has no TYPE declaration"
+            )
+        if (
+            types.get(base) == "histogram"
+            and m.group(1) == f"{base}_bucket"
+            and 'le="+Inf"' in line
+        ):
+            histogram_inf_seen[base] = True
+    for name, seen in sorted(histogram_inf_seen.items()):
+        if not seen:
+            errors.append(f"histogram {name} has no le=\"+Inf\" bucket")
+    return errors
+
+
+def _self_test_scrape() -> tuple[str, list[str]]:
+    """Start a debug server over a worst-case registry; return the scraped
+    body and any HTTP-surface errors."""
+    import json
+    import math
+    import urllib.request
+
+    from k8s_dra_driver_tpu.utils.metrics import (
+        Counter,
+        Gauge,
+        Histogram,
+        MetricsServer,
+        Registry,
+    )
+    from k8s_dra_driver_tpu.utils.tracing import Tracer
+
+    registry = Registry()
+    c = Counter("tpu_dra_verify_requests_total", "Self-test counter", registry)
+    c.inc(path='with"quote', node="back\\slash", detail="multi\nline")
+    g = Gauge("tpu_dra_verify_temperature_celsius", "Self-test gauge", registry)
+    g.set(math.inf, chip="hot")
+    g.set(-math.inf, chip="cold")
+    g.set(math.nan, chip="unknown")
+    h = Histogram("tpu_dra_verify_latency_seconds", "Self-test histogram",
+                  registry, buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(50.0)
+    renamed = Counter("tpu_dra_verify_renamed_total", "Renamed", registry)
+    renamed.inc()
+    registry.alias("tpu_dra_verify_old_total", renamed)
+
+    tracer = Tracer()
+    with tracer.span("verify", claim_uid="uid-verify"):
+        pass
+
+    errors: list[str] = []
+    srv = MetricsServer(registry, host="127.0.0.1", port=0, tracer=tracer)
+    srv.add_readiness_check("self-test", lambda: (True, "ok"))
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        body = urllib.request.urlopen(f"{base}/metrics").read().decode()
+        for route in ("/healthz", "/readyz", "/debug/traces"):
+            resp = urllib.request.urlopen(base + route)
+            if resp.status != 200:
+                errors.append(f"{route}: HTTP {resp.status}")
+        traces = urllib.request.urlopen(f"{base}/debug/traces").read().decode()
+        for line in filter(None, traces.splitlines()):
+            try:
+                json.loads(line)
+            except ValueError:
+                errors.append(f"/debug/traces: undecodable line {line!r}")
+    finally:
+        srv.stop()
+    return body, errors
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--url", default="",
+        help="scrape this /metrics URL instead of self-hosting a server",
+    )
+    args = parser.parse_args(argv)
+    if args.url:
+        import urllib.request
+
+        body = urllib.request.urlopen(args.url).read().decode()
+        errors = []
+    else:
+        sys.path.insert(0, ".")
+        body, errors = _self_test_scrape()
+    errors += validate_exposition(body)
+    for err in errors:
+        print(err, file=sys.stderr)
+    n_samples = sum(
+        1 for ln in body.splitlines() if ln and not ln.startswith("#")
+    )
+    print(
+        f"verify-metrics: {n_samples} samples, {len(errors)} errors",
+        file=sys.stderr,
+    )
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
