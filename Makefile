@@ -2,7 +2,7 @@
 IMAGE ?= tpu-dra-driver
 TAG ?= latest
 
-.PHONY: all native test image lint verify-metrics clean e2e-kind
+.PHONY: all native test image lint verify verify-metrics chaos chaos-slow clean e2e-kind
 
 all: native
 
@@ -11,6 +11,24 @@ native:
 
 test: native
 	python -m pytest tests/ -q
+
+# Deterministic chaos suite: seeded fault schedules (utils/faults.py)
+# through the cluster sim, asserting the four robustness invariants
+# (tests/test_chaos.py). The seed is FIXED so CI failures replay exactly;
+# override with TPU_DRA_CHAOS_SEED=... to explore. Long randomized
+# schedules are marked `slow` — run those with `make chaos-slow`.
+TPU_DRA_CHAOS_SEED ?= 1234
+chaos:
+	TPU_DRA_CHAOS_SEED=$(TPU_DRA_CHAOS_SEED) \
+		python -m pytest tests/test_chaos.py -q -m 'not slow'
+
+chaos-slow:
+	TPU_DRA_CHAOS_SEED=$(TPU_DRA_CHAOS_SEED) \
+		python -m pytest tests/test_chaos.py -q
+
+# The full local gate: lint + unit/integration tests + chaos schedules +
+# metrics exposition. What CI runs; what a PR must pass.
+verify: lint test chaos verify-metrics
 
 # ruff when available (CI installs it; .golangci.yaml analog is
 # [tool.ruff] in pyproject.toml), else the first-party AST lint floor.

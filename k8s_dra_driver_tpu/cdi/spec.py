@@ -174,6 +174,9 @@ class CDIHandler:
         (cdi.go:175-180): mark the container as DRA-managed so host tooling
         (and the TPU device-plugin, if both run) knows not to double-inject.
         """
+        from ..utils import faults
+
+        faults.fire("cdi.base-write")
         devices = []
         for name, dev in sorted(allocatable.items()):
             edits = self.device_edits(dev)
@@ -205,6 +208,9 @@ class CDIHandler:
         startup, so this is the injection point that survives the
         driver-installed-late race).
         """
+        from ..utils import faults
+
+        faults.fire("cdi.claim-write")
         with child_span("cdi-render", claim_uid=claim_uid) as sp:
             devices = []
             for name, edits in sorted(device_edits.items()):

@@ -59,11 +59,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    from ..utils import faults
     from ..utils.logging import setup_logging
 
     # None lets the TPU_DRA_LOG_* env overrides apply; an explicit flag wins.
     setup_logging(level=args.log_level or None,
                   json_format=True if args.log_json else None)
+    faults.arm_from_env()  # chaos drills only; no-op unless TPU_DRA_FAULTS
 
     registry = Registry()
     tracer = Tracer()

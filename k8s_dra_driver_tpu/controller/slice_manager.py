@@ -36,6 +36,7 @@ from ..kube.resourceslice import (
     ResourceSliceController,
 )
 from ..tpulib.deviceinfo import IciChannelInfo
+from ..utils.backoff import Backoff
 from ..utils.metrics import Counter, Gauge, Histogram, Registry
 from ..utils.tracing import Tracer
 
@@ -241,39 +242,119 @@ class IciSliceManager:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        # The reconcile thread may have re-established a fresh watch after
+        # the stop above raced it; close whatever is current too.
+        if self._watch is not None:
+            self._watch.stop()
         self.slice_controller.stop(delete_slices=cleanup)
 
     # -- node event stream (streamImexDomains analog, imex.go:217-305) -----
 
     def _run(self) -> None:
+        """Consume node events forever, RE-ESTABLISHING the watch when the
+        stream dies without ``stop()``.
+
+        The stream ending is normal life, not shutdown: API servers close
+        watches on timeouts, network partitions sever them, a fake client's
+        test harness stops them. The old behavior — return, leaving
+        readiness red until a pod restart — is exactly the wedge this
+        subsystem exists to avoid. Recovery is a jittered-backoff loop:
+        fresh node LIST to resync membership (events missed during the gap
+        included REMOVALS, so the list must be reconciled as truth, not
+        merged), then a new watch.
+        """
         assert self._watch is not None
-        for ev in self._watch.events():
-            if self._stop.is_set():
-                return
-            node_name = (ev.object.get("metadata") or {}).get("name", "")
-            span = self.tracer.span(
-                "reconcile", tags={"event": ev.type, "node": node_name}
-            )
-            try:
-                with span:
-                    self._handle(ev.type, ev.object)
-                self._m_reconciles.inc(outcome="ok")
-            except Exception as e:
-                self._m_reconciles.inc(outcome="error")
-                logger.exception("error handling node event")
-                if self.events is not None and node_name:
-                    # kubectl describe node must show why this node's
-                    # domain membership failed to reconcile.
-                    self.events.warning(
-                        ObjectRef.node(
-                            node_name,
-                            (ev.object.get("metadata") or {}).get("uid", ""),
-                        ),
-                        "ReconcileFailed",
-                        f"ICI slice reconcile for node event {ev.type} "
-                        f"failed: {e}",
+        backoff = Backoff(initial=0.2, cap=30.0, jitter=True)
+        try:
+            while not self._stop.is_set():
+                for ev in self._watch.events():
+                    if self._stop.is_set():
+                        return
+                    self._reconcile_event(ev)
+                delay = backoff.next_delay()
+                if self._stop.is_set():
+                    return
+                self._m_reconciles.inc(outcome="watch-restart")
+                logger.warning(
+                    "node watch stream ended unexpectedly; re-establishing "
+                    "in %.1fs", delay,
+                )
+                if self._stop.wait(delay):
+                    return
+                try:
+                    self._reestablish_watch()
+                    # Success = the apiserver is answering again; the next
+                    # stream death (server-side timeouts are routine) must
+                    # not inherit an escalated membership-blind delay.
+                    backoff.reset()
+                except Exception:
+                    logger.exception(
+                        "node watch re-establishment failed; will retry"
                     )
-            self._m_reconcile_seconds.observe(span.duration)
+        finally:
+            # stop() may have timed out its join while this thread was
+            # blocked re-establishing and then installed a fresh watch;
+            # whoever finishes last closes the current one.
+            if self._stop.is_set() and self._watch is not None:
+                self._watch.stop()
+
+    def _reconcile_event(self, ev) -> None:
+        node_name = (ev.object.get("metadata") or {}).get("name", "")
+        span = self.tracer.span(
+            "reconcile", tags={"event": ev.type, "node": node_name}
+        )
+        try:
+            with span:
+                self._handle(ev.type, ev.object)
+            self._m_reconciles.inc(outcome="ok")
+        except Exception as e:
+            self._m_reconciles.inc(outcome="error")
+            logger.exception("error handling node event")
+            if self.events is not None and node_name:
+                # kubectl describe node must show why this node's
+                # domain membership failed to reconcile.
+                self.events.warning(
+                    ObjectRef.node(
+                        node_name,
+                        (ev.object.get("metadata") or {}).get("uid", ""),
+                    ),
+                    "ReconcileFailed",
+                    f"ICI slice reconcile for node event {ev.type} "
+                    f"failed: {e}",
+                )
+        self._m_reconcile_seconds.observe(span.duration)
+
+    def _reestablish_watch(self) -> None:
+        """Fresh seed list + new watch stream after an unexpected stream
+        death. The NEW watch opens BEFORE the seed list: a node deleted
+        in the window between the two is then either absent from the
+        list (pruned by the stale sweep) or present in it with its
+        DELETED event buffered on the already-open watch — no ordering
+        lets a missed removal leak a stale channel pool. The list is
+        reconciled as the authoritative membership: vanished nodes
+        removed (their domains pruned), changed labels re-homed;
+        duplicate events from the overlap are idempotent in _handle."""
+        new_watch = self.client.watch(NODES, label_selector=SLICE_LABEL)
+        try:
+            seed = self.client.list(NODES, label_selector=SLICE_LABEL)
+            seen = {n["metadata"]["name"] for n in seed}
+            for node in seed:
+                self._handle("MODIFIED", node)
+            with self._lock:
+                stale = [n for n in self._node_domain if n not in seen]
+            for name in stale:
+                self._handle("DELETED", {"metadata": {"name": name}})
+        except BaseException:
+            # ANY failure before installation (list, or a seed-replay
+            # reconcile raising) must close the fresh watch, or each
+            # failed retry leaks a live producer thread.
+            new_watch.stop()
+            raise
+        old = self._watch
+        self._watch = new_watch
+        if old is not None:
+            old.stop()
+        logger.info("node watch re-established (%d labeled nodes)", len(seed))
 
     def _handle(self, ev_type: str, node: dict) -> None:
         name = node["metadata"]["name"]

@@ -36,7 +36,8 @@ import urllib.parse
 import urllib.request
 from typing import Callable, Iterator, Optional
 
-from ..utils.backoff import Backoff, TokenBucket
+from ..utils import faults
+from ..utils.backoff import Backoff, TokenBucket, full_jitter
 from .errors import (
     AlreadyExistsError,
     ApiError,
@@ -260,6 +261,9 @@ class FakeKubeClient(KubeClient):
         return (gvr.resource, namespace if gvr.namespaced else "", name)
 
     def _maybe_fault(self, verb: str, gvr: GVR, name: str):
+        # Chaos seam: the global fault registry fires before the per-client
+        # injector, so env-armed schedules reach the fake API server too.
+        faults.fire(f"kube.{verb}")
         if "/" in gvr.api_version:
             group, _, version = gvr.api_version.partition("/")
             served = self.served_api_versions.get(group)
@@ -803,9 +807,14 @@ class RealKubeClient(KubeClient):
                     raise
                 attempts += 1
                 self._m_retries.inc(reason=str(e.code))
-                delay = e.retry_after if e.retry_after is not None else min(
-                    0.5 * (2 ** attempts), 10.0
-                )
+                if e.retry_after is not None:
+                    # Server-directed pacing is honored exactly.
+                    delay = e.retry_after
+                else:
+                    # Client-derived delays get full jitter so a fleet of
+                    # plugins hit by one overload wave decorrelates
+                    # instead of retrying in lockstep.
+                    delay = full_jitter(min(0.5 * (2 ** attempts), 10.0))
                 delay = min(delay, 30.0)
                 logger.warning(
                     "%s %s got %d (attempt %d/%d); retrying in %.1fs",
@@ -862,6 +871,11 @@ class RealKubeClient(KubeClient):
             raise ApiError(msg, code=e.code, retry_after=retry_after) from e
 
     def get(self, gvr: GVR, name: str, namespace: str = "") -> dict:
+        # Chaos sites fire on the LOGICAL verb (kube.get/list/create/...)
+        # in both the real and fake clients, so an env-armed drill spec
+        # behaves identically against either — never on the HTTP method,
+        # which would silently rename kube.update to kube.put here.
+        faults.fire("kube.get")
         return self._request("GET", self._url(gvr, namespace, name))
 
     def api_group_versions(self, group: str) -> list[str]:
@@ -944,17 +958,21 @@ class RealKubeClient(KubeClient):
         namespace: str = "",
         label_selector: str | None = None,
     ) -> list[dict]:
+        faults.fire("kube.list")
         return self._list_raw(gvr, namespace, label_selector).get("items", [])
 
     def create(self, gvr: GVR, obj: dict, namespace: str = "") -> dict:
+        faults.fire("kube.create")
         return self._request("POST", self._url(gvr, namespace), obj)
 
     def update(self, gvr: GVR, obj: dict, namespace: str = "") -> dict:
+        faults.fire("kube.update")
         return self._request(
             "PUT", self._url(gvr, namespace, obj["metadata"]["name"]), obj
         )
 
     def delete(self, gvr: GVR, name: str, namespace: str = "") -> None:
+        faults.fire("kube.delete")
         self._request("DELETE", self._url(gvr, namespace, name))
 
     def watch(
@@ -963,6 +981,7 @@ class RealKubeClient(KubeClient):
         namespace: str = "",
         label_selector: str | None = None,
     ) -> Watch:
+        faults.fire("kube.watch")
         if self.watch_mode == "stream":
             return self._watch_stream(gvr, namespace, label_selector)
         return self._watch_poll(gvr, namespace, label_selector)
@@ -1013,7 +1032,8 @@ class RealKubeClient(KubeClient):
         def _stream():
             known: dict[str, str] = {}
             rv = ""
-            backoff = Backoff(initial=0.2, cap=max(self.poll_interval, 1.0))
+            backoff = Backoff(initial=0.2, cap=max(self.poll_interval, 1.0),
+                              jitter=True)
             while not w.stopped:
                 try:
                     if not rv:
@@ -1168,7 +1188,7 @@ class RealKubeClient(KubeClient):
         def _poll():
             known: dict[str, str] = {}  # name -> resourceVersion
             backoff = Backoff(initial=self.poll_interval,
-                              cap=max(60.0, self.poll_interval))
+                              cap=max(60.0, self.poll_interval), jitter=True)
             while not w.stopped:
                 try:
                     items = self.list(gvr, namespace, label_selector)

@@ -21,6 +21,7 @@ import logging
 import threading
 from typing import Optional
 
+from ..utils.backoff import Backoff
 from .client import GVR, KubeClient
 from .errors import AlreadyExistsError, ConflictError, NotFoundError
 from .resourceapi import ResourceApi
@@ -94,6 +95,8 @@ class ResourceSliceController:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.sync_errors = 0  # observability counter
+        self._last_sync_error = ""  # "" = last pass succeeded
+        self.last_success_monotonic = 0.0  # of the last successful pass
 
     # -- public API --------------------------------------------------------
 
@@ -134,6 +137,8 @@ class ResourceSliceController:
         outage, or the control plane was upgraded in place): re-discover,
         and when the answer differs, re-target and retry the pass — the
         pod must not need a restart to recover."""
+        import time as _time
+
         with self._sync_lock:
             with self._lock:
                 desired = self._desired
@@ -143,6 +148,8 @@ class ResourceSliceController:
                 if not self._rediscover():
                     raise
                 self._sync(desired)
+            self._last_sync_error = ""
+            self.last_success_monotonic = _time.monotonic()
 
     def _rediscover(self) -> bool:
         """Re-run version discovery; returns True when the dialect moved
@@ -163,19 +170,38 @@ class ResourceSliceController:
     # -- reconcile loop ----------------------------------------------------
 
     def _run(self) -> None:
+        # Jittered exponential retry: during an apiserver blackout every
+        # plugin's publisher queues republishes behind this — full jitter
+        # keeps a node-pool's worth of them from stampeding the recovering
+        # server in lockstep.
+        backoff = Backoff(
+            initial=0.5, cap=min(60.0, self.resync_seconds), jitter=True
+        )
         while not self._stop.is_set():
             self._trigger.wait(timeout=self.resync_seconds)
             self._trigger.clear()
             if self._stop.is_set():
                 return
             try:
-                self.sync_once()
-            except Exception:
+                self.sync_once()  # clears _last_sync_error on success
+                backoff.reset()
+            except Exception as e:
                 self.sync_errors += 1
-                logger.exception("resourceslice sync failed; will retry")
+                self._last_sync_error = str(e)
+                delay = backoff.next_delay()
+                logger.exception(
+                    "resourceslice sync failed; retrying in %.1fs", delay
+                )
                 # Transient-error retry (imex.go:143-162 analog).
                 self._trigger.set()
-                self._stop.wait(timeout=min(60.0, self.resync_seconds))
+                self._stop.wait(timeout=delay)
+
+    def sync_health(self):
+        """(ok, detail): whether the last reconcile pass against the
+        apiserver succeeded — the plugin's degraded-readiness input."""
+        if self._last_sync_error:
+            return False, f"slice republish failing: {self._last_sync_error}"
+        return True, "slices in sync"
 
     def _slice_name(self, pool_name: str, index: int) -> str:
         return f"{pool_name}-{self.driver_name.replace('.', '-')}-{index}"

@@ -52,8 +52,35 @@ class CheckpointManager:
             self.write({})
 
     def read(self) -> dict[str, dict]:
-        with open(self.path) as f:
-            payload = json.load(f)
+        """Read and verify the prepared-claims map.
+
+        Every way a checkpoint file can be bad surfaces as
+        ``CorruptCheckpointError``: truncated/garbage JSON
+        (JSONDecodeError), a non-object or field-less payload (KeyError/
+        TypeError/AttributeError), and checksum/version mismatches. A
+        missing file stays FileNotFoundError — that is "never created",
+        not corruption, and callers treat the two differently. Other
+        OSErrors (EIO from a dying disk) wrap too: to the recovery path
+        (quarantine + restart from empty) an unreadable checkpoint and an
+        undecodable one are the same condition.
+        """
+        from ..utils import faults
+
+        faults.fire("checkpoint.read")
+        try:
+            with open(self.path) as f:
+                payload = json.load(f)
+        except FileNotFoundError:
+            raise
+        except (OSError, ValueError) as e:
+            raise CorruptCheckpointError(
+                f"checkpoint {self.path}: unreadable: {e}"
+            ) from e
+        if not isinstance(payload, dict):
+            raise CorruptCheckpointError(
+                f"checkpoint {self.path}: payload is "
+                f"{type(payload).__name__}, not an object"
+            )
         want = payload.get("checksum", "")
         if _checksum(payload) != want:
             raise CorruptCheckpointError(
@@ -63,9 +90,17 @@ class CheckpointManager:
             raise CorruptCheckpointError(
                 f"checkpoint {self.path}: unknown version {payload.get('version')!r}"
             )
-        return payload["preparedClaims"]
+        claims = payload.get("preparedClaims")
+        if not isinstance(claims, dict):
+            raise CorruptCheckpointError(
+                f"checkpoint {self.path}: preparedClaims missing or not a map"
+            )
+        return claims
 
     def write(self, prepared_claims: dict[str, dict]) -> None:
+        from ..utils import faults
+
+        faults.fire("checkpoint.write")
         with child_span("checkpoint-write") as sp:
             sp.set_tag("claims", len(prepared_claims))
             payload = {
@@ -75,3 +110,14 @@ class CheckpointManager:
             }
             payload["checksum"] = _checksum(payload)
             atomic_write_json(self.path, payload, indent=1)
+
+    def quarantine(self) -> str:
+        """Move a corrupt checkpoint aside to ``<path>.corrupt`` (clobbering
+        any older quarantine — the freshest evidence wins) and return the
+        quarantine path. The startup recovery seam: a DaemonSet pod must
+        not crash-loop on a checkpoint no restart will ever fix; parking
+        the file preserves it for forensics while the plugin continues
+        from empty state (prepared claims re-prepare idempotently)."""
+        quarantine_path = f"{self.path}.corrupt"
+        os.replace(self.path, quarantine_path)
+        return quarantine_path

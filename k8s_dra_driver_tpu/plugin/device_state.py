@@ -30,7 +30,12 @@ from ..cdi.spec import (
     claim_visibility_env,
     ici_channel_launch_env,
 )
-from ..tpulib.chiplib import SHARING_EXCLUSIVE, ChipLib
+from ..tpulib.chiplib import (
+    HEALTH_GONE,
+    SHARING_EXCLUSIVE,
+    ChipLib,
+    HealthStatus,
+)
 from ..tpulib.deviceinfo import (
     AllocatableDevice,
     AllocatableDevices,
@@ -52,6 +57,12 @@ logger = logging.getLogger(__name__)
 
 class PrepareError(RuntimeError):
     pass
+
+
+class UnhealthyDeviceError(PrepareError):
+    """Typed refusal: the claim landed on a chip the health poll marked
+    degraded/gone. Kubelet retries surface this in-band; the scheduler
+    should re-place once the republished slices reflect the chip state."""
 
 
 # Which config kind governs which device type (role of the type-compatibility
@@ -96,9 +107,40 @@ class DeviceState:
         self.device_classes = device_classes or {"chip", "tensorcore", "ici"}
         self._lock = threading.Lock()
 
+        # Startup checkpoint recovery FIRST: a corrupt checkpoint must not
+        # crash-loop the DaemonSet (every later step below reads it). The
+        # corrupt file is parked at <path>.corrupt for forensics and the
+        # plugin continues from empty state — prepared claims re-prepare
+        # idempotently on kubelet's next retry.
+        from .checkpoint import CorruptCheckpointError
+
+        self.checkpoint.create_if_missing()
+        try:
+            self.checkpoint.read()
+        except CorruptCheckpointError as e:
+            quarantined = self.checkpoint.quarantine()
+            logger.error(
+                "checkpoint corrupt at startup (%s); quarantined to %s, "
+                "continuing from empty state", e, quarantined,
+            )
+            self.checkpoint.write({})
+
         self.chiplib.init()
-        self.allocatable: AllocatableDevices = (
-            self.chiplib.enumerate_all_possible_devices(self.device_classes)
+        # Per-chip health (uuid -> HealthStatus) and the transition log the
+        # driver drains for Events/metrics. Health is polled together with
+        # every inventory refresh; `gone` chips are dropped from
+        # allocatable, unhealthy ones stay published with healthy=false.
+        self.chip_health: dict[str, HealthStatus] = {}
+        self._health_transitions: list[tuple[str, str, HealthStatus]] = []
+        chips, lib_health = self.chiplib.snapshot()
+        health = self._merge_gone(lib_health)
+        self._record_transitions(health)
+        self.chip_health = health
+        self.allocatable: AllocatableDevices = self._stamp_health(
+            self.chiplib.enumerate_all_possible_devices(
+                self.device_classes, chips=chips
+            ),
+            health,
         )
         # What the base CDI spec currently contains — a superset of
         # allocatable while prepared claims pin entries for transiently
@@ -114,7 +156,89 @@ class DeviceState:
             self.chiplib, share_state, f"{state_dir}/process-share"
         )
         self.share_state = share_state
-        self.checkpoint.create_if_missing()
+
+    # ------------------------------------------------------------------
+    # Health
+    # ------------------------------------------------------------------
+
+    def _merge_gone(
+        self, fresh: dict[str, HealthStatus]
+    ) -> dict[str, HealthStatus]:
+        """Extend a library health report with gone-markers for chips WE
+        remember that the library no longer reports at all — a backend
+        without memory still yields correct gone-detection."""
+        import time as _time
+
+        now = _time.time()
+        for uuid in self.chip_health:
+            if uuid not in fresh:
+                fresh[uuid] = HealthStatus(
+                    HEALTH_GONE, "disappeared from inventory", now
+                )
+        return fresh
+
+    def _record_transitions(self, fresh: dict[str, HealthStatus]) -> None:
+        """Append (uuid, old_state, new_status) for every state change
+        against ``self.chip_health``. A chip first seen in a non-healthy
+        state counts as a transition from healthy — it must still produce
+        an Event/metric, or a chip that boots sick is invisible."""
+        from ..tpulib.chiplib import HEALTH_HEALTHY
+
+        for uuid, status in fresh.items():
+            prev = self.chip_health.get(uuid)
+            prev_state = prev.state if prev is not None else HEALTH_HEALTHY
+            if status.state != prev_state:
+                self._health_transitions.append(
+                    (uuid, prev_state, status)
+                )
+
+    @staticmethod
+    def _device_chip(dev: AllocatableDevice):
+        """The ChipInfo whose health governs this device (None for ICI
+        channels, which have no node-local hardware to sicken)."""
+        if dev.chip is not None:
+            return dev.chip
+        if dev.tensorcore is not None:
+            return dev.tensorcore.parent
+        return None
+
+    def _stamp_health(
+        self, devices: AllocatableDevices, health: dict[str, HealthStatus]
+    ) -> AllocatableDevices:
+        """Drop devices of ``gone`` chips and stamp the healthy flag (the
+        published tpu.google.com/healthy attribute) onto the rest. Chip
+        and tensorcore devices share one ChipInfo instance, so stamping
+        once covers both renderings."""
+        out: AllocatableDevices = {}
+        for name, dev in devices.items():
+            chip = self._device_chip(dev)
+            if chip is None:
+                out[name] = dev
+                continue
+            status = health.get(chip.uuid)
+            if status is not None and status.is_gone():
+                continue
+            chip.healthy = status is None or status.is_healthy()
+            chip.health_reason = "" if status is None else status.reason
+            out[name] = dev
+        return out
+
+    def drain_health_transitions(self):
+        """Hand the accumulated health transitions to the caller (the
+        driver's watch loop) exactly once each."""
+        with self._lock:
+            out = self._health_transitions
+            self._health_transitions = []
+        return out
+
+    def health_of_device(self, name: str) -> Optional[HealthStatus]:
+        chip = None
+        dev = self.allocatable.get(name)
+        if dev is not None:
+            chip = self._device_chip(dev)
+        if chip is None:
+            return None
+        return self.chip_health.get(chip.uuid)
 
     # ------------------------------------------------------------------
     # Prepare
@@ -212,8 +336,11 @@ class DeviceState:
             if dev is None:
                 raise PrepareError(f"allocated device {name!r} is not allocatable here")
             if r.get("request", "") in admin_reqs:
+                # adminAccess is deliberately NOT health-gated: draining a
+                # degraded chip is exactly when a monitoring pod needs on.
                 admin_members.append((r.get("request", ""), dev))
                 continue
+            self._ensure_device_healthy(name, dev)
             cfg = self._resolve_config(configs, r.get("request", ""), dev.type())
             key = id(cfg)
             grouped.setdefault(key, (cfg, []))[1].append((r.get("request", ""), dev))
@@ -329,12 +456,33 @@ class DeviceState:
                     )
             raise
 
+        import time as _time
+
         return PreparedClaim(
             claim_uid=claim_uid,
             namespace=claim["metadata"].get("namespace", ""),
             name=claim["metadata"].get("name", ""),
             groups=groups,
+            prepared_at=_time.time(),
         )
+
+    def _ensure_device_healthy(self, name: str, dev: AllocatableDevice) -> None:
+        """Refuse to prepare onto a chip the health poll marked unhealthy.
+
+        The allocation raced the hardware: the scheduler picked from slices
+        published before the chip sickened. A typed error (vs a generic
+        PrepareError) lets callers and tests distinguish 'health race' from
+        'bad claim', and the republished slices steer the retry elsewhere.
+        """
+        chip = self._device_chip(dev)
+        if chip is None:
+            return
+        status = self.chip_health.get(chip.uuid)
+        if status is not None and not status.is_healthy():
+            raise UnhealthyDeviceError(
+                f"device {name} (chip {chip.uuid}) is {status.state}: "
+                f"{status.reason or 'no reason recorded'}"
+            )
 
     def _make_prepared_device(
         self,
@@ -492,18 +640,35 @@ class DeviceState:
     # ------------------------------------------------------------------
 
     def refresh_allocatable(self) -> bool:
-        """Re-enumerate the chip inventory; True when it changed.
+        """Re-enumerate inventory AND poll chip health; True when either
+        changed the published view.
 
         The consumer is the driver's device-watch loop: chip hot-plug /
         vfio rebind must reach the published ResourceSlices, a path the
         reference lacks entirely (NVML enumeration happens once at
-        startup, nvlib.go:111-136). Prepared claims are unaffected — they
-        carry their own device snapshots through the checkpoint.
+        startup, nvlib.go:111-136). Health transitions ride the same
+        change detection — a flipped healthy attribute (or a dropped gone
+        chip) alters the rendered devices, so the caller republishes; the
+        transition log feeds Events/metrics via
+        ``drain_health_transitions``. Prepared claims are unaffected —
+        they carry their own device snapshots through the checkpoint.
         """
-        fresh = self.chiplib.enumerate_all_possible_devices(
-            self.device_classes
-        )
         with self._lock:
+            # ONE hardware probe per tick (ChipLib.snapshot): chips and
+            # health observe the same instant — a chip can never
+            # enumerate present while the same refresh reports it gone —
+            # and the lock (shared with Prepare RPCs) is held for a
+            # single walk, not two.
+            chips, lib_health = self.chiplib.snapshot()
+            health = self._merge_gone(lib_health)
+            self._record_transitions(health)
+            self.chip_health = health
+            fresh = self._stamp_health(
+                self.chiplib.enumerate_all_possible_devices(
+                    self.device_classes, chips=chips
+                ),
+                health,
+            )
             changed = (
                 {n: d.get_device() for n, d in fresh.items()}
                 != {n: d.get_device() for n, d in self.allocatable.items()}
@@ -536,6 +701,38 @@ class DeviceState:
                     if dev.get("name"):
                         names.add(dev["name"])
         return names
+
+    def cached_devices(self, claim_uid: str) -> Optional[list[KubeletDevice]]:
+        """The checkpointed prepare result for a claim, or None.
+
+        Degraded-mode seam: when the apiserver is unreachable the driver
+        serves kubelet retries of ALREADY-PREPARED claims from this — the
+        checkpoint is the ground truth the idempotent-prepare contract
+        rests on, and a pod restart must not hinge on apiserver health.
+        """
+        with self._lock:
+            recs = self.checkpoint.read()
+            rec = recs.get(claim_uid)
+            if rec is None:
+                return None
+            return PreparedClaim.from_dict(rec).get_devices()
+
+    def prepared_claims_on_chip(self, chip_uuid: str) -> list[PreparedClaim]:
+        """Checkpointed claims holding this chip (directly or via one of
+        its core partitions, whose uuids are prefixed by the chip's) — the
+        Event targets when a carrying chip degrades."""
+        with self._lock:
+            recs = self.checkpoint.read()
+        out = []
+        for rec in recs.values():
+            pc = PreparedClaim.from_dict(rec)
+            uuids = [
+                u for g in pc.groups for d in g.devices for u in d.uuids
+            ]
+            if any(u == chip_uuid or u.startswith(f"{chip_uuid}-")
+                   for u in uuids):
+                out.append(pc)
+        return out
 
     def published_resources(self) -> dict[str, Any]:
         """DriverResources (pool spec) for the ResourceSlice controller —

@@ -36,6 +36,24 @@ logger = logging.getLogger(__name__)
 DRIVER_NAME = "tpu.google.com"
 
 
+class ClaimVerifyError(RuntimeError):
+    """The claim could not be VERIFIED (no kube client, or the apiserver's
+    copy has a different UID) — distinct from 'the apiserver is down',
+    which the degraded-mode path may absorb."""
+
+
+def _is_outage(e: Exception) -> bool:
+    """Whether an exception from a claim fetch means the apiserver is
+    UNREACHABLE (degraded-mode territory) rather than answering. 429 and
+    5xx are load-shedding/outage; any other ApiError is a definitive
+    answer. URLError/socket timeouts subclass OSError."""
+    from ..kube.errors import ApiError
+
+    if isinstance(e, ApiError):
+        return e.code == 429 or e.code >= 500
+    return isinstance(e, (OSError, TimeoutError))
+
+
 @dataclasses.dataclass
 class DriverConfig:
     """Flags/env surface (main.go:73-123 analog)."""
@@ -118,6 +136,17 @@ class Driver(NodeServicer):
             "Device inventory changes republished",
             self.registry,
         )
+        self._m_health_transitions = Counter(
+            "tpu_dra_chip_health_transitions_total",
+            "Chip health state transitions observed by the health poll",
+            self.registry,
+        )
+        self._m_degraded_prepares = Counter(
+            "tpu_dra_degraded_prepares_total",
+            "Prepares served from checkpointed state while the apiserver "
+            "was unreachable (degraded mode)",
+            self.registry,
+        )
         # Failures (and recoveries) become kubectl-visible Events on the
         # ResourceClaim; no-op without a kube client.
         self.events = EventRecorder(
@@ -128,6 +157,17 @@ class Driver(NodeServicer):
         # Readiness inputs: monotonic time of the last successful inventory
         # enumeration (the DeviceState constructor below does the first).
         self._last_inventory_ok = time.monotonic()
+        # Degraded-mode inputs: whether the last apiserver round-trip from
+        # the claim path succeeded (served by the non-critical /readyz
+        # check, so an apiserver outage reads "degraded", not "dead").
+        self._apiserver_ok = True
+        self._apiserver_err = ""
+        self._apiserver_failed_at = 0.0  # monotonic, of the last failure
+        # Serializes the claim path's failure/success writes against the
+        # readiness thread's evidence-based recovery (check-then-act on
+        # the three fields above would otherwise let a recovery write
+        # clobber a newer failure).
+        self._apiserver_state_lock = threading.Lock()
         self.state = DeviceState(
             chiplib=config.chiplib,
             cdi=CDIHandler(
@@ -227,6 +267,7 @@ class Driver(NodeServicer):
             try:
                 changed = self.state.refresh_allocatable()
                 self._last_inventory_ok = time.monotonic()
+                self._report_health_transitions()
                 if changed:
                     # Trace only actual inventory changes: a root trace per
                     # idle 30s tick would evict the claim traces the ring
@@ -238,6 +279,40 @@ class Driver(NodeServicer):
                             self.publish_resources()
             except Exception:
                 logger.exception("device inventory refresh failed")
+
+    def _report_health_transitions(self) -> None:
+        """Turn health transitions into the metric and, when the chip
+        carries a PREPARED claim, a Kubernetes Event on that claim — the
+        operator-visible signal that a running workload's hardware
+        sickened (or recovered). Republishing itself rides the ordinary
+        changed-inventory path."""
+        for uuid, old_state, status in self.state.drain_health_transitions():
+            self._m_health_transitions.inc(
+                from_state=old_state, to=status.state
+            )
+            recovered = status.is_healthy()
+            logger.warning(
+                "chip %s health: %s -> %s (%s)",
+                uuid, old_state, status.state, status.reason or "recovered",
+            )
+            for pc in self.state.prepared_claims_on_chip(uuid):
+                ref = ObjectRef.claim(
+                    pc.name, pc.namespace, pc.claim_uid,
+                    api_version=self.resource_api.api_version,
+                )
+                if recovered:
+                    self.events.normal(
+                        ref, "ChipRecovered",
+                        f"chip {uuid} on {self.config.node_name} recovered "
+                        f"(was {old_state})",
+                    )
+                else:
+                    self.events.warning(
+                        ref, "ChipUnhealthy",
+                        f"chip {uuid} on {self.config.node_name} is "
+                        f"{status.state}: {status.reason or 'unknown'} — "
+                        "this claim holds a prepared device on it",
+                    )
 
     def _adopt_resource_api(self, api: ResourceApi) -> None:
         """Take a re-discovered dialect observed by a sibling component
@@ -277,6 +352,41 @@ class Driver(NodeServicer):
             "inventory-fresh": self._check_inventory_fresh,
             "checkpoint-writable": self._check_checkpoint_writable,
         }
+
+    def degraded_checks(self) -> dict:
+        """Non-critical /readyz probes: failing these reads DEGRADED (HTTP
+        200, body says so), not dead — during an apiserver outage the
+        plugin still serves prepares from checkpointed state, and flipping
+        readiness would make kubelet stop talking to a working plugin."""
+        return {"apiserver-reachable": self._check_apiserver}
+
+    def _check_apiserver(self):
+        if self.config.kube_client is None:
+            return True, "kube-less dev mode"
+        problems = []
+        slice_ok, detail = self.plugin.slice_sync_health()
+        if not slice_ok:
+            problems.append(detail)
+        with self._apiserver_state_lock:
+            if not self._apiserver_ok:
+                # The claim path only re-probes when kubelet sends a
+                # claim — which may be never on a quiet node. A slice
+                # reconcile that SUCCEEDED after the claim fetch failed
+                # is equally good evidence the server is back; don't stay
+                # degraded on stale news. (Under the state lock: a fresh
+                # failure recorded concurrently must not be clobbered by
+                # this recovery write.)
+                if (slice_ok and self.plugin.slice_sync_success_at()
+                        > self._apiserver_failed_at):
+                    self._apiserver_ok = True
+                    self._apiserver_err = ""
+                else:
+                    problems.append(
+                        f"claim fetch failing: {self._apiserver_err}"
+                    )
+        if problems:
+            return False, "; ".join(problems)
+        return True, "apiserver reachable"
 
     def _check_grpc_serving(self):
         if self.plugin.serving:
@@ -334,10 +444,7 @@ class Driver(NodeServicer):
             error: Optional[Exception] = None
             with span:
                 try:
-                    with tracing.child_span("fetch-claim"):
-                        resource_claim = self._fetch_claim(claim)
-                    with tracing.child_span("allocate"):
-                        devices = self.state.prepare(resource_claim)
+                    devices = self._fetch_and_prepare(claim)
                     logger.debug(
                         "prepared claim %s: %d device(s)",
                         claim.uid, len(devices),
@@ -377,6 +484,54 @@ class Driver(NodeServicer):
                 ]
             )
 
+    def _fetch_and_prepare(self, claim):
+        """Fetch-verify-prepare, with the degraded-mode fallback.
+
+        When the apiserver cannot be reached at all, an ALREADY-PREPARED
+        claim (present in the checkpoint) is served from its recorded
+        result: a kubelet retry or container restart must not fail just
+        because the control plane is dark — the devices are already set
+        up on this node. A claim the checkpoint does not know still fails
+        (preparing something new requires the allocation spec, which only
+        the apiserver holds). Apiserver ANSWERS are NOT absorbed —
+        NotFound, identity failures, and any non-outage ApiError (a 403
+        from an RBAC regression must surface as a prepare failure, not be
+        masked as an outage); only transport errors, timeouts, and
+        429/5xx load-shedding count as unreachable.
+        """
+        from ..kube.errors import NotFoundError
+
+        try:
+            with tracing.child_span("fetch-claim"):
+                resource_claim = self._fetch_claim(claim)
+        except (NotFoundError, ClaimVerifyError):
+            self._note_apiserver(ok=True)  # the server answered
+            raise
+        except Exception as e:
+            if not _is_outage(e):
+                self._note_apiserver(ok=True)  # answered, not usefully
+                raise
+            self._note_apiserver(ok=False, err=str(e))
+            cached = self.state.cached_devices(claim.uid)
+            if cached is None:
+                raise
+            self._m_degraded_prepares.inc()
+            logger.warning(
+                "apiserver unreachable (%s); serving prepare of claim %s "
+                "from checkpointed state (degraded mode)", e, claim.uid,
+            )
+            return cached
+        self._note_apiserver(ok=True)
+        with tracing.child_span("allocate"):
+            return self.state.prepare(resource_claim)
+
+    def _note_apiserver(self, ok: bool, err: str = "") -> None:
+        with self._apiserver_state_lock:
+            self._apiserver_ok = ok
+            self._apiserver_err = err
+            if not ok:
+                self._apiserver_failed_at = time.monotonic()
+
     def _fetch_claim(self, claim) -> dict:
         """GET the ResourceClaim and verify identity (driver.go:120-131).
 
@@ -386,7 +541,7 @@ class Driver(NodeServicer):
         a missing claim, so a bad boot self-heals without a pod restart.
         """
         if self.config.kube_client is None:
-            raise RuntimeError("no kube client configured")
+            raise ClaimVerifyError("no kube client configured")
         from ..kube.errors import NotFoundError
 
         try:
@@ -419,7 +574,7 @@ class Driver(NodeServicer):
         obj = self.resource_api.claim_from_wire(obj)
         uid = obj["metadata"].get("uid", "")
         if uid != claim.uid:
-            raise RuntimeError(
+            raise ClaimVerifyError(
                 f"claim {claim.namespace}/{claim.name} UID mismatch: "
                 f"kubelet={claim.uid} apiserver={uid} (deleted+recreated?)"
             )

@@ -192,5 +192,20 @@ class KubeletPlugin:
         """Whether the DRA gRPC server is up (readiness input)."""
         return self._dra_server is not None
 
+    def slice_sync_health(self):
+        """(ok, detail) for the slice publisher — degraded-readiness
+        input. True before the first publish (nothing to sync yet)."""
+        ctrl = self._slice_controller
+        if ctrl is None:
+            return True, "no slices published yet"
+        return ctrl.sync_health()
+
+    def slice_sync_success_at(self) -> float:
+        """Monotonic time of the last successful slice reconcile (0.0 if
+        none yet) — evidence of apiserver reachability that claim-fetch
+        recovery can key on."""
+        ctrl = self._slice_controller
+        return ctrl.last_success_monotonic if ctrl is not None else 0.0
+
     def registration_status(self) -> Optional[dict]:
         return self._registration_status

@@ -231,12 +231,16 @@ def lookup_fake_host_id(
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    from ..utils import faults
     from ..utils.logging import setup_logging
     from ..utils.metrics import Registry
 
     # None lets the TPU_DRA_LOG_* env overrides apply; an explicit flag wins.
     setup_logging(level=args.log_level or None,
                   json_format=True if args.log_json else None)
+    # Chaos arm point: no-op unless TPU_DRA_FAULTS is set (never in
+    # production manifests; here so failure drills run on a real binary).
+    faults.arm_from_env()
     if not args.node_name:
         logger.error("--node-name (or NODE_NAME) is required")
         return 2
@@ -297,6 +301,10 @@ def main(argv=None) -> int:
                                 tracer=driver.tracer)
         for name, check in driver.readiness_checks().items():
             metrics.add_readiness_check(name, check)
+        # Non-critical: these failing reads "degraded" (200), not dead —
+        # an apiserver outage must not flip the DaemonSet readinessProbe.
+        for name, check in driver.degraded_checks().items():
+            metrics.add_readiness_check(name, check, critical=False)
         metrics.start()
         logger.info("metrics on :%d/metrics (+/readyz, /debug/traces)",
                     metrics.port)

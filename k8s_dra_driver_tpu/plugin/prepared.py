@@ -123,6 +123,11 @@ class PreparedClaim:
     namespace: str = ""
     name: str = ""
     groups: list[PreparedDeviceGroup] = dataclasses.field(default_factory=list)
+    # Epoch seconds when the prepare completed. 0.0 on records written
+    # before this field existed; the chaos invariant checker uses it to
+    # order prepares against chip-health transitions (a claim may sit on
+    # a chip that degraded AFTER it prepared — never before).
+    prepared_at: float = 0.0
 
     def to_dict(self) -> dict:
         return {
@@ -130,6 +135,7 @@ class PreparedClaim:
             "namespace": self.namespace,
             "name": self.name,
             "groups": [g.to_dict() for g in self.groups],
+            "preparedAt": self.prepared_at,
         }
 
     @classmethod
@@ -139,6 +145,7 @@ class PreparedClaim:
             namespace=d.get("namespace", ""),
             name=d.get("name", ""),
             groups=[PreparedDeviceGroup.from_dict(g) for g in d.get("groups", [])],
+            prepared_at=d.get("preparedAt", 0.0),
         )
 
     def get_devices(self) -> list[KubeletDevice]:

@@ -2,10 +2,14 @@
 nvlib.go + deviceinfo.go + allocatable.go, see SURVEY.md §2)."""
 
 from .chiplib import (  # noqa: F401
+    HEALTH_DEGRADED,
+    HEALTH_GONE,
+    HEALTH_HEALTHY,
     ICI_CHANNEL_COUNT,
     ChipLib,
     ChipLibConfig,
     FakeChipLib,
+    HealthStatus,
     RealChipLib,
     SHARING_EXCLUSIVE,
     SHARING_PROCESS_SHARED,

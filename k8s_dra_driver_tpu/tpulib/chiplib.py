@@ -73,6 +73,33 @@ def normalize_generation(gen: str) -> str:
 # single driver instance will advertise.
 ICI_CHANNEL_COUNT = 2048
 
+# Chip health states. The reference has no health model at all — an NVML
+# device that wedges after startup stays advertised forever; here health is
+# a first-class output of the chip library, consumed by DeviceState.
+HEALTH_HEALTHY = "healthy"
+HEALTH_DEGRADED = "degraded"   # present but erroring; drain, don't allocate
+HEALTH_GONE = "gone"           # device node vanished (unplug, vfio rebind)
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthStatus:
+    """Point-in-time health of one chip.
+
+    ``since`` is the epoch timestamp of the OBSERVATION (the poll that
+    produced this status), not of the underlying hardware event — the
+    library has no better clock for that.
+    """
+
+    state: str = HEALTH_HEALTHY
+    reason: str = ""
+    since: float = 0.0
+
+    def is_healthy(self) -> bool:
+        return self.state == HEALTH_HEALTHY
+
+    def is_gone(self) -> bool:
+        return self.state == HEALTH_GONE
+
 # Sharing modes for a chip runtime (role of NVML compute modes,
 # nvlib.go:541-558).
 SHARING_EXCLUSIVE = "exclusive"
@@ -136,12 +163,16 @@ class ChipLib(abc.ABC):
     def enumerate_chips(self) -> list[ChipInfo]: ...
 
     def enumerate_all_possible_devices(
-        self, device_classes: set[str]
+        self, device_classes: set[str],
+        chips: Optional[list[ChipInfo]] = None,
     ) -> AllocatableDevices:
         """Enumerate chips + core partitions + ICI channels
-        (enumerateAllPossibleDevices, nvlib.go:111-136)."""
+        (enumerateAllPossibleDevices, nvlib.go:111-136). Pass ``chips``
+        (e.g. from :meth:`snapshot`) to build from an existing probe
+        instead of re-walking the hardware."""
         devices: AllocatableDevices = {}
-        chips = self.enumerate_chips()
+        if chips is None:
+            chips = self.enumerate_chips()
         if "chip" in device_classes or "tensorcore" in device_classes:
             for chip in chips:
                 if "chip" in device_classes:
@@ -206,6 +237,35 @@ class ChipLib(abc.ABC):
         time.sleep(timeout_s)
         return False
 
+    def chip_health(
+        self, chips: Optional[list[ChipInfo]] = None
+    ) -> dict[str, HealthStatus]:
+        """uuid → HealthStatus for every chip this backend knows about.
+
+        ``chips`` lets the caller supply an enumeration it already has
+        (hardware probes are not free; see :meth:`snapshot`). MAY include
+        chips ``enumerate_chips`` no longer returns (reported ``gone``
+        with a reason) when the backend remembers them; callers
+        additionally diff against their own previous view, so a backend
+        without memory (this default: everything visible is healthy) still
+        yields correct gone-detection one layer up (DeviceState).
+        """
+        now = time.time()
+        if chips is None:
+            chips = self.enumerate_chips()
+        return {
+            c.uuid: HealthStatus(HEALTH_HEALTHY, since=now) for c in chips
+        }
+
+    def snapshot(self) -> tuple[list[ChipInfo], dict[str, HealthStatus]]:
+        """ONE probe yielding (chips, health) observed at the same
+        instant — the device-watch loop's per-tick read. The default
+        enumerates once and derives health from that enumeration, so a
+        refresh never walks the hardware twice (the probe runs under the
+        DeviceState lock that Prepare RPCs also take)."""
+        chips = self.enumerate_chips()
+        return chips, self.chip_health(chips)
+
     # --- side-effecting operations used at Prepare time -------------------
 
     @abc.abstractmethod
@@ -225,7 +285,15 @@ class ChipLib(abc.ABC):
 
 
 class FakeChipLib(ChipLib):
-    """In-memory chip backend with a configurable slice topology."""
+    """In-memory chip backend with a configurable slice topology.
+
+    Fully scriptable fault controls (the hermetic half of the health
+    subsystem): ``wedge_chip`` marks a chip degraded in place,
+    ``unplug_chip`` removes its device node from enumeration and reports
+    it gone, ``restore_chip`` undoes either, and ``set_flap`` flips a chip
+    between present and gone on a deterministic schedule driven by the
+    health-poll count (never wall time, so chaos tests replay exactly).
+    """
 
     def __init__(
         self,
@@ -255,6 +323,45 @@ class FakeChipLib(ChipLib):
         # Tests set() this to wake a driver watch loop immediately (the
         # fake's stand-in for an inotify device event).
         self.device_event = threading.Event()
+        # Fault state, keyed by host-local chip index.
+        self._wedged: dict[int, str] = {}      # index -> reason
+        self._unplugged: dict[int, str] = {}   # index -> reason
+        self._flaps: dict[int, int] = {}       # index -> period (in polls)
+        self.health_polls = 0                  # deterministic flap clock
+
+    # -- fault controls ----------------------------------------------------
+
+    def wedge_chip(self, index: int, reason: str = "wedged") -> None:
+        """Chip stays enumerated but reports degraded (hung runtime)."""
+        self._wedged[index] = reason
+        self.device_event.set()
+
+    def unplug_chip(self, index: int, reason: str = "unplugged") -> None:
+        """Chip's device node disappears: gone from enumeration + health."""
+        self._unplugged[index] = reason
+        self.device_event.set()
+
+    def restore_chip(self, index: int) -> None:
+        """Undo wedge/unplug/flap for one chip."""
+        self._wedged.pop(index, None)
+        self._unplugged.pop(index, None)
+        self._flaps.pop(index, None)
+        self.device_event.set()
+
+    def set_flap(self, index: int, period: int = 2) -> None:
+        """Flap a chip on a schedule: present for ``period`` health polls,
+        gone for the next ``period``, repeating. Deterministic — driven by
+        the poll count, not time."""
+        if period < 1:
+            raise ValueError(f"flap period must be >= 1, got {period}")
+        self._flaps[index] = period
+        self.device_event.set()
+
+    def _flapped_out(self, index: int) -> bool:
+        period = self._flaps.get(index)
+        if period is None:
+            return False
+        return (self.health_polls // period) % 2 == 1
 
     def init(self) -> None:
         self.initialized = True
@@ -289,7 +396,9 @@ class FakeChipLib(ChipLib):
         lo = self.host_id * self.chips_per_host
         return all_coords[lo:lo + self.chips_per_host]
 
-    def enumerate_chips(self) -> list[ChipInfo]:
+    def _all_chips(self) -> list[ChipInfo]:
+        """Every chip of this host's block, ignoring fault state (the
+        ground truth unplug/flap subtract from)."""
         spec = GENERATIONS[self.generation]
         chips = []
         for local_idx, coord in enumerate(self._host_coords()):
@@ -317,11 +426,60 @@ class FakeChipLib(ChipLib):
             )
         return chips
 
+    def enumerate_chips(self) -> list[ChipInfo]:
+        from ..utils import faults
+
+        faults.fire("chiplib.enumerate")
+        return [
+            c for c in self._all_chips()
+            if c.index not in self._unplugged
+            and not self._flapped_out(c.index)
+        ]
+
+    def chip_health(
+        self, chips: Optional[list[ChipInfo]] = None
+    ) -> dict[str, HealthStatus]:
+        """Scripted health: unplugged/flapped-out chips report gone (with
+        the injected reason), wedged ones degraded, the rest healthy. Each
+        call advances the deterministic flap clock by one poll. ``chips``
+        is ignored — the fake's ground truth is its own fault state, and
+        health must cover unplugged chips a caller's enumeration lacks."""
+        self.health_polls += 1
+        now = time.time()
+        out: dict[str, HealthStatus] = {}
+        for c in self._all_chips():
+            if c.index in self._unplugged:
+                out[c.uuid] = HealthStatus(
+                    HEALTH_GONE, self._unplugged[c.index], now
+                )
+            elif self._flapped_out(c.index):
+                out[c.uuid] = HealthStatus(
+                    HEALTH_GONE,
+                    f"flapping (period {self._flaps[c.index]} polls)", now,
+                )
+            elif c.index in self._wedged:
+                out[c.uuid] = HealthStatus(
+                    HEALTH_DEGRADED, self._wedged[c.index], now
+                )
+            else:
+                out[c.uuid] = HealthStatus(HEALTH_HEALTHY, since=now)
+        return out
+
+    def snapshot(self) -> tuple[list[ChipInfo], dict[str, HealthStatus]]:
+        """Health FIRST (advancing the flap clock), then enumeration, so
+        both halves observe the same deterministic tick — the base
+        default would enumerate at the pre-advance tick."""
+        health = self.chip_health()
+        return self.enumerate_chips(), health
+
     def set_sharing_mode(self, chip_uuids: list[str], mode: str) -> None:
         for u in chip_uuids:
             self.sharing_modes[u] = mode
 
     def create_ici_channel_device(self, channel: int) -> str:
+        from ..utils import faults
+
+        faults.fire("chiplib.create-channel")
         self.created_channels.append(channel)
         return f"/dev/tpu-ici-channels/channel{channel}"
 
@@ -374,6 +532,11 @@ class RealChipLib(ChipLib):
         self.config = config or ChipLibConfig()
         self.initialized = False
         self._native = None
+        # Health-probe memory: chips seen by the last enumeration (so a
+        # vanished device node can be reported gone, not just absent) and
+        # the last libtpu/sysfs error-counter sample per chip.
+        self._known_chips: dict[str, ChipInfo] = {}
+        self._last_errors: dict[str, int] = {}
 
     def init(self) -> None:
         from . import _native
@@ -647,6 +810,9 @@ class RealChipLib(ChipLib):
            (deviceinfo withholds them when ``coords_reliable`` is False),
            so a scheduler can never gang-allocate on made-up contiguity.
         """
+        from ..utils import faults
+
+        faults.fire("chiplib.enumerate")
         nodes = self._probe_accel_nodes()
         # Reject foreign accel-class devices (other vendors' NPUs also appear
         # as /dev/accelN): keep a node only if its sysfs vendor is Google or
@@ -754,6 +920,9 @@ class RealChipLib(ChipLib):
     def create_ici_channel_device(self, channel: int) -> str:
         """mknod the per-channel device (createImexChannelDevice,
         nvlib.go:490-519)."""
+        from ..utils import faults
+
+        faults.fire("chiplib.create-channel")
         dirpath = _hostpath(self.config.dev_root, ICI_CHANNEL_DIR)
         os.makedirs(dirpath, exist_ok=True)
         path = os.path.join(dirpath, f"channel{channel}")
@@ -786,6 +955,78 @@ class RealChipLib(ChipLib):
             except OSError as e:
                 logger.debug("device watch unavailable: %s", e)
         return super().wait_device_event(timeout_s)
+
+    # -- health probing ----------------------------------------------------
+
+    def chip_health(
+        self, chips: Optional[list[ChipInfo]] = None
+    ) -> dict[str, HealthStatus]:
+        """Poll health for every chip this host has ever enumerated.
+
+        ``chips`` skips the enumeration when the caller (snapshot) just
+        did one — a full sysfs walk is not free and this path runs under
+        the DeviceState lock. Two signals, mirroring what a TPU host
+        actually exposes:
+
+        - **presence**: the chip must still enumerate AND its device node
+          must still stat — a vfio rebind or PCIe dropout reads ``gone``;
+        - **error counters**: per-chip error counts from sysfs (the files
+          libtpu's own health monitor reads); a counter that ADVANCED
+          since the previous poll reads ``degraded`` — absolute values are
+          meaningless across reboots, deltas are the signal.
+
+        Chips remembered from earlier polls keep reporting ``gone`` until
+        they re-enumerate, so one missed poll can never silently drop a
+        failure the slice publisher should be reacting to.
+        """
+        now = time.time()
+        if chips is None:
+            chips = self.enumerate_chips()
+        current = {c.uuid: c for c in chips}
+        self._known_chips.update(current)
+        out: dict[str, HealthStatus] = {}
+        for uuid, chip in self._known_chips.items():
+            if uuid not in current:
+                out[uuid] = HealthStatus(
+                    HEALTH_GONE, "chip no longer enumerable", now
+                )
+                continue
+            missing = [
+                p for p in chip.device_paths if not os.path.exists(p)
+            ]
+            if missing:
+                out[uuid] = HealthStatus(
+                    HEALTH_GONE, f"device node missing: {missing[0]}", now
+                )
+                continue
+            errs = self._error_counter(chip.index)
+            if errs is not None:
+                last = self._last_errors.get(uuid)
+                self._last_errors[uuid] = errs
+                if last is not None and errs > last:
+                    out[uuid] = HealthStatus(
+                        HEALTH_DEGRADED,
+                        f"error counter advanced {last} -> {errs}", now,
+                    )
+                    continue
+            out[uuid] = HealthStatus(HEALTH_HEALTHY, since=now)
+        return out
+
+    def _error_counter(self, index: int) -> Optional[int]:
+        """Summed per-chip error counters from sysfs, or None when the
+        host exposes none (older driver stacks): absence must read as
+        'no signal', never as 'zero errors observed'."""
+        devdir = f"{self.config.sysfs_root}/class/accel/accel{index}/device"
+        total: Optional[int] = None
+        for name in ("tpu_error_count", "errors", "ae_count"):
+            try:
+                with open(os.path.join(devdir, name)) as f:
+                    v = _safe_int(f.read(), -1)
+            except OSError:
+                continue
+            if v >= 0:
+                total = (total or 0) + v
+        return total
 
     def _ici_major(self) -> int:
         """Device major for ICI channel nodes from /proc/devices

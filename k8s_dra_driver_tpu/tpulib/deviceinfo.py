@@ -70,6 +70,12 @@ class ChipInfo:
     # the contiguity tile attributes are then withheld so a scheduler
     # never gang-allocates on made-up adjacency.
     coords_reliable: bool = True
+    # Health flags stamped by DeviceState from the chip library's health
+    # poll (chiplib.HealthStatus). Published as the tpu.google.com/healthy
+    # attribute so CEL selectors can require healthy chips; ``gone`` chips
+    # never render at all (DeviceState drops them from allocatable).
+    healthy: bool = True
+    health_reason: str = ""
 
     def canonical_name(self) -> str:
         return f"tpu-{self.index}"
@@ -120,6 +126,7 @@ class ChipInfo:
                     "hostsPerSlice": _attr(self.hosts_per_slice),
                     "pcieAddress": _attr(self.pci_address),
                     "numaNode": _attr(self.numa_node),
+                    "healthy": _attr(self.healthy),
                     "driverVersion": _version_attr(self.driver_version),
                     "firmwareVersion": _version_attr(self.firmware_version),
                 },
@@ -271,6 +278,8 @@ class TensorCoreInfo:
                     "coord": _attr(str(self.parent.coord)),
                     "sliceId": _attr(self.parent.slice_id),
                     "hostId": _attr(self.parent.host_id),
+                    # A partition is only as healthy as its parent chip.
+                    "healthy": _attr(self.parent.healthy),
                     "driverVersion": _version_attr(self.parent.driver_version),
                 },
                 "capacity": {

@@ -9,8 +9,24 @@ transient-error retry delay its controllers use
 
 from __future__ import annotations
 
+import random
 import threading
 import time
+
+
+def full_jitter(delay: float, rng: random.Random | None = None) -> float:
+    """AWS-style full jitter: uniform in [0, delay].
+
+    The point is decorrelation: a node-wide apiserver blip makes every
+    plugin's retry timer start at the same instant, and undithered
+    exponential delays keep them in lockstep — each retry wave arrives as
+    one thundering herd. Spreading each client uniformly over its window
+    converts the spike into a flat trickle at the same average rate.
+    """
+    return (rng or _module_rng).uniform(0.0, delay)
+
+
+_module_rng = random.Random()
 
 
 class TokenBucket:
@@ -69,6 +85,11 @@ class Backoff:
     The controller's transient-error retry (imex.go:143-162 waits a flat
     minute; exponential-with-cap subsumes that: short first retries for
     blips, the cap for real outages).
+
+    ``jitter=True`` applies full jitter to each returned delay (the
+    exponential base still grows deterministically, so ``current`` and the
+    cap behave identically); pass ``rng`` to make jittered sequences
+    reproducible in tests.
     """
 
     def __init__(
@@ -76,10 +97,14 @@ class Backoff:
         initial: float = 1.0,
         cap: float = 60.0,
         factor: float = 2.0,
+        jitter: bool = False,
+        rng: random.Random | None = None,
     ):
         self.initial = initial
         self.cap = cap
         self.factor = factor
+        self.jitter = jitter
+        self._rng = rng
         self._current = 0.0
 
     def next_delay(self) -> float:
@@ -88,6 +113,8 @@ class Backoff:
             self._current = self.initial
         else:
             self._current = min(self.cap, self._current * self.factor)
+        if self.jitter:
+            return full_jitter(self._current, self._rng)
         return self._current
 
     def reset(self) -> None:

@@ -288,10 +288,15 @@ class MetricsServer:
     main.go:194-241, incl. the pprof mux at main.go:216-224).
 
     ``/healthz`` is liveness: the process flag flipped by ``set_healthy``.
-    ``/readyz`` is readiness: every check registered with
+    ``/readyz`` is readiness: every CRITICAL check registered with
     ``add_readiness_check`` must pass (the DaemonSet/Deployment
     readinessProbe target — a plugin whose gRPC socket is down or whose
     checkpoint dir is read-only must stop advertising ready, not die).
+    Checks registered with ``critical=False`` distinguish DEGRADED from
+    dead: when only those fail, /readyz stays 200 but its body ends in
+    ``degraded`` and the failing checks are marked ``[~]`` — an apiserver
+    outage must not make kubelet abandon a plugin that is still serving
+    prepares from checkpointed state.
     ``/debug/traces`` streams the tracer's finished claim traces as JSONL.
     """
 
@@ -376,22 +381,26 @@ class MetricsServer:
     def set_healthy(self, ok: bool) -> None:
         self._health["ok"] = ok
 
-    def add_readiness_check(self, name: str, check: Callable) -> None:
+    def add_readiness_check(self, name: str, check: Callable,
+                            critical: bool = True) -> None:
         """Register a readiness check. ``check()`` returns ``(ok, detail)``
         (a bare bool is accepted). A check that raises reads as not-ready
         with the exception as the detail — readiness must fail closed.
-        Safe to call after ``start()`` (late registration during wiring)."""
+        ``critical=False`` checks only downgrade /readyz to ``degraded``
+        (still 200) when failing. Safe to call after ``start()`` (late
+        registration during wiring)."""
         with self._ready_lock:
-            self._ready_checks[name] = check
+            self._ready_checks[name] = (check, critical)
 
     def _render_readiness(self) -> tuple[bytes, int]:
         lines = []
         all_ok = self._health["ok"]
+        degraded = False
         if not self._health["ok"]:
             lines.append("[-] healthz: unhealthy")
         with self._ready_lock:
             checks = sorted(self._ready_checks.items())
-        for name, check in checks:
+        for name, (check, critical) in checks:
             try:
                 result = check()
             except Exception as e:
@@ -400,10 +409,19 @@ class MetricsServer:
                 ok, detail = result
             else:
                 ok, detail = bool(result), ""
-            all_ok = all_ok and ok
-            mark = "+" if ok else "-"
+            if not ok:
+                if critical:
+                    all_ok = False
+                else:
+                    degraded = True
+            mark = "+" if ok else ("-" if critical else "~")
             lines.append(f"[{mark}] {name}" + (f": {detail}" if detail else ""))
-        lines.append("ready" if all_ok else "not ready")
+        if not all_ok:
+            lines.append("not ready")
+        elif degraded:
+            lines.append("degraded")
+        else:
+            lines.append("ready")
         return ("\n".join(lines) + "\n").encode(), (200 if all_ok else 503)
 
     def stop(self) -> None:

@@ -263,3 +263,44 @@ class TestPrepareAdminAccess:
         # The workload's own lifecycle is untouched.
         state.unprepare("uid-work")
         assert state.checkpoint.read() == {}
+
+    def test_admin_prepare_allowed_on_unhealthy_chip(self, tmp_path):
+        """Health gating deliberately exempts adminAccess: draining or
+        diagnosing a degraded chip is exactly when a monitoring pod needs
+        device access — while ordinary workload claims stay refused."""
+        from k8s_dra_driver_tpu.plugin.device_state import (
+            UnhealthyDeviceError,
+        )
+
+        lib = FakeChipLib(generation="v5p", topology="2x2x1")
+        state = DeviceState(
+            chiplib=lib,
+            cdi=CDIHandler(str(tmp_path / "cdi")),
+            checkpoint=CheckpointManager(str(tmp_path / "checkpoint.json")),
+            driver_name=DRIVER,
+            pool_name="node-a",
+            state_dir=str(tmp_path / "state"),
+        )
+        lib.wedge_chip(0, reason="thermal trip")
+        state.refresh_allocatable()
+
+        def wire_claim(uid, admin):
+            return {
+                "metadata": {"name": f"c-{uid}", "namespace": "ns",
+                             "uid": uid},
+                "spec": {"devices": {"requests": [{
+                    "name": "req-0",
+                    "deviceClassName": "tpu.google.com",
+                    **({"adminAccess": True} if admin else {}),
+                }]}},
+                "status": {"allocation": {"devices": {"results": [{
+                    "request": "req-0", "driver": DRIVER,
+                    "pool": "node-a", "device": "tpu-0",
+                }], "config": []}}},
+            }
+
+        with pytest.raises(UnhealthyDeviceError):
+            state.prepare(wire_claim("uid-work", admin=False))
+        devices = state.prepare(wire_claim("uid-admin", admin=True))
+        assert devices[0].device_name == "tpu-0"
+        state.unprepare("uid-admin")

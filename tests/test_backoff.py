@@ -5,11 +5,12 @@ Reference behaviors mirrored: QPS/burst client-side limiting
 transient-error retry delay (cmd/nvidia-dra-controller/imex.go:143-162).
 """
 
+import random
 import time
 
 import pytest
 
-from k8s_dra_driver_tpu.utils.backoff import Backoff, TokenBucket
+from k8s_dra_driver_tpu.utils.backoff import Backoff, TokenBucket, full_jitter
 
 
 class TestTokenBucket:
@@ -65,3 +66,44 @@ class TestBackoff:
         b.reset()
         assert b.current == 0.0
         assert b.next_delay() == 0.5
+
+
+class TestJitter:
+    def test_full_jitter_bounds(self):
+        rng = random.Random(7)
+        for _ in range(200):
+            d = full_jitter(4.0, rng)
+            assert 0.0 <= d <= 4.0
+        assert full_jitter(0.0, rng) == 0.0
+
+    def test_jittered_backoff_stays_under_undithered_base(self):
+        """The exponential BASE still grows deterministically (``current``
+        drives the cap); each returned delay is uniform in [0, base]."""
+        rng = random.Random(42)
+        b = Backoff(initial=1.0, cap=8.0, factor=2.0, jitter=True, rng=rng)
+        bases = [1.0, 2.0, 4.0, 8.0, 8.0]
+        for base in bases:
+            d = b.next_delay()
+            assert 0.0 <= d <= base
+            assert b.current == base
+
+    def test_jittered_sequences_decorrelate(self):
+        """Two clients with different rngs must NOT produce the identical
+        delay sequence — that lockstep is the thundering herd the jitter
+        exists to break."""
+        a = Backoff(initial=1.0, cap=60.0, jitter=True,
+                    rng=random.Random(1))
+        b = Backoff(initial=1.0, cap=60.0, jitter=True,
+                    rng=random.Random(2))
+        seq_a = [a.next_delay() for _ in range(6)]
+        seq_b = [b.next_delay() for _ in range(6)]
+        assert seq_a != seq_b
+
+    def test_same_seed_replays_exactly(self):
+        mk = lambda: Backoff(initial=1.0, cap=60.0, jitter=True,  # noqa: E731
+                             rng=random.Random(9))
+        assert [mk().next_delay() for _ in range(1)] == \
+               [mk().next_delay() for _ in range(1)]
+        a, b = mk(), mk()
+        assert [a.next_delay() for _ in range(5)] == \
+               [b.next_delay() for _ in range(5)]

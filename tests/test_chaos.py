@@ -1,0 +1,604 @@
+"""Chaos harness: deterministic fault schedules against the cluster sim.
+
+Every scenario drives the real stack (DeviceState / Driver / controller
+over FakeKubeClient + FakeChipLib) through a failure schedule armed in
+``utils/faults.py``, then asserts the four robustness invariants:
+
+  I1. the checkpoint always reads back consistent;
+  I2. no orphaned CDI claim spec survives a cleaner pass;
+  I3. no ICI channel is recorded prepared by two claims;
+  I4. no prepare ever succeeds onto a chip already marked unhealthy.
+
+"Simulated seconds" are expressed as counted failed calls, not wall time —
+schedules replay exactly. The default seed is fixed (``make chaos``);
+``TPU_DRA_CHAOS_SEED`` overrides it, and the ``slow``-marked soak runs a
+band of seeds.
+"""
+
+import os
+
+import pytest
+
+from k8s_dra_driver_tpu.cdi import CDIHandler
+from k8s_dra_driver_tpu.kube import (
+    EVENTS,
+    NODES,
+    RESOURCE_CLAIMS,
+    RESOURCE_SLICES,
+    ApiError,
+    FakeKubeClient,
+)
+from k8s_dra_driver_tpu.kube.protos import dra_v1alpha4_pb2 as drapb
+from k8s_dra_driver_tpu.plugin.checkpoint import CheckpointManager
+from k8s_dra_driver_tpu.plugin.cleanup import OrphanCleaner
+from k8s_dra_driver_tpu.plugin.device_state import (
+    DeviceState,
+    PrepareError,
+    UnhealthyDeviceError,
+)
+from k8s_dra_driver_tpu.plugin.driver import Driver, DriverConfig
+from k8s_dra_driver_tpu.tpulib import FakeChipLib
+from k8s_dra_driver_tpu.utils import faults
+
+import time
+
+DRIVER = "tpu.google.com"
+SEED = int(os.environ.get("TPU_DRA_CHAOS_SEED", "1234"))
+
+
+def wait_for(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    """No schedule may leak into the next test (or the wider suite)."""
+    yield
+    faults.disarm()
+
+
+def make_claim(uid, devices, name="c", namespace="default"):
+    results = [
+        {"request": f"req-{i}", "driver": DRIVER, "pool": "node-a",
+         "device": d}
+        for i, d in enumerate(devices)
+    ]
+    return {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {"name": name, "namespace": namespace, "uid": uid},
+        "spec": {
+            "devices": {
+                "requests": [
+                    {"name": r["request"],
+                     "deviceClassName": "tpu.google.com"}
+                    for r in results
+                ]
+            }
+        },
+        "status": {
+            "allocation": {"devices": {"results": results, "config": []}}
+        },
+    }
+
+
+def make_state(tmp_path, lib=None):
+    lib = lib or FakeChipLib(generation="v5p", topology="2x2x1")
+    return DeviceState(
+        chiplib=lib,
+        cdi=CDIHandler(str(tmp_path / "cdi")),
+        checkpoint=CheckpointManager(str(tmp_path / "checkpoint.json")),
+        driver_name=DRIVER,
+        pool_name="node-a",
+        state_dir=str(tmp_path / "state"),
+    ), lib
+
+
+def make_driver(tmp_path, lib=None, client=None, interval=0.05):
+    client = client or FakeKubeClient()
+    try:
+        client.get(NODES, "node-a")
+    except Exception:
+        client.create(NODES, {"metadata": {"name": "node-a", "uid": "nu-1"}})
+    lib = lib or FakeChipLib(generation="v5p", topology="2x2x1")
+    config = DriverConfig(
+        node_name="node-a",
+        chiplib=lib,
+        kube_client=client,
+        cdi_root=str(tmp_path / "cdi"),
+        plugin_root=str(tmp_path / "plugin"),
+        registrar_root=str(tmp_path / "registry"),
+        state_root=str(tmp_path / "state"),
+        node_uid="nu-1",
+        cleanup_interval_seconds=0,
+        device_watch_interval_seconds=interval,
+    )
+    return Driver(config), client, lib
+
+
+def prepare_via_rpc(driver, claim):
+    """Drive the DRA node service the way kubelet would (in-band errors)."""
+    req = drapb.NodePrepareResourcesRequest(claims=[
+        drapb.Claim(
+            uid=claim["metadata"]["uid"],
+            name=claim["metadata"]["name"],
+            namespace=claim["metadata"]["namespace"],
+        )
+    ])
+    resp = driver.NodePrepareResources(req, None)
+    return resp.claims[claim["metadata"]["uid"]]
+
+
+def chip_uuid_of(state, device_name):
+    dev = state.allocatable[device_name]
+    return (dev.chip or dev.tensorcore.parent).uuid
+
+
+def assert_invariants(state):
+    """The four invariants (I2 assumes the caller ran a cleaner pass
+    after any simulated crash, as a restarted plugin's timer would)."""
+    # I1: checkpoint reads back consistent.
+    ckpt = state.checkpoint.read()
+    # I2: every CDI claim spec belongs to a checkpointed claim.
+    orphans = set(state.cdi.list_claim_spec_uids()) - set(ckpt)
+    assert not orphans, f"orphaned CDI specs: {orphans}"
+    # I3: no ICI channel prepared by two live claims.
+    seen_channels: dict[int, str] = {}
+    for uid, rec in ckpt.items():
+        for group in rec.get("groups", []):
+            for dev in group.get("devices", []):
+                ch = dev.get("channel")
+                if ch is None:
+                    continue
+                assert seen_channels.setdefault(ch, uid) == uid, (
+                    f"channel {ch} prepared by both "
+                    f"{seen_channels[ch]} and {uid}"
+                )
+    # I4: no checkpointed claim prepared onto an ALREADY-unhealthy chip.
+    # PreparedClaim.prepared_at orders each prepare against the health
+    # transition timestamps: a claim on a now-unhealthy chip is legal
+    # only when the chip sickened AFTER the prepare completed.
+    for uid, rec in ckpt.items():
+        prepared_at = rec.get("preparedAt", 0.0)
+        for group in rec.get("groups", []):
+            for dev in group.get("devices", []):
+                for u in dev.get("uuids", []):
+                    base = u.split("-core-")[0]
+                    st = state.chip_health.get(base)
+                    if st is None or st.is_healthy():
+                        continue
+                    assert st.since >= prepared_at, (
+                        f"claim {uid} prepared at {prepared_at} on chip "
+                        f"{base}, which was already {st.state} since "
+                        f"{st.since}"
+                    )
+
+
+class TestUnplugMidPrepare:
+    def test_unplug_between_cdi_and_checkpoint(self, tmp_path):
+        """Chip 1 drops off the bus after the CDI claim spec is rendered
+        but before the checkpoint records the claim — the narrowest
+        mid-prepare window. The prepare completes (the devices were bound
+        before the hardware died); the next health poll flags the chip,
+        new prepares are refused, and invariants hold."""
+        state, lib = make_state(tmp_path)
+        uuid1 = chip_uuid_of(state, "tpu-1")
+        plan = faults.FaultPlan()
+        plan.call("checkpoint.write", lambda: lib.unplug_chip(1))
+        with faults.armed(plan):
+            devices = state.prepare(make_claim("uid-mid", ["tpu-1"]))
+        assert devices[0].device_name == "tpu-1"
+
+        # The health poll sees the unplug: transition logged, chip gone
+        # from allocatable, published resources shrink.
+        assert state.refresh_allocatable() is True
+        transitions = state.drain_health_transitions()
+        assert any(u == uuid1 and s.is_gone() for u, _, s in transitions)
+        assert "tpu-1" not in state.allocatable
+        pub = {d["name"] for d in state.published_resources()["devices"]}
+        assert "tpu-1" not in pub
+
+        # A retried prepare of a NEW claim for that chip is refused.
+        with pytest.raises(PrepareError):
+            state.prepare(make_claim("uid-new", ["tpu-1"], name="c2"))
+        assert_invariants(state)
+        # The mid-prepare claim unprepares cleanly despite the dead chip.
+        state.unprepare("uid-mid")
+        assert state.checkpoint.read() == {}
+
+    def test_wedged_chip_refused_with_typed_error(self, tmp_path):
+        """A degraded (present but erroring) chip stays enumerated and
+        published unhealthy — and prepares onto it fail with the TYPED
+        error, distinguishable from a malformed claim."""
+        state, lib = make_state(tmp_path)
+        lib.wedge_chip(0, reason="hbm uncorrectable errors")
+        assert state.refresh_allocatable() is True
+        assert "tpu-0" in state.allocatable  # still visible, drainable
+        dev = next(
+            d for d in state.published_resources()["devices"]
+            if d["name"] == "tpu-0"
+        )
+        assert dev["basic"]["attributes"]["healthy"]["bool"] is False
+        with pytest.raises(UnhealthyDeviceError, match="hbm uncorrectable"):
+            state.prepare(make_claim("uid-w", ["tpu-0"]))
+        # Its core partitions are equally refused (parent health governs).
+        with pytest.raises(UnhealthyDeviceError):
+            state.prepare(make_claim("uid-w2", ["tpu-0-core-0"], name="c3"))
+        # Healthy neighbors are unaffected.
+        state.prepare(make_claim("uid-ok", ["tpu-1"], name="c4"))
+        assert_invariants(state)
+
+
+class TestApiserverBlackout:
+    def test_blackout_serves_prepares_from_checkpoint(self, tmp_path):
+        """During a full apiserver blackout the plugin keeps serving
+        kubelet retries of already-prepared claims from checkpointed
+        state (degraded mode), readiness reads degraded-not-dead, and the
+        queued slice republish converges once the server returns."""
+        driver, client, lib = make_driver(tmp_path)
+        driver.start()
+        try:
+            claim = make_claim("uid-bo", ["tpu-0"])
+            client.create(RESOURCE_CLAIMS, claim, namespace="default")
+            assert prepare_via_rpc(driver, claim).error == ""
+            before = driver._m_degraded_prepares.value()
+
+            # Blackout: every API verb fails (fault_injector is the fake
+            # server's network cable).
+            client.fault_injector = lambda verb, gvr, name: ApiError(
+                "apiserver blackout", code=503
+            )
+            # A kubelet retry of the SAME claim still succeeds, served
+            # from the checkpoint.
+            result = prepare_via_rpc(driver, claim)
+            assert result.error == ""
+            assert [d.device_name for d in result.devices] == ["tpu-0"]
+            assert driver._m_degraded_prepares.value() == before + 1
+            # Readiness: degraded, not dead.
+            ok, detail = driver._check_apiserver()
+            assert not ok and "blackout" in detail
+            for check in driver.readiness_checks().values():
+                assert check()[0], "critical checks must stay green"
+
+            # A NEVER-prepared claim cannot be served dark.
+            c2 = make_claim("uid-bo2", ["tpu-1"], name="c2")
+            result = prepare_via_rpc(driver, c2)
+            assert result.error != ""
+
+            # Inventory changes during the blackout queue behind the
+            # republish backoff instead of being lost.
+            lib.unplug_chip(1)
+            assert wait_for(lambda: "tpu-1" not in driver.state.allocatable)
+
+            # Server returns: republish converges to the post-blackout
+            # truth without a restart.
+            client.fault_injector = None
+            assert wait_for(lambda: "tpu-1" not in {
+                d["name"]
+                for s in client.list(RESOURCE_SLICES)
+                for d in s["spec"].get("devices", [])
+            })
+            # The first post-outage claim fetch flips readiness back.
+            assert prepare_via_rpc(driver, claim).error == ""
+            assert wait_for(lambda: driver._check_apiserver()[0])
+            assert_invariants(driver.state)
+        finally:
+            client.fault_injector = None
+            driver.shutdown()
+
+
+class TestCrashRestart:
+    def test_crash_between_cdi_write_and_checkpoint_write(self, tmp_path):
+        """Simulated SIGKILL in the window where the claim CDI spec is on
+        disk but the checkpoint is not: the restarted plugin must treat
+        the claim as never-prepared, the cleaner reclaims the orphaned
+        spec AND the leaked sharing hold, and the chip is reusable."""
+        state, lib = make_state(tmp_path)
+        plan = faults.FaultPlan().crash("checkpoint.write")
+        with faults.armed(plan):
+            with pytest.raises(faults.CrashPoint):
+                state.prepare(make_claim("uid-crash", ["tpu-0"]))
+        del state  # the dead incarnation
+
+        restarted, _ = make_state(tmp_path)
+        # I1 holds across the crash; the claim is NOT checkpointed.
+        assert restarted.checkpoint.read() == {}
+        # The orphaned CDI spec is visible pre-clean...
+        assert restarted.cdi.list_claim_spec_uids() == ["uid-crash"]
+        OrphanCleaner(restarted, kube_client=None,
+                      interval_seconds=0).clean_once()
+        # ...and all four invariants hold after the cleaner pass.
+        assert_invariants(restarted)
+        assert restarted.cdi.list_claim_spec_uids() == []
+        # The chip is fully reusable (the leaked exclusive hold was
+        # released by the share-state cleanup).
+        devices = restarted.prepare(make_claim("uid-after", ["tpu-0"]))
+        assert devices[0].device_name == "tpu-0"
+
+    def test_corrupt_checkpoint_quarantined_on_restart(self, tmp_path):
+        """A checkpoint torn by a node crash must not crash-loop the
+        plugin: startup parks it at <path>.corrupt and continues empty."""
+        state, _ = make_state(tmp_path)
+        state.prepare(make_claim("uid-c", ["tpu-0"]))
+        path = tmp_path / "checkpoint.json"
+        path.write_text(path.read_text()[: len(path.read_text()) // 2])
+
+        restarted, _ = make_state(tmp_path)  # must not raise
+        assert restarted.checkpoint.read() == {}
+        assert (tmp_path / "checkpoint.json.corrupt").exists()
+        assert_invariants_after_clean(restarted)
+
+
+def assert_invariants_after_clean(state):
+    OrphanCleaner(state, kube_client=None, interval_seconds=0).clean_once()
+    assert_invariants(state)
+
+
+class TestWatchStreamDeath:
+    def test_controller_reestablishes_node_watch(self, tmp_path):
+        """The node watch dying without stop() (apiserver closed it) must
+        not permanently wedge the controller: it relists, reconciles
+        membership changes missed during the gap — including removals —
+        and resumes streaming."""
+        from k8s_dra_driver_tpu.controller.slice_manager import (
+            SLICE_LABEL,
+            IciSliceManager,
+        )
+
+        client = FakeKubeClient()
+        client.create(NODES, {"metadata": {
+            "name": "n1", "labels": {SLICE_LABEL: "s1"}}})
+        mgr = IciSliceManager(client)
+        mgr.start()
+        try:
+            assert wait_for(lambda: len(client.list(RESOURCE_SLICES)) == 1)
+            dead = mgr._watch
+            dead.stop()  # server-side stream death, NOT mgr.stop()
+
+            # Changes during the dark window: one domain vanishes, one
+            # appears.
+            client.delete(NODES, "n1")
+            client.create(NODES, {"metadata": {
+                "name": "n2", "labels": {SLICE_LABEL: "s2"}}})
+
+            assert wait_for(lambda: mgr.healthy()[0] and
+                            mgr._watch is not dead)
+            assert wait_for(lambda: [
+                k.slice_id for k in mgr.domains()
+            ] == ["s2"])
+            # And the re-established STREAM works: a post-recovery event
+            # reconciles too.
+            client.create(NODES, {"metadata": {
+                "name": "n3", "labels": {SLICE_LABEL: "s3"}}})
+            assert wait_for(lambda: {
+                k.slice_id for k in mgr.domains()
+            } == {"s2", "s3"})
+        finally:
+            mgr.stop(cleanup=False)
+
+
+class TestHealthEndToEnd:
+    def test_degraded_chip_leaves_slices_and_returns_with_event_and_metric(
+        self, tmp_path
+    ):
+        """Acceptance e2e: a chip carrying a prepared claim dies → it
+        disappears from published ResourceSlices, a correlated Warning
+        Event lands on the claim, and the health-transition metric moves;
+        recovery republishes the chip and emits the Normal Event."""
+        driver, client, lib = make_driver(tmp_path)
+        driver.start()
+        try:
+            def slice_names():
+                return {
+                    d["name"]
+                    for s in client.list(RESOURCE_SLICES)
+                    for d in s["spec"].get("devices", [])
+                }
+
+            assert wait_for(lambda: "tpu-0" in slice_names())
+            claim = make_claim("uid-e2e", ["tpu-0"], name="workload")
+            client.create(RESOURCE_CLAIMS, claim, namespace="default")
+            assert prepare_via_rpc(driver, claim).error == ""
+
+            lib.unplug_chip(0, reason="pcie link down")
+            assert wait_for(lambda: "tpu-0" not in slice_names())
+            # Core partitions of the dead chip are gone too.
+            assert wait_for(
+                lambda: "tpu-0-core-0" not in slice_names()
+            )
+            assert driver._m_health_transitions.value(
+                from_state="healthy", to="gone"
+            ) >= 1
+            driver.events.flush()
+            assert wait_for(lambda: any(
+                ev["reason"] == "ChipUnhealthy"
+                and ev["involvedObject"]["name"] == "workload"
+                and "pcie link down" in ev["message"]
+                for ev in client.list(EVENTS)
+            ))
+
+            lib.restore_chip(0)
+            assert wait_for(lambda: "tpu-0" in slice_names())
+            assert driver._m_health_transitions.value(
+                from_state="gone", to="healthy"
+            ) >= 1
+            driver.events.flush()
+            assert wait_for(lambda: any(
+                ev["reason"] == "ChipRecovered"
+                and ev["involvedObject"]["name"] == "workload"
+                for ev in client.list(EVENTS)
+            ))
+            assert_invariants(driver.state)
+        finally:
+            driver.shutdown()
+
+    def test_flap_schedule_is_deterministic(self, tmp_path):
+        """set_flap flips presence on the health-poll count: the same
+        refresh sequence yields the same transition sequence, every run."""
+        state, lib = make_state(tmp_path)
+        lib.set_flap(1, period=2)
+        states = []
+        for _ in range(8):
+            state.refresh_allocatable()
+            states.append("tpu-1" in state.allocatable)
+        # The flap clock advanced once during DeviceState init, so the
+        # eight refreshes observe polls 2..9; with period=2 presence is
+        # (poll // 2) even — a fixed pattern every run:
+        assert states == [False, False, True, True, False, False, True,
+                          True]
+        transitions = [
+            (old, s.state) for _, old, s in state.drain_health_transitions()
+        ]
+        assert transitions == [("healthy", "gone"), ("gone", "healthy"),
+                               ("healthy", "gone"), ("gone", "healthy")]
+
+
+def run_acceptance_schedule(tmp_path, seed):
+    """The acceptance schedule: unplug mid-prepare, a 10-simulated-second
+    apiserver blackout during republish, and a crash-restart between
+    checkpoint write and CDI cleanup — seeded choices for which chip and
+    how the blackout lands; all four invariants after every phase."""
+    import random
+
+    rng = random.Random(seed)
+    driver, client, lib = make_driver(tmp_path)
+    driver.start()
+    try:
+        # Phase 1: unplug a seeded chip mid-prepare.
+        victim = rng.randrange(2)  # chips 0/1 (2/3 stay as healthy pool)
+        claim1 = make_claim("uid-p1", [f"tpu-{victim}"], name="p1")
+        client.create(RESOURCE_CLAIMS, claim1, namespace="default")
+        plan = faults.FaultPlan()
+        plan.call("checkpoint.write",
+                  lambda: lib.unplug_chip(victim, reason="chaos unplug"))
+        with faults.armed(plan):
+            assert prepare_via_rpc(driver, claim1).error == ""
+        assert wait_for(
+            lambda: f"tpu-{victim}" not in driver.state.allocatable
+        )
+        assert_invariants(driver.state)
+
+        # Phase 2: apiserver blackout ("10 simulated seconds" = the dark
+        # window spans ≥2 failed republish attempts plus a degraded-mode
+        # prepare; counted events, not wall time, so it replays exactly).
+        blackout_failures = {"n": 0}
+
+        def injector(verb, gvr, name):
+            blackout_failures["n"] += 1
+            return ApiError("chaos blackout", code=503)
+
+        client.fault_injector = injector
+        retried = prepare_via_rpc(driver, claim1)  # kubelet retry, dark
+        assert retried.error == ""                 # served from checkpoint
+        survivor = 2 if victim != 2 else 3
+        lib.wedge_chip(survivor, reason="chaos wedge")
+        # The wedge reaches LOCAL state during the blackout; the
+        # republish queues behind jittered backoff and keeps failing.
+        assert wait_for(lambda: not driver.state.chip_health[
+            chip_uuid_of(driver.state, f"tpu-{survivor}")
+        ].is_healthy(), timeout=10)
+        assert wait_for(
+            lambda: not driver.plugin.slice_sync_health()[0], timeout=10
+        )
+        assert wait_for(lambda: blackout_failures["n"] >= 3, timeout=30)
+        # Server returns: the queued republish converges, no restart.
+        client.fault_injector = None
+        assert wait_for(lambda: any(
+            d["name"] == f"tpu-{survivor}"
+            and d["basic"]["attributes"]["healthy"]["bool"] is False
+            for s in client.list(RESOURCE_SLICES)
+            for d in s["spec"].get("devices", [])
+        ), timeout=30)
+        with pytest.raises(UnhealthyDeviceError):
+            driver.state.prepare(
+                make_claim("uid-w", [f"tpu-{survivor}"], name="w")
+            )
+        assert_invariants(driver.state)
+
+        # Phase 3: crash-restart between CDI write and checkpoint write.
+        healthy = [i for i in range(4) if i not in (victim, survivor)]
+        target = rng.choice(healthy)
+        crash_claim = make_claim("uid-crash", [f"tpu-{target}"], name="cr")
+        client.create(RESOURCE_CLAIMS, crash_claim, namespace="default")
+        plan = faults.FaultPlan().crash("checkpoint.write")
+        with faults.armed(plan):
+            # CrashPoint is a BaseException: it tears through the RPC
+            # surface the way SIGKILL tears through the process — no
+            # in-band error, no rollback.
+            with pytest.raises(faults.CrashPoint):
+                prepare_via_rpc(driver, crash_claim)
+        driver.shutdown()
+
+        restarted, client2, lib2 = make_driver(tmp_path, interval=0.05)
+        restarted.start()
+        try:
+            assert restarted.state.checkpoint.read().keys() == {"uid-p1"}
+            OrphanCleaner(restarted.state, kube_client=None,
+                          interval_seconds=0).clean_once()
+            assert_invariants(restarted.state)
+            # The crashed claim re-prepares idempotently on retry.
+            client2.create(RESOURCE_CLAIMS, crash_claim,
+                           namespace="default")
+            assert prepare_via_rpc(restarted, crash_claim).error == ""
+            assert_invariants(restarted.state)
+        finally:
+            restarted.shutdown()
+    finally:
+        client.fault_injector = None
+        if getattr(driver, "plugin", None) is not None:
+            try:
+                driver.shutdown()
+            except Exception:
+                pass
+
+
+class TestSeededSchedules:
+    def test_acceptance_schedule_fixed_seed(self, tmp_path):
+        run_acceptance_schedule(tmp_path, SEED)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", [SEED + i for i in range(1, 6)])
+    def test_acceptance_schedule_seed_band(self, tmp_path, seed):
+        run_acceptance_schedule(tmp_path, seed)
+
+    @pytest.mark.slow
+    def test_randomized_fault_soak(self, tmp_path):
+        """Seeded random faults sprayed across every instrumented site
+        while a prepare/unprepare/refresh workload runs; whatever the
+        interleaving, a cleaner pass restores all four invariants."""
+        import random
+
+        rng = random.Random(SEED)
+        state, lib = make_state(tmp_path)
+        sites = ["checkpoint.write", "checkpoint.read", "cdi.claim-write",
+                 "chiplib.enumerate", "kube.get"]
+        for round_no in range(20):
+            plan = faults.FaultPlan.seeded(
+                rng.randrange(1 << 30), sites, rounds=4, fail_rate=0.5
+            )
+            uid = f"soak-{round_no}"
+            with faults.armed(plan):
+                try:
+                    state.prepare(make_claim(
+                        uid, [f"tpu-{rng.randrange(4)}"], name=uid
+                    ))
+                except faults.CrashPoint:
+                    state, lib = make_state(tmp_path)
+                except (faults.FaultError, PrepareError, OSError):
+                    pass
+                try:
+                    state.refresh_allocatable()
+                except faults.FaultError:
+                    pass
+                try:
+                    state.unprepare(uid)
+                except (faults.FaultError, OSError):
+                    pass
+                except faults.CrashPoint:
+                    state, lib = make_state(tmp_path)
+            assert_invariants_after_clean(state)

@@ -38,6 +38,53 @@ class TestCheckpoint:
         with pytest.raises(CorruptCheckpointError, match="checksum"):
             m.read()
 
+    def test_truncated_file_raises_corrupt_not_json_error(self, tmp_path):
+        """A node crash can tear the file mid-write on non-atomic
+        filesystems: the raw JSONDecodeError must surface as the typed
+        corruption error the recovery path catches."""
+        p = tmp_path / "c.json"
+        m = CheckpointManager(str(p))
+        m.write({"u1": {"claimUID": "u1"}})
+        p.write_text(p.read_text()[:20])
+        with pytest.raises(CorruptCheckpointError, match="unreadable"):
+            m.read()
+
+    def test_garbage_and_wrong_shape_raise_corrupt(self, tmp_path):
+        p = tmp_path / "c.json"
+        m = CheckpointManager(str(p))
+        p.write_text("\x00\x01 not json")
+        with pytest.raises(CorruptCheckpointError):
+            m.read()
+        p.write_text('["a", "list"]')  # valid JSON, wrong shape
+        with pytest.raises(CorruptCheckpointError, match="not an object"):
+            m.read()
+
+    def test_unreadable_path_raises_corrupt(self, tmp_path):
+        # A directory where the file should be: open() raises an OSError
+        # that is neither FileNotFound nor a decode error.
+        d = tmp_path / "c.json"
+        d.mkdir()
+        with pytest.raises(CorruptCheckpointError, match="unreadable"):
+            CheckpointManager(str(d)).read()
+
+    def test_missing_file_stays_file_not_found(self, tmp_path):
+        """Never-created is not corruption — create_if_missing keys off
+        this distinction."""
+        with pytest.raises(FileNotFoundError):
+            CheckpointManager(str(tmp_path / "absent.json")).read()
+
+    def test_quarantine_parks_file_and_clobbers_older_quarantine(
+        self, tmp_path
+    ):
+        p = tmp_path / "c.json"
+        m = CheckpointManager(str(p))
+        (tmp_path / "c.json.corrupt").write_text("older evidence")
+        p.write_text("garbage")
+        q = m.quarantine()
+        assert q == str(p) + ".corrupt"
+        assert not p.exists()
+        assert (tmp_path / "c.json.corrupt").read_text() == "garbage"
+
     def test_unknown_version_rejected(self, tmp_path):
         p = tmp_path / "c.json"
         m = CheckpointManager(str(p))

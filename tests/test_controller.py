@@ -222,6 +222,91 @@ class TestOutageRecovery:
             mgr.stop()
 
 
+class TestWatchStreamRecovery:
+    def test_watch_death_reestablishes_with_fresh_seed(self):
+        """A node watch that ends without stop() (server timeout, severed
+        connection) must re-list and resume — the old behavior left the
+        reconcile loop dead with readiness red until a pod restart."""
+        client = FakeKubeClient()
+        client.create(NODES, node("n1", "slice-a"))
+        mgr = IciSliceManager(client)
+        mgr.start()
+        try:
+            assert wait_for(lambda: mgr.domains())
+            dead = mgr._watch
+            dead.stop()  # server-side stream death
+            # Membership changed while the stream was dark: a relabel AND
+            # a removal — only a fresh LIST can reconcile the removal.
+            client.delete(NODES, "n1")
+            client.create(NODES, node("n2", "slice-b"))
+            assert wait_for(
+                lambda: {k.slice_id for k in mgr.domains()} == {"slice-b"}
+            )
+            assert mgr.healthy()[0]
+            assert mgr._watch is not dead and not mgr._watch.stopped
+        finally:
+            mgr.stop()
+
+    def test_reestablish_retries_through_injected_relist_faults(self):
+        """Faults injected on the recovery relist (the fake analog of a
+        410-compaction/outage window) only delay resumption: the manager
+        backs off, retries, and resumes once the API heals."""
+        from k8s_dra_driver_tpu.kube.errors import ApiError
+
+        client = FakeKubeClient()
+        client.create(NODES, node("n1", "slice-a"))
+        mgr = IciSliceManager(client)
+        mgr.start()
+        try:
+            assert wait_for(lambda: mgr.domains())
+            relist_faults = {"remaining": 3, "seen": 0}
+
+            def inject(verb, gvr, name):
+                if verb in ("list", "watch") and gvr.resource == "nodes":
+                    relist_faults["seen"] += 1
+                    if relist_faults["remaining"] > 0:
+                        relist_faults["remaining"] -= 1
+                        return ApiError("history compacted", code=410)
+                return None
+
+            client.fault_injector = inject
+            mgr._watch.stop()  # stream death into a faulty API window
+            client.create(NODES, node("n2", "slice-b"))
+            assert wait_for(
+                lambda: {k.slice_id for k in mgr.domains()}
+                == {"slice-a", "slice-b"},
+                timeout=15,
+            ), relist_faults
+            assert relist_faults["seen"] >= 3  # recovery actually retried
+            assert mgr.healthy()[0]
+        finally:
+            client.fault_injector = None
+            mgr.stop()
+
+    def test_healthy_reports_not_ready_during_dark_window(self):
+        client = FakeKubeClient()
+        client.create(NODES, node("n1", "slice-a"))
+        mgr = IciSliceManager(client)
+        mgr.start()
+        try:
+            assert wait_for(lambda: mgr.healthy()[0])
+            # Permanently block re-establishment to observe the window.
+            from k8s_dra_driver_tpu.kube.errors import ApiError
+
+            client.fault_injector = lambda verb, gvr, name: (
+                ApiError("down", code=503)
+                if verb in ("list", "watch") and gvr.resource == "nodes"
+                else None
+            )
+            mgr._watch.stop()
+            assert wait_for(lambda: not mgr.healthy()[0])
+            client.fault_injector = None
+            assert wait_for(lambda: mgr.healthy()[0], timeout=15)
+        finally:
+            client.fault_injector = None
+            mgr.stop()
+
+
 class TestOffsetRecovery:
     def test_restart_preserves_channel_numbering(self):
         client = FakeKubeClient()

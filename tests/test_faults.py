@@ -1,0 +1,108 @@
+"""Unit tests for the fault-injection registry (utils/faults.py)."""
+
+import pytest
+
+from k8s_dra_driver_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faults.disarm()
+
+
+class TestRegistry:
+    def test_disarmed_fire_is_a_noop(self):
+        faults.fire("any.site")  # must not raise, count, or allocate
+
+    def test_rule_fires_on_matching_call_index(self):
+        plan = faults.FaultPlan().fail(
+            "s.op", faults.FaultError("boom"), on_calls={2}
+        )
+        with faults.armed(plan):
+            faults.fire("s.op")  # call 1: no rule
+            with pytest.raises(faults.FaultError):
+                faults.fire("s.op")  # call 2
+            faults.fire("s.op")  # call 3: rule exhausted (times implied)
+            assert faults.REGISTRY.hits("s.op") == 3
+
+    def test_times_bounds_total_firings(self):
+        plan = faults.FaultPlan().fail(
+            "s.op", lambda: faults.FaultError("again"), times=2
+        )
+        with faults.armed(plan):
+            for _ in range(2):
+                with pytest.raises(faults.FaultError):
+                    faults.fire("s.op")
+            faults.fire("s.op")  # third hit passes
+
+    def test_action_rules_run_inline_and_continue(self):
+        ran = []
+        plan = faults.FaultPlan().call("s.op", lambda: ran.append(1))
+        with faults.armed(plan):
+            faults.fire("s.op")
+            faults.fire("s.op")  # times=1 default: runs once
+        assert ran == [1]
+
+    def test_crash_rule_raises_base_exception(self):
+        plan = faults.FaultPlan().crash("s.op")
+        with faults.armed(plan):
+            with pytest.raises(faults.CrashPoint):
+                faults.fire("s.op")
+        # CrashPoint must NOT be caught by except-Exception recovery code.
+        assert not issubclass(faults.CrashPoint, Exception)
+
+    def test_armed_context_always_disarms(self):
+        plan = faults.FaultPlan().fail("s.op", faults.FaultError("x"))
+        with pytest.raises(faults.FaultError):
+            with faults.armed(plan):
+                faults.fire("s.op")
+        assert not faults.REGISTRY.armed
+        faults.fire("s.op")  # disarmed again
+
+
+class TestSeededPlans:
+    def test_same_seed_same_schedule(self):
+        sites = ["a", "b", "c"]
+        p1 = faults.FaultPlan.seeded(77, sites, rounds=16, fail_rate=0.5)
+        p2 = faults.FaultPlan.seeded(77, sites, rounds=16, fail_rate=0.5)
+        key = lambda p: [(r.site, sorted(r.on_calls)) for r in p.rules]  # noqa: E731
+        assert key(p1) == key(p2) and p1.rules
+
+    def test_different_seed_different_schedule(self):
+        sites = ["a", "b", "c"]
+        p1 = faults.FaultPlan.seeded(77, sites, rounds=32, fail_rate=0.9)
+        p2 = faults.FaultPlan.seeded(78, sites, rounds=32, fail_rate=0.9)
+        key = lambda p: [(r.site, sorted(r.on_calls)) for r in p.rules]  # noqa: E731
+        assert key(p1) != key(p2)
+
+
+class TestEnvArming:
+    def test_unset_env_is_noop(self, monkeypatch):
+        monkeypatch.delenv("TPU_DRA_FAULTS", raising=False)
+        assert faults.arm_from_env() is False
+        assert not faults.REGISTRY.armed
+
+    def test_env_spec_arms_sites_and_kinds(self, monkeypatch):
+        monkeypatch.setenv(
+            "TPU_DRA_FAULTS",
+            "checkpoint.write@2=oserror, kube.get=api503, cdi.claim-write=crash",
+        )
+        assert faults.arm_from_env() is True
+        try:
+            faults.fire("checkpoint.write")  # call 1: clean
+            with pytest.raises(OSError):
+                faults.fire("checkpoint.write")  # call 2
+            from k8s_dra_driver_tpu.kube.errors import ApiError
+
+            with pytest.raises(ApiError) as exc_info:
+                faults.fire("kube.get")
+            assert exc_info.value.code == 503
+            with pytest.raises(faults.CrashPoint):
+                faults.fire("cdi.claim-write")
+        finally:
+            faults.disarm()
+
+    def test_malformed_call_index_skipped(self, monkeypatch, caplog):
+        monkeypatch.setenv("TPU_DRA_FAULTS", "a@zzz=oserror")
+        assert faults.arm_from_env() is False

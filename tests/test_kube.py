@@ -115,6 +115,71 @@ class TestFakeCrud:
             c.create(RESOURCE_SLICES, mk("s1"))
 
 
+class TestFaultInjectorWatchPath:
+    """The fault injector on the WATCH verb (and on the list that seeds
+    it): the seam the chaos harness uses to kill informer streams."""
+
+    def test_watch_establishment_fault_surfaces_then_clears(self):
+        from k8s_dra_driver_tpu.kube import ApiError
+
+        c = fake()
+        c.create(NODES, mk("n1"))
+        calls = {"n": 0}
+
+        def injector(verb, gvr, name):
+            if verb == "watch":
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    return ApiError("watch refused", code=500)
+            return None
+
+        c.fault_injector = injector
+        with pytest.raises(ApiError):
+            c.watch(NODES)
+        # The retry (what a reconnecting consumer does) succeeds AND the
+        # recovered stream both seeds and streams.
+        w = c.watch(NODES)
+        c.create(NODES, mk("n2"))
+        got = [
+            (ev.type, ev.object["metadata"]["name"])
+            for _, ev in zip(range(2), w.events(timeout=1.0))
+        ]
+        assert got == [("ADDED", "n1"), ("ADDED", "n2")]
+        w.stop()
+
+    def test_seed_list_fault_fails_watch_not_stream(self):
+        """The informer seed (list) failing must surface at watch() time —
+        a consumer that survives it retries from scratch, the relist
+        contract the real client's 410 path shares."""
+        from k8s_dra_driver_tpu.kube import ApiError
+
+        c = fake()
+        c.create(NODES, mk("n1"))
+        c.fault_injector = lambda verb, gvr, name: (
+            ApiError("relist shed", code=503) if verb == "list" else None
+        )
+        with pytest.raises(ApiError):
+            c.watch(NODES)
+        c.fault_injector = None
+        w = c.watch(NODES)
+        assert next(iter(w.events(timeout=1.0))).object["metadata"][
+            "name"] == "n1"
+        w.stop()
+
+    def test_global_fault_registry_reaches_fake_watch(self):
+        from k8s_dra_driver_tpu.utils import faults
+
+        c = fake()
+        plan = faults.FaultPlan().fail(
+            "kube.watch", faults.FaultError("chaos"), times=1
+        )
+        with faults.armed(plan):
+            with pytest.raises(faults.FaultError):
+                c.watch(NODES)
+            assert faults.REGISTRY.hits("kube.watch") == 1
+            c.watch(NODES).stop()  # rule exhausted: next watch is clean
+
+
 class TestFakeWatch:
     def test_watch_seed_and_stream(self):
         c = fake()

@@ -220,6 +220,39 @@ class TestServer:
         finally:
             srv.stop()
 
+    def test_readyz_degraded_vs_dead(self):
+        """Non-critical checks distinguish DEGRADED (200, body says so,
+        [~] mark) from not-ready (503): an apiserver outage must not flip
+        the readinessProbe of a plugin still serving from checkpoint."""
+        ready = {"apiserver": True, "grpc": True}
+        srv = MetricsServer(Registry(), host="127.0.0.1", port=0)
+        srv.add_readiness_check("grpc", lambda: (ready["grpc"], ""))
+        srv.add_readiness_check(
+            "apiserver", lambda: (ready["apiserver"], "blackout"),
+            critical=False,
+        )
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            body = urllib.request.urlopen(f"{base}/readyz").read().decode()
+            assert body.strip().endswith("ready")
+
+            ready["apiserver"] = False  # degraded: still 200
+            resp = urllib.request.urlopen(f"{base}/readyz")
+            assert resp.status == 200
+            body = resp.read().decode()
+            assert "[~] apiserver: blackout" in body
+            assert body.strip().endswith("degraded")
+
+            ready["grpc"] = False  # a critical failure wins: 503
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(f"{base}/readyz")
+            assert exc_info.value.code == 503
+            assert exc_info.value.read().decode().strip().endswith(
+                "not ready")
+        finally:
+            srv.stop()
+
     def test_readyz_check_that_raises_fails_closed(self):
         srv = MetricsServer(Registry(), host="127.0.0.1", port=0)
 

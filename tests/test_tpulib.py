@@ -12,6 +12,7 @@ import pytest
 
 from k8s_dra_driver_tpu.tpulib import (
     GENERATIONS,
+    ChipInfo,
     Coord,
     FakeChipLib,
     MeshShape,
@@ -134,6 +135,110 @@ class TestFakeChipLib:
         assert attrs["sliceId"] == {"string": "s1"}
         assert attrs["coord"] == {"string": "1,1,0"}
         assert dev["basic"]["capacity"]["hbm"]["value"] == str(32 << 30)
+
+
+class TestChipHealth:
+    """The ChipLib health API: scriptable fault controls on the fake,
+    presence + error-counter probing on the real backend."""
+
+    def test_default_health_all_healthy(self):
+        lib = FakeChipLib(generation="v5e", topology="2x2x1")
+        health = lib.chip_health()
+        assert len(health) == 4
+        assert all(s.is_healthy() for s in health.values())
+
+    def test_wedge_reports_degraded_but_still_enumerates(self):
+        lib = FakeChipLib(generation="v5e", topology="2x2x1")
+        chips = {c.index: c.uuid for c in lib.enumerate_chips()}
+        lib.wedge_chip(2, reason="stuck DMA")
+        assert len(lib.enumerate_chips()) == 4  # present, just sick
+        st = lib.chip_health()[chips[2]]
+        assert st.state == "degraded" and st.reason == "stuck DMA"
+        assert not st.is_healthy() and not st.is_gone()
+
+    def test_unplug_reports_gone_and_drops_from_enumeration(self):
+        lib = FakeChipLib(generation="v5e", topology="2x2x1")
+        chips = {c.index: c.uuid for c in lib.enumerate_chips()}
+        lib.unplug_chip(0)
+        assert {c.index for c in lib.enumerate_chips()} == {1, 2, 3}
+        st = lib.chip_health()[chips[0]]
+        assert st.is_gone() and st.reason == "unplugged"
+        lib.restore_chip(0)
+        assert len(lib.enumerate_chips()) == 4
+        assert lib.chip_health()[chips[0]].is_healthy()
+
+    def test_flap_is_driven_by_poll_count_not_time(self):
+        lib = FakeChipLib(generation="v5e", topology="2x2x1")
+        uuid1 = next(c.uuid for c in lib.enumerate_chips() if c.index == 1)
+        lib.set_flap(1, period=3)
+        states = [lib.chip_health()[uuid1].state for _ in range(12)]
+        # polls 1..12, out while (poll // 3) is odd.
+        assert states == ["healthy"] * 2 + ["gone"] * 3 + ["healthy"] * 3 \
+            + ["gone"] * 3 + ["healthy"]
+        with pytest.raises(ValueError):
+            lib.set_flap(1, period=0)
+
+    def test_fault_controls_wake_device_event(self):
+        lib = FakeChipLib(generation="v5e", topology="1x1x1")
+        for action in (
+            lambda: lib.wedge_chip(0),
+            lambda: lib.unplug_chip(0),
+            lambda: lib.restore_chip(0),
+            lambda: lib.set_flap(0),
+        ):
+            lib.device_event.clear()
+            action()
+            assert lib.device_event.is_set()
+
+    def test_real_backend_missing_device_node_reads_gone(self, tmp_path):
+        lib = RealChipLib(ChipLibConfig(dev_root=str(tmp_path)))
+        lib.init()
+        chip = ChipInfo(
+            index=0, uuid="TPU-x", generation="v5e",
+            device_paths=[str(tmp_path / "dev" / "accel0")],
+            hbm_bytes=1, cores=1, coord=Coord(0, 0, 0),
+            slice_id="s", slice_topology=MeshShape(1, 1, 1),
+            host_id=0, hosts_per_slice=1,
+        )
+        # Seed the memory as if a prior enumeration saw the chip; with no
+        # /dev node on disk the next poll must report it gone.
+        lib._known_chips[chip.uuid] = chip
+        st = lib.chip_health()[chip.uuid]
+        assert st.is_gone()
+
+    def test_real_backend_error_counter_delta_reads_degraded(
+        self, tmp_path, monkeypatch
+    ):
+        lib = RealChipLib(
+            ChipLibConfig(dev_root=str(tmp_path),
+                          sysfs_root=str(tmp_path / "sys"))
+        )
+        lib.init()
+        dev = tmp_path / "dev"
+        dev.mkdir()
+        node = dev / "accel0"
+        node.write_text("")  # present-enough: os.path.exists passes
+        errdir = tmp_path / "sys" / "class" / "accel" / "accel0" / "device"
+        errdir.mkdir(parents=True)
+        (errdir / "tpu_error_count").write_text("5\n")
+        chip = ChipInfo(
+            index=0, uuid="TPU-y", generation="v5e",
+            device_paths=[str(node)], hbm_bytes=1, cores=1,
+            coord=Coord(0, 0, 0), slice_id="s",
+            slice_topology=MeshShape(1, 1, 1), host_id=0,
+            hosts_per_slice=1,
+        )
+        monkeypatch.setattr(lib, "enumerate_chips", lambda: [chip])
+        # First poll: absolute value is just a baseline, chip healthy.
+        assert lib.chip_health()[chip.uuid].is_healthy()
+        # Counter stable: still healthy.
+        assert lib.chip_health()[chip.uuid].is_healthy()
+        # Counter advanced: degraded, with the delta in the reason.
+        (errdir / "tpu_error_count").write_text("9\n")
+        st = lib.chip_health()[chip.uuid]
+        assert st.state == "degraded" and "5 -> 9" in st.reason
+        # Back to stable at the new baseline: healthy again.
+        assert lib.chip_health()[chip.uuid].is_healthy()
 
 
 class TestRealChipLib:
