@@ -2,7 +2,7 @@
 IMAGE ?= tpu-dra-driver
 TAG ?= latest
 
-.PHONY: all native test image lint verify verify-metrics chaos chaos-slow clean e2e-kind
+.PHONY: all native test image lint verify verify-metrics chaos chaos-slow doctor clean e2e-kind
 
 all: native
 
@@ -26,9 +26,17 @@ chaos-slow:
 	TPU_DRA_CHAOS_SEED=$(TPU_DRA_CHAOS_SEED) \
 		python -m pytest tests/test_chaos.py -q
 
+# Doctor gate: the support-bundle CLI against the cluster sim. A clean
+# fleet must diagnose CLEAN (any drift finding fails the target), and
+# injected crash artifacts (orphan CDI spec + torn checkpoint) must be
+# flagged by both the node auditor and the doctor.
+doctor:
+	python tools/run_doctor_sim.py
+
 # The full local gate: lint + unit/integration tests + chaos schedules +
-# metrics exposition. What CI runs; what a PR must pass.
-verify: lint test chaos verify-metrics
+# metrics exposition + the doctor/auditor drill. What CI runs; what a PR
+# must pass.
+verify: lint test chaos verify-metrics doctor
 
 # ruff when available (CI installs it; .golangci.yaml analog is
 # [tool.ruff] in pyproject.toml), else the first-party AST lint floor.

@@ -122,9 +122,20 @@ def main(argv=None) -> int:
         logger.info("ICI slice manager started")
 
     stop = install_signal_stop()
+    import time as _time
+
+    # Channel-occupancy refresh is a full cluster-wide claims LIST, so
+    # it runs on its own gentle cadence, not the 10s status tick — its
+    # consumers (Prometheus, the doctor) sample far slower than that.
+    occupancy_interval = 60.0
+    next_occupancy = 0.0
     while not stop.wait(timeout=10):
         if manager is not None:
             domains_gauge.set(len(manager.domains()))
+            now = _time.monotonic()
+            if now >= next_occupancy:
+                manager.refresh_channel_occupancy()
+                next_occupancy = now + occupancy_interval
     if manager is not None:
         manager.stop(cleanup=args.cleanup_on_exit)
     if metrics is not None:

@@ -35,7 +35,7 @@ from ..kube.resourceslice import (
     Pool,
     ResourceSliceController,
 )
-from ..tpulib.deviceinfo import IciChannelInfo
+from ..tpulib.deviceinfo import IciChannelInfo, is_ici_channel_device_name
 from ..utils.backoff import Backoff
 from ..utils.metrics import Counter, Gauge, Histogram, Registry
 from ..utils.tracing import Tracer
@@ -145,6 +145,20 @@ class IciSliceManager:
         self._m_domain_nodes = Gauge(
             "tpu_dra_ici_domain_nodes",
             "Nodes currently labeled into any ICI slice domain", reg,
+        )
+        # Controller-side utilization accounting: the node plugins see
+        # chips; only the controller can see the whole channel pool, so
+        # ICI occupancy is measured here (refresh_channel_occupancy)
+        # rather than summed from nodes.
+        self._m_channels_published = Gauge(
+            "tpu_dra_usage_ici_channels_published",
+            "ICI channels currently offered across all published pools",
+            reg,
+        )
+        self._m_channels_allocated = Gauge(
+            "tpu_dra_usage_ici_channels_allocated",
+            "ICI channels currently held by allocated ResourceClaims",
+            reg,
         )
         self.offsets = OffsetAllocator()
         # DomainKey -> set of node names carrying the label
@@ -445,7 +459,38 @@ class IciSliceManager:
             pools[key.pool_name] = self._channel_pool(key)
         self._m_published_pools.set(len(pools))
         self._m_domain_nodes.set(len(self._node_domain))
+        self._m_channels_published.set(len(pools) * CHANNELS_PER_POOL)
         self.slice_controller.update(DriverResources(pools=pools))
+
+    # -- channel occupancy (controller-side utilization accounting) --------
+
+    def refresh_channel_occupancy(self) -> Optional[int]:
+        """Count ICI channels held by allocated claims and update the
+        occupancy gauge; returns the count, or None when the claim list
+        failed (apiserver dark — keep the last good value rather than
+        reporting a phantom zero). This is a full cluster-wide claims
+        LIST: the controller main loop calls it on a ~60s cadence, well
+        below the 10s status tick, and nothing else should call it in a
+        tight loop."""
+        api = self.slice_controller.api
+        try:
+            claims = self.client.list(api.claims)
+        except Exception:
+            logger.debug("channel occupancy refresh skipped (list failed)")
+            return None
+        allocated = 0
+        for claim in claims:
+            results = (
+                ((claim.get("status") or {}).get("allocation") or {})
+                .get("devices", {}).get("results")
+            ) or []
+            for r in results:
+                if (r.get("driver") == self.driver_name
+                        and is_ici_channel_device_name(
+                            r.get("device", ""))):
+                    allocated += 1
+        self._m_channels_allocated.set(allocated)
+        return allocated
 
     # -- introspection -----------------------------------------------------
 

@@ -1,0 +1,653 @@
+"""tpu-dra doctor: fleet-wide diagnosis + support bundles.
+
+The node-level surfaces (PR 1's /metrics + /debug/traces, this PR's
+/debug/usage and the state-drift auditor) answer "what does ONE node
+think"; an operator debugging a fleet needs the cross-node question:
+does the cluster's view (ResourceSlices, ResourceClaims) agree with what
+every node actually holds — and how busy is the fleet?
+
+    python -m k8s_dra_driver_tpu.doctor \\
+        --node node-a=http://10.0.0.11:8081 \\
+        --node node-b=http://10.0.0.12:8081 \\
+        --bundle /tmp/tpu-dra-bundle.tar
+
+Per node it scrapes ``/metrics``, ``/debug/usage``, ``/debug/traces``
+and ``/readyz``; from the API server it reads ResourceSlices and
+ResourceClaims; then it re-runs the audit cross-checks FLEET-wide:
+
+- node-local drift surfaced by each node's auditor
+  (``tpu_dra_audit_findings`` > 0);
+- claims a node holds whose ResourceClaim no longer exists (or changed
+  UID) in the apiserver;
+- claims the apiserver says are allocated to a node that the node has
+  not prepared (informational — the pod may simply not have started);
+- per-claim device-set mismatches between allocation and prepare;
+- ICI channel occupancy vs the controller's published pools.
+
+``--bundle`` additionally writes a tar of every raw document (metrics,
+usage JSON, traces JSONL, readyz, cluster objects, findings) for
+offline support. The whole tool is read-only and runs unchanged against
+the FakeKubeClient cluster sim (tools/run_doctor_sim.py — the ``make
+doctor`` gate), so its checks are exercised hermetically in CI.
+
+Exit status: 0 clean, 1 drift findings, 2 collection errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import io
+import json
+import logging
+import re
+import sys
+import tarfile
+import time
+import urllib.request
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+SEVERITY_DRIFT = "drift"
+SEVERITY_INFO = "info"
+SEVERITY_ERROR = "error"
+
+
+@dataclasses.dataclass(frozen=True)
+class DoctorFinding:
+    severity: str  # drift | info | error
+    check: str
+    subject: str
+    detail: str
+
+    def __str__(self) -> str:
+        return f"{self.severity.upper()} [{self.check}] {self.subject}: {self.detail}"
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text parsing (just enough to read gauges/counters back)
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)(?:\s+-?\d+)?$"
+)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape_label(v: str) -> str:
+    # One left-to-right pass: sequential str.replace would turn the
+    # wire form of a literal backslash-then-n (``\\n``) into a newline.
+    out = []
+    i = 0
+    while i < len(v):
+        if v[i] == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            out.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt, "\\" + nxt))
+            i += 2
+        else:
+            out.append(v[i])
+            i += 1
+    return "".join(out)
+
+
+def parse_metrics(text: str) -> dict[str, list[tuple[dict, float]]]:
+    """name -> [(labels, value), ...] for every sample line."""
+    out: dict[str, list[tuple[dict, float]]] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, raw_labels, raw_value = m.groups()
+        labels = {
+            k: _unescape_label(v)
+            for k, v in _LABEL_RE.findall(raw_labels or "")
+        }
+        try:
+            value = float(raw_value)
+        except ValueError:
+            continue
+        out.setdefault(name, []).append((labels, value))
+    return out
+
+
+def metric_value(
+    metrics: dict, name: str, **labels
+) -> Optional[float]:
+    for sample_labels, value in metrics.get(name, []):
+        if all(sample_labels.get(k) == v for k, v in labels.items()):
+            return value
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Collection
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class NodeScrape:
+    name: str
+    url: str
+    metrics_text: str = ""
+    metrics: dict = dataclasses.field(default_factory=dict)
+    usage: Optional[dict] = None
+    traces_text: str = ""
+    readyz_text: str = ""
+    errors: list = dataclasses.field(default_factory=list)
+
+    @property
+    def readiness(self) -> str:
+        lines = [ln for ln in self.readyz_text.splitlines() if ln]
+        return lines[-1] if lines else "unknown"
+
+    @property
+    def holds(self) -> list[dict]:
+        return list((self.usage or {}).get("holds") or [])
+
+    @property
+    def pool_name(self) -> str:
+        """The node name used for placement checks: the one the plugin
+        REPORTS about itself (usage snapshot ``node``, which is its pool
+        name) — the operator-supplied ``--node`` label is only a display
+        key and may be a nickname. A mismatch is also surfaced as a
+        collection error by collect_node."""
+        reported = (self.usage or {}).get("node")
+        return reported or self.name
+
+
+def _fetch(url: str, timeout: float) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def collect_node(name: str, url: str, timeout: float = 5.0) -> NodeScrape:
+    scrape = NodeScrape(name=name, url=url.rstrip("/"))
+    for attr, path, body_is_diagnosis in (
+        ("metrics_text", "/metrics", False),
+        ("traces_text", "/debug/traces", False),
+        ("readyz_text", "/readyz", True),
+    ):
+        try:
+            setattr(scrape, attr, _fetch(scrape.url + path, timeout))
+        except Exception as e:
+            # ONLY /readyz answers non-200 as part of normal operation,
+            # and only with a 503 (= not ready IS the diagnosis). Any
+            # other error body — from /metrics, /debug/traces, or a
+            # proxy's 502 page in front of /readyz — is a failure, not
+            # data; storing it would silently parse to nothing (or to a
+            # nonsense readiness line) and hide the node from every
+            # downstream check.
+            body = (getattr(e, "read", lambda: b"")()
+                    if body_is_diagnosis
+                    and getattr(e, "code", None) == 503 else b"")
+            if body:
+                setattr(scrape, attr, body.decode(errors="replace"))
+            else:
+                scrape.errors.append(f"{path}: {e}")
+    try:
+        scrape.usage = json.loads(
+            _fetch(scrape.url + "/debug/usage", timeout)
+        )
+    except Exception as e:
+        scrape.errors.append(f"/debug/usage: {e}")
+    reported = (scrape.usage or {}).get("node")
+    if reported and reported != name:
+        scrape.errors.append(
+            f"/debug/usage: node reports its name as {reported!r}, not "
+            f"{name!r} — check the --node mapping (placement checks key "
+            "on the reported name)"
+        )
+    scrape.metrics = parse_metrics(scrape.metrics_text)
+    return scrape
+
+
+def collect_cluster(client, driver_name: str) -> dict[str, Any]:
+    """ResourceSlices + ResourceClaims in normalized (v1alpha3-shaped)
+    form, via the served resource.k8s.io dialect."""
+    from .kube.resourceapi import ResourceApi
+
+    api = ResourceApi.discover(client)
+    slices = [
+        api.slice_from_wire(s) for s in client.list(api.slices)
+        if (s.get("spec") or {}).get("driver") == driver_name
+    ]
+    claims = []
+    for c in client.list(api.claims):
+        c = api.claim_from_wire(c)
+        if _allocation_results(c, driver_name):
+            claims.append(c)
+    return {"resourceSlices": slices, "resourceClaims": claims}
+
+
+# ---------------------------------------------------------------------------
+# Fleet-wide audit
+# ---------------------------------------------------------------------------
+
+def fleet_findings(
+    nodes: list[NodeScrape], cluster: Optional[dict], driver_name: str
+) -> list[DoctorFinding]:
+    findings: list[DoctorFinding] = []
+
+    for node in nodes:
+        for err in node.errors:
+            findings.append(DoctorFinding(
+                SEVERITY_ERROR, "collect", node.name, err
+            ))
+        # Node-local drift, as reported by that node's auditor.
+        for labels, value in node.metrics.get("tpu_dra_audit_findings", []):
+            if value > 0:
+                findings.append(DoctorFinding(
+                    SEVERITY_DRIFT, "node-audit",
+                    f"{node.name}/{labels.get('check', '?')}",
+                    f"node auditor reports {int(value)} open drift "
+                    f"finding(s)",
+                ))
+        if node.readiness == "not ready":
+            findings.append(DoctorFinding(
+                SEVERITY_DRIFT, "readiness", node.name,
+                "node /readyz reports not ready",
+            ))
+        elif node.readiness == "degraded":
+            findings.append(DoctorFinding(
+                SEVERITY_INFO, "readiness", node.name,
+                "node /readyz reports degraded",
+            ))
+        elif node.readiness != "ready" and not any(
+            err.startswith("/readyz") for err in node.errors
+        ):
+            # Truncated body, version skew — whatever it is, an
+            # unrecognized state must not read as healthy. A FAILED
+            # /readyz fetch is already a collect error above; a second
+            # finding for the same root cause would just inflate triage.
+            findings.append(DoctorFinding(
+                SEVERITY_ERROR, "readiness", node.name,
+                f"unrecognized /readyz state {node.readiness!r}",
+            ))
+
+    if cluster is None:
+        return findings
+
+    claims_by_uid = {
+        (c.get("metadata") or {}).get("uid", ""): c
+        for c in cluster["resourceClaims"]
+    }
+    # Nodes whose /debug/usage scrape failed have an UNKNOWN hold set —
+    # keep them out of the placement checks (their collect error above
+    # already reports them) rather than read "no holds" into a
+    # not-prepared finding for every claim allocated there.
+    usage_known = [n for n in nodes if n.usage is not None]
+    scraped = {n.pool_name for n in usage_known}
+    # Per-node held UIDs: every placement check below must be node-local
+    # (a claim held on the WRONG node must not satisfy the right one).
+    held_by_node = {
+        n.pool_name: {h.get("claimUid", "") for h in n.holds}
+        for n in usage_known
+    }
+
+    for node in nodes:
+        for hold in node.holds:
+            uid = hold.get("claimUid", "")
+            claim = claims_by_uid.get(uid)
+            if claim is None:
+                findings.append(DoctorFinding(
+                    SEVERITY_DRIFT, "claim-gone",
+                    f"{node.name}/{uid}",
+                    f"node holds prepared claim "
+                    f"{hold.get('namespace')}/{hold.get('name')} but no "
+                    "ResourceClaim with that UID exists (orphan cleaner "
+                    "should unprepare it)",
+                ))
+                continue
+            results = _allocation_results(claim, driver_name)
+            # Node pools the allocation actually targets. ICI channel
+            # results are cluster-scoped and place no node-pool devices;
+            # they are recognized by DEVICE name ("ici-channel-<n>",
+            # driver-controlled) — never by pool name, which for node
+            # pools is the operator-controlled node name and may itself
+            # start with "ici-".
+            node_pools = {
+                r.get("pool", "") for r in results
+                if not _is_channel_result(r)
+            }
+            if node_pools and node.pool_name not in node_pools:
+                findings.append(DoctorFinding(
+                    SEVERITY_DRIFT, "wrong-node",
+                    f"{node.name}/{uid}",
+                    f"node holds prepared claim "
+                    f"{hold.get('namespace')}/{hold.get('name')} but its "
+                    f"allocation targets {sorted(node_pools)} (stale "
+                    "prepare from a superseded placement)",
+                ))
+                continue
+            allocated = {
+                r.get("device", "") for r in results
+                if r.get("pool") == node.pool_name
+            }
+            # ICI channels come from the controller's cluster pools, not
+            # the node pool — compare node-pool devices only.
+            held_node = {
+                d.get("name", "?") for d in hold.get("devices", [])
+                if d.get("type") != "ici"
+            }
+            if allocated and held_node != allocated:
+                findings.append(DoctorFinding(
+                    SEVERITY_DRIFT, "devices-mismatch",
+                    f"{node.name}/{uid}",
+                    f"prepared {sorted(held_node)} but allocation says "
+                    f"{sorted(allocated)}",
+                ))
+
+    for uid, claim in sorted(claims_by_uid.items()):
+        md = claim.get("metadata") or {}
+        for r in _allocation_results(claim, driver_name):
+            if _is_channel_result(r):
+                continue  # cluster pools; nothing to prepare on a node
+            pool = r.get("pool", "")
+            if pool in scraped and uid not in held_by_node.get(pool, ()):
+                findings.append(DoctorFinding(
+                    SEVERITY_INFO, "not-prepared",
+                    f"{pool}/{uid}",
+                    f"claim {md.get('namespace')}/{md.get('name')} is "
+                    f"allocated to {pool} but not prepared there (pod "
+                    "may not have started yet)",
+                ))
+                break
+
+    published_channels, allocated_channels = ici_occupancy(
+        cluster, driver_name
+    )
+    if allocated_channels > published_channels:
+        findings.append(DoctorFinding(
+            SEVERITY_DRIFT, "ici",
+            "channels",
+            f"{allocated_channels} ICI channels allocated but only "
+            f"{published_channels} published",
+        ))
+    return findings
+
+
+def _is_channel_result(result: dict) -> bool:
+    """Whether an allocation result is an ICI channel (cluster pool)
+    rather than a node-pool device — keyed on the driver-controlled
+    device name, never the pool name."""
+    from .tpulib.deviceinfo import is_ici_channel_device_name
+
+    return is_ici_channel_device_name(result.get("device", ""))
+
+
+def _allocation_results(claim: dict, driver_name: str) -> list[dict]:
+    results = (
+        ((claim.get("status") or {}).get("allocation") or {})
+        .get("devices", {}).get("results")
+    ) or []
+    return [r for r in results if r.get("driver") == driver_name]
+
+
+def ici_occupancy(cluster: dict, driver_name: str) -> tuple[int, int]:
+    """(published, allocated) ICI channel counts — the controller-side
+    occupancy number, derived from cluster objects alone."""
+    published = sum(
+        len((s.get("spec") or {}).get("devices", []))
+        for s in cluster["resourceSlices"]
+        if "nodeSelector" in (s.get("spec") or {})
+    )
+    allocated = sum(
+        1
+        for c in cluster["resourceClaims"]
+        for r in _allocation_results(c, driver_name)
+        if _is_channel_result(r)
+    )
+    return published, allocated
+
+
+# ---------------------------------------------------------------------------
+# Report
+# ---------------------------------------------------------------------------
+
+def render_report(
+    nodes: list[NodeScrape],
+    cluster: Optional[dict],
+    findings: list[DoctorFinding],
+    driver_name: str,
+) -> str:
+    lines = [
+        f"tpu-dra doctor — {time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}",
+        f"nodes scraped: {len(nodes)}"
+        + (f" ({sum(1 for n in nodes if n.errors)} with collection errors)"
+           if any(n.errors for n in nodes) else ""),
+        "",
+    ]
+    for node in sorted(nodes, key=lambda n: n.name):
+        usage = node.usage or {}
+        cap = usage.get("capacity") or {}
+        # Distinct devices per type, unioned across holds: an adminAccess
+        # claim holds the same device as the workload claim it observes,
+        # so summing per-mode counts would read occupancy over capacity.
+        occ_devices: dict[str, set] = {}
+        for hold in node.holds:
+            for d in hold.get("devices", []):
+                occ_devices.setdefault(d.get("type", "?"), set()).add(
+                    d.get("name", "")
+                )
+        occupancy = ", ".join(
+            f"{t} {len(occ_devices.get(t, ()))}/{cap[t]}"
+            for t in sorted(cap)
+        ) or "no usage data"
+        lines.append(
+            f"[{node.name}] {node.readiness} | {occupancy} | "
+            f"holds: {len(node.holds)}"
+        )
+        for hold in node.holds:
+            # Defensive .get()s throughout: a version-skewed plugin's
+            # malformed snapshot must degrade the report, never abort
+            # the run before the bundle is written.
+            devs = ", ".join(
+                f"{d.get('name', '?')} [{d.get('mode', '?')}]"
+                for d in hold.get("devices", [])
+            )
+            try:
+                held = f"{float(hold.get('heldSeconds', 0)):.0f}"
+            except (TypeError, ValueError):
+                held = "?"
+            lines.append(
+                f"    {hold.get('namespace')}/{hold.get('name')} "
+                f"({hold.get('claimUid')}): {devs} — held {held}s"
+            )
+        for err in node.errors:
+            lines.append(f"    COLLECTION ERROR: {err}")
+    lines.append("")
+    if cluster is not None:
+        node_pools = sum(
+            1 for s in cluster["resourceSlices"]
+            if "nodeName" in (s.get("spec") or {})
+        )
+        published, allocated = ici_occupancy(cluster, driver_name)
+        lines.append(
+            f"cluster: {len(cluster['resourceSlices'])} ResourceSlices "
+            f"({node_pools} node pools), "
+            f"{len(cluster['resourceClaims'])} allocated claims, "
+            f"ICI channels {allocated}/{published} allocated"
+        )
+    else:
+        lines.append("cluster: (no kube access; cross-checks skipped)")
+    lines.append("")
+    drift = [f for f in findings if f.severity == SEVERITY_DRIFT]
+    errors = [f for f in findings if f.severity == SEVERITY_ERROR]
+    infos = [f for f in findings if f.severity == SEVERITY_INFO]
+    if not findings:
+        lines.append("diagnosis: CLEAN — cluster and node views agree")
+    else:
+        lines.append(
+            f"diagnosis: {len(drift)} drift, {len(errors)} collection "
+            f"error(s), {len(infos)} informational"
+        )
+        for f in findings:
+            lines.append(f"  {f}")
+    return "\n".join(lines) + "\n"
+
+
+def write_bundle(
+    path: str,
+    nodes: list[NodeScrape],
+    cluster: Optional[dict],
+    findings: list[DoctorFinding],
+    report: str,
+) -> None:
+    """Support-bundle tar: every raw document the diagnosis was derived
+    from, so offline support can re-run the analysis."""
+
+    def add(tar: tarfile.TarFile, name: str, text: str) -> None:
+        data = text.encode()
+        info = tarfile.TarInfo(name=name)
+        info.size = len(data)
+        info.mtime = int(time.time())
+        tar.addfile(info, io.BytesIO(data))
+
+    with tarfile.open(path, "w") as tar:
+        add(tar, "report.txt", report)
+        add(tar, "findings.json", json.dumps(
+            [dataclasses.asdict(f) for f in findings], indent=2
+        ))
+        for node in nodes:
+            base = f"nodes/{node.name}"
+            add(tar, f"{base}/metrics.txt", node.metrics_text)
+            add(tar, f"{base}/usage.json",
+                json.dumps(node.usage or {}, indent=2, sort_keys=True))
+            add(tar, f"{base}/traces.jsonl", node.traces_text)
+            add(tar, f"{base}/readyz.txt", node.readyz_text)
+            if node.errors:
+                add(tar, f"{base}/errors.txt", "\n".join(node.errors) + "\n")
+        if cluster is not None:
+            add(tar, "cluster/resourceslices.json",
+                json.dumps(cluster["resourceSlices"], indent=2))
+            add(tar, "cluster/resourceclaims.json",
+                json.dumps(cluster["resourceClaims"], indent=2))
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+def run(
+    node_urls: dict[str, str],
+    kube_client=None,
+    driver_name: str = "tpu.google.com",
+    bundle: Optional[str] = None,
+    timeout: float = 5.0,
+) -> tuple[str, list[DoctorFinding], int]:
+    """The doctor's whole pass, kube-client-injectable so the cluster sim
+    (FakeKubeClient) exercises the identical code path as production.
+    Returns (report text, findings, exit status)."""
+    # Scrape nodes concurrently: collection is per-node independent, and
+    # a fleet with a few dark nodes (the very situation the doctor is
+    # for) would otherwise stall ~4 fetch timeouts per dark node,
+    # serially. Sorted input + map keeps the report order deterministic.
+    from concurrent.futures import ThreadPoolExecutor
+
+    ordered = sorted(node_urls.items())
+    nodes: list[NodeScrape] = []
+    if ordered:  # ThreadPoolExecutor rejects max_workers=0
+        with ThreadPoolExecutor(
+            max_workers=min(16, len(ordered))
+        ) as pool:
+            nodes = list(pool.map(
+                lambda nu: collect_node(nu[0], nu[1], timeout=timeout),
+                ordered,
+            ))
+    cluster = None
+    cluster_error = None
+    if kube_client is not None:
+        try:
+            cluster = collect_cluster(kube_client, driver_name)
+        except Exception as e:
+            logger.exception("cluster collection failed")
+            cluster_error = DoctorFinding(
+                SEVERITY_ERROR, "collect", "cluster", str(e)
+            )
+    findings = fleet_findings(nodes, cluster, driver_name)
+    if cluster_error is not None:
+        findings.append(cluster_error)
+    report = render_report(nodes, cluster, findings, driver_name)
+    if bundle:
+        write_bundle(bundle, nodes, cluster, findings, report)
+    status = 0
+    if any(f.severity == SEVERITY_DRIFT for f in findings):
+        status = 1
+    if any(f.severity == SEVERITY_ERROR for f in findings):
+        status = 2
+    return report, findings, status
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu-dra-doctor",
+        description="Fleet-wide TPU DRA diagnosis + support bundles "
+                    "(read-only)",
+    )
+    p.add_argument(
+        "--node", action="append", default=[], metavar="NAME=URL",
+        help="a node plugin's debug endpoint, e.g. "
+             "node-a=http://10.0.0.11:8081 (repeatable)",
+    )
+    p.add_argument("--driver-name", default="tpu.google.com")
+    p.add_argument("--kubeconfig", default="",
+                   help="kubeconfig path (default: in-cluster)")
+    p.add_argument("--no-kube", action="store_true",
+                   help="skip apiserver cross-checks (node scrapes only)")
+    p.add_argument("--bundle", default="",
+                   help="write a support-bundle tar of all raw documents "
+                        "to this path")
+    p.add_argument("--timeout", type=float, default=5.0,
+                   help="per-request scrape timeout, seconds")
+    p.add_argument("--json", action="store_true",
+                   help="emit findings as JSON instead of the report")
+    return p
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    node_urls: dict[str, str] = {}
+    for spec in args.node:
+        name, sep, url = spec.partition("=")
+        if not sep or not name or not url:
+            print(f"--node must be NAME=URL, got {spec!r}", file=sys.stderr)
+            return 2
+        node_urls[name] = url
+    if not node_urls:
+        print("at least one --node NAME=URL is required", file=sys.stderr)
+        return 2
+    client = None
+    if not args.no_kube:
+        from .utils.cli import make_kube_client
+
+        try:
+            client = make_kube_client(args.kubeconfig)
+        except (OSError, ValueError) as exc:
+            print(
+                f"cannot build a kube client ({exc}); pass --kubeconfig "
+                "or use --no-kube for node-scrape-only diagnosis",
+                file=sys.stderr,
+            )
+            return 2
+    report, findings, status = run(
+        node_urls,
+        kube_client=client,
+        driver_name=args.driver_name,
+        bundle=args.bundle or None,
+        timeout=args.timeout,
+    )
+    if args.json:
+        print(json.dumps(
+            [dataclasses.asdict(f) for f in findings], indent=2
+        ))
+    else:
+        print(report, end="")
+    if args.bundle:
+        print(f"support bundle written to {args.bundle}", file=sys.stderr)
+    return status
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -106,6 +106,10 @@ class DeviceState:
         self.pool_name = pool_name
         self.device_classes = device_classes or {"chip", "tensorcore", "ici"}
         self._lock = threading.Lock()
+        # Utilization accounting (plugin/accounting.py), attached by the
+        # Driver after construction; None keeps direct DeviceState users
+        # (tests, inspector) hook-free.
+        self.accountant = None
 
         # Startup checkpoint recovery FIRST: a corrupt checkpoint must not
         # crash-loop the DaemonSet (every later step below reads it). The
@@ -116,7 +120,7 @@ class DeviceState:
 
         self.checkpoint.create_if_missing()
         try:
-            self.checkpoint.read()
+            startup_records = self.checkpoint.read()
         except CorruptCheckpointError as e:
             quarantined = self.checkpoint.quarantine()
             logger.error(
@@ -124,6 +128,11 @@ class DeviceState:
                 "continuing from empty state", e, quarantined,
             )
             self.checkpoint.write({})
+            startup_records = {}
+        # The view recovered above, kept for consumers that seed from the
+        # startup state (usage-accounting rebuild): they must see the
+        # SAME records recovery saw, not a second read's.
+        self.startup_prepared_records: dict[str, dict] = startup_records
 
         self.chiplib.init()
         # Per-chip health (uuid -> HealthStatus) and the transition log the
@@ -251,10 +260,14 @@ class DeviceState:
             prepared_claims = self.checkpoint.read()
             if claim_uid in prepared_claims:
                 cached = PreparedClaim.from_dict(prepared_claims[claim_uid])
+                if self.accountant is not None:
+                    self.accountant.note_prepared(cached)  # idempotent
                 return cached.get_devices()
             prepared = self._prepare_devices(claim)
             prepared_claims[claim_uid] = prepared.to_dict()
             self.checkpoint.write(prepared_claims)
+            if self.accountant is not None:
+                self.accountant.note_prepared(prepared)
             return prepared.get_devices()
 
     def _allocation_results(self, claim: dict) -> list[dict]:
@@ -603,6 +616,8 @@ class DeviceState:
             self.cdi.delete_claim_spec_file(claim_uid)
             del prepared_claims[claim_uid]
             self.checkpoint.write(prepared_claims)
+            if self.accountant is not None:
+                self.accountant.note_unprepared(claim_uid)
 
     @staticmethod
     def _config_strategy(config_dict: dict) -> str:
@@ -733,6 +748,33 @@ class DeviceState:
                    for u in uuids):
                 out.append(pc)
         return out
+
+    def usage_inventory(self) -> dict[str, Any]:
+        """Capacity + chip-health view for the utilization accountant.
+
+        Deliberately lock-free: ``allocatable`` and ``chip_health`` are
+        replaced wholesale (atomic reference assignment) by
+        ``refresh_allocatable``, so grabbing the references and iterating
+        them is consistent — and the accountant's render hook can call
+        this from the scrape thread without ordering against the
+        DeviceState lock held by an in-flight prepare.
+        """
+        alloc = self.allocatable
+        health = self.chip_health
+        capacity: dict[str, int] = {}
+        for dev in alloc.values():
+            capacity[dev.type()] = capacity.get(dev.type(), 0) + 1
+        return {
+            "capacity": capacity,
+            "chips": {
+                uuid: {
+                    "state": st.state,
+                    "since": st.since,
+                    "reason": st.reason,
+                }
+                for uuid, st in health.items()
+            },
+        }
 
     def published_resources(self) -> dict[str, Any]:
         """DriverResources (pool spec) for the ResourceSlice controller —

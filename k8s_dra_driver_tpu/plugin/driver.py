@@ -82,6 +82,9 @@ class DriverConfig:
     # library's inotify, where available) and republish on change. 0
     # disables; the reference enumerates once at startup only.
     device_watch_interval_seconds: float = 30.0
+    # State-drift auditor pass cadence (plugin/audit.py). 0 disables the
+    # periodic thread; run_once stays callable either way (doctor/tests).
+    audit_interval_seconds: float = 300.0
 
     @property
     def plugin_socket(self) -> str:
@@ -182,6 +185,34 @@ class Driver(NodeServicer):
             state_dir=f"{config.state_root}/state",
             device_classes=set(config.device_classes),
         )
+        # Utilization accounting: holds rebuilt from the checkpoint so a
+        # DaemonSet crash never zeroes the node's occupancy view.
+        from .accounting import UsageAccountant
+
+        self.usage = UsageAccountant(
+            self.registry,
+            node_name=config.node_name,
+            inventory=self.state.usage_inventory,
+        )
+        self.usage.attach_prepare_latency(self._m_prepare_latency)
+        try:
+            self.usage.rebuild(self.state.startup_prepared_records)
+        except Exception:
+            logger.exception("usage rebuild from checkpoint failed")
+        self.state.accountant = self.usage
+        # State-drift auditor: the chaos invariants, run continuously.
+        from .audit import StateAuditor
+
+        self.auditor = StateAuditor(
+            state=self.state,
+            registry=self.registry,
+            kube_client=config.kube_client,
+            resource_api=lambda: self.resource_api,
+            node_name=config.node_name,
+            node_uid=config.node_uid,
+            events=self.events,
+            interval_seconds=config.audit_interval_seconds,
+        )
         self.plugin = KubeletPlugin(
             node_server=self,
             driver_name=config.driver_name,
@@ -211,6 +242,8 @@ class Driver(NodeServicer):
         )
         if self.config.cleanup_interval_seconds > 0:
             self.cleaner.start()
+        if self.config.audit_interval_seconds > 0:
+            self.auditor.start()
         self._watch_stop = threading.Event()
         self._watch_thread: Optional[threading.Thread] = None
         if self.config.device_watch_interval_seconds > 0:
@@ -235,6 +268,7 @@ class Driver(NodeServicer):
             self._watch_thread.join(timeout=1.0)
         if getattr(self, "cleaner", None) is not None:
             self.cleaner.stop()
+        self.auditor.stop()
         self.plugin.stop()
         self.state.chiplib.shutdown()
 
@@ -357,8 +391,13 @@ class Driver(NodeServicer):
         """Non-critical /readyz probes: failing these reads DEGRADED (HTTP
         200, body says so), not dead — during an apiserver outage the
         plugin still serves prepares from checkpointed state, and flipping
-        readiness would make kubelet stop talking to a working plugin."""
-        return {"apiserver-reachable": self._check_apiserver}
+        readiness would make kubelet stop talking to a working plugin.
+        State drift is equally non-fatal: the plugin keeps serving while
+        an operator (or the doctor CLI) investigates the findings."""
+        return {
+            "apiserver-reachable": self._check_apiserver,
+            "state-consistent": self.auditor.readiness_check,
+        }
 
     def _check_apiserver(self):
         if self.config.kube_client is None:

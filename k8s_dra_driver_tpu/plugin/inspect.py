@@ -26,11 +26,56 @@ from ..plugin.prepared import PreparedClaim
 from ..plugin.sharing import CorruptShareStateError, SharingStateStore
 
 
+def collect_live(http_url: str, timeout: float = 3.0) -> dict[str, Any]:
+    """Live-process state no file can show: the degraded-mode flag and
+    whether slice republishes are queued behind backoff. Scraped from a
+    running plugin's ``/readyz`` (a 503 body is still a diagnosis, not a
+    failure). Errors are reported in-band — the inspector must stay
+    useful against a dead plugin."""
+    import urllib.request
+
+    out: dict[str, Any] = {"url": http_url}
+    try:
+        with urllib.request.urlopen(
+            http_url.rstrip("/") + "/readyz", timeout=timeout
+        ) as resp:
+            body = resp.read().decode()
+    except Exception as e:
+        # Only the documented not-ready answer (503) carries a readiness
+        # body; a proxy's 502 page is a failure, not a diagnosis.
+        body = (getattr(e, "read", lambda: b"")()
+                if getattr(e, "code", None) == 503 else b"")
+        if body:
+            body = body.decode(errors="replace")
+        else:
+            out["error"] = f"/readyz unreachable: {e}"
+            return out
+    lines = [ln for ln in body.splitlines() if ln]
+    mode = lines[-1] if lines else "unknown"
+    out["mode"] = mode
+    out["degraded"] = mode == "degraded"
+    out["checks"] = lines[:-1]
+    # A failing apiserver-reachable check whose detail names the slice
+    # republish means inventory/health changes are queued behind backoff
+    # (resourceslice.py sync_health wording), not lost.
+    queued = next(
+        (ln for ln in lines
+         if "apiserver-reachable" in ln and not ln.startswith("[+]")
+         and "republish" in ln),
+        "",
+    )
+    out["queuedSliceRepublish"] = bool(queued)
+    if queued:
+        out["queuedSliceRepublishDetail"] = queued
+    return out
+
+
 def collect(
     state_root: str,
     cdi_root: str,
     chiplib=None,
     driver_name: str = "tpu.google.com",
+    http_url: str = "",
 ) -> dict[str, Any]:
     """Gather the node's driver state into one structure (pure reads)."""
     out: dict[str, Any] = {"stateRoot": state_root, "cdiRoot": cdi_root}
@@ -106,10 +151,13 @@ def collect(
                 cdi["orphanedClaimSpecs"].append(uid)
     out["cdi"] = cdi
 
-    # Live inventory, when a chip library is given (real probing needs a
-    # TPU host; the fake serves tests and demos).
+    # Live inventory + health, when a chip library is given (real probing
+    # needs a TPU host; the fake serves tests and demos). One snapshot()
+    # probe yields both, so a chip can never list present while the same
+    # collection reports it gone.
     if chiplib is not None:
         chiplib.init()
+        chips, health = chiplib.snapshot()
         out["inventory"] = [
             {
                 "name": c.canonical_name(),
@@ -117,9 +165,35 @@ def collect(
                 "generation": c.generation,
                 "coord": str(c.coord),
                 "sliceId": c.slice_id,
+                "health": (
+                    health[c.uuid].state if c.uuid in health else "healthy"
+                ),
+                "healthSince": (
+                    health[c.uuid].since if c.uuid in health else 0.0
+                ),
+                "healthReason": (
+                    health[c.uuid].reason if c.uuid in health else ""
+                ),
             }
-            for c in chiplib.enumerate_chips()
+            for c in chips
         ]
+        # Gone chips are absent from the enumeration but their health
+        # record is the evidence an operator is looking for.
+        out["unhealthyChips"] = [
+            {
+                "uuid": uuid,
+                "state": st.state,
+                "since": st.since,
+                "reason": st.reason,
+            }
+            for uuid, st in sorted(health.items())
+            if not st.is_healthy()
+        ]
+
+    # Live plugin state (degraded mode, queued republishes) — only a
+    # running process can answer these; opt-in via --http-url.
+    if http_url:
+        out["live"] = collect_live(http_url)
     return out
 
 
@@ -163,10 +237,51 @@ def render(state: dict[str, Any]) -> str:
         lines.append("")
         lines.append(f"chips visible: {len(state['inventory'])}")
         for c in state["inventory"]:
+            health = c.get("health", "healthy")
+            suffix = ""
+            if health != "healthy":
+                suffix = (
+                    f" [{health.upper()} since {c.get('healthSince', 0):.0f}"
+                    + (f": {c['healthReason']}" if c.get("healthReason")
+                       else "")
+                    + "]"
+                )
             lines.append(
                 f"  {c['name']} {c['uuid']} {c['generation']} "
-                f"coord={c['coord']} slice={c['sliceId']}"
+                f"coord={c['coord']} slice={c['sliceId']}{suffix}"
             )
+        unhealthy = state.get("unhealthyChips") or []
+        if unhealthy:
+            lines.append("")
+            lines.append(f"unhealthy chips: {len(unhealthy)}")
+            for u in unhealthy:
+                lines.append(
+                    f"  {u['uuid']}: {u['state']} since "
+                    f"{u['since']:.0f}"
+                    + (f" ({u['reason']})" if u.get("reason") else "")
+                )
+    live = state.get("live")
+    if live is not None:
+        lines.append("")
+        if "error" in live:
+            lines.append(f"live plugin: UNREACHABLE ({live['error']})")
+        else:
+            # The cause lives in the [~]-marked check lines below (an
+            # apiserver outage reads differently from state drift); the
+            # headline only states the mode.
+            lines.append(
+                f"live plugin: {live.get('mode', 'unknown')}"
+                + (" — DEGRADED MODE (still serving; the [~] checks "
+                   "below name the cause)" if live.get("degraded")
+                   else "")
+            )
+            if live.get("queuedSliceRepublish"):
+                lines.append(
+                    "  slice republishes QUEUED behind backoff: "
+                    + live.get("queuedSliceRepublishDetail", "")
+                )
+            for check in live.get("checks", []):
+                lines.append(f"  {check}")
     return "\n".join(lines)
 
 
@@ -181,6 +296,10 @@ def main(argv: Optional[list[str]] = None) -> int:
                    help="inspect with a fake chip inventory (tests/demos)")
     p.add_argument("--probe-chips", action="store_true",
                    help="probe the real /dev + sysfs chip inventory")
+    p.add_argument("--http-url", default="",
+                   help="a running plugin's debug endpoint (e.g. "
+                        "http://localhost:8081) for live state: degraded "
+                        "mode, queued slice republishes, readiness checks")
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
 
@@ -195,7 +314,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         chiplib = RealChipLib()
 
     state = collect(
-        args.state_root, args.cdi_root, chiplib, args.driver_name
+        args.state_root, args.cdi_root, chiplib, args.driver_name,
+        http_url=args.http_url,
     )
     if args.json:
         print(json.dumps(state, indent=2))

@@ -89,6 +89,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "(multi-node kind, the nvkind analog) [FAKE_HOSTS]")
     p.add_argument("--http-port", type=int, default=int(_env("HTTP_PORT", "0")),
                    help="metrics/health endpoint port; 0 disables [HTTP_PORT]")
+    p.add_argument("--audit-interval", type=float,
+                   default=float(_env("AUDIT_INTERVAL", "300") or 300),
+                   help="seconds between state-drift audit passes "
+                        "(checkpoint vs CDI vs ResourceSlices vs chip "
+                        "inventory); 0 disables [AUDIT_INTERVAL]")
     p.add_argument("--log-level", default=_env("LOG_LEVEL", ""),
                    help="log level; empty falls back to TPU_DRA_LOG_LEVEL "
                         "then INFO [LOG_LEVEL]")
@@ -290,6 +295,7 @@ def main(argv=None) -> int:
         registration_versions=resolve_registration_versions(
             args.plugin_api_versions, node_obj, args.node_name
         ),
+        audit_interval_seconds=args.audit_interval,
     )
     driver = Driver(config, registry=registry)
     driver.start()
@@ -305,9 +311,10 @@ def main(argv=None) -> int:
         # an apiserver outage must not flip the DaemonSet readinessProbe.
         for name, check in driver.degraded_checks().items():
             metrics.add_readiness_check(name, check, critical=False)
+        metrics.set_usage_provider(driver.usage.snapshot)
         metrics.start()
-        logger.info("metrics on :%d/metrics (+/readyz, /debug/traces)",
-                    metrics.port)
+        logger.info("metrics on :%d/metrics (+/readyz, /debug/traces, "
+                    "/debug/usage)", metrics.port)
     logger.info(
         "tpu-dra-plugin started: node=%s devices=%d",
         args.node_name,

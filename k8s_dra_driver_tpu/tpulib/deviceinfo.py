@@ -227,6 +227,8 @@ class TensorCoreInfo:
     def uuid(self) -> str:
         # Profile-qualified so placements of different profiles at the
         # same start core never collide; "1c" keeps the historical form.
+        # Parse these back with ``chip_uuid_of_device_uuid`` — never with
+        # ad-hoc string splitting.
         if self.profile.name == "1c":
             return f"{self.parent.uuid}-core-{self.core_index}"
         return (
@@ -323,6 +325,8 @@ class IciChannelInfo:
     def canonical_name(self) -> str:
         return f"ici-channel-{self.channel}"
 
+    NAME_PREFIX = "ici-channel-"
+
     def uuids(self) -> list[str]:
         return [f"ici-channel-{self.channel}"]
 
@@ -369,6 +373,24 @@ class AllocatableDevice:
 
 # name -> AllocatableDevice (reference: AllocatableDevices map, allocatable.go:25)
 AllocatableDevices = dict[str, AllocatableDevice]
+
+
+def is_ici_channel_device_name(name: str) -> bool:
+    """Whether a device (or allocation-result) name is an ICI channel —
+    IciChannelInfo.canonical_name's form. The one classifier; callers
+    must not match the prefix themselves, and must never classify by
+    POOL name: node pools are named after operator-controlled node
+    names, which may themselves start with "ici-"."""
+    return name.startswith(IciChannelInfo.NAME_PREFIX)
+
+
+def chip_uuid_of_device_uuid(device_uuid: str) -> str:
+    """The chip uuid any device uuid belongs to. Chip uuids are
+    ``TPU-<serial>`` with a hyphen-free serial; partition uuids append
+    ``-core-<i>`` (1c profile) or ``-<profile>-<i>`` (TensorCoreInfo.uuid
+    above) — so the chip is always the first two hyphen tokens. The one
+    parser for that format; callers must not re-implement the split."""
+    return "-".join(device_uuid.split("-")[:2])
 
 
 def chip_uuids(devices: AllocatableDevices) -> list[str]:

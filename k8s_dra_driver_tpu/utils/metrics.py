@@ -17,6 +17,7 @@ release via ``Registry.alias`` with a ``(deprecated)`` HELP marker.
 
 from __future__ import annotations
 
+import logging
 import math
 import re
 import threading
@@ -151,6 +152,11 @@ class Histogram:
 
         return _Timer()
 
+    def summary(self) -> tuple[int, float]:
+        """(count, sum) — the scalar view snapshot/doctor reports use."""
+        with self._lock:
+            return self._n, self._sum
+
     def render(self) -> list[str]:
         return self.render_as(self.name, self.help)
 
@@ -216,6 +222,7 @@ class Registry:
         self._metrics: list = []
         self._names: set[str] = set()
         self._lock = threading.Lock()
+        self._render_hooks: list[Callable] = []
 
     def _register(self, metric) -> None:
         with self._lock:
@@ -228,7 +235,24 @@ class Registry:
         """Keep ``old_name`` rendering (deprecated) for a renamed metric."""
         self._register(_DeprecatedAlias(old_name, metric))
 
+    def add_render_hook(self, hook: Callable) -> None:
+        """Run ``hook()`` before every render. The seam for metrics that
+        integrate over time (usage allocated-seconds): values must be
+        brought current at the scrape instant, not at the last event.
+        Hooks run OUTSIDE the registry lock (they set gauges/counters,
+        which register nothing) and a raising hook is swallowed — a
+        broken integrator must not take /metrics down with it."""
+        with self._lock:
+            self._render_hooks.append(hook)
+
     def render(self) -> str:
+        with self._lock:
+            hooks = list(self._render_hooks)
+        for hook in hooks:
+            try:
+                hook()
+            except Exception:
+                logging.getLogger(__name__).exception("render hook failed")
         lines: list[str] = []
         with self._lock:
             for m in self._metrics:
@@ -298,12 +322,17 @@ class MetricsServer:
     outage must not make kubelet abandon a plugin that is still serving
     prepares from checkpointed state.
     ``/debug/traces`` streams the tracer's finished claim traces as JSONL.
+    ``/debug/usage`` serves the utilization accountant's JSON snapshot
+    when a provider was registered with ``set_usage_provider`` (404
+    otherwise). All routes are GET-only; other methods get ``405`` with
+    an ``Allow: GET`` header — the scrape surface mutates nothing.
     """
 
     def __init__(self, registry: Registry, host: str = "0.0.0.0",
                  port: int = 0, tracer=None):
         self.registry = registry
         self.tracer = tracer
+        self.usage_provider: Optional[Callable] = None
         registry_ref = registry
         health = self._health = {"ok": True}
         self._ready_checks: dict[str, Callable] = {}
@@ -312,10 +341,40 @@ class MetricsServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):
+                status, ctype, body = self._resolve()
+                self._reply(status, ctype, body, include_body=True)
+
+            def do_HEAD(self):
+                # Same status line + headers as the GET would produce,
+                # no body (RFC 9110) — HEAD-probing health checkers keep
+                # working.
+                status, ctype, body = self._resolve(head=True)
+                self._reply(status, ctype, body, include_body=False)
+
+            def _resolve(self, head=False):
                 status = 200
                 if self.path == "/metrics":
                     body = registry_ref.render().encode()
                     ctype = "text/plain; version=0.0.4"
+                elif self.path == "/debug/usage":
+                    provider = server_ref.usage_provider
+                    if provider is None:
+                        body = b"usage accounting not enabled\n"
+                        status = 404
+                        ctype = "text/plain"
+                    else:
+                        import json as _json
+
+                        try:
+                            body = (
+                                _json.dumps(provider(), sort_keys=True)
+                                + "\n"
+                            ).encode()
+                            ctype = "application/json"
+                        except Exception as e:
+                            body = f"usage snapshot failed: {e}\n".encode()
+                            status = 500
+                            ctype = "text/plain"
                 elif self.path == "/healthz":
                     body = (b"ok" if health["ok"] else b"unhealthy")
                     status = 200 if health["ok"] else 503
@@ -353,17 +412,41 @@ class MetricsServer:
                         # NaN fails both bounds checks and lands on 2s.
                         if not (0.0 <= secs <= 60.0):
                             secs = min(max(secs, 0.0), 60.0) if secs == secs else 2.0
-                        body = _sample_profile(secs).encode()
+                        # A HEAD probe must not pin a handler thread on
+                        # seconds of stack sampling just to drop the body.
+                        body = b"" if head else _sample_profile(secs).encode()
                         ctype = "text/plain"
                 else:
                     body = b"not found"
                     status = 404
                     ctype = "text/plain"
+                return status, ctype, body
+
+            def _reply(self, status, ctype, body, include_body):
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
+                if include_body:
+                    self.wfile.write(body)
+
+            def _method_not_allowed(self):
+                body = b"method not allowed; this surface is GET-only\n"
+                self.send_response(405)
+                self.send_header("Allow", "GET, HEAD")
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
                 self.wfile.write(body)
+
+            # The debug surface is read-only by contract; a mutating
+            # method is a caller bug (or a probe misconfiguration) and
+            # must say so rather than fall into BaseHTTPRequestHandler's
+            # 501. HEAD is a read and is served above.
+            do_POST = _method_not_allowed
+            do_PUT = _method_not_allowed
+            do_DELETE = _method_not_allowed
+            do_PATCH = _method_not_allowed
 
             def log_message(self, *args):
                 pass  # quiet; structured logs carry the signal
@@ -380,6 +463,11 @@ class MetricsServer:
 
     def set_healthy(self, ok: bool) -> None:
         self._health["ok"] = ok
+
+    def set_usage_provider(self, provider: Callable) -> None:
+        """Serve ``provider()`` (a JSON-serializable dict) at
+        ``/debug/usage``. Safe to call after ``start()``."""
+        self.usage_provider = provider
 
     def add_readiness_check(self, name: str, check: Callable,
                             critical: bool = True) -> None:

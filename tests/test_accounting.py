@@ -1,0 +1,217 @@
+"""Utilization accounting: occupancy from prepare/unprepare, integrated
+allocated-seconds, checkpoint rebuild, and the /debug/usage snapshot."""
+
+import pytest
+
+from k8s_dra_driver_tpu.cdi import CDIHandler
+from k8s_dra_driver_tpu.plugin.accounting import UsageAccountant, group_mode
+from k8s_dra_driver_tpu.plugin.checkpoint import CheckpointManager
+from k8s_dra_driver_tpu.plugin.device_state import DeviceState
+from k8s_dra_driver_tpu.tpulib import FakeChipLib
+from k8s_dra_driver_tpu.utils.metrics import Registry
+
+DRIVER = "tpu.google.com"
+
+
+class FakeClock:
+    """Starts at the REAL wall clock (PreparedClaim.prepared_at is
+    stamped by DeviceState with time.time(), and the accountant compares
+    the two) but advances only when told."""
+
+    def __init__(self):
+        import time
+
+        self.t = time.time()
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_state(tmp_path):
+    return DeviceState(
+        chiplib=FakeChipLib(generation="v5p", topology="2x2x1"),
+        cdi=CDIHandler(str(tmp_path / "cdi")),
+        checkpoint=CheckpointManager(str(tmp_path / "checkpoint.json")),
+        driver_name=DRIVER,
+        pool_name="node-a",
+        state_dir=str(tmp_path / "state"),
+    )
+
+
+def make_claim(uid, devices, strategy=None, name="c"):
+    cfgs = []
+    if strategy:
+        cfgs = [{
+            "source": "FromClaim", "requests": [],
+            "opaque": {"driver": DRIVER, "parameters": {
+                "apiVersion": "tpu.google.com/v1alpha1",
+                "kind": "TpuChipConfig",
+                "sharing": {"strategy": strategy},
+            }},
+        }]
+    return {
+        "metadata": {"name": name, "namespace": "ns", "uid": uid},
+        "status": {"allocation": {"devices": {"results": [
+            {"request": f"r{i}", "driver": DRIVER, "pool": "node-a",
+             "device": d}
+            for i, d in enumerate(devices)
+        ], "config": cfgs}}},
+    }
+
+
+def attach(state, clock):
+    registry = Registry()
+    acct = UsageAccountant(
+        registry, node_name="node-a",
+        inventory=state.usage_inventory, clock=clock,
+    )
+    state.accountant = acct
+    return acct, registry
+
+
+class TestOccupancy:
+    def test_prepare_unprepare_moves_gauges(self, tmp_path):
+        state = make_state(tmp_path)
+        clock = FakeClock()
+        acct, _ = attach(state, clock)
+
+        state.prepare(make_claim("uid-1", ["tpu-0"]))
+        snap = acct.snapshot()
+        assert snap["occupied"] == {"chip": {"exclusive": 1}}
+        assert snap["occupancyRatio"]["chip"] == pytest.approx(0.25)
+        assert acct._m_occupied.value(type="chip", mode="exclusive") == 1
+        assert acct._m_capacity.value(type="chip") == 4
+
+        state.unprepare("uid-1")
+        snap = acct.snapshot()
+        assert snap["occupied"]["chip"]["exclusive"] == 0
+        assert snap["holds"] == []
+        assert acct._m_occupied.value(type="chip", mode="exclusive") == 0
+
+    def test_sharing_mode_labels(self, tmp_path):
+        state = make_state(tmp_path)
+        acct, _ = attach(state, FakeClock())
+        state.prepare(make_claim("uid-ts", ["tpu-0"], strategy="TimeShared"))
+        state.prepare(make_claim("uid-ex", ["tpu-1"], name="c2"))
+        snap = acct.snapshot()
+        assert snap["occupied"]["chip"] == {
+            "time-shared": 1, "exclusive": 1,
+        }
+
+    def test_idempotent_prepare_books_once(self, tmp_path):
+        state = make_state(tmp_path)
+        acct, _ = attach(state, FakeClock())
+        claim = make_claim("uid-1", ["tpu-0"])
+        state.prepare(claim)
+        state.prepare(claim)  # kubelet retry -> cached path
+        assert len(acct.snapshot()["holds"]) == 1
+        assert acct._m_occupied.value(type="chip", mode="exclusive") == 1
+
+    def test_chip_claims_gauge_counts_core_partitions(self, tmp_path):
+        state = make_state(tmp_path)
+        acct, _ = attach(state, FakeClock())
+        state.prepare(make_claim("uid-core", ["tpu-0-core-0"]))
+        chip_uuid = state.allocatable["tpu-0"].chip.uuid
+        assert acct._m_chip_claims.value(chip=chip_uuid) == 1
+        state.unprepare("uid-core")
+        assert acct._m_chip_claims.value(chip=chip_uuid) == 0
+
+
+class TestAllocatedSeconds:
+    def test_integration_at_scrape_and_release(self, tmp_path):
+        state = make_state(tmp_path)
+        clock = FakeClock()
+        acct, registry = attach(state, clock)
+        state.prepare(make_claim("uid-1", ["tpu-0", "tpu-1"]))
+        clock.advance(10.0)
+        # The render hook brings the counter current mid-hold.
+        registry.render()
+        assert acct._m_alloc_seconds.value(type="chip") == pytest.approx(20.0)
+        clock.advance(5.0)
+        state.unprepare("uid-1")
+        assert acct._m_alloc_seconds.value(type="chip") == pytest.approx(30.0)
+
+    def test_hold_duration_histogram_observed_at_unprepare(self, tmp_path):
+        state = make_state(tmp_path)
+        clock = FakeClock()
+        acct, _ = attach(state, clock)
+        state.prepare(make_claim("uid-1", ["tpu-0"]))
+        clock.advance(120.0)
+        state.unprepare("uid-1")
+        n, total = acct._m_hold_seconds.summary()
+        assert n == 1
+        # prepared_at is real wall clock (stamped inside prepare), the
+        # fake clock started at wall clock too — sub-second skew only.
+        assert total == pytest.approx(120.0, abs=1.0)
+
+
+class TestRebuild:
+    def test_rebuild_survives_restart(self, tmp_path):
+        state = make_state(tmp_path)
+        clock = FakeClock()
+        acct, _ = attach(state, clock)
+        state.prepare(make_claim("uid-1", ["tpu-0"]))
+        prepared_at = acct.snapshot()["holds"][0]["preparedAt"]
+        del state, acct  # the crashed incarnation
+
+        clock.advance(60.0)
+        restarted = make_state(tmp_path)
+        acct2, _ = attach(restarted, clock)
+        acct2.rebuild(restarted.checkpoint.read())
+        snap = acct2.snapshot()
+        assert [h["claimUid"] for h in snap["holds"]] == ["uid-1"]
+        assert snap["occupied"]["chip"]["exclusive"] == 1
+        # Hold duration keeps counting from the CHECKPOINTED prepared_at
+        # across the restart; the (restarted) counter does NOT re-count
+        # pre-crash seconds (an ordinary Prometheus counter reset).
+        assert snap["holds"][0]["preparedAt"] == pytest.approx(prepared_at)
+        assert snap["holds"][0]["heldSeconds"] == pytest.approx(60.0, abs=1.0)
+        assert acct2._m_alloc_seconds.value(type="chip") == pytest.approx(0.0)
+        # Unprepare after rebuild releases cleanly.
+        restarted.unprepare("uid-1")
+        assert acct2.snapshot()["holds"] == []
+
+
+class TestGroupMode:
+    def test_modes(self):
+        assert group_mode({"adminAccess": True}) == "admin"
+        assert group_mode({"kind": "IciChannelConfig"}) == "channel"
+        assert group_mode(
+            {"sharing": {"strategy": "TimeShared"}}
+        ) == "time-shared"
+        assert group_mode(
+            {"sharing": {"strategy": "ProcessShared"}}
+        ) == "process-shared"
+        assert group_mode({}) == "exclusive"
+
+
+class TestSnapshot:
+    def test_snapshot_carries_chip_health(self, tmp_path):
+        lib = FakeChipLib(generation="v5p", topology="2x2x1")
+        state = DeviceState(
+            chiplib=lib,
+            cdi=CDIHandler(str(tmp_path / "cdi")),
+            checkpoint=CheckpointManager(str(tmp_path / "checkpoint.json")),
+            driver_name=DRIVER,
+            pool_name="node-a",
+            state_dir=str(tmp_path / "state"),
+        )
+        acct, _ = attach(state, FakeClock())
+        lib.wedge_chip(0, reason="hbm errors")
+        state.refresh_allocatable()
+        snap = acct.snapshot()
+        uuid0 = state.allocatable["tpu-0"].chip.uuid
+        assert snap["chips"][uuid0]["state"] == "degraded"
+        assert snap["chips"][uuid0]["reason"] == "hbm errors"
+
+    def test_snapshot_is_json_serializable(self, tmp_path):
+        import json
+
+        state = make_state(tmp_path)
+        acct, _ = attach(state, FakeClock())
+        state.prepare(make_claim("uid-1", ["tpu-0"],
+                                 strategy="ProcessShared"))
+        json.dumps(acct.snapshot())

@@ -1,0 +1,260 @@
+"""State-drift auditor: the chaos invariants run as a production pass —
+metrics, the deduped StateDrift Event, and the /readyz input."""
+
+import json
+import time
+
+from k8s_dra_driver_tpu.cdi import CDIHandler
+from k8s_dra_driver_tpu.kube import EVENTS, RESOURCE_CLAIMS, FakeKubeClient
+from k8s_dra_driver_tpu.kube.events import EventRecorder
+from k8s_dra_driver_tpu.plugin.audit import StateAuditor
+from k8s_dra_driver_tpu.plugin.checkpoint import CheckpointManager
+from k8s_dra_driver_tpu.plugin.device_state import DeviceState
+from k8s_dra_driver_tpu.tpulib import FakeChipLib
+from k8s_dra_driver_tpu.utils.metrics import Registry
+
+DRIVER = "tpu.google.com"
+
+
+def make_state(tmp_path, lib=None):
+    lib = lib or FakeChipLib(generation="v5p", topology="2x2x1")
+    return DeviceState(
+        chiplib=lib,
+        cdi=CDIHandler(str(tmp_path / "cdi")),
+        checkpoint=CheckpointManager(str(tmp_path / "checkpoint.json")),
+        driver_name=DRIVER,
+        pool_name="node-a",
+        state_dir=str(tmp_path / "state"),
+    ), lib
+
+
+def make_claim(uid, devices, name="c"):
+    return {
+        "metadata": {"name": name, "namespace": "ns", "uid": uid},
+        "status": {"allocation": {"devices": {"results": [
+            {"request": f"r{i}", "driver": DRIVER, "pool": "node-a",
+             "device": d}
+            for i, d in enumerate(devices)
+        ], "config": []}}},
+    }
+
+
+def make_auditor(state, registry=None, **kw):
+    return StateAuditor(
+        state=state, registry=registry or Registry(),
+        node_name="node-a", node_uid="nu-1", **kw,
+    )
+
+
+class TestChecks:
+    def test_clean_state_is_clean(self, tmp_path):
+        state, _ = make_state(tmp_path)
+        state.prepare(make_claim("uid-1", ["tpu-0"]))
+        auditor = make_auditor(state)
+        assert auditor.run_once() == []
+        assert auditor._m_runs.value(outcome="clean") == 1
+        assert auditor._m_findings.value(check="cdi") == 0
+        ok, detail = auditor.readiness_check()
+        assert ok and "consistent" in detail
+
+    def test_orphan_cdi_spec_flagged(self, tmp_path):
+        state, _ = make_state(tmp_path)
+        state.cdi.create_claim_spec_file("uid-orphan", {}, {})
+        auditor = make_auditor(state)
+        findings = auditor.run_once()
+        assert [(f.check, f.subject) for f in findings] == [
+            ("cdi", "uid-orphan")
+        ]
+        assert auditor._m_findings.value(check="cdi") == 1
+        assert auditor._m_drift_total.value(check="cdi") == 1
+        ok, detail = auditor.readiness_check()
+        assert not ok and "cdi=1" in detail
+        # A repeat pass keeps the gauge but does not re-count the SAME
+        # finding into the cumulative counter.
+        auditor.run_once()
+        assert auditor._m_drift_total.value(check="cdi") == 1
+
+    def test_corrupt_checkpoint_flagged(self, tmp_path):
+        state, _ = make_state(tmp_path)
+        state.prepare(make_claim("uid-1", ["tpu-0"]))
+        path = tmp_path / "checkpoint.json"
+        path.write_text(path.read_text()[:40])
+        auditor = make_auditor(state)
+        findings = auditor.run_once()
+        checks = {f.check for f in findings}
+        assert "checkpoint" in checks
+        assert auditor._m_runs.value(outcome="drift") == 1
+
+    def test_missing_cdi_spec_for_checkpointed_claim(self, tmp_path):
+        state, _ = make_state(tmp_path)
+        state.prepare(make_claim("uid-1", ["tpu-0"]))
+        state.cdi.delete_claim_spec_file("uid-1")
+        findings = make_auditor(state).run_once()
+        assert any(
+            f.check == "cdi" and f.subject == "uid-1"
+            and "missing" in f.detail
+            for f in findings
+        )
+
+    def test_phantom_sharing_hold_flagged(self, tmp_path):
+        state, _ = make_state(tmp_path)
+        uuid0 = state.allocatable["tpu-0"].chip.uuid
+        state.share_state.acquire(uuid0, "uid-ghost", "exclusive")
+        findings = make_auditor(state).run_once()
+        assert any(
+            f.check == "sharing" and "uid-ghost" in f.detail
+            for f in findings
+        )
+
+    def test_health_ordering_violation_flagged(self, tmp_path):
+        """A checkpoint record claiming a prepare AFTER the chip sickened
+        is exactly invariant I4's violation — forge one and the auditor
+        must see it."""
+        state, lib = make_state(tmp_path)
+        state.prepare(make_claim("uid-1", ["tpu-0"]))
+        lib.wedge_chip(0, reason="ecc")
+        state.refresh_allocatable()
+        # Forge: pretend the prepare happened well after the wedge.
+        records = state.checkpoint.read()
+        records["uid-1"]["preparedAt"] = time.time() + 3600
+        state.checkpoint.write(records)
+        findings = make_auditor(state).run_once()
+        assert any(f.check == "health" and f.subject == "uid-1"
+                   for f in findings)
+
+    def test_admin_access_on_sick_chip_is_not_drift(self, tmp_path):
+        """adminAccess prepares are exempt from health gating (draining
+        a sick chip is exactly when a monitoring pod needs on) — the
+        auditor must not flag the sanctioned prepare as drift."""
+        state, lib = make_state(tmp_path)
+        lib.wedge_chip(0, reason="ecc")
+        state.refresh_allocatable()
+        state.prepare({
+            "metadata": {"name": "mon", "namespace": "ns",
+                         "uid": "uid-admin"},
+            "spec": {"devices": {"requests": [
+                {"name": "r0", "deviceClassName": "tpu.google.com",
+                 "adminAccess": True},
+            ]}},
+            "status": {"allocation": {"devices": {"results": [
+                {"request": "r0", "driver": DRIVER, "pool": "node-a",
+                 "device": "tpu-0"},
+            ], "config": []}}},
+        })
+        assert make_auditor(state).run_once() == []
+
+    def test_duplicate_channel_flagged(self, tmp_path):
+        state, _ = make_state(tmp_path)
+        state.prepare(make_claim("uid-1", ["tpu-0"]))
+        records = state.checkpoint.read()
+        # Forge two claims recording the same channel (the invariant-I3
+        # breach a buggy prepare path could write).
+        for uid in ("uid-1", "uid-2"):
+            rec = json.loads(json.dumps(records["uid-1"]))
+            rec["claimUID"] = uid
+            rec["groups"][0]["devices"][0]["channel"] = 7
+            records[uid] = rec
+        state.checkpoint.write(records)
+        findings = make_auditor(state).run_once()
+        assert any(f.check == "channels" for f in findings)
+
+
+class TestSliceDrift:
+    def test_stale_publish_flagged_and_blackout_skipped(self, tmp_path):
+        from k8s_dra_driver_tpu.kube import ApiError, NODES
+        from k8s_dra_driver_tpu.plugin.driver import Driver, DriverConfig
+
+        client = FakeKubeClient()
+        client.create(NODES, {"metadata": {"name": "node-a", "uid": "nu-1"}})
+        lib = FakeChipLib(generation="v5p", topology="2x2x1")
+        config = DriverConfig(
+            node_name="node-a",
+            chiplib=lib,
+            kube_client=client,
+            cdi_root=str(tmp_path / "cdi"),
+            plugin_root=str(tmp_path / "plugin"),
+            registrar_root=str(tmp_path / "registry"),
+            state_root=str(tmp_path / "state"),
+            node_uid="nu-1",
+            cleanup_interval_seconds=0,
+            device_watch_interval_seconds=0,
+            audit_interval_seconds=0,
+        )
+        driver = Driver(config)
+        driver.start()
+        try:
+            assert driver.auditor.run_once() == []
+            # The hardware changes but NO republish runs (watch disabled):
+            # published slices are now stale relative to local truth.
+            lib.unplug_chip(1)
+            driver.state.refresh_allocatable()
+            findings = driver.auditor.run_once()
+            assert any(f.check == "slices" and f.subject == "tpu-1"
+                       for f in findings)
+            # During a blackout the comparison is SKIPPED, not drift.
+            client.fault_injector = lambda verb, gvr, name: ApiError(
+                "blackout", code=503
+            )
+            findings = driver.auditor.run_once()
+            assert not any(f.check == "slices" for f in findings)
+        finally:
+            client.fault_injector = None
+            driver.shutdown()
+
+
+class TestEventAndReadiness:
+    def test_state_drift_event_deduped(self, tmp_path):
+        client = FakeKubeClient()
+        state, _ = make_state(tmp_path)
+        state.cdi.create_claim_spec_file("uid-orphan", {}, {})
+        recorder = EventRecorder(client, component="test")
+        auditor = make_auditor(state, events=recorder)
+        auditor.run_once()
+        auditor.run_once()
+        recorder.flush()
+        events = [e for e in client.list(EVENTS)
+                  if e["reason"] == "StateDrift"]
+        assert len(events) == 1  # aggregated, not spammed
+        assert events[0]["involvedObject"]["name"] == "node-a"
+        assert events[0]["count"] == 2
+        assert "cdi=1" in events[0]["message"]
+
+    def test_driver_wires_auditor_into_degraded_checks(self, tmp_path):
+        from k8s_dra_driver_tpu.kube import NODES
+        from k8s_dra_driver_tpu.plugin.driver import Driver, DriverConfig
+
+        client = FakeKubeClient()
+        client.create(NODES, {"metadata": {"name": "node-a", "uid": "nu-1"}})
+        config = DriverConfig(
+            node_name="node-a",
+            chiplib=FakeChipLib(generation="v5p", topology="2x2x1"),
+            kube_client=client,
+            cdi_root=str(tmp_path / "cdi"),
+            plugin_root=str(tmp_path / "plugin"),
+            registrar_root=str(tmp_path / "registry"),
+            state_root=str(tmp_path / "state"),
+            node_uid="nu-1",
+            cleanup_interval_seconds=0,
+            device_watch_interval_seconds=0,
+            audit_interval_seconds=0,
+        )
+        driver = Driver(config)
+        driver.start()
+        try:
+            checks = driver.degraded_checks()
+            assert "state-consistent" in checks
+            ok, detail = checks["state-consistent"]()
+            assert ok  # no pass yet -> non-blocking
+            claim = make_claim("uid-1", ["tpu-0"])
+            claim["apiVersion"] = "resource.k8s.io/v1beta1"
+            claim["kind"] = "ResourceClaim"
+            claim["spec"] = {"devices": {"requests": [
+                {"name": "r0", "deviceClassName": "tpu.google.com"}
+            ]}}
+            client.create(RESOURCE_CLAIMS, claim, namespace="ns")
+            driver.state.prepare(claim)
+            assert driver.auditor.run_once() == []
+            ok, _ = driver.degraded_checks()["state-consistent"]()
+            assert ok
+        finally:
+            driver.shutdown()

@@ -36,9 +36,11 @@ from k8s_dra_driver_tpu.plugin.device_state import (
     PrepareError,
     UnhealthyDeviceError,
 )
+from k8s_dra_driver_tpu.plugin.audit import StateAuditor
 from k8s_dra_driver_tpu.plugin.driver import Driver, DriverConfig
 from k8s_dra_driver_tpu.tpulib import FakeChipLib
 from k8s_dra_driver_tpu.utils import faults
+from k8s_dra_driver_tpu.utils.metrics import Registry
 
 import time
 
@@ -137,6 +139,16 @@ def prepare_via_rpc(driver, claim):
 def chip_uuid_of(state, device_name):
     dev = state.allocatable[device_name]
     return (dev.chip or dev.tensorcore.parent).uuid
+
+
+def run_audit(state):
+    """One auditor pass (fresh registry: kube-less, local checks only) —
+    the production form of assert_invariants, used here as an ORACLE:
+    schedules assert it reports exactly the drift the fault injected,
+    and nothing when the fault left state consistent."""
+    return StateAuditor(
+        state=state, registry=Registry(), node_name="node-a"
+    ).run_once()
 
 
 def assert_invariants(state):
@@ -331,7 +343,14 @@ class TestCrashRestart:
         restarted, _ = make_state(tmp_path)  # must not raise
         assert restarted.checkpoint.read() == {}
         assert (tmp_path / "checkpoint.json.corrupt").exists()
+        # Oracle: the quarantine emptied the checkpoint, so the surviving
+        # CDI spec + sharing hold of uid-c ARE the drift — and exactly
+        # that is what the auditor must report, until a cleaner pass.
+        found = {(f.check, f.subject) for f in run_audit(restarted)}
+        assert ("cdi", "uid-c") in found
+        assert any(c == "sharing" for c, _ in found)
         assert_invariants_after_clean(restarted)
+        assert run_audit(restarted) == []
 
 
 def assert_invariants_after_clean(state):
@@ -458,6 +477,57 @@ class TestHealthEndToEnd:
                                ("healthy", "gone"), ("gone", "healthy")]
 
 
+class TestAuditorOracle:
+    """Satellite tie-in: after a seeded fault, the auditor must report
+    exactly the drift that fault injected — and stay silent for faults
+    that leave state consistent (precision matters as much as recall:
+    an auditor that cries wolf gets ignored)."""
+
+    def test_crash_artifacts_reported_exactly_then_clean(self, tmp_path):
+        state, lib = make_state(tmp_path)
+        plan = faults.FaultPlan().crash("checkpoint.write")
+        with faults.armed(plan):
+            with pytest.raises(faults.CrashPoint):
+                state.prepare(make_claim("uid-crash", ["tpu-0"]))
+        del state  # the dead incarnation
+
+        restarted, _ = make_state(tmp_path)
+        findings = run_audit(restarted)
+        # Exactly the two artifacts this crash window leaves: the CDI
+        # spec written before the checkpoint, and the sharing hold
+        # acquired before it. Nothing else.
+        assert {(f.check, f.subject) for f in findings} == {
+            ("cdi", "uid-crash"),
+            ("sharing", chip_uuid_of(restarted, "tpu-0")),
+        }
+        OrphanCleaner(restarted, kube_client=None,
+                      interval_seconds=0).clean_once()
+        assert run_audit(restarted) == []
+
+    def test_mid_prepare_unplug_is_not_drift(self, tmp_path):
+        """A chip dying AFTER its prepare completed leaves checkpoint,
+        CDI, sharing, and health timestamps all mutually consistent —
+        the auditor must report nothing."""
+        state, lib = make_state(tmp_path)
+        plan = faults.FaultPlan()
+        plan.call("checkpoint.write", lambda: lib.unplug_chip(1))
+        with faults.armed(plan):
+            state.prepare(make_claim("uid-mid", ["tpu-1"]))
+        state.refresh_allocatable()
+        assert not state.chip_health[
+            chip_uuid_of_gone(state, lib, 1)
+        ].is_healthy()
+        assert run_audit(state) == []
+
+
+def chip_uuid_of_gone(state, lib, index):
+    """UUID of a chip that no longer enumerates (gone chips drop out of
+    state.allocatable, so chip_uuid_of cannot resolve them)."""
+    return next(
+        c.uuid for c in lib._all_chips() if c.index == index
+    )
+
+
 def run_acceptance_schedule(tmp_path, seed):
     """The acceptance schedule: unplug mid-prepare, a 10-simulated-second
     apiserver blackout during republish, and a crash-restart between
@@ -482,6 +552,10 @@ def run_acceptance_schedule(tmp_path, seed):
             lambda: f"tpu-{victim}" not in driver.state.allocatable
         )
         assert_invariants(driver.state)
+        # Oracle: the unplug raced the prepare but produced NO drift —
+        # once the republish converges the auditor must read clean
+        # (driver.auditor includes the published-slices comparison).
+        assert wait_for(lambda: driver.auditor.run_once() == [])
 
         # Phase 2: apiserver blackout ("10 simulated seconds" = the dark
         # window spans ≥2 failed republish attempts plus a degraded-mode
@@ -519,6 +593,9 @@ def run_acceptance_schedule(tmp_path, seed):
                 make_claim("uid-w", [f"tpu-{survivor}"], name="w")
             )
         assert_invariants(driver.state)
+        # Oracle after the blackout: the wedge reached both the local
+        # view and (post-recovery) the published slices; no drift.
+        assert wait_for(lambda: driver.auditor.run_once() == [])
 
         # Phase 3: crash-restart between CDI write and checkpoint write.
         healthy = [i for i in range(4) if i not in (victim, survivor)]
@@ -538,6 +615,20 @@ def run_acceptance_schedule(tmp_path, seed):
         restarted.start()
         try:
             assert restarted.state.checkpoint.read().keys() == {"uid-p1"}
+            # Oracle BEFORE the cleaner: exactly the crash window's two
+            # artifacts (orphan CDI spec + leaked sharing hold), nothing
+            # else. Local checks only — the fresh fake apiserver's slice
+            # publication is still converging.
+            pre = {
+                (f.check, f.subject)
+                for f in run_audit(restarted.state)
+                if f.check != "slices"
+            }
+            assert pre == {
+                ("cdi", "uid-crash"),
+                ("sharing", chip_uuid_of(restarted.state,
+                                         f"tpu-{target}")),
+            }
             OrphanCleaner(restarted.state, kube_client=None,
                           interval_seconds=0).clean_once()
             assert_invariants(restarted.state)
@@ -546,6 +637,9 @@ def run_acceptance_schedule(tmp_path, seed):
                            namespace="default")
             assert prepare_via_rpc(restarted, crash_claim).error == ""
             assert_invariants(restarted.state)
+            # Oracle at schedule end: the full fleet state (slices
+            # included) converges back to consistent.
+            assert wait_for(lambda: restarted.auditor.run_once() == [])
         finally:
             restarted.shutdown()
     finally:

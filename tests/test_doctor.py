@@ -1,0 +1,343 @@
+"""Doctor CLI against the cluster sim: fleet scrape, cross-checks,
+support bundle. This is the acceptance path — occupancy in the report
+must match the sim's prepared claims exactly, and injected
+checkpoint/CDI corruption must be flagged as drift.
+
+The fleet bootstrap (drivers + debug servers + claim seeding) is
+IMPORTED from tools/run_doctor_sim.py, so this suite and the
+`make doctor` gate exercise the identical construction."""
+
+import json
+import os
+import sys
+import tarfile
+import time
+
+import pytest
+
+from k8s_dra_driver_tpu import doctor
+from k8s_dra_driver_tpu.controller.slice_manager import IciSliceManager
+from k8s_dra_driver_tpu.kube import (
+    RESOURCE_CLAIMS,
+    RESOURCE_SLICES,
+    FakeKubeClient,
+)
+
+DRIVER = "tpu.google.com"
+
+
+def _load_sim():
+    tools = os.path.join(os.path.dirname(__file__), "..", "tools")
+    sys.path.insert(0, tools)
+    try:
+        import run_doctor_sim
+    finally:
+        sys.path.pop(0)
+    return run_doctor_sim
+
+
+sim = _load_sim()
+seed_claims = sim.seed_claims
+
+
+def wait_for(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+@pytest.fixture
+def fleet(tmp_path):
+    """Two node plugins with real debug HTTP servers + the controller,
+    against one FakeKubeClient — built by the `make doctor` harness."""
+    client = FakeKubeClient()
+    drivers, servers = {}, {}
+    for h, name in enumerate(["node-a", "node-b"]):
+        drivers[name], servers[name] = sim.start_node(
+            client, str(tmp_path), name, h
+        )
+    mgr = IciSliceManager(client)
+    mgr.start()
+    assert wait_for(lambda: len(client.list(RESOURCE_SLICES)) >= 3)
+    urls = {n: f"http://127.0.0.1:{s.port}" for n, s in servers.items()}
+    yield client, drivers, urls
+    mgr.stop(cleanup=False)
+    for name in drivers:
+        servers[name].stop()
+        drivers[name].shutdown()
+
+
+class TestDoctorCleanFleet:
+    def test_occupancy_matches_prepared_claims_exactly(self, fleet):
+        client, drivers, urls = fleet
+        expected = seed_claims(client, drivers)
+        report, findings, status = doctor.run(urls, kube_client=client)
+        assert status == 0
+        assert not [f for f in findings
+                    if f.severity == doctor.SEVERITY_DRIFT]
+        assert "diagnosis: CLEAN" in report
+        for node, want in expected.items():
+            scrape = doctor.collect_node(node, urls[node])
+            held = {
+                d["name"] for h in scrape.holds
+                for d in h.get("devices", [])
+            }
+            assert held == want
+            occupied = scrape.usage["occupied"]["chip"]
+            assert sum(occupied.values()) == len(want)
+
+    def test_bundle_tar_contains_raw_documents(self, fleet, tmp_path):
+        client, drivers, urls = fleet
+        seed_claims(client, drivers)
+        bundle = str(tmp_path / "bundle.tar")
+        report, _, status = doctor.run(
+            urls, kube_client=client, bundle=bundle
+        )
+        assert status == 0
+        with tarfile.open(bundle) as tar:
+            names = set(tar.getnames())
+            assert {"report.txt", "findings.json",
+                    "cluster/resourceslices.json",
+                    "cluster/resourceclaims.json"} <= names
+            for node in urls:
+                assert f"nodes/{node}/metrics.txt" in names
+                assert f"nodes/{node}/usage.json" in names
+                assert f"nodes/{node}/traces.jsonl" in names
+                assert f"nodes/{node}/readyz.txt" in names
+            usage = json.load(tar.extractfile("nodes/node-a/usage.json"))
+            assert usage["node"] == "node-a"
+            assert len(usage["holds"]) == 1
+            assert tar.extractfile("report.txt").read().decode() == report
+
+
+class TestDoctorDrift:
+    def test_corrupted_checkpoint_and_cdi_flagged(self, fleet):
+        """The acceptance drill: a deliberately corrupted checkpoint/CDI
+        pair must be flagged by the node auditor (metric) AND surface in
+        the doctor's fleet diagnosis."""
+        client, drivers, urls = fleet
+        seed_claims(client, drivers)
+        victim = drivers["node-a"]
+        victim.state.cdi.create_claim_spec_file("uid-orphan", {}, {})
+        path = victim.state.checkpoint.path
+        with open(path) as f:
+            content = f.read()
+        with open(path, "w") as f:
+            f.write(content[: len(content) // 2])
+        node_findings = victim.auditor.run_once()
+        assert {f.check for f in node_findings} >= {"checkpoint", "cdi"}
+
+        report, findings, status = doctor.run(urls, kube_client=client)
+        assert status == 1
+        subjects = {f.subject for f in findings
+                    if f.check == "node-audit"}
+        assert "node-a/checkpoint" in subjects
+        assert "node-a/cdi" in subjects
+        assert "node-b" not in str(subjects)
+        assert "drift" in report
+
+    def test_claim_gone_from_apiserver_is_drift(self, fleet):
+        client, drivers, urls = fleet
+        seed_claims(client, drivers)
+        client.delete(RESOURCE_CLAIMS, "wl-0", namespace="sim")
+        report, findings, status = doctor.run(urls, kube_client=client)
+        assert status == 1
+        assert any(
+            f.check == "claim-gone" and f.subject == "node-a/sim-uid-0"
+            for f in findings
+        )
+
+    def test_claim_prepared_on_wrong_node_is_drift(self, fleet):
+        """A claim allocated to node-a but held by node-b (stale prepare
+        from a superseded placement) must surface BOTH ways: wrong-node
+        drift on node-b, and not-prepared on node-a — a hold on the
+        wrong node must not satisfy the right one."""
+        from k8s_dra_driver_tpu.kube.allocator import ReferenceAllocator
+
+        client, drivers, urls = fleet
+        alloc = ReferenceAllocator(client)
+        claim = sim.claim_obj("uid-wrong", "misplaced")
+        alloc.allocate(claim, node_name="node-a")
+        client.create(RESOURCE_CLAIMS, claim, namespace="sim")
+        # Device names are node-local ("tpu-N" on every host), so the
+        # wrong node happily prepares the same-named device.
+        sim.prepare(drivers["node-b"], claim)
+        for d in drivers.values():
+            d.auditor.run_once()
+        report, findings, status = doctor.run(urls, kube_client=client)
+        assert status == 1
+        assert any(
+            f.check == "wrong-node" and f.subject == "node-b/uid-wrong"
+            for f in findings
+        )
+        assert any(
+            f.check == "not-prepared" and f.subject == "node-a/uid-wrong"
+            for f in findings
+        )
+
+    def test_metrics_error_body_is_collection_error(self, fleet):
+        """A proxy-style error page on /metrics must read as a collection
+        failure, not be silently parsed as an empty scrape."""
+        import threading
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        class ErrorPage(BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = b"<html>upstream connect error</html>"
+                self.send_response(503)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        srv = HTTPServer(("127.0.0.1", 0), ErrorPage)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            client, drivers, urls = fleet
+            urls = dict(urls)
+            urls["node-proxy"] = f"http://127.0.0.1:{srv.server_port}"
+            report, findings, status = doctor.run(
+                urls, kube_client=client, timeout=2.0
+            )
+            assert status == 2
+            errs = [f for f in findings
+                    if f.severity == doctor.SEVERITY_ERROR
+                    and f.subject == "node-proxy"]
+            assert any("/metrics" in f.detail for f in errs)
+        finally:
+            srv.shutdown()
+
+    def test_unreachable_node_is_collection_error(self, fleet):
+        client, drivers, urls = fleet
+        urls = dict(urls)
+        urls["node-gone"] = "http://127.0.0.1:1"  # nothing listens here
+        report, findings, status = doctor.run(
+            urls, kube_client=client, timeout=0.5
+        )
+        assert status == 2
+        assert any(f.severity == doctor.SEVERITY_ERROR
+                   and f.subject == "node-gone" for f in findings)
+
+
+class TestNodeNameMismatch:
+    def test_nickname_is_collection_error_not_false_drift(self, fleet):
+        """--node labels are operator-supplied nicknames; placement
+        checks must key on the name the plugin reports about itself, and
+        the mismatch must surface as a collection error — never as a
+        false wrong-node drift finding."""
+        client, drivers, urls = fleet
+        seed_claims(client, drivers)
+        nicknamed = {
+            "a-nickname": urls["node-a"], "node-b": urls["node-b"],
+        }
+        report, findings, status = doctor.run(
+            nicknamed, kube_client=client
+        )
+        assert not any(f.check == "wrong-node" for f in findings)
+        assert not any(f.check == "not-prepared" for f in findings)
+        errs = [f for f in findings
+                if f.severity == doctor.SEVERITY_ERROR
+                and f.subject == "a-nickname"]
+        assert any("--node mapping" in f.detail for f in errs)
+        assert status == 2
+
+
+class TestIciClassification:
+    def test_node_pool_named_ici_is_not_a_channel(self):
+        """Node pools are named after operator-controlled node names; a
+        node called 'ici-rack1-host0' must not have its chip allocations
+        counted as ICI channels (classification keys on the
+        driver-controlled device name)."""
+        cluster = {
+            "resourceSlices": [],
+            "resourceClaims": [{
+                "metadata": {"uid": "u1", "namespace": "ns", "name": "w"},
+                "status": {"allocation": {"devices": {"results": [
+                    {"driver": DRIVER, "pool": "ici-rack1-host0",
+                     "device": "tpu-0"},
+                    {"driver": DRIVER, "pool": "ici-slice0-abc123",
+                     "device": "ici-channel-5"},
+                ]}}},
+            }],
+        }
+        published, allocated = doctor.ici_occupancy(cluster, DRIVER)
+        assert allocated == 1
+
+
+class TestUsageScrapeFailure:
+    def test_failed_usage_scrape_is_not_read_as_no_holds(self):
+        """A node whose /debug/usage fetch failed has an UNKNOWN hold
+        set; misreading it as empty would emit a not-prepared finding
+        for every claim genuinely prepared there. Only the collect
+        error may surface."""
+        scrape = doctor.NodeScrape(name="node-a", url="http://x")
+        scrape.errors.append("/debug/usage: boom")
+        cluster = {
+            "resourceSlices": [],
+            "resourceClaims": [{
+                "metadata": {
+                    "uid": "uid-1", "namespace": "ns", "name": "wl",
+                },
+                "status": {"allocation": {"devices": {"results": [{
+                    "driver": DRIVER, "pool": "node-a", "device": "tpu-0",
+                }]}}},
+            }],
+        }
+        findings = doctor.fleet_findings([scrape], cluster, DRIVER)
+        assert not any(f.check == "not-prepared" for f in findings)
+        assert any(f.check == "collect" and f.subject == "node-a"
+                   for f in findings)
+
+
+class TestRenderDefensive:
+    def test_malformed_hold_degrades_report_not_run(self):
+        """A version-skewed plugin's snapshot missing device fields must
+        not abort the run (the bundle is the point of the tool)."""
+        scrape = doctor.NodeScrape(name="n1", url="http://x")
+        scrape.usage = {
+            "node": "n1", "capacity": {"chip": 4},
+            "occupied": {}, "holds": [{
+                "claimUid": "uid-1",
+                "devices": [{"type": "chip"}],  # no name, no mode
+                "heldSeconds": "not-a-number",
+            }],
+        }
+        report = doctor.render_report([scrape], None, [], DRIVER)
+        assert "? [?]" in report
+        assert "held ?s" in report
+
+
+class TestMetricsParser:
+    def test_parse_and_lookup(self):
+        text = (
+            '# HELP x y\n# TYPE tpu_dra_audit_findings gauge\n'
+            'tpu_dra_audit_findings{check="cdi"} 2\n'
+            'tpu_dra_audit_findings{check="slices"} 0\n'
+            'tpu_dra_up 1\n'
+            'escaped{label="a\\"b"} 3\n'
+        )
+        parsed = doctor.parse_metrics(text)
+        assert doctor.metric_value(
+            parsed, "tpu_dra_audit_findings", check="cdi"
+        ) == 2
+        assert doctor.metric_value(
+            parsed, "tpu_dra_audit_findings", check="slices"
+        ) == 0
+        assert doctor.metric_value(parsed, "tpu_dra_up") == 1
+        assert doctor.metric_value(parsed, "escaped", label='a"b') == 3
+        assert doctor.metric_value(parsed, "missing") is None
+
+    def test_label_unescape_is_single_pass(self):
+        """A literal backslash before 'n' wire-escapes as \\\\n; a
+        sequential-replace decoder would read the tail of the escaped
+        backslash plus the n as a newline."""
+        text = 'm{path="C:\\\\new",msg="a\\nb"} 1\n'
+        parsed = doctor.parse_metrics(text)
+        assert doctor.metric_value(
+            parsed, "m", path="C:\\new", msg="a\nb"
+        ) == 1

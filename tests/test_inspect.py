@@ -117,3 +117,84 @@ class TestInspector:
         assert out["preparedClaims"] == []
         assert out["sharingState"] == []
         assert "prepared claims: 0" in render(out)
+
+
+class TestHealthAndLiveFields:
+    """The PR 2 fields the inspector never learned: chip health
+    status/since, the degraded-mode flag, and queued slice republishes."""
+
+    def test_inventory_carries_chip_health(self, tmp_path):
+        lib = FakeChipLib(generation="v5p", topology="2x2x1")
+        lib.wedge_chip(0, reason="hbm uncorrectable errors")
+        lib.unplug_chip(1, reason="pcie link down")
+        out = collect(str(tmp_path), str(tmp_path / "cdi"), chiplib=lib)
+        by_name = {c["name"]: c for c in out["inventory"]}
+        assert by_name["tpu-0"]["health"] == "degraded"
+        assert by_name["tpu-0"]["healthReason"] == "hbm uncorrectable errors"
+        assert by_name["tpu-0"]["healthSince"] > 0
+        assert "tpu-1" not in by_name  # gone chips don't enumerate...
+        unhealthy = {u["uuid"]: u for u in out["unhealthyChips"]}
+        gone = [u for u in unhealthy.values() if u["state"] == "gone"]
+        assert len(gone) == 1  # ...but their health record is reported
+        assert gone[0]["reason"] == "pcie link down"
+
+        text = render(out)
+        assert "[DEGRADED since" in text
+        assert "hbm uncorrectable errors" in text
+        assert "unhealthy chips: 2" in text
+        assert "pcie link down" in text
+
+    def test_live_degraded_and_queued_republish(self, tmp_path):
+        """collect(--http-url) reads the degraded flag and the queued-
+        republish signal from a live plugin's /readyz."""
+        from k8s_dra_driver_tpu.utils.metrics import MetricsServer, Registry
+
+        srv = MetricsServer(Registry(), host="127.0.0.1", port=0)
+        srv.add_readiness_check("grpc-serving", lambda: (True, "ok"))
+        srv.add_readiness_check(
+            "apiserver-reachable",
+            lambda: (False, "slice republish failing: 503 blackout"),
+            critical=False,
+        )
+        srv.start()
+        try:
+            out = collect(
+                str(tmp_path), str(tmp_path / "cdi"),
+                http_url=f"http://127.0.0.1:{srv.port}",
+            )
+            live = out["live"]
+            assert live["mode"] == "degraded"
+            assert live["degraded"] is True
+            assert live["queuedSliceRepublish"] is True
+            assert "republish failing" in live["queuedSliceRepublishDetail"]
+            text = render(out)
+            assert "DEGRADED MODE" in text
+            assert "QUEUED behind backoff" in text
+        finally:
+            srv.stop()
+
+    def test_live_ready_plugin_not_degraded(self, tmp_path):
+        from k8s_dra_driver_tpu.utils.metrics import MetricsServer, Registry
+
+        srv = MetricsServer(Registry(), host="127.0.0.1", port=0)
+        srv.add_readiness_check("grpc-serving", lambda: (True, "ok"))
+        srv.start()
+        try:
+            out = collect(
+                str(tmp_path), str(tmp_path / "cdi"),
+                http_url=f"http://127.0.0.1:{srv.port}",
+            )
+            assert out["live"]["mode"] == "ready"
+            assert out["live"]["degraded"] is False
+            assert out["live"]["queuedSliceRepublish"] is False
+            assert "live plugin: ready" in render(out)
+        finally:
+            srv.stop()
+
+    def test_live_unreachable_reported_in_band(self, tmp_path):
+        out = collect(
+            str(tmp_path), str(tmp_path / "cdi"),
+            http_url="http://127.0.0.1:1",
+        )
+        assert "error" in out["live"]
+        assert "UNREACHABLE" in render(out)

@@ -2,6 +2,7 @@
 
 import math
 import sys
+import time
 import urllib.error
 import urllib.request
 
@@ -326,5 +327,110 @@ class TestServer:
             prof = urllib.request.urlopen(
                 f"{base}/debug/profile?seconds=-5").read().decode()
             assert "samples at" in prof
+        finally:
+            srv.stop()
+
+
+class TestMethodGuardAndUsage:
+    """The shared-handler satellite: GET-only contract, /debug/usage,
+    and concurrent scrapes through one ThreadingHTTPServer."""
+
+    def test_non_get_methods_rejected_405(self):
+        srv = MetricsServer(Registry(), host="127.0.0.1", port=0)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            for method, path in (
+                ("POST", "/metrics"), ("PUT", "/readyz"),
+                ("DELETE", "/debug/usage"), ("PATCH", "/healthz"),
+            ):
+                req = urllib.request.Request(
+                    base + path, method=method, data=b"x",
+                )
+                with pytest.raises(urllib.error.HTTPError) as exc_info:
+                    urllib.request.urlopen(req)
+                assert exc_info.value.code == 405, method
+                assert exc_info.value.headers.get("Allow") == "GET, HEAD"
+            # HEAD is a read: same status + headers as GET, no body
+            # (HEAD-probing health checkers must keep working).
+            head = urllib.request.urlopen(urllib.request.Request(
+                f"{base}/healthz", method="HEAD",
+            ))
+            assert head.status == 200
+            assert head.headers.get("Content-Length") == "2"  # b"ok"
+            assert head.read() == b""
+            # ...but a HEAD probe must not pin a handler thread on
+            # seconds of stack sampling just to discard the body.
+            start = time.monotonic()
+            head = urllib.request.urlopen(urllib.request.Request(
+                f"{base}/debug/profile?seconds=30", method="HEAD",
+            ))
+            assert head.status == 200
+            assert time.monotonic() - start < 5.0
+            # GET keeps working after the rejections.
+            assert urllib.request.urlopen(f"{base}/healthz").status == 200
+        finally:
+            srv.stop()
+
+    def test_debug_usage_serves_provider_json(self):
+        import json
+
+        srv = MetricsServer(Registry(), host="127.0.0.1", port=0)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            # No provider -> 404, like /debug/traces without a tracer.
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(f"{base}/debug/usage")
+            assert exc_info.value.code == 404
+            srv.set_usage_provider(lambda: {"node": "n1", "holds": []})
+            resp = urllib.request.urlopen(f"{base}/debug/usage")
+            assert resp.headers.get("Content-Type") == "application/json"
+            assert json.loads(resp.read()) == {"node": "n1", "holds": []}
+            # A raising provider must not kill the handler thread.
+            def boom():
+                raise RuntimeError("snapshot exploded")
+
+            srv.set_usage_provider(boom)
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(f"{base}/debug/usage")
+            assert exc_info.value.code == 500
+        finally:
+            srv.stop()
+
+    def test_concurrent_scrapes(self):
+        """/metrics and /debug/usage hammered concurrently: every
+        response complete and parseable (the render hook + provider run
+        on handler threads; a lock bug would corrupt or deadlock)."""
+        import json
+        from concurrent.futures import ThreadPoolExecutor
+
+        r = Registry()
+        c = Counter("tpu_dra_test_scrapes_total", "Scrapes", r)
+        r.add_render_hook(lambda: c.inc(hooked="yes"))
+        srv = MetricsServer(r, host="127.0.0.1", port=0)
+        srv.set_usage_provider(lambda: {"holds": [], "node": "n1"})
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+
+            def scrape(i):
+                if i % 2:
+                    body = urllib.request.urlopen(
+                        f"{base}/metrics").read().decode()
+                    assert "tpu_dra_test_scrapes_total" in body
+                    assert body.endswith("\n")
+                    return "metrics"
+                body = urllib.request.urlopen(
+                    f"{base}/debug/usage").read().decode()
+                assert json.loads(body)["node"] == "n1"
+                return "usage"
+
+            with ThreadPoolExecutor(max_workers=8) as pool:
+                results = list(pool.map(scrape, range(40)))
+            assert results.count("metrics") == 20
+            assert results.count("usage") == 20
+            # The render hook ran once per /metrics scrape.
+            assert c.value(hooked="yes") == 20
         finally:
             srv.stop()

@@ -23,6 +23,17 @@ Plus one first-party rule with no ruff analog:
   ``_seconds``/``_bytes`` for histograms), and have non-empty help text —
   the naming contract docs/observability.md documents and
   ``make verify-metrics`` scrapes for.
+- TPM04: per-chip labels (``chip=``/``uuid=``/``device=`` keywords on
+  ``.inc()``/``.set()``/``.observe()``) are confined to
+  ``plugin/accounting.py`` and ``plugin/audit.py`` — the modules whose
+  series counts are provably bounded by the node's device inventory.
+  Anywhere else a per-chip label is a cardinality leak waiting for a
+  large fleet (``make verify-metrics`` additionally bounds the rendered
+  series count of such families).
+- TPM05: ``plugin/accounting.py`` may only declare ``tpu_dra_usage_*``
+  metrics and ``plugin/audit.py`` only ``tpu_dra_audit_*`` — each
+  family's home module stays coherent, so the docs catalog and the
+  verify-metrics coverage can reason per-module.
 
 Exit status 1 when any finding is emitted, so `make lint` is a gate,
 not a suggestion.
@@ -178,6 +189,17 @@ _METRIC_PREFIX = "tpu_dra_"
 # _total is a counter-only suffix (it would collide with histogram series
 # naming), so histograms get the unit suffixes without it.
 _HISTOGRAM_UNIT_SUFFIXES = ("_seconds", "_bytes", "_celsius", "_ratio")
+# TPM04: label names whose values scale with the device inventory, and
+# the only modules allowed to emit them (their series counts are bounded
+# by the node's chip count by construction).
+_PER_CHIP_LABELS = {"chip", "uuid", "device"}
+_PER_CHIP_LABEL_MODULES = {"accounting.py", "audit.py"}
+# TPM05: module-owned family prefixes.
+_MODULE_FAMILY_PREFIXES = {
+    "accounting.py": "tpu_dra_usage_",
+    "audit.py": "tpu_dra_audit_",
+}
+_METRIC_METHODS = {"inc", "set", "observe"}
 
 
 def check_metric_conventions(tree: ast.Module, path: Path) -> list[Finding]:
@@ -222,6 +244,35 @@ def check_metric_conventions(tree: ast.Module, path: Path) -> list[Finding]:
             out.append(Finding(
                 path, node.lineno, "TPM03",
                 f"{cls} {name!r} has empty help text"))
+        owned_prefix = _MODULE_FAMILY_PREFIXES.get(path.name)
+        if owned_prefix and not name.startswith(owned_prefix):
+            out.append(Finding(
+                path, node.lineno, "TPM05",
+                f"{cls} name {name!r} declared in {path.name} must use "
+                f"the {owned_prefix!r} family prefix"))
+    return out
+
+
+def check_per_chip_labels(tree: ast.Module, path: Path) -> list[Finding]:
+    """TPM04: per-chip metric labels only where series counts are bounded
+    by the node's device inventory (accounting.py / audit.py)."""
+    if path.name in _PER_CHIP_LABEL_MODULES:
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in _METRIC_METHODS):
+            continue
+        for kw in node.keywords:
+            if kw.arg in _PER_CHIP_LABELS:
+                out.append(Finding(
+                    path, node.lineno, "TPM04",
+                    f"per-chip label {kw.arg!r} on .{func.attr}() outside "
+                    f"{sorted(_PER_CHIP_LABEL_MODULES)} — unbounded label "
+                    "cardinality"))
     return out
 
 
@@ -243,6 +294,7 @@ def lint_file(path: Path) -> list[Finding]:
     # deliberately-odd names to exercise the renderer.
     if "k8s_dra_driver_tpu" in path.parts:
         out += check_metric_conventions(tree, path)
+        out += check_per_chip_labels(tree, path)
     return out
 
 

@@ -1,0 +1,214 @@
+#!/usr/bin/env python3
+"""``make doctor`` gate: the doctor CLI against the cluster sim.
+
+Builds the same hermetic cluster the e2e tests use — FakeKubeClient, two
+node plugins with real debug HTTP servers, the ICI slice controller —
+prepares claims through the real DRA surface, then drives
+``k8s_dra_driver_tpu.doctor`` twice:
+
+1. **clean phase**: the fleet is consistent; the doctor must report zero
+   drift (exit 0) and its per-node occupancy must match the sim's
+   prepared claims exactly;
+2. **drift phase**: an orphaned CDI claim spec and a corrupted
+   checkpoint are injected (the exact artifacts the chaos harness
+   produces); the node auditors and the doctor must BOTH flag them
+   (doctor exit 1).
+
+Either phase misbehaving fails the gate — a doctor that cries wolf on a
+clean fleet is as useless as one that misses real drift.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+
+sys.path.insert(0, ".")
+
+from k8s_dra_driver_tpu import doctor  # noqa: E402
+from k8s_dra_driver_tpu.controller.slice_manager import (  # noqa: E402
+    SLICE_LABEL,
+    IciSliceManager,
+)
+from k8s_dra_driver_tpu.kube import (  # noqa: E402
+    NODES,
+    RESOURCE_CLAIMS,
+    FakeKubeClient,
+)
+from k8s_dra_driver_tpu.kube.allocator import ReferenceAllocator  # noqa: E402
+from k8s_dra_driver_tpu.kube.protos import dra_v1alpha4_pb2 as drapb  # noqa: E402
+from k8s_dra_driver_tpu.plugin.driver import Driver, DriverConfig  # noqa: E402
+from k8s_dra_driver_tpu.tpulib import FakeChipLib  # noqa: E402
+from k8s_dra_driver_tpu.utils.metrics import MetricsServer  # noqa: E402
+
+DRIVER = "tpu.google.com"
+
+
+# The fleet-construction helpers below (start_node / prepare / claim_obj
+# / seed_claims) are the single source of truth for "a doctor-ready sim
+# fleet": tests/test_doctor.py imports them, so the pytest suite and the
+# `make doctor` gate can never drift apart in what they build.
+
+
+def start_node(client, tmp, name, host_id):
+    client.create(NODES, {"metadata": {
+        "name": name, "uid": f"uid-{name}",
+        "labels": {SLICE_LABEL: "slice-1"},
+    }})
+    cfg = DriverConfig(
+        node_name=name,
+        chiplib=FakeChipLib(
+            generation="v5p", topology="4x2x1", host_id=host_id,
+            hosts_per_slice=2, slice_id="slice-1",
+        ),
+        kube_client=client,
+        cdi_root=f"{tmp}/{name}/cdi",
+        plugin_root=f"{tmp}/{name}/plugin",
+        registrar_root=f"{tmp}/{name}/reg",
+        state_root=f"{tmp}/{name}/state",
+        node_uid=f"uid-{name}",
+        cleanup_interval_seconds=0,
+        device_watch_interval_seconds=0,
+        audit_interval_seconds=0,  # passes are driven explicitly below
+    )
+    d = Driver(cfg)
+    d.start()
+    srv = MetricsServer(d.registry, host="127.0.0.1", port=0,
+                        tracer=d.tracer)
+    for check_name, check in d.readiness_checks().items():
+        srv.add_readiness_check(check_name, check)
+    for check_name, check in d.degraded_checks().items():
+        srv.add_readiness_check(check_name, check, critical=False)
+    srv.set_usage_provider(d.usage.snapshot)
+    srv.start()
+    return d, srv
+
+
+def prepare(driver, claim):
+    req = drapb.NodePrepareResourcesRequest(claims=[drapb.Claim(
+        uid=claim["metadata"]["uid"],
+        name=claim["metadata"]["name"],
+        namespace=claim["metadata"]["namespace"],
+    )])
+    resp = driver.NodePrepareResources(req, None)
+    result = resp.claims[claim["metadata"]["uid"]]
+    if result.error:
+        raise SystemExit(f"sim prepare failed: {result.error}")
+
+
+def claim_obj(uid, name):
+    return {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {"name": name, "namespace": "sim", "uid": uid},
+        "spec": {"devices": {"requests": [
+            {"name": "chip", "deviceClassName": "tpu.google.com"},
+        ]}},
+    }
+
+
+def seed_claims(client, drivers):
+    """One allocated + prepared single-chip claim per node, auditors
+    brought current; returns {node: expected held device names}."""
+    alloc = ReferenceAllocator(client)
+    expected = {}
+    for i, node in enumerate(sorted(drivers)):
+        claim = claim_obj(f"sim-uid-{i}", f"wl-{i}")
+        alloc.allocate(claim, node_name=node)
+        client.create(RESOURCE_CLAIMS, claim, namespace="sim")
+        prepare(drivers[node], claim)
+        expected[node] = {
+            r["device"]
+            for r in claim["status"]["allocation"]["devices"]["results"]
+        }
+    for d in drivers.values():
+        d.auditor.run_once()
+    return expected
+
+
+def main() -> int:
+    failures: list[str] = []
+    with tempfile.TemporaryDirectory(prefix="tpu-dra-doctor-sim-") as tmp:
+        client = FakeKubeClient()
+        drivers, servers = {}, {}
+        for i, name in enumerate(["node-a", "node-b"]):
+            drivers[name], servers[name] = start_node(client, tmp, name, i)
+        mgr = IciSliceManager(client)
+        mgr.start()
+        try:
+            expected_holds = seed_claims(client, drivers)
+
+            urls = {
+                name: f"http://127.0.0.1:{srv.port}"
+                for name, srv in servers.items()
+            }
+
+            # Phase 1: a consistent fleet must diagnose CLEAN, with
+            # occupancy matching the prepared claims exactly.
+            bundle = f"{tmp}/bundle.tar"
+            report, findings, status = doctor.run(
+                urls, kube_client=client, bundle=bundle,
+            )
+            print(report)
+            drift = [f for f in findings
+                     if f.severity == doctor.SEVERITY_DRIFT]
+            if status != 0 or drift:
+                failures.append(
+                    f"clean phase: expected no drift, got status={status} "
+                    f"findings={[str(f) for f in findings]}"
+                )
+            for name, want in expected_holds.items():
+                scrape = doctor.collect_node(name, urls[name])
+                got = {
+                    d["name"] for h in scrape.holds
+                    for d in h.get("devices", [])
+                }
+                if got != want:
+                    failures.append(
+                        f"{name}: /debug/usage holds {sorted(got)} != "
+                        f"prepared {sorted(want)}"
+                    )
+
+            # Phase 2: inject the chaos-harness crash artifacts; both the
+            # node auditor and the doctor must flag them.
+            victim = drivers["node-a"]
+            victim.state.cdi.create_claim_spec_file("uid-orphan", {}, {})
+            ckpt_path = victim.state.checkpoint.path
+            with open(ckpt_path) as f:
+                torn = f.read()
+            with open(ckpt_path, "w") as f:
+                f.write(torn[: len(torn) // 2])
+            node_findings = victim.auditor.run_once()
+            if not any(f.check == "cdi" for f in node_findings):
+                failures.append("auditor missed the orphaned CDI spec")
+            if not any(f.check == "checkpoint" for f in node_findings):
+                failures.append("auditor missed the corrupt checkpoint")
+            report2, findings2, status2 = doctor.run(
+                urls, kube_client=client,
+            )
+            if status2 != 1 or not any(
+                f.check == "node-audit" for f in findings2
+            ):
+                failures.append(
+                    f"drift phase: doctor did not flag the injected "
+                    f"drift (status={status2}, findings="
+                    f"{[str(f) for f in findings2]})"
+                )
+        finally:
+            mgr.stop(cleanup=False)
+            for name in drivers:
+                servers[name].stop()
+                drivers[name].shutdown()
+    if failures:
+        print(json.dumps(failures, indent=2), file=sys.stderr)
+        print(f"doctor sim gate: {len(failures)} failure(s)",
+              file=sys.stderr)
+        return 1
+    print("doctor sim gate: clean fleet diagnosed clean, injected drift "
+          "caught", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

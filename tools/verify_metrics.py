@@ -89,11 +89,48 @@ def validate_exposition(text: str) -> list[str]:
     return errors
 
 
+_PER_CHIP_LABELS = ("chip=", "uuid=", "device=")
+
+
+def check_cardinality(
+    text: str, max_series: int = 500, max_chip_series: int = 64
+) -> list[str]:
+    """Series-count bounds per metric family. Families carrying per-chip
+    labels (chip/uuid/device — allowed only in accounting.py/audit.py,
+    lint TPM04) get the tighter bound: a node has at most a handful of
+    chips, so more series than that means a label is leaking identifiers
+    (claim UIDs, timestamps) into what must stay a bounded dimension."""
+    series: dict[str, int] = {}
+    chip_labeled: set[str] = set()
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name = line.split("{", 1)[0].split(" ", 1)[0]
+        series[name] = series.get(name, 0) + 1
+        if "{" in line and any(
+            f"{lbl}" in line.split("{", 1)[1] for lbl in _PER_CHIP_LABELS
+        ):
+            chip_labeled.add(name)
+    errors = []
+    for name, count in sorted(series.items()):
+        if name in chip_labeled and count > max_chip_series:
+            errors.append(
+                f"family {name} renders {count} per-chip series "
+                f"(bound {max_chip_series}): label cardinality leak"
+            )
+        elif count > max_series:
+            errors.append(
+                f"family {name} renders {count} series (bound {max_series})"
+            )
+    return errors
+
+
 def _self_test_scrape() -> tuple[str, list[str]]:
     """Start a debug server over a worst-case registry; return the scraped
     body and any HTTP-surface errors."""
     import json
     import math
+    import urllib.error
     import urllib.request
 
     from k8s_dra_driver_tpu.utils.metrics import (
@@ -120,6 +157,56 @@ def _self_test_scrape() -> tuple[str, list[str]]:
     renamed.inc()
     registry.alias("tpu_dra_verify_old_total", renamed)
 
+    # The usage + audit families (this driver's utilization accounting
+    # and state-drift auditing), populated through the REAL code paths so
+    # the rendered exposition — per-chip labels included — is what a
+    # production scrape sees.
+    import tempfile
+
+    from k8s_dra_driver_tpu.cdi import CDIHandler
+    from k8s_dra_driver_tpu.plugin.accounting import UsageAccountant
+    from k8s_dra_driver_tpu.plugin.audit import StateAuditor
+    from k8s_dra_driver_tpu.plugin.checkpoint import CheckpointManager
+    from k8s_dra_driver_tpu.plugin.device_state import DeviceState
+    from k8s_dra_driver_tpu.tpulib import FakeChipLib
+
+    usage = UsageAccountant(
+        registry,
+        node_name="verify",
+        inventory=lambda: {
+            "capacity": {"chip": 2, "tensorcore": 4},
+            "chips": {"TPU-verify": {
+                "state": "healthy", "since": 0.0, "reason": "",
+            }},
+        },
+    )
+    with tempfile.TemporaryDirectory(prefix="verify-metrics-") as tmp:
+        state = DeviceState(
+            chiplib=FakeChipLib(generation="v5p", topology="2x1x1"),
+            cdi=CDIHandler(f"{tmp}/cdi"),
+            checkpoint=CheckpointManager(f"{tmp}/checkpoint.json"),
+            driver_name="tpu.google.com",
+            pool_name="verify",
+            state_dir=f"{tmp}/state",
+        )
+        state.accountant = usage
+        state.prepare({
+            "metadata": {"name": "v", "namespace": "verify",
+                         "uid": "uid-usage"},
+            "status": {"allocation": {"devices": {"results": [{
+                "request": "r", "driver": "tpu.google.com",
+                "pool": "verify", "device": "tpu-0",
+            }], "config": []}}},
+        })
+        auditor = StateAuditor(state=state, registry=registry)
+        # One guaranteed drift sample, so the audit gauges render both
+        # zero and non-zero series.
+        state.cdi.create_claim_spec_file("uid-orphan", {}, {})
+        auditor.run_once()
+        snapshot = usage.snapshot()
+    if not snapshot.get("holds"):
+        return "", ["usage snapshot lost the prepared hold"]
+
     tracer = Tracer()
     with tracer.span("verify", claim_uid="uid-verify"):
         pass
@@ -127,11 +214,13 @@ def _self_test_scrape() -> tuple[str, list[str]]:
     errors: list[str] = []
     srv = MetricsServer(registry, host="127.0.0.1", port=0, tracer=tracer)
     srv.add_readiness_check("self-test", lambda: (True, "ok"))
+    srv.set_usage_provider(lambda: snapshot)
     srv.start()
     try:
         base = f"http://127.0.0.1:{srv.port}"
         body = urllib.request.urlopen(f"{base}/metrics").read().decode()
-        for route in ("/healthz", "/readyz", "/debug/traces"):
+        for route in ("/healthz", "/readyz", "/debug/traces",
+                      "/debug/usage"):
             resp = urllib.request.urlopen(base + route)
             if resp.status != 200:
                 errors.append(f"{route}: HTTP {resp.status}")
@@ -141,8 +230,32 @@ def _self_test_scrape() -> tuple[str, list[str]]:
                 json.loads(line)
             except ValueError:
                 errors.append(f"/debug/traces: undecodable line {line!r}")
+        usage_body = urllib.request.urlopen(
+            f"{base}/debug/usage"
+        ).read().decode()
+        try:
+            decoded = json.loads(usage_body)
+            if decoded.get("node") != "verify":
+                errors.append("/debug/usage: wrong snapshot served")
+        except ValueError:
+            errors.append("/debug/usage: body is not JSON")
+        # The scrape surface is GET-only by contract.
+        try:
+            urllib.request.urlopen(f"{base}/metrics", data=b"x")
+            errors.append("/metrics accepted a POST (want 405)")
+        except urllib.error.HTTPError as e:
+            if e.code != 405:
+                errors.append(f"/metrics POST: HTTP {e.code} (want 405)")
     finally:
         srv.stop()
+    for family in ("tpu_dra_usage_allocated_device_seconds_total",
+                   "tpu_dra_usage_occupied_devices",
+                   "tpu_dra_usage_claim_hold_seconds",
+                   "tpu_dra_usage_chip_claims",
+                   "tpu_dra_audit_findings",
+                   "tpu_dra_audit_runs_total"):
+        if f"\n{family}" not in body and not body.startswith(family):
+            errors.append(f"expected family {family} missing from scrape")
     return body, errors
 
 
@@ -151,6 +264,15 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--url", default="",
         help="scrape this /metrics URL instead of self-hosting a server",
+    )
+    parser.add_argument(
+        "--max-series-per-family", type=int, default=500,
+        help="series-count bound per metric family",
+    )
+    parser.add_argument(
+        "--max-chip-series", type=int, default=64,
+        help="tighter series bound for families carrying per-chip labels "
+             "(chip/uuid/device)",
     )
     args = parser.parse_args(argv)
     if args.url:
@@ -162,6 +284,9 @@ def main(argv: list[str] | None = None) -> int:
         sys.path.insert(0, ".")
         body, errors = _self_test_scrape()
     errors += validate_exposition(body)
+    errors += check_cardinality(
+        body, args.max_series_per_family, args.max_chip_series
+    )
     for err in errors:
         print(err, file=sys.stderr)
     n_samples = sum(
