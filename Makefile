@@ -27,9 +27,12 @@ chaos-slow:
 		python -m pytest tests/test_chaos.py -q
 
 # Doctor gate: the support-bundle CLI against the cluster sim. A clean
-# fleet must diagnose CLEAN (any drift finding fails the target), and
+# fleet must diagnose CLEAN (any drift finding fails the target),
 # injected crash artifacts (orphan CDI spec + torn checkpoint) must be
-# flagged by both the node auditor and the doctor.
+# flagged by both the node auditor and the doctor, and an unallocatable
+# claim must travel the explainability chain (typed AllocationError →
+# /debug/allocations → the doctor's `explain` finding with its runbook
+# hint).
 doctor:
 	python tools/run_doctor_sim.py
 

@@ -22,7 +22,10 @@ ResourceClaims; then it re-runs the audit cross-checks FLEET-wide:
 - claims the apiserver says are allocated to a node that the node has
   not prepared (informational — the pod may simply not have started);
 - per-claim device-set mismatches between allocation and prepare;
-- ICI channel occupancy vs the controller's published pools.
+- ICI channel occupancy vs the controller's published pools;
+- unsatisfiable allocation decisions surfaced by ``/debug/allocations``
+  (the ``explain`` check), each mapped to a runbook hint answering "why
+  won't my claim schedule?".
 
 ``--bundle`` additionally writes a tar of every raw document (metrics,
 usage JSON, traces JSONL, readyz, cluster objects, findings) for
@@ -135,6 +138,7 @@ class NodeScrape:
     usage: Optional[dict] = None
     traces_text: str = ""
     readyz_text: str = ""
+    allocations_text: str = ""
     errors: list = dataclasses.field(default_factory=list)
 
     @property
@@ -145,6 +149,23 @@ class NodeScrape:
     @property
     def holds(self) -> list[dict]:
         return list((self.usage or {}).get("holds") or [])
+
+    @property
+    def allocations(self) -> list[dict]:
+        """Solve-decision records from /debug/allocations (oldest first).
+        Undecodable lines are skipped — a version-skewed record must
+        degrade the check, not abort the run."""
+        out = []
+        for line in self.allocations_text.splitlines():
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+        return out
 
     @property
     def pool_name(self) -> str:
@@ -192,6 +213,16 @@ def collect_node(name: str, url: str, timeout: float = 5.0) -> NodeScrape:
         )
     except Exception as e:
         scrape.errors.append(f"/debug/usage: {e}")
+    try:
+        scrape.allocations_text = _fetch(
+            scrape.url + "/debug/allocations", timeout
+        )
+    except Exception as e:
+        # 404 = allocation explainability simply not wired on this node
+        # (node plugins don't run the allocator; only sim/scheduler
+        # processes do) — absence is normal, not a collection error.
+        if getattr(e, "code", None) != 404:
+            scrape.errors.append(f"/debug/allocations: {e}")
     reported = (scrape.usage or {}).get("node")
     if reported and reported != name:
         scrape.errors.append(
@@ -266,13 +297,53 @@ def fleet_findings(
                 f"unrecognized /readyz state {node.readiness!r}",
             ))
 
-    if cluster is None:
-        return findings
-
     claims_by_uid = {
         (c.get("metadata") or {}).get("uid", ""): c
-        for c in cluster["resourceClaims"]
+        for c in (cluster["resourceClaims"] if cluster else [])
     }
+
+    # "Why won't my claim schedule?": unsatisfiable solve decisions from
+    # /debug/allocations, mapped to runbook hints. A claim that has since
+    # been allocated (it appears in the apiserver WITH an allocation —
+    # collect_cluster keeps only those) is stale history, not a finding;
+    # without kube access every unsat record is surfaced. Deduped
+    # fleet-wide: in the sim, several nodes can serve the same
+    # scheduler's decision buffer.
+    from .kube.allocator import RUNBOOK_HINTS
+
+    seen_unsat: set[tuple[str, str]] = set()
+    for node in nodes:
+        latest: dict[str, dict] = {}
+        for rec in node.allocations:
+            uid = (rec.get("claim") or {}).get("uid") or ""
+            latest[uid or f"line-{len(latest)}"] = rec
+        for uid, rec in sorted(latest.items()):
+            if rec.get("outcome") == "ok":
+                continue
+            if cluster is not None and uid in claims_by_uid:
+                continue  # allocated since this decision was recorded
+            reason = rec.get("reason") or "?"
+            if (uid, reason) in seen_unsat:
+                continue
+            seen_unsat.add((uid, reason))
+            claim_ref = rec.get("claim") or {}
+            subject = (
+                f"{claim_ref.get('namespace', '?')}/"
+                f"{claim_ref.get('name', '?')}"
+            )
+            detail = (
+                f"unallocatable (terminal reason {reason!r}): "
+                f"{rec.get('detail') or 'no detail recorded'}"
+            )
+            hint = RUNBOOK_HINTS.get(reason)
+            if hint:
+                detail += f" — runbook: {hint}"
+            findings.append(DoctorFinding(
+                SEVERITY_DRIFT, "explain", subject, detail,
+            ))
+
+    if cluster is None:
+        return findings
     # Nodes whose /debug/usage scrape failed have an UNKNOWN hold set —
     # keep them out of the placement checks (their collect error above
     # already reports them) rather than read "no holds" into a
@@ -517,6 +588,9 @@ def write_bundle(
                 json.dumps(node.usage or {}, indent=2, sort_keys=True))
             add(tar, f"{base}/traces.jsonl", node.traces_text)
             add(tar, f"{base}/readyz.txt", node.readyz_text)
+            if node.allocations_text:
+                add(tar, f"{base}/allocations.jsonl",
+                    node.allocations_text)
             if node.errors:
                 add(tar, f"{base}/errors.txt", "\n".join(node.errors) + "\n")
         if cluster is not None:

@@ -12,18 +12,35 @@ Selectors come in two forms: programmatic (attribute, op, value) triples,
 and real CEL expressions from DeviceClass specs / request ``selectors``
 (evaluated by the cel module's subset engine, so the demo specs run through
 the sim verbatim). The production path still uses the real scheduler.
+
+**Allocation explainability.** Every solve records a per-request *candidate
+funnel* — how many devices entered, how many each named stage rejected and
+why — plus backtrack count and per-stage latency, into an
+:class:`Explanation`. Failures raise :class:`AllocationError` carrying the
+explanation and a terminal ``reason`` drawn from :data:`REASONS`;
+successes keep a compact funnel (counts, no per-device samples). Decisions
+land in a bounded ring buffer served as JSONL at ``/debug/allocations``
+(``MetricsServer.set_allocations_provider``) and feed the
+``tpu_dra_alloc_*`` metric families, so "why won't my claim schedule?" is
+answerable from a scrape instead of a debugger (kube-scheduler's
+``Unschedulable`` filter messages are the model; docs/operations.md maps
+each terminal reason to an operator action).
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import json
 import logging
+import os
 import threading
+import time
 from typing import Any, Optional
 
-from ..utils.metrics import Counter, Registry
+from ..utils.metrics import Counter, Histogram, Registry
 from ..utils.tracing import child_span
-from .cel import CelError, evaluate as cel_evaluate
+from .cel import CelError, evaluate_detailed as cel_evaluate_detailed
 from .client import KubeClient
 from .resourceapi import ResourceApi
 
@@ -36,9 +53,322 @@ DEVICE_CLASS_TYPES = {
     "ici.tpu.google.com": "ici",
 }
 
+# -- funnel stages (pipeline order; the enum `stage` metric labels and
+#    /debug/allocations records are confined to — lint rule TPM06) --------
+STAGE_INVALID_SLICE = "invalid-slice"
+STAGE_CLASS_CEL = "class-cel"
+STAGE_REQUEST_CEL = "request-cel"
+STAGE_RESERVED = "reserved"
+STAGE_COUNTERS = "counters"
+STAGE_CONSTRAINT = "constraint"
+STAGE_GANG = "gang"
+
+STAGES = (
+    STAGE_INVALID_SLICE,
+    STAGE_CLASS_CEL,
+    STAGE_REQUEST_CEL,
+    STAGE_RESERVED,
+    STAGE_COUNTERS,
+    STAGE_CONSTRAINT,
+    STAGE_GANG,
+)
+
+# Stages applied while FILTERING candidates (before the search): a deepest
+# rejection here with survivors left means the request simply wants more
+# devices than match — reported as `shortfall`, not as the filter stage.
+_FILTER_STAGES = (STAGE_INVALID_SLICE, STAGE_CLASS_CEL, STAGE_REQUEST_CEL)
+
+# -- terminal reasons (the enum `reason` metric labels are confined to).
+#    Kept a full literal (not STAGES + extras) so tools/lint.py TPM06 can
+#    read the values without evaluating expressions; the assert below
+#    keeps the two in sync.
+REASON_SHORTFALL = "shortfall"
+REASON_NO_DEVICES = "no-devices"
+REASON_CEL_ERROR = "cel-error"
+REASON_UNKNOWN_CLASS = "unknown-class"
+REASON_UNKNOWN_MODE = "unknown-mode"
+REASON_BACKTRACK_BUDGET = "backtrack-budget"
+REASON_INTERNAL = "internal"
+
+REASONS = (
+    "invalid-slice",
+    "class-cel",
+    "request-cel",
+    "reserved",
+    "counters",
+    "constraint",
+    "gang",
+    "shortfall",
+    "no-devices",
+    "cel-error",
+    "unknown-class",
+    "unknown-mode",
+    "backtrack-budget",
+    "internal",
+)
+assert set(STAGES) <= set(REASONS)
+
+# Terminal reason → the operator's next move. Single source for the
+# doctor's `explain` cross-check, the inspector's live view, and the
+# "why won't my claim schedule?" runbook in docs/operations.md.
+RUNBOOK_HINTS = {
+    "invalid-slice": (
+        "a published ResourceSlice is misconfigured (devices consume "
+        "counters their slice never declared); fix the slice publisher "
+        "and check plugin logs for 'undeclared counters'"
+    ),
+    "class-cel": (
+        "no device satisfies the DeviceClass selector; inspect `kubectl "
+        "get deviceclass -o yaml` for a typo'd expression or a "
+        "class/driver mismatch"
+    ),
+    "request-cel": (
+        "the claim's request selectors reject every device; check the "
+        "request's CEL expressions and attribute names against the "
+        "published ResourceSlice attributes"
+    ),
+    "reserved": (
+        "every matching device is already held by another claim; free "
+        "capacity (delete idle claims) or wait for running workloads to "
+        "finish"
+    ),
+    "counters": (
+        "the shared counter budget is exhausted (e.g. chips already "
+        "carved into core partitions); deallocate partition claims or "
+        "target another pool"
+    ),
+    "constraint": (
+        "the matchAttribute constraint cannot be satisfied by the "
+        "remaining devices (e.g. the gang would span ICI slices); relax "
+        "the constraint or free devices on one slice"
+    ),
+    "gang": (
+        "no contiguous ICI submesh of the requested shape is free; the "
+        "slice is fragmented — drain/repack smaller claims or request a "
+        "smaller gang"
+    ),
+    "shortfall": (
+        "fewer matching devices exist than the request asks for; lower "
+        "the request count or add capacity"
+    ),
+    "no-devices": (
+        "no ResourceSlices are published for this driver; check that the "
+        "node plugin and controller are running and publishing"
+    ),
+    "cel-error": (
+        "a selector expression is malformed and cannot be evaluated; fix "
+        "the expression quoted in the error"
+    ),
+    "unknown-class": (
+        "the request names a DeviceClass this driver does not serve; "
+        "check the deviceClassName spelling"
+    ),
+    "unknown-mode": (
+        "the request uses an allocationMode this driver does not "
+        "implement; use ExactCount or All"
+    ),
+    "backtrack-budget": (
+        "the solver hit its backtrack budget before finding a placement; "
+        "the claim is pathologically constrained — simplify constraints "
+        "or raise TPU_DRA_MAX_BACKTRACK_STEPS"
+    ),
+    "internal": (
+        "the allocator failed unexpectedly; check plugin logs for the "
+        "stack trace"
+    ),
+}
+assert set(RUNBOOK_HINTS) == set(REASONS)
+
+# A pathological claim (dense matchAttribute groups over a fragmented
+# slice) can drive the backtracking search exponential. The budget turns
+# that into a typed `backtrack-budget` failure instead of a wedged
+# allocator; generous enough that every legitimate solve in the scale
+# suite stays orders of magnitude below it.
+DEFAULT_MAX_BACKTRACK_STEPS = 200_000
+# Solve decisions kept for /debug/allocations.
+DEFAULT_DECISION_BUFFER = 256
+
 
 class AllocationError(RuntimeError):
-    pass
+    """An unallocatable claim. ``reason`` is the terminal cause (one of
+    :data:`REASONS`); ``explanation`` carries the full candidate funnel
+    once ``allocate()`` has finalized the solve record."""
+
+    def __init__(self, message: str, reason: str = REASON_INTERNAL,
+                 explanation: Optional["Explanation"] = None):
+        super().__init__(message)
+        self.reason = reason
+        self.explanation = explanation
+
+
+@dataclasses.dataclass
+class RequestFunnel:
+    """One request's candidate funnel: devices entering, per-stage
+    rejection counts with sampled per-device reasons, survivors, and the
+    count the request wanted."""
+
+    request: str
+    entering: int = 0
+    wanted: int = 0
+    survivors: int = 0
+    rejected: dict[str, int] = dataclasses.field(default_factory=dict)
+    reasons: dict[str, list[str]] = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "request": self.request,
+            "entering": self.entering,
+            "wanted": self.wanted,
+            "survivors": self.survivors,
+            "rejected": dict(self.rejected),
+            "reasons": {k: list(v) for k, v in self.reasons.items()},
+        }
+
+
+class Explanation:
+    """Structured record of one solve: the per-request funnels, the
+    terminal reason on failure, backtrack count, CEL evaluation count
+    (the memo's effectiveness is observable), and per-stage + end-to-end
+    latency. Rendered as one JSONL line at ``/debug/allocations``."""
+
+    # Per-device reason strings kept per (request, stage); counts are
+    # exact, samples are bounded so a 192-device funnel stays one line.
+    MAX_REASON_SAMPLES = 4
+
+    def __init__(self, claim_uid: str = "", claim_name: str = "",
+                 claim_namespace: str = ""):
+        self.claim_uid = claim_uid
+        self.claim_name = claim_name
+        self.claim_namespace = claim_namespace
+        self.outcome = "ok"  # ok | unsat | error
+        self.reason = ""
+        self.detail = ""
+        self.failing_request = ""
+        self.backtracks = 0
+        self.cel_evaluations = 0
+        self.duration_seconds = 0.0
+        self.stage_seconds: dict[str, float] = {}
+        self.timestamp = 0.0
+        self._funnels: dict[str, RequestFunnel] = {}
+        self._seen: set = set()
+        self._fail_depth = -1
+
+    # -- recording (solver side) ------------------------------------------
+
+    def funnel(self, request: str) -> RequestFunnel:
+        f = self._funnels.get(request)
+        if f is None:
+            f = self._funnels[request] = RequestFunnel(request=request)
+        return f
+
+    def reject(self, request: str, stage: str, key: Any,
+               detail: str = "") -> None:
+        """Count one rejection of candidate ``key`` at ``stage``. Deduped
+        per (request, stage, key): backtracking revisits the same device
+        under different partial solutions, and re-counting each probe
+        would turn the funnel into a measure of search effort, not of
+        inventory."""
+        seen_key = (request, stage, key)
+        if seen_key in self._seen:
+            return
+        self._seen.add(seen_key)
+        f = self.funnel(request)
+        f.rejected[stage] = f.rejected.get(stage, 0) + 1
+        if detail:
+            samples = f.reasons.setdefault(stage, [])
+            if len(samples) < self.MAX_REASON_SAMPLES \
+                    and detail not in samples:
+                samples.append(detail)
+
+    def add_stage_seconds(self, stage: str, seconds: float) -> None:
+        self.stage_seconds[stage] = (
+            self.stage_seconds.get(stage, 0.0) + seconds
+        )
+
+    def note_request_failure(self, depth: int, request: str) -> None:
+        """The DEEPEST request to exhaust its candidates is the terminal
+        one — earlier requests failing merely means the solver is
+        unwinding through them."""
+        if depth > self._fail_depth:
+            self._fail_depth = depth
+            self.failing_request = request
+
+    # -- reading ----------------------------------------------------------
+
+    @property
+    def funnels(self) -> list[RequestFunnel]:
+        return list(self._funnels.values())
+
+    def terminal(self) -> tuple[str, str]:
+        """(reason, human detail) for a failed solve, derived from the
+        terminal request's funnel: the deepest stage that rejected
+        candidates — except that filter-stage rejections with survivors
+        left read as `shortfall` (the devices that DID match were simply
+        too few)."""
+        f = self._funnels.get(self.failing_request)
+        if f is None:
+            return (
+                REASON_INTERNAL,
+                "solver failed before exploring any request",
+            )
+        deepest = None
+        for stage in STAGES:
+            if f.rejected.get(stage):
+                deepest = stage
+        prefix = f"request {f.request!r}"
+        if deepest is None and f.entering == 0:
+            return REASON_NO_DEVICES, (
+                f"{prefix}: no published devices to consider"
+            )
+        if (
+            f.survivors > 0
+            and f.survivors < max(f.wanted, 1)
+            and (deepest is None or deepest in _FILTER_STAGES)
+        ):
+            return REASON_SHORTFALL, (
+                f"{prefix}: only {f.survivors} of {f.wanted} matching "
+                "device(s) available"
+            )
+        if deepest is None:
+            return REASON_INTERNAL, (
+                f"{prefix}: search exhausted with no recorded rejections"
+            )
+        msg = (
+            f"{prefix}: {f.rejected[deepest]} candidate(s) rejected at "
+            f"stage {deepest!r}"
+        )
+        samples = f.reasons.get(deepest)
+        if samples:
+            msg += f" (e.g. {samples[0]})"
+        return deepest, msg
+
+    def compact(self) -> None:
+        """Successes keep the funnel counts but drop per-device samples —
+        the ring buffer must stay cheap on the happy path."""
+        for f in self._funnels.values():
+            f.reasons = {}
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "ts": round(self.timestamp, 3),
+            "claim": {
+                "uid": self.claim_uid,
+                "name": self.claim_name,
+                "namespace": self.claim_namespace,
+            },
+            "outcome": self.outcome,
+            "reason": self.reason,
+            "detail": self.detail,
+            "failingRequest": self.failing_request,
+            "backtracks": self.backtracks,
+            "celEvaluations": self.cel_evaluations,
+            "durationSeconds": round(self.duration_seconds, 6),
+            "stageSeconds": {
+                k: round(v, 6)
+                for k, v in sorted(self.stage_seconds.items())
+            },
+            "funnels": [f.to_dict() for f in self._funnels.values()],
+        }
 
 
 @dataclasses.dataclass
@@ -86,31 +416,44 @@ def _consumption_entries(dev: dict):
             yield dev["pool"], cc["counterSet"], cname, int(cval["value"])
 
 
-def _gang_contiguous(chosen: list[dict]) -> bool:
+def _gang_contiguous(chosen: list[dict]) -> tuple[bool, str]:
     """A multi-chip request is a gang: its chips must be one contiguous
     ICI sub-mesh within a single slice (SURVEY.md §7 hard part (a); the
     reference's analog is same-parent MIG constraints,
     demo/specs/quickstart/gpu-test4.yaml:42-44). XLA's collective
     performance model assumes mesh neighbours, so a fragmented pick is
     useless to the workload and must be rejected, not granted.
+
+    Returns (ok, why_not) so the explainer can say WHICH invariant the
+    combination broke.
     """
     chips = [
         d for d in chosen
         if _attr_value(d["attributes"], "type") == "chip"
     ]
     if len(chips) < 2:
-        return True
+        return True, ""
     from ..tpulib.topology import Coord, is_contiguous_submesh
 
-    if len({_attr_value(d["attributes"], "sliceId") for d in chips}) > 1:
-        return False
+    slice_ids = {_attr_value(d["attributes"], "sliceId") for d in chips}
+    if len(slice_ids) > 1:
+        return False, f"gang:chips span ICI slices {sorted(map(str, slice_ids))}"
     coords = []
     for d in chips:
         c = _attr_value(d["attributes"], "coord")
         if c is None:
-            return False
+            return False, f"gang:chip {d['name']!r} publishes no coord"
         coords.append(Coord.parse(c))
-    return is_contiguous_submesh(coords)
+    if not is_contiguous_submesh(coords):
+        return False, (
+            "gang:non-contiguous coords "
+            f"[{', '.join(str(c) for c in coords)}]"
+        )
+    return True, ""
+
+
+def _cel_mismatch_detail(expr: str, why: str) -> str:
+    return f"cel:mismatch expr={expr!r}" + (f" ({why})" if why else "")
 
 
 class ReferenceAllocator:
@@ -123,6 +466,8 @@ class ReferenceAllocator:
         device_classes: Optional[dict[str, list[str]]] = None,
         resource_api: Optional[ResourceApi] = None,
         registry: Optional[Registry] = None,
+        recorder=None,
+        max_backtrack_steps: Optional[int] = None,
     ):
         """``device_classes`` maps DeviceClass name → CEL selector
         expressions (from the class spec). When given, class membership is
@@ -130,13 +475,24 @@ class ReferenceAllocator:
         the built-in DEVICE_CLASS_TYPES name → type mapping applies.
         ``resource_api`` selects the resource.k8s.io dialect slices are
         read in (default: discover from the client). ``registry`` receives
-        the attempt/backtrack counters (a solver that starts thrashing
-        shows up as a backtrack-rate spike long before latency does).
+        the attempt/backtrack counters and the ``tpu_dra_alloc_*``
+        explainability families. ``recorder`` (an
+        ``events.EventRecorder``) gets a deduped ``UnsatisfiableClaim``
+        Warning on the claim for every failed solve.
+        ``max_backtrack_steps`` bounds the search (default
+        ``TPU_DRA_MAX_BACKTRACK_STEPS`` env or
+        ``DEFAULT_MAX_BACKTRACK_STEPS``).
         """
         self.client = client
         self.driver_name = driver_name
         self.device_classes = device_classes
         self.api = resource_api or ResourceApi.discover(client)
+        self.recorder = recorder
+        if max_backtrack_steps is None:
+            max_backtrack_steps = int(os.environ.get(
+                "TPU_DRA_MAX_BACKTRACK_STEPS", DEFAULT_MAX_BACKTRACK_STEPS
+            ))
+        self.max_backtrack_steps = max_backtrack_steps
         self._lock = threading.Lock()
         reg = registry if registry is not None else Registry()
         self._m_attempts = Counter(
@@ -149,9 +505,30 @@ class ReferenceAllocator:
             "Device picks undone by the allocation solver",
             reg,
         )
+        self._m_solve_seconds = Histogram(
+            "tpu_dra_alloc_solve_seconds",
+            "End-to-end allocation solve latency",
+            reg,
+        )
+        self._m_funnel_rejections = Counter(
+            "tpu_dra_alloc_funnel_rejections_total",
+            "Candidate devices rejected by the allocation funnel, by stage",
+            reg,
+        )
+        self._m_unsat = Counter(
+            "tpu_dra_alloc_unsat_total",
+            "Failed allocation attempts by terminal reason",
+            reg,
+        )
         # Steps undone during the current solve; folded into the counter
         # once per allocate() (all access is under self._lock).
         self._backtrack_steps = 0
+        # Solve decisions (Explanation dicts) for /debug/allocations.
+        self._decisions: collections.deque = collections.deque(
+            maxlen=int(os.environ.get(
+                "TPU_DRA_ALLOC_DECISION_BUFFER", DEFAULT_DECISION_BUFFER
+            ))
+        )
         # (pool, device) -> claim uid holding it
         self._reservations: dict[tuple[str, str], str] = {}
         # (pool, counter set, counter) -> amount consumed by reservations.
@@ -226,6 +603,63 @@ class ReferenceAllocator:
                     )
         return devices, capacity
 
+    # -- decision record ---------------------------------------------------
+
+    def recent_decisions(self) -> list[dict]:
+        """Newest-last snapshot of the solve-decision ring buffer."""
+        with self._lock:
+            return list(self._decisions)
+
+    def export_allocations_jsonl(self) -> str:
+        """The ``/debug/allocations`` payload: one JSON object per solve,
+        oldest first (the newest decision is the last line)."""
+        return "".join(
+            json.dumps(d, sort_keys=True) + "\n"
+            for d in self.recent_decisions()
+        )
+
+    def _finish(self, expl: Explanation, t0: float, outcome: str,
+                reason: str = "", detail: str = "") -> None:
+        """Finalize the solve record: stamp outcome/latency, feed the
+        funnel-rejection counters, and push onto the ring buffer."""
+        expl.outcome = outcome
+        expl.reason = reason
+        if detail:
+            expl.detail = detail
+        expl.duration_seconds = time.monotonic() - t0
+        expl.timestamp = time.time()
+        self._m_solve_seconds.observe(expl.duration_seconds)
+        for f in expl.funnels:
+            for stage, n in f.rejected.items():
+                self._m_funnel_rejections.inc(n, stage=stage)
+        if outcome == "ok":
+            expl.compact()
+        self._decisions.append(expl.to_dict())
+
+    def _emit_unsat_event(self, expl: Explanation) -> None:
+        """Deduped UnsatisfiableClaim Warning on the claim — the kubectl-
+        describe-visible form of the explanation. Best-effort by the
+        recorder's own contract; a nameless claim (pure sim object) is
+        skipped."""
+        if self.recorder is None or not expl.claim_name:
+            return
+        from .events import ObjectRef
+
+        hint = RUNBOOK_HINTS.get(expl.reason, "")
+        message = f"cannot allocate: {expl.detail or expl.reason}"
+        if hint:
+            message += f" — {hint}"
+        self.recorder.warning(
+            ObjectRef.claim(
+                expl.claim_name,
+                expl.claim_namespace,
+                expl.claim_uid,
+                api_version=self.api.api_version,
+            ),
+            "UnsatisfiableClaim",
+            message,
+        )
+
     # -- allocation --------------------------------------------------------
 
     def allocate(
@@ -238,11 +672,20 @@ class ReferenceAllocator:
 
         ``selectors`` maps request name → extra Selector predicates (the
         CEL-lite substitute). ``node_name`` restricts node-local pools.
+        On failure raises :class:`AllocationError` with ``reason`` and
+        ``explanation`` populated; either way the decision is recorded
+        for ``/debug/allocations``.
         """
         spec = claim.get("spec", {}).get("devices", {})
         requests = spec.get("requests", [])
         constraints = spec.get("constraints", [])
         selectors = selectors or {}
+        md = claim.get("metadata", {})
+        expl = Explanation(
+            claim_uid=md.get("uid", ""),
+            claim_name=md.get("name", ""),
+            claim_namespace=md.get("namespace", ""),
+        )
         # adminAccess requests "ignore all ordinary claims with respect to
         # access modes and any resource allocations" (types.go:448-456):
         # they may land on reserved devices and neither reserve nor consume
@@ -250,8 +693,9 @@ class ReferenceAllocator:
         admin_reqs = {r["name"] for r in requests if r.get("adminAccess")}
         with self._lock, child_span(
             "allocator/allocate",
-            claim_uid=claim.get("metadata", {}).get("uid", ""),
+            claim_uid=md.get("uid", ""),
         ) as sp:
+            t0 = time.monotonic()
             devices, capacity = self._inventory()
             inventory = [
                 d
@@ -261,16 +705,32 @@ class ReferenceAllocator:
             self._backtrack_steps = 0
             try:
                 results, picked_devs = self._solve(
-                    requests, constraints, selectors, inventory, capacity
+                    requests, constraints, selectors, inventory, capacity,
+                    expl,
                 )
             except Exception as e:
-                self._m_attempts.inc(result="error")
-                sp.set_error(str(e))
-                raise
-            finally:
                 if self._backtrack_steps:
                     self._m_backtracks.inc(self._backtrack_steps)
+                expl.backtracks = self._backtrack_steps
                 sp.set_tag("backtracks", self._backtrack_steps)
+                self._m_attempts.inc(result="error")
+                sp.set_error(str(e))
+                if isinstance(e, AllocationError):
+                    self._finish(expl, t0, "unsat", e.reason, str(e))
+                    if e.explanation is None:
+                        e.explanation = expl
+                    self._emit_unsat_event(expl)
+                else:
+                    self._finish(
+                        expl, t0, "error", REASON_INTERNAL, str(e)
+                    )
+                self._m_unsat.inc(reason=expl.reason)
+                sp.set_tag("reason", expl.reason)
+                raise
+            if self._backtrack_steps:
+                self._m_backtracks.inc(self._backtrack_steps)
+            expl.backtracks = self._backtrack_steps
+            sp.set_tag("backtracks", self._backtrack_steps)
             self._m_attempts.inc(result="ok")
             sp.set_tag("devices", len(picked_devs))
             uid = claim["metadata"]["uid"]
@@ -285,6 +745,7 @@ class ReferenceAllocator:
                     self._claim_consumption.setdefault(uid, []).append(
                         (pool, cset, cname, amount)
                     )
+            self._finish(expl, t0, "ok")
         claim.setdefault("status", {})["allocation"] = {
             "devices": {
                 "results": results,
@@ -303,11 +764,28 @@ class ReferenceAllocator:
             out.append(entry)
         return out
 
-    def _solve(self, requests, constraints, selectors, inventory, capacity):
+    def _note_backtrack(self, n: int) -> None:
+        self._backtrack_steps += n
+        if self._backtrack_steps > self.max_backtrack_steps:
+            raise AllocationError(
+                f"backtrack budget exhausted after "
+                f"{self._backtrack_steps} steps (max "
+                f"{self.max_backtrack_steps}; TPU_DRA_MAX_BACKTRACK_STEPS "
+                "overrides)",
+                reason=REASON_BACKTRACK_BUDGET,
+            )
+
+    def _solve(self, requests, constraints, selectors, inventory, capacity,
+               expl: Explanation):
         """Greedy backtracking over requests with matchAttribute checks,
         shared-counter budgets, and ICI contiguity for multi-chip gangs.
 
-        Returns (allocation results, picked device dicts).
+        Returns (allocation results, picked device dicts). Every
+        rejection is recorded into ``expl``'s per-request funnels, and
+        both candidate lists and CEL evaluations are memoized per solve —
+        the search re-enters ``candidates()`` on every probe, and before
+        the memo each re-entry re-ran every CEL expression against every
+        device (quadratic-and-worse under backtracking).
         """
         match_groups = [
             (set(c.get("requests", [])), c["matchAttribute"].split("/")[-1])
@@ -317,17 +795,159 @@ class ReferenceAllocator:
         # Counters consumed by the in-progress solution, on top of the
         # amounts already reserved by other claims.
         tentative: dict[tuple[str, str, str], int] = {}
+        # Per-solve memos: (expression, device identity) → (ok, why_not)
+        # and (request name, include_reserved) → candidate list. Both are
+        # sound because everything they read — inventory, reservations,
+        # selectors — is frozen for the duration of the solve.
+        cel_memo: dict[tuple, tuple[bool, str]] = {}
+        cand_memo: dict[tuple, list] = {}
 
-        def counters_fit(dev) -> bool:
+        def cel_matches(expr: str, d: dict) -> tuple[bool, str]:
+            key = (expr, id(d))
+            hit = cel_memo.get(key)
+            if hit is None:
+                expl.cel_evaluations += 1
+                try:
+                    hit = cel_evaluate_detailed(
+                        expr, self.driver_name, d["attributes"],
+                        d.get("capacity"),
+                    )
+                except CelError as e:
+                    # Bad expressions make the claim unallocatable,
+                    # matching the solver's error contract for malformed
+                    # specs; the CelError names the offending expression.
+                    raise AllocationError(
+                        f"invalid CEL selector: {e}",
+                        reason=REASON_CEL_ERROR,
+                    ) from e
+                cel_memo[key] = hit
+            return hit
+
+        def class_matches(class_name: str, d: dict) -> tuple[bool, str]:
+            if self.device_classes is not None:
+                exprs = self.device_classes.get(class_name)
+                if exprs is None:
+                    raise AllocationError(
+                        f"unknown device class {class_name!r}",
+                        reason=REASON_UNKNOWN_CLASS,
+                    )
+                for e in exprs:
+                    ok, why = cel_matches(e, d)
+                    if not ok:
+                        return False, _cel_mismatch_detail(e, why)
+                return True, ""
+            dtype = DEVICE_CLASS_TYPES.get(class_name)
+            if dtype is None:
+                raise AllocationError(
+                    f"unknown device class {class_name!r}",
+                    reason=REASON_UNKNOWN_CLASS,
+                )
+            have = _attr_value(d["attributes"], "type")
+            if have != dtype:
+                return False, f"class:device type {have!r} != {dtype!r}"
+            return True, ""
+
+        def candidates(req, include_reserved=False):
+            memo_key = (req["name"], bool(include_reserved))
+            memoized = cand_memo.get(memo_key)
+            if memoized is not None:
+                return memoized
+            cel_selectors = [
+                s["cel"]["expression"]
+                for s in req.get("selectors", [])
+                if "cel" in s
+            ]
+            admin = req.get("adminAccess", False)
+            # Only the primary pass populates the funnel: the
+            # include_reserved variant exists solely for allocationMode=
+            # All's completeness check.
+            record = not include_reserved
+            if record:
+                expl.funnel(req["name"]).entering = len(inventory)
+            stage_t = dict.fromkeys(STAGES[:4], 0.0)
+            out = []
+            for d in inventory:
+                dk = (d["pool"], d["name"])
+                t = time.perf_counter()
+                invalid = d.get("invalid", False)
+                stage_t[STAGE_INVALID_SLICE] += time.perf_counter() - t
+                if invalid:
+                    # Misconfigured slice: unallocatable, and it must not
+                    # inflate allocationMode=All's target count.
+                    if record:
+                        expl.reject(
+                            req["name"], STAGE_INVALID_SLICE, dk,
+                            "slice:device consumes counters its slice "
+                            "never declared",
+                        )
+                    continue
+                t = time.perf_counter()
+                ok, why = class_matches(req.get("deviceClassName", ""), d)
+                stage_t[STAGE_CLASS_CEL] += time.perf_counter() - t
+                if not ok:
+                    if record:
+                        expl.reject(req["name"], STAGE_CLASS_CEL, dk, why)
+                    continue
+                t = time.perf_counter()
+                why = ""
+                for s in selectors.get(req["name"], []):
+                    if not s.matches(d["attributes"]):
+                        why = (
+                            f"selector:{s.attribute} {s.op} "
+                            f"{s.value!r} mismatch"
+                        )
+                        break
+                if not why:
+                    for e in cel_selectors:
+                        ok, cel_why = cel_matches(e, d)
+                        if not ok:
+                            why = _cel_mismatch_detail(e, cel_why)
+                            break
+                stage_t[STAGE_REQUEST_CEL] += time.perf_counter() - t
+                if why:
+                    if record:
+                        expl.reject(req["name"], STAGE_REQUEST_CEL, dk, why)
+                    continue
+                # Ordinary requests never see reserved devices; admin
+                # requests observe them (monitoring over live workloads).
+                # Checked LAST so the funnel reads "the right devices
+                # exist but are held", not "nothing matched".
+                t = time.perf_counter()
+                reserved = (
+                    not (admin or include_reserved)
+                    and dk in self._reservations
+                )
+                stage_t[STAGE_RESERVED] += time.perf_counter() - t
+                if reserved:
+                    if record:
+                        expl.reject(
+                            req["name"], STAGE_RESERVED, dk,
+                            "reserved:held by claim "
+                            f"{self._reservations[dk]}",
+                        )
+                    continue
+                out.append(d)
+            if record:
+                expl.funnel(req["name"]).survivors = len(out)
+                for stage, secs in stage_t.items():
+                    expl.add_stage_seconds(stage, secs)
+            cand_memo[memo_key] = out
+            return out
+
+        def counters_fit(dev) -> tuple[bool, str]:
             for pool, cset, cname, amount in _consumption_entries(dev):
                 key = (pool, cset, cname)
                 cap = capacity.get(key)
                 if cap is None:
-                    return False  # unreachable: _inventory flags these
+                    # unreachable: _inventory flags these as invalid
+                    return False, f"counters:{cset}/{cname} undeclared"
                 used = self._consumed.get(key, 0) + tentative.get(key, 0)
                 if used + amount > cap:
-                    return False
-            return True
+                    return False, (
+                        f"counters:{cset}/{cname} {used}/{cap} used, "
+                        f"need {amount}"
+                    )
+            return True, ""
 
         def consume(dev) -> None:
             for pool, cset, cname, amount in _consumption_entries(dev):
@@ -339,85 +959,37 @@ class ReferenceAllocator:
                 key = (pool, cset, cname)
                 tentative[key] -= amount
 
-        def cel_matches(expr: str, d: dict) -> bool:
-            try:
-                return cel_evaluate(
-                    expr, self.driver_name, d["attributes"], d.get("capacity")
-                )
-            except CelError as e:
-                # Bad expressions make the claim unallocatable, matching the
-                # solver's error contract for malformed specs.
-                raise AllocationError(f"invalid CEL selector: {e}") from e
-
-        def class_matches(class_name: str, d: dict) -> bool:
-            if self.device_classes is not None:
-                exprs = self.device_classes.get(class_name)
-                if exprs is None:
-                    raise AllocationError(
-                        f"unknown device class {class_name!r}"
-                    )
-                return all(cel_matches(e, d) for e in exprs)
-            dtype = DEVICE_CLASS_TYPES.get(class_name)
-            if dtype is None:
-                raise AllocationError(f"unknown device class {class_name!r}")
-            return _attr_value(d["attributes"], "type") == dtype
-
-        def candidates(req, include_reserved=False):
-            cel_selectors = [
-                s["cel"]["expression"]
-                for s in req.get("selectors", [])
-                if "cel" in s
-            ]
-            admin = req.get("adminAccess", False)
-            out = []
-            for d in inventory:
-                if d.get("invalid"):
-                    continue  # misconfigured slice: unallocatable, and it
-                    # must not inflate allocationMode=All's target count
-                # Ordinary requests never see reserved devices; admin
-                # requests observe them (monitoring over live workloads).
-                if not (admin or include_reserved) and (
-                    (d["pool"], d["name"]) in self._reservations
-                ):
-                    continue
-                if not class_matches(req.get("deviceClassName", ""), d):
-                    continue
-                if not all(
-                    s.matches(d["attributes"])
-                    for s in selectors.get(req["name"], [])
-                ):
-                    continue
-                if not all(cel_matches(e, d) for e in cel_selectors):
-                    continue
-                out.append(d)
-            return out
-
         picked: list[tuple[str, dict]] = []  # (request name, device)
         admin_request_names = {
             r["name"] for r in requests if r.get("adminAccess")
         }
 
-        def picked_blocks(req_admin: bool, d) -> bool:
+        def picked_blocker(req_admin: bool, d) -> Optional[str]:
             """Admin picks are invisible to ordinary placement and vice
             versa (types.go:448-456) — exclusion applies only between
-            requests of the same access kind."""
+            requests of the same access kind. Returns the blocking
+            request's name (for the funnel) or None."""
             for other_name, p in picked:
                 if p is d and (
                     (other_name in admin_request_names) == req_admin
                 ):
-                    return True
-            return False
+                    return other_name
+            return None
 
-        def consistent(req_name, dev) -> bool:
+        def consistent(req_name, dev) -> tuple[bool, str]:
             for group, attr in match_groups:
                 if req_name not in group:
                     continue
                 want = _attr_value(dev["attributes"], attr)
                 for other_name, other in picked:
                     if other_name in group:
-                        if _attr_value(other["attributes"], attr) != want:
-                            return False
-            return True
+                        have = _attr_value(other["attributes"], attr)
+                        if have != want:
+                            return False, (
+                                f"constraint:{attr} {want!r} conflicts "
+                                f"with request {other_name!r} ({have!r})"
+                            )
+            return True, ""
 
         def backtrack(ri: int) -> bool:
             if ri == len(requests):
@@ -425,21 +997,48 @@ class ReferenceAllocator:
             req = requests[ri]
             admin = req.get("adminAccess", False)
             mode = req.get("allocationMode", "ExactCount")
-            cands = [
-                d for d in candidates(req)
-                if not picked_blocks(admin, d)
-            ]
+            cands = []
+            for d in candidates(req):
+                blocker = picked_blocker(admin, d)
+                if blocker is not None:
+                    # Held by an earlier request of this same claim: a
+                    # funnel-visible rejection, or multi-request
+                    # contention would misread as whatever filter stage
+                    # happened to reject unrelated devices.
+                    expl.reject(
+                        req["name"], STAGE_RESERVED,
+                        (d["pool"], d["name"]),
+                        f"reserved:held by request {blocker!r} of "
+                        "this claim",
+                    )
+                    continue
+                cands.append(d)
             if mode == "All":
                 # Every matching device in scope (types.go:427-429): fails
                 # when some are already allocated — unless adminAccess,
                 # whose candidates() already includes reserved devices.
                 count = len(cands)
                 if count == 0:
+                    expl.note_request_failure(ri, req["name"])
                     return False
-                if not admin and count != len(
-                    candidates(req, include_reserved=True)
-                ):
-                    return False  # some matching devices already allocated
+                if not admin:
+                    with_reserved = candidates(
+                        req, include_reserved=True
+                    )
+                    if count != len(with_reserved):
+                        # Some matching devices already allocated.
+                        for d in with_reserved:
+                            dk = (d["pool"], d["name"])
+                            holder = self._reservations.get(dk)
+                            if holder is not None:
+                                expl.reject(
+                                    req["name"], STAGE_RESERVED, dk,
+                                    "reserved:allocationMode=All needs "
+                                    "every matching device; held by "
+                                    f"claim {holder}",
+                                )
+                        expl.note_request_failure(ri, req["name"])
+                        return False
             elif mode == "ExactCount":
                 count = req.get("count", 1)
             else:
@@ -447,50 +1046,114 @@ class ReferenceAllocator:
                 # modes" (types.go:435-436).
                 raise AllocationError(
                     f"unknown allocationMode {mode!r} in request "
-                    f"{req.get('name')!r}"
+                    f"{req.get('name')!r}",
+                    reason=REASON_UNKNOWN_MODE,
                 )
+            expl.funnel(req["name"]).wanted = count
 
             def pick_n(chosen: list) -> bool:
                 if len(chosen) == count:
                     # Contiguity is a WORKLOAD constraint (ICI collectives);
                     # admin picks observe, so fragmented sets are fine.
-                    if not admin and not _gang_contiguous(chosen):
-                        return False
+                    if not admin:
+                        t = time.perf_counter()
+                        ok, why = _gang_contiguous(chosen)
+                        expl.add_stage_seconds(
+                            STAGE_GANG, time.perf_counter() - t
+                        )
+                        if not ok:
+                            # Keyed by the device that completed the
+                            # failing combination — NOT the combination
+                            # itself, which backtracking enumerates in
+                            # exponential numbers and would turn the
+                            # funnel into a measure of search effort.
+                            last = chosen[-1]
+                            expl.reject(
+                                req["name"], STAGE_GANG,
+                                (last["pool"], last["name"]), why,
+                            )
+                            return False
                     for d in chosen:
                         picked.append((req["name"], d))
                     if backtrack(ri + 1):
                         return True
                     for _ in chosen:
                         picked.pop()
-                    self._backtrack_steps += len(chosen)
+                    self._note_backtrack(len(chosen))
                     return False
                 start = cands.index(chosen[-1]) + 1 if chosen else 0
                 for d in cands[start:]:
-                    if picked_blocks(admin, d) or d in chosen:
+                    if d in chosen:
                         continue
-                    if not consistent(req["name"], d):
+                    blocker = picked_blocker(admin, d)
+                    if blocker is not None:
+                        expl.reject(
+                            req["name"], STAGE_RESERVED,
+                            (d["pool"], d["name"]),
+                            f"reserved:held by request {blocker!r} of "
+                            "this claim",
+                        )
+                        continue
+                    t = time.perf_counter()
+                    ok, why = consistent(req["name"], d)
+                    expl.add_stage_seconds(
+                        STAGE_CONSTRAINT, time.perf_counter() - t
+                    )
+                    if not ok:
+                        expl.reject(
+                            req["name"], STAGE_CONSTRAINT,
+                            (d["pool"], d["name"]), why,
+                        )
                         continue
                     # Admin picks consume nothing, so counters are moot.
-                    if not admin and not counters_fit(d):
-                        continue
+                    if not admin:
+                        t = time.perf_counter()
+                        ok, why = counters_fit(d)
+                        expl.add_stage_seconds(
+                            STAGE_COUNTERS, time.perf_counter() - t
+                        )
+                        if not ok:
+                            expl.reject(
+                                req["name"], STAGE_COUNTERS,
+                                (d["pool"], d["name"]), why,
+                            )
+                            continue
                     chosen.append(d)
                     if not admin:
                         consume(d)
                     # Intra-request matchAttribute consistency.
-                    if self._group_ok(
-                        req["name"], chosen, match_groups
-                    ) and pick_n(chosen):
+                    if not self._group_ok(req["name"], chosen, match_groups):
+                        # Keyed by the newly-added device (see the gang
+                        # rejection above): counts stay bounded by
+                        # inventory, not by combinations explored.
+                        expl.reject(
+                            req["name"], STAGE_CONSTRAINT,
+                            (d["pool"], d["name"]),
+                            "constraint:matchAttribute conflict within "
+                            "request",
+                        )
+                        group_ok = False
+                    else:
+                        group_ok = True
+                    if group_ok and pick_n(chosen):
                         return True
                     if not admin:
                         unconsume(d)
                     chosen.pop()
-                    self._backtrack_steps += 1
+                    self._note_backtrack(1)
                 return False
 
-            return pick_n([])
+            ok = pick_n([])
+            if not ok:
+                expl.note_request_failure(ri, req["name"])
+            return ok
 
         if not backtrack(0):
-            raise AllocationError("no satisfying allocation found")
+            reason, detail = expl.terminal()
+            raise AllocationError(
+                f"no satisfying allocation found: {detail}",
+                reason=reason,
+            )
         return [
             {
                 "request": name,

@@ -33,7 +33,17 @@ from ..api.v1alpha1.quantity import InvalidQuantityError, parse_quantity
 
 
 class CelError(ValueError):
-    pass
+    """A malformed expression (tokenizer/parser/structural error).
+
+    ``expression`` carries the offending source once known — a claim can
+    hold several selectors, and "invalid CEL selector" without a pointer
+    to WHICH one sent operators grepping every DeviceClass in the
+    cluster. ``evaluate``/``evaluate_detailed`` attach it on the way out;
+    internal raise sites may leave it empty."""
+
+    def __init__(self, message: str, expression: str = ""):
+        super().__init__(message)
+        self.expression = expression
 
 
 class _EvalError(Exception):
@@ -47,7 +57,13 @@ class _EvalError(Exception):
 
 
 class _Missing(_EvalError):
-    """An attribute referenced by the expression is absent on the device."""
+    """An attribute referenced by the expression is absent on the device.
+    Carries the attribute name so mismatch diagnostics can say WHICH
+    reference failed, not just that one did."""
+
+    def __init__(self, attribute: str = ""):
+        super().__init__(attribute)
+        self.attribute = attribute
 
 
 class _TypeMismatch(_EvalError):
@@ -103,13 +119,13 @@ class _AttrMap:
 
     def get(self, name: str):
         if not self._match:
-            raise _Missing()
+            raise _Missing(name)
         raw = self._attrs.get(name)
         if raw is None:
-            raise _Missing()
+            raise _Missing(name)
         if isinstance(raw, dict):
             if not raw:
-                raise _Missing()  # empty value union carries no value
+                raise _Missing(name)  # empty value union carries no value
             raw = next(iter(raw.values()))
         if self._is_capacity:
             try:
@@ -364,6 +380,47 @@ class _Parser:
         return run
 
 
+def evaluate_detailed(
+    expression: str,
+    driver: str,
+    attributes: dict,
+    capacity: dict | None = None,
+) -> tuple[bool, str]:
+    """Evaluate a selector expression against one device.
+
+    Returns ``(matched, why_not)``: ``why_not`` is empty for a match (and
+    for a plain boolean non-match), and names the absorbed evaluation
+    error — the absent attribute, the type mismatch — when that is what
+    made the device not match. The allocation explainer threads this into
+    per-device rejection reasons, so a typo'd attribute name reads as
+    ``attribute 'iciY' absent``, not as a silent non-match.
+
+    A malformed expression raises :class:`CelError` with ``expression``
+    attached (every raise path here is wrapped, including structural
+    errors that only surface at evaluation time, e.g. an unknown
+    ``device`` member)."""
+    device = _Device(driver, attributes, capacity or {})
+    try:
+        thunk = _Parser(_tokenize(expression), driver, device).parse()
+        result = bool(thunk())
+    except _Missing as e:
+        return False, (
+            f"attribute {e.attribute!r} absent on device"
+            if e.attribute else "referenced attribute absent on device"
+        )
+    except _TypeMismatch as e:
+        return False, str(e)
+    except _EvalError as e:
+        return False, str(e) or "evaluation error"
+    except CelError as e:
+        if not e.expression:
+            raise CelError(
+                f"{e} in expression {expression!r}", expression=expression
+            ) from e
+        raise
+    return result, ""
+
+
 def evaluate(
     expression: str,
     driver: str,
@@ -373,9 +430,4 @@ def evaluate(
     """Evaluate a selector expression against one device. Returns False when
     the expression (irrecoverably) references attributes the device doesn't
     carry."""
-    device = _Device(driver, attributes, capacity or {})
-    thunk = _Parser(_tokenize(expression), driver, device).parse()
-    try:
-        return bool(thunk())
-    except _EvalError:
-        return False
+    return evaluate_detailed(expression, driver, attributes, capacity)[0]

@@ -67,7 +67,55 @@ def collect_live(http_url: str, timeout: float = 3.0) -> dict[str, Any]:
     out["queuedSliceRepublish"] = bool(queued)
     if queued:
         out["queuedSliceRepublishDetail"] = queued
+    out.update(_collect_unsat_allocations(http_url, timeout))
     return out
+
+
+def _collect_unsat_allocations(
+    http_url: str, timeout: float, keep: int = 5
+) -> dict[str, Any]:
+    """Recent unallocatable solve decisions from ``/debug/allocations``,
+    each mapped to its runbook hint — the "why won't my claim schedule?"
+    answer, live. The endpoint 404s on processes that don't run the
+    allocator (plain node plugins); absence is normal and yields
+    nothing. Any OTHER failure (500 from a raising provider, timeout) is
+    surfaced, not swallowed — silence must mean "no unsat claims", never
+    "couldn't look" (same split as doctor.collect_node)."""
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+            http_url.rstrip("/") + "/debug/allocations", timeout=timeout
+        ) as resp:
+            text = resp.read().decode()
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return {}
+        return {"unsatAllocationsError": f"HTTP {e.code}"}
+    except Exception as e:
+        return {"unsatAllocationsError": str(e) or type(e).__name__}
+    from ..kube.allocator import RUNBOOK_HINTS
+
+    unsat = []
+    for line in text.splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if not isinstance(rec, dict) or rec.get("outcome") == "ok":
+            continue
+        claim = rec.get("claim") or {}
+        reason = rec.get("reason") or "?"
+        unsat.append({
+            "claim": f"{claim.get('namespace', '?')}/"
+                     f"{claim.get('name', '?')}",
+            "uid": claim.get("uid", ""),
+            "reason": reason,
+            "detail": rec.get("detail", ""),
+            "hint": RUNBOOK_HINTS.get(reason, ""),
+        })
+    return {"unsatAllocations": unsat[-keep:]} if unsat else {}
 
 
 def collect(
@@ -282,6 +330,25 @@ def render(state: dict[str, Any]) -> str:
                 )
             for check in live.get("checks", []):
                 lines.append(f"  {check}")
+            if live.get("unsatAllocationsError"):
+                lines.append(
+                    "  /debug/allocations scrape FAILED "
+                    f"({live['unsatAllocationsError']}) — unallocatable-"
+                    "claim view unavailable, NOT known-empty"
+                )
+            unsat = live.get("unsatAllocations") or []
+            if unsat:
+                lines.append("")
+                lines.append(
+                    f"recent unallocatable claims: {len(unsat)}"
+                )
+                for u in unsat:
+                    lines.append(
+                        f"  {u['claim']}: {u['reason']} — "
+                        f"{u.get('detail') or 'no detail'}"
+                    )
+                    if u.get("hint"):
+                        lines.append(f"    runbook: {u['hint']}")
     return "\n".join(lines)
 
 

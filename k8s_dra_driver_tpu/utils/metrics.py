@@ -324,6 +324,9 @@ class MetricsServer:
     ``/debug/traces`` streams the tracer's finished claim traces as JSONL.
     ``/debug/usage`` serves the utilization accountant's JSON snapshot
     when a provider was registered with ``set_usage_provider`` (404
+    otherwise). ``/debug/allocations`` streams the allocator's solve
+    decisions (candidate funnels, terminal reasons) as JSONL when a
+    provider was registered with ``set_allocations_provider`` (404
     otherwise). All routes are GET-only; other methods get ``405`` with
     an ``Allow: GET`` header — the scrape surface mutates nothing.
     """
@@ -333,6 +336,7 @@ class MetricsServer:
         self.registry = registry
         self.tracer = tracer
         self.usage_provider: Optional[Callable] = None
+        self.allocations_provider: Optional[Callable] = None
         registry_ref = registry
         health = self._health = {"ok": True}
         self._ready_checks: dict[str, Callable] = {}
@@ -373,6 +377,22 @@ class MetricsServer:
                             ctype = "application/json"
                         except Exception as e:
                             body = f"usage snapshot failed: {e}\n".encode()
+                            status = 500
+                            ctype = "text/plain"
+                elif self.path == "/debug/allocations":
+                    provider = server_ref.allocations_provider
+                    if provider is None:
+                        body = b"allocation explainability not enabled\n"
+                        status = 404
+                        ctype = "text/plain"
+                    else:
+                        try:
+                            body = provider().encode()
+                            ctype = "application/x-ndjson"
+                        except Exception as e:
+                            body = (
+                                f"allocations snapshot failed: {e}\n"
+                            ).encode()
                             status = 500
                             ctype = "text/plain"
                 elif self.path == "/healthz":
@@ -468,6 +488,12 @@ class MetricsServer:
         """Serve ``provider()`` (a JSON-serializable dict) at
         ``/debug/usage``. Safe to call after ``start()``."""
         self.usage_provider = provider
+
+    def set_allocations_provider(self, provider: Callable) -> None:
+        """Serve ``provider()`` (a JSONL string, e.g.
+        ``ReferenceAllocator.export_allocations_jsonl``) at
+        ``/debug/allocations``. Safe to call after ``start()``."""
+        self.allocations_provider = provider
 
     def add_readiness_check(self, name: str, check: Callable,
                             critical: bool = True) -> None:

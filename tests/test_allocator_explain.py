@@ -1,0 +1,492 @@
+"""Allocation explainability: every funnel stage independently observable.
+
+For each forced rejection cause — reserved device, failing DeviceClass
+CEL, failing request selector, exhausted counter set, matchAttribute
+conflict, fragmented gang, unknown allocationMode, misconfigured slice,
+malformed CEL, backtrack budget — the cluster sim is driven into it and
+the IDENTICAL terminal reason must appear in
+
+  (a) the raised ``AllocationError.explanation`` (and ``.reason``),
+  (b) the ``tpu_dra_alloc_unsat_total{reason=...}`` metric, and
+  (c) the newest ``/debug/allocations`` record, scraped over real HTTP.
+
+Plus: successes keep a compact funnel, the solve latency histogram
+moves, and unsatisfiable claims surface as one deduped
+``UnsatisfiableClaim`` Kubernetes Event.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from k8s_dra_driver_tpu.kube import EVENTS, NODES, FakeKubeClient
+from k8s_dra_driver_tpu.kube.allocator import (
+    REASONS,
+    RUNBOOK_HINTS,
+    STAGES,
+    AllocationError,
+    ReferenceAllocator,
+    Selector,
+)
+from k8s_dra_driver_tpu.kube.events import EventRecorder
+from k8s_dra_driver_tpu.kube.resourceslice import (
+    DriverResources,
+    Pool,
+    ResourceSliceController,
+)
+from k8s_dra_driver_tpu.tpulib import FakeChipLib
+from k8s_dra_driver_tpu.utils.metrics import MetricsServer, Registry
+
+DRIVER = "tpu.google.com"
+
+
+def publish_host(client, node, *, topology="2x1x1", host_id=0,
+                 hosts_per_slice=1, slice_id="s1", mutate=None):
+    """One node pool published through the real controller path.
+    ``mutate(devices, counters)`` lets a test corrupt the slice before
+    publication (the invalid-slice stage)."""
+    from k8s_dra_driver_tpu.tpulib.deviceinfo import counter_sets
+
+    client.create(NODES, {"metadata": {"name": node, "uid": f"u-{node}"}})
+    lib = FakeChipLib(
+        generation="v5p", topology=topology, host_id=host_id,
+        hosts_per_slice=hosts_per_slice, slice_id=slice_id,
+    )
+    allocatable = lib.enumerate_all_possible_devices({"chip", "tensorcore"})
+    devices = [dev.get_device() for _, dev in sorted(allocatable.items())]
+    counters = counter_sets(allocatable)
+    if mutate is not None:
+        devices, counters = mutate(devices, counters)
+    ctrl = ResourceSliceController(
+        client, DRIVER, scope=node,
+        owner={"kind": "Node", "name": node, "uid": f"u-{node}"},
+    )
+    ctrl.update(DriverResources(pools={
+        node: Pool(devices=devices, shared_counters=counters,
+                   node_name=node),
+    }))
+    ctrl.sync_once()
+
+
+def chip_claim(uid, count=1, name=None, selectors=None, mode=None,
+               constraints=None, device_class=DRIVER):
+    req = {"name": "r0", "deviceClassName": device_class}
+    if mode is not None:
+        req["allocationMode"] = mode
+    else:
+        req["count"] = count
+    if selectors is not None:
+        req["selectors"] = selectors
+    return {
+        "metadata": {"name": name or f"claim-{uid}", "namespace": "explain",
+                     "uid": uid},
+        "spec": {"devices": {"requests": [req],
+                             "constraints": constraints or []}},
+    }
+
+
+def assert_unsat_triple(alloc, registry, claim, want_reason,
+                        selectors=None):
+    """The acceptance contract: the same terminal reason in the
+    exception+explanation, the unsat metric, and the newest
+    /debug/allocations record served over HTTP."""
+    before = alloc._m_unsat.value(reason=want_reason)
+    with pytest.raises(AllocationError) as ei:
+        alloc.allocate(claim, selectors=selectors)
+    e = ei.value
+    # (a) the exception and its structured explanation
+    assert e.reason == want_reason
+    assert e.explanation is not None
+    assert e.explanation.outcome == "unsat"
+    assert e.explanation.reason == want_reason
+    assert want_reason in REASONS
+    # (b) the metric, by exact label
+    assert alloc._m_unsat.value(reason=want_reason) == before + 1
+    text = registry.render()
+    assert f'tpu_dra_alloc_unsat_total{{reason="{want_reason}"}}' in text
+    # (c) the newest /debug/allocations record, over real HTTP
+    srv = MetricsServer(registry, host="127.0.0.1", port=0)
+    srv.set_allocations_provider(alloc.export_allocations_jsonl)
+    srv.start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/debug/allocations"
+        ).read().decode()
+    finally:
+        srv.stop()
+    lines = [ln for ln in body.splitlines() if ln]
+    assert lines, "no decisions served"
+    newest = json.loads(lines[-1])
+    assert newest["outcome"] == "unsat"
+    assert newest["reason"] == want_reason
+    assert newest["claim"]["uid"] == claim["metadata"]["uid"]
+    return e.explanation, newest
+
+
+class TestFunnelStages:
+    def test_reserved_device_is_terminal_reason(self):
+        client = FakeKubeClient()
+        publish_host(client, "node-0")
+        reg = Registry()
+        alloc = ReferenceAllocator(client, registry=reg)
+        alloc.allocate(chip_claim("uid-holder", count=2))
+        expl, rec = assert_unsat_triple(
+            alloc, reg, chip_claim("uid-blocked"), "reserved",
+        )
+        funnel = rec["funnels"][0]
+        assert funnel["rejected"]["reserved"] == 2
+        assert any("held by claim uid-holder" in s
+                   for s in funnel["reasons"]["reserved"])
+
+    def test_failing_deviceclass_cel(self):
+        client = FakeKubeClient()
+        publish_host(client, "node-0")
+        reg = Registry()
+        alloc = ReferenceAllocator(
+            client, registry=reg,
+            device_classes={DRIVER: [
+                "device.attributes['tpu.google.com'].type == 'gpu'",
+            ]},
+        )
+        expl, rec = assert_unsat_triple(
+            alloc, reg, chip_claim("uid-class"), "class-cel",
+        )
+        samples = rec["funnels"][0]["reasons"]["class-cel"]
+        # The mismatch diagnostic names the offending expression.
+        assert any("cel:mismatch expr=" in s and "'gpu'" in s
+                   for s in samples)
+
+    def test_failing_request_selector(self):
+        client = FakeKubeClient()
+        publish_host(client, "node-0")
+        reg = Registry()
+        alloc = ReferenceAllocator(client, registry=reg)
+        claim = chip_claim("uid-sel", selectors=[{
+            "cel": {"expression":
+                    "device.attributes['tpu.google.com'].type == "
+                    "'optical'"},
+        }])
+        expl, rec = assert_unsat_triple(alloc, reg, claim, "request-cel")
+        samples = rec["funnels"][0]["reasons"]["request-cel"]
+        assert any("'optical'" in s for s in samples)
+
+    def test_absent_attribute_named_in_mismatch(self):
+        """A typo'd attribute name reads as 'attribute absent', not as a
+        silent non-match — the diagnostic an operator greps for."""
+        client = FakeKubeClient()
+        publish_host(client, "node-0")
+        reg = Registry()
+        alloc = ReferenceAllocator(client, registry=reg)
+        claim = chip_claim("uid-typo", selectors=[{
+            "cel": {"expression":
+                    "device.attributes['tpu.google.com'].iciQ == 0"},
+        }])
+        expl, rec = assert_unsat_triple(alloc, reg, claim, "request-cel")
+        samples = rec["funnels"][0]["reasons"]["request-cel"]
+        assert any("attribute 'iciQ' absent" in s for s in samples)
+
+    def test_exhausted_counter_set(self):
+        client = FakeKubeClient()
+        publish_host(client, "node-0")
+        reg = Registry()
+        alloc = ReferenceAllocator(client, registry=reg)
+        alloc.allocate(chip_claim("uid-whole", count=2))  # whole chips
+        core = chip_claim(
+            "uid-core", device_class="tensorcore.tpu.google.com",
+        )
+        expl, rec = assert_unsat_triple(alloc, reg, core, "counters")
+        samples = rec["funnels"][0]["reasons"]["counters"]
+        assert any(s.startswith("counters:") and "used" in s
+                   for s in samples)
+
+    def test_match_attribute_conflict(self):
+        client = FakeKubeClient()
+        publish_host(client, "node-a", topology="1x1x1", slice_id="s-a")
+        publish_host(client, "node-b", topology="1x1x1", slice_id="s-b")
+        reg = Registry()
+        alloc = ReferenceAllocator(client, registry=reg)
+        claim = chip_claim("uid-gang", count=2, constraints=[{
+            "requests": ["r0"],
+            "matchAttribute": "tpu.google.com/sliceId",
+        }])
+        expl, rec = assert_unsat_triple(alloc, reg, claim, "constraint")
+        samples = rec["funnels"][0]["reasons"]["constraint"]
+        assert any("constraint:" in s for s in samples)
+
+    def test_fragmented_gang(self):
+        client = FakeKubeClient()
+        publish_host(client, "node-0", topology="4x1x1")
+        reg = Registry()
+        alloc = ReferenceAllocator(client, registry=reg)
+        # Hold the two middle chips; the free corners cannot form a
+        # contiguous 2-gang.
+        for i, coord in enumerate(("1,0,0", "2,0,0")):
+            alloc.allocate(
+                chip_claim(f"uid-mid-{i}"),
+                selectors={"r0": [Selector("coord", "eq", coord)]},
+            )
+        expl, rec = assert_unsat_triple(
+            alloc, reg, chip_claim("uid-frag", count=2), "gang",
+        )
+        samples = rec["funnels"][0]["reasons"]["gang"]
+        assert any("non-contiguous" in s for s in samples)
+
+    def test_intra_claim_contention_reads_reserved(self):
+        """Two requests of ONE claim over-subscribing the node: the
+        terminal reason is `reserved` with a held-by-request sample —
+        not whatever filter stage happened to reject unrelated devices
+        (which once misdiagnosed this as `class-cel`)."""
+        client = FakeKubeClient()
+        publish_host(client, "node-0", topology="2x2x1")  # 4 chips
+        reg = Registry()
+        alloc = ReferenceAllocator(client, registry=reg)
+        claim = chip_claim("uid-contend", count=2)
+        claim["spec"]["devices"]["requests"].append(
+            {"name": "r1", "deviceClassName": DRIVER, "count": 3},
+        )
+        expl, rec = assert_unsat_triple(alloc, reg, claim, "reserved")
+        funnel = next(f for f in rec["funnels"] if f["request"] == "r1")
+        assert any("of this claim" in s
+                   for s in funnel["reasons"]["reserved"])
+
+    def test_gang_rejections_bounded_by_inventory(self):
+        """Gang rejections count devices, not failing combinations: a
+        checkerboard-fragmented 4x4 mesh explores C(8,2)=28 doomed
+        pairs, but the funnel (and the rejections metric feeding off
+        it) must stay bounded by the surviving inventory."""
+        client = FakeKubeClient()
+        publish_host(client, "node-0", topology="4x4x1")
+        reg = Registry()
+        alloc = ReferenceAllocator(client, registry=reg)
+        held = 0
+        for x in range(4):
+            for y in range(4):
+                if (x + y) % 2 == 0:
+                    continue  # free the even checkerboard cells
+                alloc.allocate(
+                    chip_claim(f"uid-cb-{x}{y}"),
+                    selectors={"r0": [
+                        Selector("coord", "eq", f"{x},{y},0"),
+                    ]},
+                )
+                held += 1
+        assert held == 8
+        expl, rec = assert_unsat_triple(
+            alloc, reg, chip_claim("uid-pair", count=2), "gang",
+        )
+        funnel = next(f for f in rec["funnels"] if f["request"] == "r0")
+        assert funnel["rejected"]["gang"] <= funnel["survivors"] == 8
+
+    def test_invalid_slice(self):
+        def corrupt(devices, counters):
+            # A counter NAME the declared set never carries: passes the
+            # apiserver's schema floor (which cross-checks set names
+            # only) but is a misconfigured slice to the allocator.
+            bad = {
+                "name": "ghost-chip",
+                "basic": {
+                    "attributes": {"type": {"string": "chip"}},
+                    "consumesCounters": [{
+                        "counterSet": "cs",
+                        "counters": {"ghostCores": {"value": "1"}},
+                    }],
+                },
+            }
+            shared = [{
+                "name": "cs",
+                "counters": {"cores": {"value": "4"}},
+            }]
+            return [bad], shared
+
+        client = FakeKubeClient()
+        publish_host(client, "node-0", mutate=corrupt)
+        reg = Registry()
+        alloc = ReferenceAllocator(client, registry=reg)
+        expl, rec = assert_unsat_triple(
+            alloc, reg, chip_claim("uid-bad"), "invalid-slice",
+        )
+        assert rec["funnels"][0]["rejected"]["invalid-slice"] == 1
+
+    def test_unknown_allocation_mode(self):
+        client = FakeKubeClient()
+        publish_host(client, "node-0")
+        reg = Registry()
+        alloc = ReferenceAllocator(client, registry=reg)
+        assert_unsat_triple(
+            alloc, reg, chip_claim("uid-mode", mode="BestEffort"),
+            "unknown-mode",
+        )
+
+    def test_unknown_device_class(self):
+        client = FakeKubeClient()
+        publish_host(client, "node-0")
+        reg = Registry()
+        alloc = ReferenceAllocator(client, registry=reg)
+        assert_unsat_triple(
+            alloc, reg,
+            chip_claim("uid-cls", device_class="gpu.example.com"),
+            "unknown-class",
+        )
+
+    def test_malformed_cel_names_expression(self):
+        client = FakeKubeClient()
+        publish_host(client, "node-0")
+        reg = Registry()
+        alloc = ReferenceAllocator(client, registry=reg)
+        claim = chip_claim("uid-syntax", selectors=[{
+            "cel": {"expression": "device.attributes["},
+        }])
+        with pytest.raises(AllocationError) as ei:
+            alloc.allocate(claim)
+        assert ei.value.reason == "cel-error"
+        # The error points at WHICH expression failed.
+        assert "device.attributes[" in str(ei.value)
+        assert alloc.recent_decisions()[-1]["reason"] == "cel-error"
+        assert reg.render().count(
+            'tpu_dra_alloc_unsat_total{reason="cel-error"} 1'
+        ) == 1
+
+    def test_shortfall_when_fewer_devices_than_requested(self):
+        client = FakeKubeClient()
+        publish_host(client, "node-0")
+        reg = Registry()
+        alloc = ReferenceAllocator(client, registry=reg)
+        expl, rec = assert_unsat_triple(
+            alloc, reg, chip_claim("uid-many", count=5), "shortfall",
+        )
+        assert "only 2 of 5" in rec["detail"]
+
+    def test_backtrack_budget(self):
+        client = FakeKubeClient()
+        publish_host(client, "node-0", topology="4x1x1")
+        reg = Registry()
+        alloc = ReferenceAllocator(
+            client, registry=reg, max_backtrack_steps=1,
+        )
+        claim = chip_claim("uid-budget", count=2)
+        corners = Selector("coord", "in", ["0,0,0", "3,0,0"])
+        expl, rec = assert_unsat_triple(
+            alloc, reg, claim, "backtrack-budget",
+            selectors={"r0": [corners]},
+        )
+        assert rec["backtracks"] >= 1
+
+    def test_backtrack_budget_env_override(self, monkeypatch):
+        monkeypatch.setenv("TPU_DRA_MAX_BACKTRACK_STEPS", "7")
+        alloc = ReferenceAllocator(FakeKubeClient())
+        assert alloc.max_backtrack_steps == 7
+
+    def test_all_mode_with_reserved_devices(self):
+        client = FakeKubeClient()
+        publish_host(client, "node-0")
+        reg = Registry()
+        alloc = ReferenceAllocator(client, registry=reg)
+        alloc.allocate(chip_claim("uid-one", selectors=[{
+            "cel": {"expression":
+                    "device.attributes['tpu.google.com'].iciX == 0"},
+        }]))
+        assert_unsat_triple(
+            alloc, reg, chip_claim("uid-all", mode="All"), "reserved",
+        )
+
+
+class TestDecisionRecord:
+    def test_success_keeps_compact_funnel(self):
+        client = FakeKubeClient()
+        publish_host(client, "node-0")
+        reg = Registry()
+        alloc = ReferenceAllocator(client, registry=reg)
+        alloc.allocate(chip_claim("uid-ok", count=2))
+        rec = alloc.recent_decisions()[-1]
+        assert rec["outcome"] == "ok"
+        assert rec["reason"] == ""
+        funnel = rec["funnels"][0]
+        assert funnel["entering"] > 0
+        assert funnel["survivors"] == 2
+        # Compact: counts survive, per-device samples are dropped.
+        assert funnel["rejected"].get("class-cel", 0) > 0
+        assert funnel["reasons"] == {}
+        assert rec["durationSeconds"] >= 0
+        assert "class-cel" in rec["stageSeconds"]
+        n, _ = alloc._m_solve_seconds.summary()
+        assert n == 1
+
+    def test_ring_buffer_is_bounded(self, monkeypatch):
+        monkeypatch.setenv("TPU_DRA_ALLOC_DECISION_BUFFER", "3")
+        client = FakeKubeClient()
+        publish_host(client, "node-0")
+        alloc = ReferenceAllocator(client)
+        for i in range(5):
+            with pytest.raises(AllocationError):
+                alloc.allocate(chip_claim(f"uid-{i}", count=99))
+        recs = alloc.recent_decisions()
+        assert len(recs) == 3
+        assert recs[-1]["claim"]["uid"] == "uid-4"
+
+    def test_stage_and_reason_values_confined_to_enums(self):
+        """Every stage/reason value that can reach a metric label or a
+        record is declared in the allocator's enums (the TPM06 / docs
+        contract)."""
+        client = FakeKubeClient()
+        publish_host(client, "node-0")
+        reg = Registry()
+        alloc = ReferenceAllocator(client, registry=reg)
+        alloc.allocate(chip_claim("uid-ok"))
+        with pytest.raises(AllocationError):
+            alloc.allocate(chip_claim("uid-no", count=99))
+        for rec in alloc.recent_decisions():
+            if rec["reason"]:
+                assert rec["reason"] in REASONS
+            for funnel in rec["funnels"]:
+                assert set(funnel["rejected"]) <= set(STAGES)
+        assert set(RUNBOOK_HINTS) == set(REASONS)
+
+    def test_funnel_rejections_metric_by_stage(self):
+        client = FakeKubeClient()
+        publish_host(client, "node-0")
+        reg = Registry()
+        alloc = ReferenceAllocator(client, registry=reg)
+        alloc.allocate(chip_claim("uid-a", count=2))
+        # 4 tensorcores rejected at class-cel while allocating chips.
+        assert alloc._m_funnel_rejections.value(stage="class-cel") >= 4
+
+
+class TestUnsatisfiableClaimEvent:
+    def test_event_emitted_and_deduped(self):
+        client = FakeKubeClient()
+        publish_host(client, "node-0")
+        recorder = EventRecorder(
+            client, component="scheduler-sim", registry=Registry(),
+        )
+        alloc = ReferenceAllocator(
+            client, registry=Registry(), recorder=recorder,
+        )
+        claim = chip_claim("uid-ev", count=99, name="wl-stuck")
+        for _ in range(2):
+            with pytest.raises(AllocationError):
+                alloc.allocate(claim)
+        assert recorder.flush()
+        events = client.list(EVENTS, namespace="explain")
+        unsat = [e for e in events if e["reason"] == "UnsatisfiableClaim"]
+        assert len(unsat) == 1  # deduped, not flooded
+        ev = unsat[0]
+        assert ev["type"] == "Warning"
+        assert ev["count"] == 2
+        assert ev["involvedObject"]["name"] == "wl-stuck"
+        assert "only 2 of 99" in ev["message"]
+        # The event carries the operator's next move.
+        assert RUNBOOK_HINTS["shortfall"] in ev["message"]
+
+    def test_success_emits_no_event(self):
+        client = FakeKubeClient()
+        publish_host(client, "node-0")
+        recorder = EventRecorder(
+            client, component="scheduler-sim", registry=Registry(),
+        )
+        alloc = ReferenceAllocator(
+            client, registry=Registry(), recorder=recorder,
+        )
+        alloc.allocate(chip_claim("uid-fine"))
+        assert recorder.flush()
+        assert client.list(EVENTS, namespace="explain") == []

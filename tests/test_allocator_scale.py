@@ -209,3 +209,43 @@ class TestAllocatorScale:
         with pytest.raises(AllocationError):
             frag.allocate(claim, selectors={"pair": [corners]})
         assert frag._m_backtracks.value() > 0
+
+    def test_cel_memo_keeps_evaluations_linear(self, monkeypatch):
+        """The per-solve (expression, device) memo: a 4-chip gang over
+        the 192-device inventory with a one-expression DeviceClass must
+        evaluate CEL at most once per (expression, device) — before the
+        memo, every backtrack probe re-entered candidates() and re-ran
+        the expression against every device."""
+        import k8s_dra_driver_tpu.kube.allocator as allocator_mod
+
+        calls = {"n": 0}
+        real = allocator_mod.cel_evaluate_detailed
+
+        def counting(*args, **kwargs):
+            calls["n"] += 1
+            return real(*args, **kwargs)
+
+        monkeypatch.setattr(
+            allocator_mod, "cel_evaluate_detailed", counting
+        )
+        client = FakeKubeClient()
+        publish_cluster(client)
+        class_expr = "device.attributes['tpu.google.com'].type == 'chip'"
+        alloc = ReferenceAllocator(
+            client, driver_name=DRIVER,
+            device_classes={DRIVER: [class_expr]},
+        )
+        claim = gang_claim(
+            "uid-memo", 4, match="tpu.google.com/submesh2x2Id"
+        )
+        alloc.allocate(claim)
+        n_devices = 64 + 128  # chips + core partitions over 16 hosts
+        assert calls["n"] <= n_devices, (
+            f"{calls['n']} CEL evaluations for {n_devices} devices: "
+            "the per-solve memo is not being consulted"
+        )
+        # The decision record exposes the same number, so memo
+        # regressions are visible from /debug/allocations too.
+        rec = alloc.recent_decisions()[-1]
+        assert rec["celEvaluations"] == calls["n"]
+        assert rec["celEvaluations"] <= n_devices

@@ -15,7 +15,11 @@ from k8s_dra_driver_tpu.kube.allocator import (
     AllocationError,
     ReferenceAllocator,
 )
-from k8s_dra_driver_tpu.kube.cel import CelError, evaluate
+from k8s_dra_driver_tpu.kube.cel import (
+    CelError,
+    evaluate,
+    evaluate_detailed,
+)
 from k8s_dra_driver_tpu.kube.resourceslice import (
     DriverResources,
     Pool,
@@ -172,6 +176,52 @@ class TestCelEvaluator:
             ev("device.attributes[")
         with pytest.raises(CelError):
             ev("frobnicate == 1")
+
+
+class TestEvaluateDetailed:
+    """evaluate_detailed returns (matched, why_not): the diagnostic the
+    allocation explainer threads into per-device rejection reasons, and
+    CelError carries the offending expression (a claim can hold several
+    selectors; "invalid CEL selector" alone doesn't say which one)."""
+
+    def test_match_and_plain_non_match_have_no_diagnostic(self):
+        assert evaluate_detailed(
+            "device.attributes['tpu.google.com'].type == 'chip'",
+            DRIVER, ATTRS,
+        ) == (True, "")
+        # A boolean non-match is not an error: no why_not.
+        assert evaluate_detailed(
+            "device.attributes['tpu.google.com'].type == 'tensorcore'",
+            DRIVER, ATTRS,
+        ) == (False, "")
+
+    def test_absent_attribute_is_named(self):
+        ok, why = evaluate_detailed(
+            "device.attributes['tpu.google.com'].iciQ == 0",
+            DRIVER, ATTRS,
+        )
+        assert ok is False
+        assert "attribute 'iciQ' absent" in why
+
+    def test_type_mismatch_names_the_overload(self):
+        ok, why = evaluate_detailed(
+            "device.attributes['tpu.google.com'].generation >= 16",
+            DRIVER, ATTRS,
+        )
+        assert ok is False
+        assert "no matching overload" in why
+
+    def test_malformed_expression_carries_source(self):
+        with pytest.raises(CelError) as ei:
+            evaluate_detailed("device.attributes[", DRIVER, ATTRS)
+        assert ei.value.expression == "device.attributes["
+        assert "device.attributes[" in str(ei.value)
+
+    def test_unknown_identifier_carries_source(self):
+        with pytest.raises(CelError) as ei:
+            evaluate_detailed("frobnicate == 1", DRIVER, ATTRS)
+        assert ei.value.expression == "frobnicate == 1"
+        assert "frobnicate" in str(ei.value)
 
 
 def load_device_classes():

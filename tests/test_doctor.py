@@ -294,6 +294,89 @@ class TestUsageScrapeFailure:
                    for f in findings)
 
 
+class TestExplainCheck:
+    """The `explain` cross-check: unsatisfiable solve decisions from
+    /debug/allocations become findings carrying the runbook hint —
+    unless the claim has since been allocated (stale history)."""
+
+    @staticmethod
+    def _scrape(name="node-a", uid="uid-stuck", outcome="unsat",
+                reason="gang"):
+        scrape = doctor.NodeScrape(name=name, url="http://x")
+        scrape.allocations_text = json.dumps({
+            "outcome": outcome,
+            "reason": reason,
+            "detail": "request 'r0': 1 candidate(s) rejected at "
+                      "stage 'gang'",
+            "claim": {"uid": uid, "namespace": "ns", "name": "wl-stuck"},
+        }) + "\n"
+        return scrape
+
+    def test_unsat_record_is_flagged_with_runbook_hint(self):
+        from k8s_dra_driver_tpu.kube.allocator import RUNBOOK_HINTS
+
+        findings = doctor.fleet_findings(
+            [self._scrape()],
+            {"resourceSlices": [], "resourceClaims": []},
+            DRIVER,
+        )
+        explain = [f for f in findings if f.check == "explain"]
+        assert len(explain) == 1
+        f = explain[0]
+        assert f.severity == doctor.SEVERITY_DRIFT
+        assert f.subject == "ns/wl-stuck"
+        assert "'gang'" in f.detail
+        assert RUNBOOK_HINTS["gang"] in f.detail
+
+    def test_since_allocated_claim_is_stale_history(self):
+        cluster = {
+            "resourceSlices": [],
+            "resourceClaims": [{
+                "metadata": {"uid": "uid-stuck", "namespace": "ns",
+                             "name": "wl-stuck"},
+                "status": {"allocation": {"devices": {"results": []}}},
+            }],
+        }
+        findings = doctor.fleet_findings(
+            [self._scrape()], cluster, DRIVER,
+        )
+        assert not any(f.check == "explain" for f in findings)
+
+    def test_same_decision_on_two_nodes_reported_once(self):
+        # In the sim several nodes serve the same scheduler's buffer.
+        findings = doctor.fleet_findings(
+            [self._scrape("node-a"), self._scrape("node-b")],
+            {"resourceSlices": [], "resourceClaims": []},
+            DRIVER,
+        )
+        assert sum(f.check == "explain" for f in findings) == 1
+
+    def test_successful_solves_are_not_findings(self):
+        findings = doctor.fleet_findings(
+            [self._scrape(outcome="ok", reason="")],
+            {"resourceSlices": [], "resourceClaims": []},
+            DRIVER,
+        )
+        assert not any(f.check == "explain" for f in findings)
+
+    def test_without_kube_every_unsat_surfaces(self):
+        findings = doctor.fleet_findings([self._scrape()], None, DRIVER)
+        assert any(f.check == "explain" for f in findings)
+
+    def test_undecodable_lines_degrade_not_abort(self):
+        scrape = doctor.NodeScrape(name="node-a", url="http://x")
+        scrape.allocations_text = "not json\n" + json.dumps({
+            "outcome": "unsat", "reason": "reserved",
+            "detail": "held", "claim": {"uid": "u", "namespace": "ns",
+                                        "name": "wl"},
+        }) + "\n"
+        findings = doctor.fleet_findings(
+            [scrape], {"resourceSlices": [], "resourceClaims": []},
+            DRIVER,
+        )
+        assert sum(f.check == "explain" for f in findings) == 1
+
+
 class TestRenderDefensive:
     def test_malformed_hold_degrades_report_not_run(self):
         """A version-skewed plugin's snapshot missing device fields must

@@ -198,3 +198,85 @@ class TestHealthAndLiveFields:
         )
         assert "error" in out["live"]
         assert "UNREACHABLE" in render(out)
+
+    def test_live_unsat_allocations_render_with_hint(self, tmp_path):
+        """--http-url against a process serving /debug/allocations:
+        recent unallocatable claims render with their terminal reason
+        and the runbook hint (the live "why won't my claim schedule?"
+        view)."""
+        from k8s_dra_driver_tpu.kube.allocator import RUNBOOK_HINTS
+        from k8s_dra_driver_tpu.utils.metrics import MetricsServer, Registry
+
+        srv = MetricsServer(Registry(), host="127.0.0.1", port=0)
+        srv.add_readiness_check("grpc-serving", lambda: (True, "ok"))
+        records = [
+            {"outcome": "ok", "reason": "", "claim":
+                {"uid": "u-ok", "namespace": "ns", "name": "wl-ok"}},
+            {"outcome": "unsat", "reason": "gang",
+             "detail": "non-contiguous coords",
+             "claim": {"uid": "u-frag", "namespace": "ns",
+                       "name": "wl-frag"}},
+        ]
+        srv.set_allocations_provider(lambda: "".join(
+            json.dumps(r) + "\n" for r in records
+        ))
+        srv.start()
+        try:
+            out = collect(
+                str(tmp_path), str(tmp_path / "cdi"),
+                http_url=f"http://127.0.0.1:{srv.port}",
+            )
+            unsat = out["live"]["unsatAllocations"]
+            assert [u["claim"] for u in unsat] == ["ns/wl-frag"]
+            assert unsat[0]["reason"] == "gang"
+            assert unsat[0]["hint"] == RUNBOOK_HINTS["gang"]
+            text = render(out)
+            assert "recent unallocatable claims: 1" in text
+            assert "ns/wl-frag: gang — non-contiguous coords" in text
+            assert RUNBOOK_HINTS["gang"] in text
+        finally:
+            srv.stop()
+
+    def test_live_no_allocations_endpoint_is_quiet(self, tmp_path):
+        """A plain node plugin 404s /debug/allocations; the inspector
+        must not invent an empty section."""
+        from k8s_dra_driver_tpu.utils.metrics import MetricsServer, Registry
+
+        srv = MetricsServer(Registry(), host="127.0.0.1", port=0)
+        srv.add_readiness_check("grpc-serving", lambda: (True, "ok"))
+        srv.start()
+        try:
+            out = collect(
+                str(tmp_path), str(tmp_path / "cdi"),
+                http_url=f"http://127.0.0.1:{srv.port}",
+            )
+            assert "unsatAllocations" not in out["live"]
+            assert "unallocatable" not in render(out)
+        finally:
+            srv.stop()
+
+    def test_live_allocations_scrape_failure_is_loud(self, tmp_path):
+        """A 500 from /debug/allocations (raising provider) is NOT the
+        benign 404: the inspector must say it couldn't look rather than
+        imply there are no unallocatable claims."""
+        from k8s_dra_driver_tpu.utils.metrics import MetricsServer, Registry
+
+        def boom():
+            raise RuntimeError("provider exploded")
+
+        srv = MetricsServer(Registry(), host="127.0.0.1", port=0)
+        srv.add_readiness_check("grpc-serving", lambda: (True, "ok"))
+        srv.set_allocations_provider(boom)
+        srv.start()
+        try:
+            out = collect(
+                str(tmp_path), str(tmp_path / "cdi"),
+                http_url=f"http://127.0.0.1:{srv.port}",
+            )
+            assert out["live"]["unsatAllocationsError"] == "HTTP 500"
+            assert "unsatAllocations" not in out["live"]
+            text = render(out)
+            assert "/debug/allocations scrape FAILED (HTTP 500)" in text
+            assert "NOT known-empty" in text
+        finally:
+            srv.stop()

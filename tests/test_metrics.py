@@ -398,6 +398,48 @@ class TestMethodGuardAndUsage:
         finally:
             srv.stop()
 
+    def test_debug_allocations_serves_provider_jsonl(self):
+        import json
+
+        srv = MetricsServer(Registry(), host="127.0.0.1", port=0)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            # No provider -> 404 (processes that don't run the
+            # allocator simply don't have the surface).
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(f"{base}/debug/allocations")
+            assert exc_info.value.code == 404
+            records = [
+                {"outcome": "ok", "reason": ""},
+                {"outcome": "unsat", "reason": "gang"},
+            ]
+            srv.set_allocations_provider(lambda: "".join(
+                json.dumps(r) + "\n" for r in records
+            ))
+            resp = urllib.request.urlopen(f"{base}/debug/allocations")
+            assert resp.headers.get("Content-Type") == \
+                "application/x-ndjson"
+            lines = resp.read().decode().splitlines()
+            assert [json.loads(ln) for ln in lines] == records
+            # GET-only, like every other route on the scrape surface.
+            req = urllib.request.Request(
+                f"{base}/debug/allocations", method="POST", data=b"x",
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(req)
+            assert exc_info.value.code == 405
+            # A raising provider reads 500, not a dead handler thread.
+            def boom():
+                raise RuntimeError("ring buffer exploded")
+
+            srv.set_allocations_provider(boom)
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(f"{base}/debug/allocations")
+            assert exc_info.value.code == 500
+        finally:
+            srv.stop()
+
     def test_concurrent_scrapes(self):
         """/metrics and /debug/usage hammered concurrently: every
         response complete and parseable (the render hook + provider run

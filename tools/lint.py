@@ -34,6 +34,15 @@ Plus one first-party rule with no ruff analog:
   metrics and ``plugin/audit.py`` only ``tpu_dra_audit_*`` — each
   family's home module stays coherent, so the docs catalog and the
   verify-metrics coverage can reason per-module.
+- TPM06: ``stage=``/``reason=`` label values on the ``tpu_dra_alloc_*``
+  explainability families are confined to the ``STAGES``/``REASONS``
+  enums declared in ``kube/allocator.py`` (parsed by AST, not imported):
+  a constant outside the enum is a typo'd label that dashboards and the
+  docs/operations.md runbook would silently never match, and
+  non-constant values are only allowed inside ``allocator.py`` itself,
+  where the solver's control flow (and tests/test_allocator_explain.py)
+  confine them. The rule also fails if the enum tuples cannot be found —
+  renaming them without updating the lint is itself a finding.
 
 Exit status 1 when any finding is emitted, so `make lint` is a gate,
 not a suggestion.
@@ -253,6 +262,138 @@ def check_metric_conventions(tree: ast.Module, path: Path) -> list[Finding]:
     return out
 
 
+# TPM06: the alloc explainability families and their enum'd labels.
+_ALLOC_FAMILY_PREFIX = "tpu_dra_alloc"
+_ALLOC_ENUM_LABELS = {"stage": "STAGES", "reason": "REASONS"}
+_ALLOC_ENUMS_PATH = Path("k8s_dra_driver_tpu/kube/allocator.py")
+_alloc_enums_cache: dict[str, frozenset[str]] | None = None
+
+
+def _alloc_enums() -> dict[str, frozenset[str]]:
+    """{label name: allowed values} parsed from allocator.py's module-level
+    STAGES/REASONS tuple literals. Empty sets when the file or a tuple is
+    missing — the caller reports that as its own finding rather than
+    silently passing everything."""
+    global _alloc_enums_cache
+    if _alloc_enums_cache is not None:
+        return _alloc_enums_cache
+    values: dict[str, frozenset[str]] = {
+        label: frozenset() for label in _ALLOC_ENUM_LABELS
+    }
+    try:
+        tree = ast.parse(_ALLOC_ENUMS_PATH.read_text())
+    except OSError:
+        _alloc_enums_cache = values
+        return values
+    wanted = set(_ALLOC_ENUM_LABELS.values())
+    # Module-level string constants (STAGE_GANG = "gang", ...), so enum
+    # tuples may list either literals or those names.
+    consts: dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    consts[tgt.id] = node.value.value
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if not (isinstance(tgt, ast.Name) and tgt.id in wanted):
+                continue
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                vals = set()
+                for el in node.value.elts:
+                    if isinstance(el, ast.Constant) \
+                            and isinstance(el.value, str):
+                        vals.add(el.value)
+                    elif isinstance(el, ast.Name) and el.id in consts:
+                        vals.add(consts[el.id])
+                for label, enum_name in _ALLOC_ENUM_LABELS.items():
+                    if enum_name == tgt.id:
+                        values[label] = frozenset(vals)
+    _alloc_enums_cache = values
+    return values
+
+
+def _receiver_name(node: ast.AST) -> str:
+    """Terminal identifier of a metric receiver: ``self._m_unsat`` and
+    ``alloc._m_unsat`` both read ``_m_unsat``; a bare Name reads as is."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def check_alloc_label_enums(tree: ast.Module, path: Path) -> list[Finding]:
+    """TPM06: stage/reason label values on tpu_dra_alloc_* metrics are
+    confined to allocator.py's declared enums."""
+    # Metric objects bound from a constructor whose family name is
+    # tpu_dra_alloc_*: {terminal receiver name}.
+    alloc_receivers: set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        func = node.value.func
+        cls = func.id if isinstance(func, ast.Name) else (
+            func.attr if isinstance(func, ast.Attribute) else "")
+        if cls not in _METRIC_CLASSES or not node.value.args:
+            continue
+        name_arg = node.value.args[0]
+        if not (isinstance(name_arg, ast.Constant)
+                and isinstance(name_arg.value, str)
+                and name_arg.value.startswith(_ALLOC_FAMILY_PREFIX)):
+            continue
+        for tgt in node.targets:
+            recv = _receiver_name(tgt)
+            if recv:
+                alloc_receivers.add(recv)
+    if not alloc_receivers:
+        return []
+    enums = _alloc_enums()
+    out = []
+    if any(not vals for vals in enums.values()):
+        out.append(Finding(
+            path, 1, "TPM06",
+            f"cannot resolve {sorted(_ALLOC_ENUM_LABELS.values())} tuple "
+            f"literals in {_ALLOC_ENUMS_PATH} — the alloc label enums the "
+            "stage/reason labels are confined to"))
+        return out
+    # Full-path comparison: a future <other>/allocator.py must NOT
+    # inherit the computed-label exemption. Resolved against the repo
+    # root cwd, same assumption _alloc_enums() already makes.
+    in_allocator = path.resolve() == _ALLOC_ENUMS_PATH.resolve()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in _METRIC_METHODS
+                and _receiver_name(func.value) in alloc_receivers):
+            continue
+        for kw in node.keywords:
+            allowed = enums.get(kw.arg or "")
+            if allowed is None:
+                continue
+            if isinstance(kw.value, ast.Constant):
+                if kw.value.value not in allowed:
+                    out.append(Finding(
+                        path, node.lineno, "TPM06",
+                        f"label {kw.arg}={kw.value.value!r} not in "
+                        f"allocator.py's {_ALLOC_ENUM_LABELS[kw.arg]} "
+                        "enum"))
+            elif not in_allocator:
+                out.append(Finding(
+                    path, node.lineno, "TPM06",
+                    f"computed {kw.arg!r} label on a tpu_dra_alloc_* "
+                    "metric outside kube/allocator.py — enum confinement "
+                    "cannot be checked"))
+    return out
+
+
 def check_per_chip_labels(tree: ast.Module, path: Path) -> list[Finding]:
     """TPM04: per-chip metric labels only where series counts are bounded
     by the node's device inventory (accounting.py / audit.py)."""
@@ -295,6 +436,7 @@ def lint_file(path: Path) -> list[Finding]:
     if "k8s_dra_driver_tpu" in path.parts:
         out += check_metric_conventions(tree, path)
         out += check_per_chip_labels(tree, path)
+        out += check_alloc_label_enums(tree, path)
     return out
 
 

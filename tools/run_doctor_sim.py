@@ -12,9 +12,14 @@ prepares claims through the real DRA surface, then drives
 2. **drift phase**: an orphaned CDI claim spec and a corrupted
    checkpoint are injected (the exact artifacts the chaos harness
    produces); the node auditors and the doctor must BOTH flag them
-   (doctor exit 1).
+   (doctor exit 1);
+3. **explain phase**: an unallocatable claim (typo'd selector matching
+   nothing) must travel the whole explainability chain — typed
+   ``AllocationError`` reason → ``/debug/allocations`` record → the
+   doctor's ``explain`` finding carrying the runbook hint (exit
+   non-zero).
 
-Either phase misbehaving fails the gate — a doctor that cries wolf on a
+Any phase misbehaving fails the gate — a doctor that cries wolf on a
 clean fleet is as useless as one that misses real drift.
 """
 
@@ -36,7 +41,11 @@ from k8s_dra_driver_tpu.kube import (  # noqa: E402
     RESOURCE_CLAIMS,
     FakeKubeClient,
 )
-from k8s_dra_driver_tpu.kube.allocator import ReferenceAllocator  # noqa: E402
+from k8s_dra_driver_tpu.kube.allocator import (  # noqa: E402
+    RUNBOOK_HINTS,
+    AllocationError,
+    ReferenceAllocator,
+)
 from k8s_dra_driver_tpu.kube.protos import dra_v1alpha4_pb2 as drapb  # noqa: E402
 from k8s_dra_driver_tpu.plugin.driver import Driver, DriverConfig  # noqa: E402
 from k8s_dra_driver_tpu.tpulib import FakeChipLib  # noqa: E402
@@ -108,10 +117,13 @@ def claim_obj(uid, name):
     }
 
 
-def seed_claims(client, drivers):
+def seed_claims(client, drivers, alloc=None):
     """One allocated + prepared single-chip claim per node, auditors
-    brought current; returns {node: expected held device names}."""
-    alloc = ReferenceAllocator(client)
+    brought current; returns {node: expected held device names}.
+    ``alloc`` lets the caller share the scheduler-sim allocator whose
+    decision buffer the debug servers publish."""
+    if alloc is None:
+        alloc = ReferenceAllocator(client)
     expected = {}
     for i, node in enumerate(sorted(drivers)):
         claim = claim_obj(f"sim-uid-{i}", f"wl-{i}")
@@ -136,8 +148,15 @@ def main() -> int:
             drivers[name], servers[name] = start_node(client, tmp, name, i)
         mgr = IciSliceManager(client)
         mgr.start()
+        # The scheduler-sim allocator; its solve-decision buffer is
+        # published at every node's /debug/allocations so the doctor's
+        # `explain` cross-check sees it (in production this surface lives
+        # on whatever process runs the allocator).
+        alloc = ReferenceAllocator(client)
+        for srv in servers.values():
+            srv.set_allocations_provider(alloc.export_allocations_jsonl)
         try:
-            expected_holds = seed_claims(client, drivers)
+            expected_holds = seed_claims(client, drivers, alloc)
 
             urls = {
                 name: f"http://127.0.0.1:{srv.port}"
@@ -195,6 +214,44 @@ def main() -> int:
                     f"drift (status={status2}, findings="
                     f"{[str(f) for f in findings2]})"
                 )
+
+            # Phase 3: "why won't my claim schedule?" — a selector no
+            # published device satisfies must surface the SAME terminal
+            # reason in the AllocationError, the /debug/allocations
+            # record, and the doctor's explain finding (hint included).
+            bad = claim_obj("sim-uid-unsat", "wl-unsat")
+            bad["spec"]["devices"]["requests"][0]["selectors"] = [{
+                "cel": {"expression":
+                        "device.attributes['tpu.google.com'].type == "
+                        "'optical-interconnect'"},
+            }]
+            try:
+                alloc.allocate(bad)
+                failures.append("explain phase: unsat claim allocated")
+            except AllocationError as e:
+                if e.reason != "request-cel":
+                    failures.append(
+                        f"explain phase: terminal reason {e.reason!r}, "
+                        "want 'request-cel'"
+                    )
+            client.create(RESOURCE_CLAIMS, bad, namespace="sim")
+            report3, findings3, status3 = doctor.run(
+                urls, kube_client=client,
+            )
+            hint = RUNBOOK_HINTS["request-cel"]
+            if status3 == 0 or not any(
+                f.check == "explain" for f in findings3
+            ):
+                failures.append(
+                    f"explain phase: doctor did not flag the "
+                    f"unallocatable claim (status={status3}, findings="
+                    f"{[str(f) for f in findings3]})"
+                )
+            elif hint not in report3:
+                failures.append(
+                    "explain phase: runbook hint missing from the "
+                    "doctor report"
+                )
         finally:
             mgr.stop(cleanup=False)
             for name in drivers:
@@ -206,7 +263,7 @@ def main() -> int:
               file=sys.stderr)
         return 1
     print("doctor sim gate: clean fleet diagnosed clean, injected drift "
-          "caught", file=sys.stderr)
+          "caught, unallocatable claim explained", file=sys.stderr)
     return 0
 
 

@@ -207,14 +207,71 @@ def _self_test_scrape() -> tuple[str, list[str]]:
     if not snapshot.get("holds"):
         return "", ["usage snapshot lost the prepared hold"]
 
+    # The alloc explainability families (tpu_dra_alloc_*), populated
+    # through REAL solve paths — one success and one forced unsat — so
+    # the stage/reason label values the scrape renders are exactly what
+    # the solver emits (and provably inside allocator.py's enums, the
+    # TPM06 contract).
+    from k8s_dra_driver_tpu.kube import NODES, FakeKubeClient
+    from k8s_dra_driver_tpu.kube.allocator import (
+        REASONS,
+        STAGES,
+        AllocationError,
+        ReferenceAllocator,
+    )
+    from k8s_dra_driver_tpu.kube.resourceslice import (
+        DriverResources,
+        Pool,
+        ResourceSliceController,
+    )
+    from k8s_dra_driver_tpu.tpulib.deviceinfo import counter_sets
+
+    alloc_errors: list[str] = []
+    client = FakeKubeClient()
+    client.create(NODES, {"metadata": {"name": "verify", "uid": "u-v"}})
+    lib = FakeChipLib(generation="v5p", topology="2x1x1")
+    allocatable = lib.enumerate_all_possible_devices({"chip", "tensorcore"})
+    ctrl = ResourceSliceController(
+        client, "tpu.google.com", scope="verify",
+        owner={"kind": "Node", "name": "verify", "uid": "u-v"},
+    )
+    ctrl.update(DriverResources(pools={"verify": Pool(
+        devices=[d.get_device() for _, d in sorted(allocatable.items())],
+        shared_counters=counter_sets(allocatable),
+        node_name="verify",
+    )}))
+    ctrl.sync_once()
+    allocator = ReferenceAllocator(client, registry=registry)
+
+    def _verify_claim(uid, count):
+        return {
+            "metadata": {"name": f"wl-{uid}", "namespace": "verify",
+                         "uid": uid},
+            "spec": {"devices": {"requests": [{
+                "name": "r0", "deviceClassName": "tpu.google.com",
+                "count": count,
+            }]}},
+        }
+
+    allocator.allocate(_verify_claim("uid-alloc-ok", 1))
+    try:
+        allocator.allocate(_verify_claim("uid-alloc-unsat", 99))
+        alloc_errors.append("forced-unsat claim unexpectedly allocated")
+    except AllocationError as e:
+        if e.reason not in REASONS:
+            alloc_errors.append(
+                f"unsat reason {e.reason!r} outside the REASONS enum"
+            )
+
     tracer = Tracer()
     with tracer.span("verify", claim_uid="uid-verify"):
         pass
 
-    errors: list[str] = []
+    errors: list[str] = alloc_errors
     srv = MetricsServer(registry, host="127.0.0.1", port=0, tracer=tracer)
     srv.add_readiness_check("self-test", lambda: (True, "ok"))
     srv.set_usage_provider(lambda: snapshot)
+    srv.set_allocations_provider(allocator.export_allocations_jsonl)
     srv.start()
     try:
         base = f"http://127.0.0.1:{srv.port}"
@@ -239,13 +296,59 @@ def _self_test_scrape() -> tuple[str, list[str]]:
                 errors.append("/debug/usage: wrong snapshot served")
         except ValueError:
             errors.append("/debug/usage: body is not JSON")
-        # The scrape surface is GET-only by contract.
-        try:
-            urllib.request.urlopen(f"{base}/metrics", data=b"x")
-            errors.append("/metrics accepted a POST (want 405)")
-        except urllib.error.HTTPError as e:
-            if e.code != 405:
-                errors.append(f"/metrics POST: HTTP {e.code} (want 405)")
+        # /debug/allocations: decodable JSONL, newest record is the
+        # forced unsat with an enum-confined reason and a funnel.
+        alloc_body = urllib.request.urlopen(
+            f"{base}/debug/allocations"
+        ).read().decode()
+        records = []
+        for line in filter(None, alloc_body.splitlines()):
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                errors.append(
+                    f"/debug/allocations: undecodable line {line!r}"
+                )
+        if len(records) != 2:
+            errors.append(
+                f"/debug/allocations: {len(records)} records (want 2: "
+                "one ok, one unsat)"
+            )
+        else:
+            newest = records[-1]
+            if newest.get("outcome") != "unsat":
+                errors.append(
+                    "/debug/allocations: newest record is not the "
+                    "forced unsat"
+                )
+            if newest.get("reason") not in REASONS:
+                errors.append(
+                    f"/debug/allocations: reason "
+                    f"{newest.get('reason')!r} outside the REASONS enum"
+                )
+            if not newest.get("funnels"):
+                errors.append(
+                    "/debug/allocations: unsat record carries no funnel"
+                )
+            for rec in records:
+                for funnel in rec.get("funnels", []):
+                    bad = set(funnel.get("rejected", {})) - set(STAGES)
+                    if bad:
+                        errors.append(
+                            f"/debug/allocations: funnel stages {bad} "
+                            "outside the STAGES enum"
+                        )
+        # The scrape surface is GET-only by contract — /metrics and the
+        # debug endpoints alike.
+        for route in ("/metrics", "/debug/allocations"):
+            try:
+                urllib.request.urlopen(base + route, data=b"x")
+                errors.append(f"{route} accepted a POST (want 405)")
+            except urllib.error.HTTPError as e:
+                if e.code != 405:
+                    errors.append(
+                        f"{route} POST: HTTP {e.code} (want 405)"
+                    )
     finally:
         srv.stop()
     for family in ("tpu_dra_usage_allocated_device_seconds_total",
@@ -253,9 +356,27 @@ def _self_test_scrape() -> tuple[str, list[str]]:
                    "tpu_dra_usage_claim_hold_seconds",
                    "tpu_dra_usage_chip_claims",
                    "tpu_dra_audit_findings",
-                   "tpu_dra_audit_runs_total"):
+                   "tpu_dra_audit_runs_total",
+                   "tpu_dra_alloc_solve_seconds",
+                   "tpu_dra_alloc_funnel_rejections_total",
+                   "tpu_dra_alloc_unsat_total"):
         if f"\n{family}" not in body and not body.startswith(family):
             errors.append(f"expected family {family} missing from scrape")
+    # The rendered stage/reason label values stay inside the enums the
+    # lint (TPM06) pins at the call sites — the runtime half of the same
+    # contract.
+    enum_labels = {"stage": set(STAGES), "reason": set(REASONS)}
+    for line in body.splitlines():
+        if not line.startswith("tpu_dra_alloc") or "{" not in line:
+            continue
+        for pair in re.findall(rf'({_LABEL_NAME})="({_LABEL_VALUE})"',
+                               line.split("{", 1)[1]):
+            allowed = enum_labels.get(pair[0])
+            if allowed is not None and pair[1] not in allowed:
+                errors.append(
+                    f"label {pair[0]}={pair[1]!r} on {line.split(' ')[0]} "
+                    "outside the allocator's enum"
+                )
     return body, errors
 
 
