@@ -2,7 +2,7 @@
 IMAGE ?= tpu-dra-driver
 TAG ?= latest
 
-.PHONY: all native test image lint verify verify-metrics chaos chaos-slow doctor clean e2e-kind
+.PHONY: all native test image lint verify verify-metrics chaos chaos-slow doctor decodebench clean e2e-kind
 
 all: native
 
@@ -36,10 +36,18 @@ chaos-slow:
 doctor:
 	python tools/run_doctor_sim.py
 
+# Decode-engine smoke: fixed-seed traffic through the continuous-batching
+# engine on CPU, asserting the compile-once invariant per serving variant
+# (bf16/int8/kvq), deterministic token streams, and bounded repeat spread
+# (tools/run_decode_smoke.py) — the fast gate for the BENCH_r05
+# recompile-spread regression.
+decodebench:
+	python tools/run_decode_smoke.py
+
 # The full local gate: lint + unit/integration tests + chaos schedules +
-# metrics exposition + the doctor/auditor drill. What CI runs; what a PR
-# must pass.
-verify: lint test chaos verify-metrics doctor
+# metrics exposition + the doctor/auditor drill + the decode-engine
+# smoke. What CI runs; what a PR must pass.
+verify: lint test chaos verify-metrics doctor decodebench
 
 # ruff when available (CI installs it; .golangci.yaml analog is
 # [tool.ruff] in pyproject.toml), else the first-party AST lint floor.

@@ -15,7 +15,10 @@ from the persistent cache instead of recompiling).
 Knobs (script mode): TPU_DRA_DECODE_PRESET (e.g. 160m-gqa, 1b, or a
 MoE preset like 8x160m), TPU_DRA_DECODE_PROMPT (long-context cache
 costs), TPU_DRA_DECODE_QUANT ("int8" = weights, "int8-kv" = KV cache,
-"int8,int8-kv" = both).
+"int8,int8-kv" = both), TPU_DRA_DECODE_SERVING=1 (also run the
+sustained-traffic continuous-batching bench: requests/s at measured
+p99 token latency). Any decode metric whose repeat spread exceeds 2%
+of its mean is flagged (spread_flags) — the recompile tripwire.
 """
 import os
 import time
@@ -154,6 +157,179 @@ def run_decode_bench(
     }
 
 
+def spread_flags(metrics, rel: float = 0.02) -> list:
+    """Flag any ``*_decode_toks_*`` metric whose repeat spread exceeds
+    ``rel`` of its mean — the signature of per-shape recompilation (the
+    BENCH_r05 125-315 tok/s spreads). Mutates the dicts in place
+    (``spread_flag: true``) and returns the flagged metric names so
+    bench.py can surface them on stderr."""
+    flagged = []
+    for m in metrics:
+        name = m.get("metric", "")
+        if "_decode_toks_" not in name:
+            continue
+        spread = m.get("spread")
+        value = m.get("value")
+        if spread is None or not value:
+            continue
+        if spread > rel * value:
+            m["spread_flag"] = True
+            flagged.append(name)
+    return flagged
+
+
+def run_serving_bench(
+    preset: str = "160m",
+    batch_slots: int = 8,
+    n_requests: int = 32,
+    prompt_lens=(32, 128, 256),
+    max_new_tokens: int = 64,
+    block_size: int = 64,
+    quant: bool = False,
+    quant_kv: bool = False,
+    seed: int = 0,
+) -> dict:
+    """Sustained mixed traffic through the continuous-batching engine:
+    requests/s completed at a measured p99 per-token latency.
+
+    Unlike the steady-state decode number, this measures the whole
+    serving loop — chunked prefill interleaving, admissions, block churn
+    — under prompts of mixed length, the shape production traffic has.
+    """
+    import numpy as np
+
+    from k8s_dra_driver_tpu.models.llama import PRESETS, init_params
+    from k8s_dra_driver_tpu.models.moe import MOE_PRESETS
+    from k8s_dra_driver_tpu.models.moe import init_params as moe_init_params
+    from k8s_dra_driver_tpu.models.quant import quantize_params
+    from k8s_dra_driver_tpu.models.serving import DecodeEngine
+
+    is_moe = preset in MOE_PRESETS
+    config = MOE_PRESETS[preset] if is_moe else PRESETS[preset]
+    init = moe_init_params if is_moe else init_params
+    params = jax.jit(lambda k: init(config, k))(jax.random.PRNGKey(0))
+    if quant:
+        params = jax.jit(quantize_params)(params)
+
+    rng = np.random.RandomState(seed)
+    prompts = [
+        rng.randint(0, config.vocab_size,
+                    size=int(prompt_lens[i % len(prompt_lens)])).tolist()
+        for i in range(n_requests)
+    ]
+    span = max(prompt_lens) + max_new_tokens
+    # Pool sized so roughly half the requests fit concurrently: block
+    # churn and admission control are part of what's being measured.
+    num_blocks = max(
+        batch_slots * (-(-span // block_size)),
+        -(-sum(len(p) + max_new_tokens for p in prompts) // (2 * block_size)),
+    )
+    engine = DecodeEngine(
+        params, config, batch_slots=batch_slots, num_blocks=num_blocks,
+        block_size=block_size, max_seq_len=span,
+        prefill_chunk=min(128, max(prompt_lens)),
+        quantize_cache=quant_kv,
+    )
+    # Warm the two compiled programs so the timed window measures the
+    # serving loop, not the compiler; latency stats reset after.
+    from k8s_dra_driver_tpu.models.serving import ServingStats
+
+    engine.submit(prompts[0][: prompt_lens[0]], max_new_tokens=2)
+    engine.run()
+    engine.stats = ServingStats()
+    for p in prompts:
+        engine.submit(p, max_new_tokens=max_new_tokens)
+    t0 = time.perf_counter()
+    engine.run()
+    wall = time.perf_counter() - t0
+    engine.assert_no_leaks()
+    s = engine.stats
+    tags = "".join(
+        t for t, on in (("-int8", quant), ("-kvq", quant_kv)) if on
+    )
+    family = "mixtral" if is_moe else "llama3"
+    return {
+        "metric": f"{family}_{preset}{tags}_serving_rps_b{batch_slots}",
+        "value": round(n_requests / wall, 2),
+        "unit": "requests_per_s",
+        # p99 token latency is the SLO leg of "requests/s at fixed p99".
+        "vs_baseline": 0.0,
+        "detail": {
+            "p99_token_ms": round(s.p99_token_ms(), 2),
+            "p50_token_ms": round(s.p50_token_ms(), 2),
+            "p99_ttft_ms": round(s.p99_ttft_ms(), 2),
+            "toks_per_s": round(s.tokens_generated / wall, 1),
+            "preemptions": s.preemptions,
+            "decode_steps": s.decode_steps,
+            "prefill_chunks": s.prefill_chunks,
+            "compile_counts": dict(engine.compile_counts),
+            "num_blocks": num_blocks,
+            "block_size": block_size,
+        },
+    }
+
+
+def run_speculative_bench(
+    preset: str = "160m",
+    draft_layers: int = 3,
+    k: int = 4,
+    prompt_len: int = 64,
+    n_new: int = 96,
+) -> dict:
+    """Speculative decode with a shallow same-vocab draft, reporting the
+    draft-acceptance rate in detail so speculation wins/losses are
+    attributable (an untrained random draft pins the floor: acceptance
+    near 0, pure drafting overhead)."""
+    import dataclasses
+
+    from k8s_dra_driver_tpu.models.llama import PRESETS, init_params
+    from k8s_dra_driver_tpu.models.speculative import speculative_generate
+
+    config = PRESETS[preset]
+    draft_config = dataclasses.replace(config, n_layers=draft_layers)
+    params = jax.jit(lambda key: init_params(config, key))(
+        jax.random.PRNGKey(0)
+    )
+    draft = jax.jit(lambda key: init_params(draft_config, key))(
+        jax.random.PRNGKey(1)
+    )
+    prompts = [
+        jax.random.randint(jax.random.PRNGKey(10 + i), (1, prompt_len), 0,
+                           config.vocab_size)
+        for i in range(3)
+    ]
+    jax.block_until_ready(prompts)
+    fn = jax.jit(
+        lambda tp, dp, t: speculative_generate(
+            tp, dp, t, config, draft_config, n_new, k=k, return_stats=True,
+        )
+    )
+    out, stats = fn(params, draft, prompts[0])   # compile + warm
+    float(out[0, -1])
+    times = []
+    rate = 0.0
+    for p in prompts:
+        t0 = time.perf_counter()
+        out, stats = fn(params, draft, p)
+        rate = float(stats["acceptance_rate"])
+        float(out[0, -1])
+        times.append(time.perf_counter() - t0)
+    dt = sorted(times)[len(times) // 2]
+    return {
+        "metric": f"llama3_{preset}_specdecode_toks_k{k}_p{prompt_len}",
+        "value": round(n_new / dt, 1),
+        "unit": "tokens_per_s",
+        "vs_baseline": 0.0,
+        "detail": {
+            "acceptance_rate": round(rate, 4),
+            "rounds": int(stats["rounds"]),
+            "accepted": int(stats["accepted"]),
+            "k": k,
+            "draft_layers": draft_layers,
+        },
+    }
+
+
 def main():
     enable_compile_cache()
     quant_modes = set(
@@ -175,6 +351,23 @@ def main():
         f"{r['vs_baseline']:.0%} of roofline)",
         flush=True,
     )
+    for name in spread_flags([r]):
+        print(
+            f"WARNING: {name} repeat spread {r['spread']} exceeds 2% of "
+            f"the mean — per-shape recompilation suspected", flush=True,
+        )
+    if os.environ.get("TPU_DRA_DECODE_SERVING"):
+        s = run_serving_bench(
+            preset=os.environ.get("TPU_DRA_DECODE_PRESET", "160m"),
+            quant="int8" in quant_modes,
+            quant_kv="int8-kv" in quant_modes,
+        )
+        print(
+            f"serving {s['metric']}: {s['value']} req/s, "
+            f"p99 token {s['detail']['p99_token_ms']} ms, "
+            f"p99 ttft {s['detail']['p99_ttft_ms']} ms, "
+            f"{s['detail']['preemptions']} preemptions", flush=True,
+        )
 
 
 if __name__ == "__main__":

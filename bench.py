@@ -274,6 +274,37 @@ def extra_metrics(peak_flops, remat_policy) -> list:
             except Exception as e:
                 print(f"decode metric {kwargs} failed: "
                       f"{type(e).__name__}: {e}", file=sys.stderr)
+        # Serving-loop + speculative companions: sustained mixed traffic
+        # (requests/s at measured p99) and the draft-acceptance datapoint.
+        # Their detail IS the payload (p99/acceptance), so it stays.
+        for name, fn_name, kwargs in (
+            ("serving", "run_serving_bench", dict(preset=decode_preset)),
+            ("speculative", "run_speculative_bench",
+             dict(preset=decode_preset)),
+        ):
+            if time.monotonic() > deadline:
+                print(f"{name} metric skipped: budget spent",
+                      file=sys.stderr)
+                continue
+            try:
+                import _decodebench
+
+                out.append(getattr(_decodebench, fn_name)(**kwargs))
+            except Exception as e:
+                print(f"{name} metric failed: {type(e).__name__}: {e}",
+                      file=sys.stderr)
+    # The recompile tripwire (machine-readable, round over round): any
+    # decode-toks metric whose repeat spread exceeds 2% of its mean gets
+    # spread_flag=true in the JSON and a stderr warning.
+    try:
+        from _decodebench import spread_flags
+
+        for name in spread_flags(out):
+            print(f"WARNING: {name} repeat spread exceeds 2% of the mean "
+                  f"— per-shape recompilation suspected", file=sys.stderr)
+    except Exception as e:
+        print(f"spread flagging failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
     return out
 
 

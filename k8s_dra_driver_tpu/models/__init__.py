@@ -37,14 +37,27 @@ from .checkpoint import (
     restore_template,
     save_checkpoint,
 )
-from .decode import KVCache, QuantKVCache, decode_step, generate, prefill
+from .decode import (
+    PagedKVCache,
+    PagedQuantKVCache,
+    decode_step,
+    generate,
+    prefill,
+)
+from .paged import BlockAllocator, OutOfBlocksError
 from .quant import QuantTensor, quantize_params, quantize_specs
+from .serving import DecodeEngine, Request, ServingStats
 from .speculative import speculative_generate
 
 __all__ += [
     "moe",
-    "KVCache",
-    "QuantKVCache",
+    "PagedKVCache",
+    "PagedQuantKVCache",
+    "BlockAllocator",
+    "OutOfBlocksError",
+    "DecodeEngine",
+    "Request",
+    "ServingStats",
     "QuantTensor",
     "prefill",
     "decode_step",

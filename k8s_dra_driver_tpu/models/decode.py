@@ -1,26 +1,73 @@
-"""Inference: KV-cache prefill + autoregressive decode.
+"""Inference: paged-KV-cache prefill + fixed-shape autoregressive decode.
 
-tpu-first decode design: static cache shapes (no dynamic growth — XLA traces
-once), `lax.scan` over layers with stacked per-layer caches, masked
-attention against the preallocated cache, and greedy generation under
-`lax.while_loop` so the whole generate loop compiles to one program.
+tpu-first decode design, rebuilt around a **paged/block KV cache**
+(models/paged.py): the cache is a flat pool of fixed-size blocks shared
+by all sequences, addressed through per-sequence block tables. Every
+array shape in the decode step is independent of sequence length —
+growing sequences advance block-table entries and per-sequence length
+scalars, never retrace — so one compiled step serves from token 1 to
+max_len (the regression oracle in tests/test_decode.py counts traces).
+
+Attention reads the pool through the block table: a fused Pallas kernel
+on TPU for the single-token decode shape and a gather-based XLA path
+everywhere else (ops/attention.py). `lax.scan` over layers with stacked
+per-layer pools and greedy generation under `lax.while_loop` keep the
+whole generate loop one program, as before.
+
+The continuous-batching engine that drives this machinery at token
+granularity lives in models/serving.py.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
+from ..ops.attention import paged_attention_reference, paged_decode_attention
 from ..ops.norms import rmsnorm
 from ..ops.rotary import rope_frequencies
 from .llama import LlamaConfig, _mlp_block, attn_out, project_qkv
 from .moe import MoeConfig, _moe_block
-from .quant import q_lookup, q_matmul, quantize_tensor
+from .paged import (
+    BlockAllocator,
+    OutOfBlocksError,
+    PagedKVCache,
+    PagedQuantKVCache,
+    flat_write_positions,
+)
+from .quant import QuantTensor, q_lookup, q_matmul, quantize_tensor
+
+__all__ = [
+    "PagedKVCache",
+    "PagedQuantKVCache",
+    "BlockAllocator",
+    "OutOfBlocksError",
+    "prefill",
+    "decode_step",
+    "generate",
+    "TRACE_COUNTS",
+]
 
 NEG_INF = -1e30
+
+#: Trace counter per decode variant: the compile-once regression oracle.
+#: Every retrace of the decode-step forward bumps its variant key, so a
+#: shape leak (anything still depending on sequence length) shows up as
+#: a count > 1 when decoding from length 1 to max_len.
+TRACE_COUNTS: "collections.Counter[str]" = collections.Counter()
+
+
+def variant_label(params: dict, cache) -> str:
+    """"bf16" | "int8" | "kvq" | "int8+kvq" — the bench variant names."""
+    wq = isinstance(params["layers"]["wqkv"], QuantTensor)
+    cq = isinstance(cache, PagedQuantKVCache)
+    return "+".join(
+        n for n, on in (("int8", wq), ("kvq", cq)) if on
+    ) or "bf16"
 
 
 def _mlp_or_moe(x, layer, config, mesh=None):
@@ -35,81 +82,6 @@ def _mlp_or_moe(x, layer, config, mesh=None):
     return _mlp_block(x, layer, config)
 
 
-@dataclasses.dataclass
-class KVCache:
-    """Per-layer stacked cache: k,v [L, B, H_kv, S_max, D]."""
-
-    k: jax.Array
-    v: jax.Array
-    length: jax.Array  # [] int32: filled positions
-
-    @classmethod
-    def init(cls, config: LlamaConfig, batch: int, max_len: int) -> "KVCache":
-        shape = (
-            config.n_layers, batch, config.n_kv_heads, max_len, config.head_dim,
-        )
-        return cls(
-            k=jnp.zeros(shape, config.dtype),
-            v=jnp.zeros(shape, config.dtype),
-            length=jnp.zeros((), jnp.int32),
-        )
-
-    @property
-    def max_len(self) -> int:
-        return self.k.shape[3]
-
-
-jax.tree_util.register_dataclass(
-    KVCache, data_fields=["k", "v", "length"], meta_fields=[]
-)
-
-
-@dataclasses.dataclass
-class QuantKVCache:
-    """int8 KV cache with per-(position, head) scales.
-
-    Long-context decode streams the cache from HBM every step; int8 halves
-    that traffic. The score einsum contracts over D, so k's scale (constant
-    over D) factors OUT of the sum — exact, no fusion reliance; v's scale
-    varies over the contraction axis S, so it folds INTO the probabilities
-    instead (also exact). Layout: k,v int8 [L, B, H_kv, S_max, D]; scales
-    f32 [L, B, H_kv, S_max].
-    """
-
-    k: jax.Array
-    k_scale: jax.Array
-    v: jax.Array
-    v_scale: jax.Array
-    length: jax.Array  # [] int32: filled positions
-
-    @classmethod
-    def init(
-        cls, config: LlamaConfig, batch: int, max_len: int
-    ) -> "QuantKVCache":
-        shape = (
-            config.n_layers, batch, config.n_kv_heads, max_len,
-            config.head_dim,
-        )
-        return cls(
-            k=jnp.zeros(shape, jnp.int8),
-            k_scale=jnp.zeros(shape[:-1], jnp.float32),
-            v=jnp.zeros(shape, jnp.int8),
-            v_scale=jnp.zeros(shape[:-1], jnp.float32),
-            length=jnp.zeros((), jnp.int32),
-        )
-
-    @property
-    def max_len(self) -> int:
-        return self.k.shape[3]
-
-
-jax.tree_util.register_dataclass(
-    QuantKVCache,
-    data_fields=["k", "k_scale", "v", "v_scale", "length"],
-    meta_fields=[],
-)
-
-
 def _quantize_kv(x):
     """[B, H, T, D] -> (int8 values, f32 scales [B, H, T]); symmetric
     per-vector quantization over D (one shared recipe: quant.
@@ -118,100 +90,110 @@ def _quantize_kv(x):
     return qt.q, jnp.squeeze(qt.scale, axis=-1)
 
 
-def _cached_attention(q, k_cache, v_cache, valid_len, scale,
-                      k_scale=None, v_scale=None):
-    """q: [B, H, T, D]; caches: [B, H_kv, S_max, D]; positions >= valid_len
-    masked. T is the new-token count (prompt at prefill, 1 at decode).
-    With k_scale/v_scale the caches are int8 (QuantKVCache read path).
-
-    GQA is contracted in grouped form (q reshaped to [B, H_kv, G, T, D])
-    so the H_kv-sized cache is read once — a materialized head repeat
-    would stream a G-times-larger cache copy every step, forfeiting
-    exactly the bandwidth the int8 cache saves."""
-    b, hq, t, d = q.shape
-    hkv = k_cache.shape[1]
-    qg = q.reshape(b, hkv, hq // hkv, t, d)  # heads are kv-major
-    s = jnp.einsum(
-        "bhgtd,bhsd->bhgts", qg, k_cache.astype(q.dtype),
-        preferred_element_type=jnp.float32,
-    ) * scale
-    if k_scale is not None:
-        # k's per-position scale is constant over the contracted D axis,
-        # so it multiplies the finished scores exactly.
-        s = s * k_scale[:, :, None, None, :]
-    s_max = k_cache.shape[2]
-    # Causal within the new tokens + cache-length bound. New token i sits at
-    # absolute position valid_len - t + i.
-    qpos = valid_len - t + jnp.arange(t)[:, None]
-    kpos = jnp.arange(s_max)[None, :]
-    mask = kpos <= qpos
-    s = jnp.where(mask[None, None, None], s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    out_dtype = q.dtype
-    if v_scale is not None:
-        # v's scale varies over the contraction axis S: fold it into the
-        # probabilities (exact), then contract against raw int8 values.
-        p = p * v_scale[:, :, None, None, :]
-    out = jnp.einsum(
-        "bhgts,bhsd->bhgtd", p.astype(out_dtype), v_cache.astype(out_dtype)
-    )
-    return out.reshape(b, hq, t, d)
-
-
 def _forward_with_cache(
     params: dict,
     tokens: jax.Array,            # [B, T] new tokens
-    cache: "KVCache | QuantKVCache",
+    cache: "PagedKVCache | PagedQuantKVCache",
     config: LlamaConfig,
-    positions: jax.Array,         # [T] absolute positions of the new tokens
+    positions: jax.Array,         # [T] shared or [B, T] per-sequence
     mesh=None,
-) -> "tuple[jax.Array, KVCache | QuantKVCache]":
-    """Run the stack over new tokens, reading+writing the cache.
-    Returns (logits [B, T, V], updated cache)."""
+    n_valid: jax.Array | None = None,   # [] real tokens in a padded chunk
+    active: jax.Array | None = None,    # [B] bool: slots allowed to write
+) -> "tuple[jax.Array, PagedKVCache | PagedQuantKVCache]":
+    """Run the stack over new tokens, reading+writing the paged cache.
+    Returns (logits [B, T, V], updated cache).
+
+    ``positions`` are absolute per-sequence positions of the new tokens.
+    ``n_valid`` marks the first n columns of a right-padded chunk as
+    real (prefill chunking); padded columns are neither written to the
+    pool nor advance lengths. ``active`` gates whole sequences: an
+    inactive slot's block table may reference blocks re-owned by another
+    sequence, so its writes are dropped and its length frozen."""
     c = config
     b, t = tokens.shape
+    bs = cache.block_size
     scale = c.head_dim ** -0.5
+    quantized = isinstance(cache, PagedQuantKVCache)
+    if positions.ndim == 1:
+        positions = jnp.broadcast_to(positions[None, :], (b, t))
+    TRACE_COUNTS[
+        f"forward:{variant_label(params, cache)}:t{t}"
+    ] += 1
+
     x = q_lookup(params["embed"], tokens, c.dtype)
     cos, sin = rope_frequencies(
         c.head_dim, cache.max_len, c.rope_theta, dtype=jnp.float32
     )
-    start = cache.length
-    new_len = start + t
-    quantized = isinstance(cache, QuantKVCache)
+    # Clamp rope positions: padded/garbage columns may sit past the
+    # table (their writes are dropped and their outputs discarded, but
+    # the gather must stay in range).
+    rope_pos = jnp.clip(positions, 0, cache.max_len - 1)
+
+    valid = None
+    if n_valid is not None:
+        valid = jnp.broadcast_to(
+            jnp.arange(t, dtype=jnp.int32)[None, :] < n_valid, (b, t)
+        )
+    if active is not None:
+        valid = (
+            active[:, None] if valid is None
+            else valid & active[:, None]
+        )
+    flat_pos = flat_write_positions(
+        cache.block_tables, positions, bs, valid=valid
+    )                                                   # [B, T]
+    # Attention visibility: the kernel masks kv rows >= valid_len; at
+    # query position p the row written this step is p itself, so
+    # valid_len = p + 1.
+    vlen = rope_pos[:, -1] + 1                          # [B]
 
     def block(x, layer_and_cache):
         if quantized:
-            layer, k_cache, ks, v_cache, vs = layer_and_cache
+            layer, k_pool, ks_pool, v_pool, vs_pool = layer_and_cache
         else:
-            layer, k_cache, v_cache = layer_and_cache
-            ks = vs = None
+            layer, k_pool, v_pool = layer_and_cache
+            ks_pool = vs_pool = None
         xn = rmsnorm(x, layer["ln_attn"], c.norm_eps)
-        q, k, v = project_qkv(xn, layer, c, cos, sin, positions=positions)
+        q, k, v = project_qkv(xn, layer, c, cos, sin, positions=rope_pos)
         if quantized:
             k8, k_s = _quantize_kv(k)
             v8, v_s = _quantize_kv(v)
-            k_cache = jax.lax.dynamic_update_slice(
-                k_cache, k8, (0, 0, start, 0)
+            k_pool = k_pool.at[:, flat_pos, :].set(
+                k8.transpose(1, 0, 2, 3), mode="drop"
             )
-            v_cache = jax.lax.dynamic_update_slice(
-                v_cache, v8, (0, 0, start, 0)
+            v_pool = v_pool.at[:, flat_pos, :].set(
+                v8.transpose(1, 0, 2, 3), mode="drop"
             )
-            ks = jax.lax.dynamic_update_slice(ks, k_s, (0, 0, start))
-            vs = jax.lax.dynamic_update_slice(vs, v_s, (0, 0, start))
+            ks_pool = ks_pool.at[:, flat_pos].set(
+                k_s.transpose(1, 0, 2), mode="drop"
+            )
+            vs_pool = vs_pool.at[:, flat_pos].set(
+                v_s.transpose(1, 0, 2), mode="drop"
+            )
         else:
-            k_cache = jax.lax.dynamic_update_slice(
-                k_cache, k.astype(k_cache.dtype), (0, 0, start, 0)
+            k_pool = k_pool.at[:, flat_pos, :].set(
+                k.astype(k_pool.dtype).transpose(1, 0, 2, 3), mode="drop"
             )
-            v_cache = jax.lax.dynamic_update_slice(
-                v_cache, v.astype(v_cache.dtype), (0, 0, start, 0)
+            v_pool = v_pool.at[:, flat_pos, :].set(
+                v.astype(v_pool.dtype).transpose(1, 0, 2, 3), mode="drop"
             )
-        o = _cached_attention(q, k_cache, v_cache, new_len, scale,
-                              k_scale=ks, v_scale=vs)
+        if t == 1:
+            # The serving hot path: fused paged kernel on TPU, gather
+            # fallback elsewhere (dispatch inside ops/attention.py).
+            o = paged_decode_attention(
+                q[:, :, 0, :], k_pool, v_pool, cache.block_tables, vlen,
+                bs, scale, k_scale=ks_pool, v_scale=vs_pool,
+            )[:, :, None, :]
+        else:
+            o = paged_attention_reference(
+                q, k_pool, v_pool, cache.block_tables, rope_pos, bs,
+                scale, k_scale=ks_pool, v_scale=vs_pool,
+            )
         x = attn_out(x, o, layer)
         x = _mlp_or_moe(x, layer, c, mesh=mesh)
         if quantized:
-            return x, (k_cache, ks, v_cache, vs)
-        return x, (k_cache, v_cache)
+            return x, (k_pool, ks_pool, v_pool, vs_pool)
+        return x, (k_pool, v_pool)
 
     if quantized:
         x, (new_k, new_ks, new_v, new_vs) = jax.lax.scan(
@@ -219,15 +201,24 @@ def _forward_with_cache(
             (params["layers"], cache.k, cache.k_scale, cache.v,
              cache.v_scale),
         )
-        new_cache = QuantKVCache(
-            k=new_k, k_scale=new_ks, v=new_v, v_scale=new_vs,
-            length=new_len,
-        )
+        pools = dict(k=new_k, k_scale=new_ks, v=new_v, v_scale=new_vs)
     else:
         x, (new_k, new_v) = jax.lax.scan(
             block, x, (params["layers"], cache.k, cache.v)
         )
-        new_cache = KVCache(k=new_k, v=new_v, length=new_len)
+        pools = dict(k=new_k, v=new_v)
+
+    # Committed length per sequence: last real position + 1, frozen for
+    # padded columns / inactive slots.
+    if n_valid is not None:
+        new_len = positions[:, 0] + n_valid
+    else:
+        new_len = positions[:, -1] + 1
+    new_len = jnp.clip(new_len, 0, cache.max_len).astype(jnp.int32)
+    if active is not None:
+        new_len = jnp.where(active, new_len, cache.lengths)
+    new_cache = dataclasses.replace(cache, lengths=new_len, **pools)
+
     x = rmsnorm(x, params["final_norm"], c.norm_eps)
     logits = q_matmul(x, params["lm_head"]).astype(jnp.float32)
     return logits, new_cache
@@ -240,13 +231,19 @@ def prefill(
     max_len: int,
     quantize_cache: bool = False,
     mesh=None,
-) -> "tuple[jax.Array, KVCache | QuantKVCache]":
+    block_size: int | None = None,
+) -> "tuple[jax.Array, PagedKVCache | PagedQuantKVCache]":
     """Process the prompt; returns (last-position logits [B, V], cache).
+
+    Builds a fixed-reservation paged cache (every sequence pre-owns the
+    blocks covering ``max_len``) — the single-program serving shape.
     ``quantize_cache`` stores KV in int8 with per-position scales
-    (QuantKVCache) — half the cache traffic for long-context decode."""
+    (PagedQuantKVCache) — half the cache traffic for long-context
+    decode. The continuous-batching engine (models/serving.py) manages
+    its own pool/allocator instead of calling this."""
     b, s = tokens.shape
-    cache_cls = QuantKVCache if quantize_cache else KVCache
-    cache = cache_cls.init(config, b, max_len)
+    cache_cls = PagedQuantKVCache if quantize_cache else PagedKVCache
+    cache = cache_cls.init(config, b, max_len, block_size=block_size)
     positions = jnp.arange(s)
     logits, cache = _forward_with_cache(
         params, tokens, cache, config, positions, mesh=mesh
@@ -257,12 +254,16 @@ def prefill(
 def decode_step(
     params: dict,
     token: jax.Array,             # [B] latest token
-    cache: "KVCache | QuantKVCache",
+    cache: "PagedKVCache | PagedQuantKVCache",
     config: LlamaConfig,
     mesh=None,
-) -> "tuple[jax.Array, KVCache | QuantKVCache]":
-    """One autoregressive step; returns (next-token logits [B, V], cache)."""
-    positions = cache.length[None]
+) -> "tuple[jax.Array, PagedKVCache | PagedQuantKVCache]":
+    """One autoregressive step; returns (next-token logits [B, V], cache).
+
+    Fixed-shape: nothing here depends on how long the sequences are —
+    the per-sequence lengths drive positions, the block tables drive
+    placement, and the compiled program is reused for every step."""
+    positions = cache.lengths[:, None]                  # [B, 1]
     logits, cache = _forward_with_cache(
         params, token[:, None], cache, config, positions, mesh=mesh
     )

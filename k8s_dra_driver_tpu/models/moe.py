@@ -606,9 +606,9 @@ def _moe_block_dropless_ep(x, layer, config: MoeConfig, mesh: Mesh):
         # expert shard, no reduction needed.
         return out.reshape(b, s, h), aux
 
-    from jax import shard_map
+    from ..parallel.compat import shard_map_compat
 
-    fn = shard_map(
+    fn = shard_map_compat(
         local,
         mesh=mesh,
         in_specs=(P(), P(), P(), P("expert"), P("expert")),

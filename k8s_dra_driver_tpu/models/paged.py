@@ -1,0 +1,279 @@
+"""Paged KV cache: block pool, per-sequence block tables, free-list allocator.
+
+vLLM-style memory management for the decode engine (models/serving.py):
+the KV cache is one flat pool of fixed-size blocks shared by every
+sequence, and each sequence maps its logical positions onto pool blocks
+through a small int32 block table. Two properties fall out:
+
+- **Capacity is decoupled from batch slots.** A long sequence takes many
+  blocks, a short one few; the pool is sized for expected total tokens,
+  not ``batch x max_len``.
+- **No shape depends on sequence length.** Pools, block tables, and
+  per-sequence length vectors are all statically shaped; growing a
+  sequence advances integers. One compiled decode step serves the whole
+  engine lifetime (the recompile-per-shape spreads in BENCH_r05 cannot
+  happen structurally).
+
+Layout: pools are ``[L, H_kv, P, D]`` where ``P = num_blocks *
+block_size`` flat token rows — block ``n`` owns rows ``[n*bs, (n+1)*bs)``,
+so a block is contiguous for the Pallas kernel's DMA and a flat row
+index is a plain scatter/gather target for the XLA fallback. The
+quantized variant stores int8 values plus per-(position, head) f32
+scales ``[L, H_kv, P]`` (same algebra as the old contiguous QuantKVCache:
+k's scale factors out of the score dot, v's folds into the softmax
+probabilities — both exact).
+
+The allocator is host-side Python: block placement is a scheduling
+decision (models/serving.py), not a compiled one. Device code only ever
+sees the resulting tables.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+#: Default block granularity. Small enough that short sequences waste
+#: little pool, large enough that the kernel's per-block DMA amortizes
+#: (a [64, 128] bf16 block is 16 KiB — comfortably above the DMA knee).
+DEFAULT_BLOCK_SIZE = 64
+
+
+class OutOfBlocksError(RuntimeError):
+    """The pool has no free blocks for a required allocation.
+
+    Raised by :meth:`BlockAllocator.alloc` when the free list runs dry,
+    and by the serving engine when preemption cannot reclaim enough
+    blocks (a single request larger than the whole pool). Typed so
+    schedulers can catch it and shed load instead of crashing."""
+
+    def __init__(self, requested: int, free: int, total: int):
+        self.requested = requested
+        self.free = free
+        self.total = total
+        super().__init__(
+            f"requested {requested} KV block(s) but only {free} of "
+            f"{total} are free"
+        )
+
+
+class BlockAllocator:
+    """Free-list allocator over ``num_blocks`` fixed-size cache blocks.
+
+    LIFO reuse: freshly freed blocks are handed out first, so a steady
+    admit/retire workload keeps touching the same hot pool region
+    instead of sweeping cold HBM."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks <= 0:
+            raise ValueError(f"num_blocks must be positive, got {num_blocks}")
+        self.num_blocks = num_blocks
+        self._free = list(range(num_blocks - 1, -1, -1))
+        self._allocated: set[int] = set()
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_allocated(self) -> int:
+        return len(self._allocated)
+
+    def alloc(self, n: int = 1) -> list[int]:
+        """Take ``n`` blocks off the free list; all-or-nothing."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} blocks")
+        if n > len(self._free):
+            raise OutOfBlocksError(n, len(self._free), self.num_blocks)
+        out = [self._free.pop() for _ in range(n)]
+        self._allocated.update(out)
+        return out
+
+    def free(self, blocks) -> None:
+        """Return blocks to the free list; double-free and foreign ids
+        fail loudly (a leaked or double-owned block silently corrupts a
+        neighbour sequence's cache)."""
+        for b in blocks:
+            if b not in self._allocated:
+                raise ValueError(
+                    f"block {b} is not allocated (double free or foreign id)"
+                )
+            self._allocated.discard(b)
+            self._free.append(b)
+
+
+@dataclasses.dataclass
+class PagedKVCache:
+    """Paged KV cache: pools + block tables + per-sequence lengths.
+
+    k, v:          [L, H_kv, P, D] with P = num_blocks * block_size
+    block_tables:  [B, max_blocks_per_seq] int32 pool-block ids; entries
+                   beyond a sequence's allocated prefix are sentinel 0
+                   (a valid block id — reads of it are always masked)
+    lengths:       [B] int32 committed tokens per sequence
+    block_size is static metadata (it shapes the compiled program).
+    """
+
+    k: jax.Array
+    v: jax.Array
+    block_tables: jax.Array
+    lengths: jax.Array
+    block_size: int
+
+    @classmethod
+    def init(
+        cls,
+        config,
+        batch: int,
+        max_len: int,
+        block_size: int | None = None,
+        num_blocks: int | None = None,
+    ) -> "PagedKVCache":
+        """A cache where every sequence pre-owns a contiguous run of
+        blocks covering ``max_len`` — the fixed-reservation layout the
+        plain ``prefill``/``generate`` API uses. The serving engine
+        builds its pool with ``init_pool`` + a BlockAllocator instead."""
+        bs = block_size or _fit_block_size(max_len)
+        nbps = -(-max_len // bs)
+        nb = num_blocks if num_blocks is not None else batch * nbps
+        k, v = _init_pools(config, nb, bs)
+        tables = jnp.arange(batch * nbps, dtype=jnp.int32).reshape(
+            batch, nbps
+        )
+        return cls(
+            k=k, v=v, block_tables=tables,
+            lengths=jnp.zeros((batch,), jnp.int32), block_size=bs,
+        )
+
+    @property
+    def max_len(self) -> int:
+        """Positions addressable per sequence (the attention span)."""
+        return self.block_tables.shape[1] * self.block_size
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k.shape[2] // self.block_size
+
+
+jax.tree_util.register_dataclass(
+    PagedKVCache,
+    data_fields=["k", "v", "block_tables", "lengths"],
+    meta_fields=["block_size"],
+)
+
+
+@dataclasses.dataclass
+class PagedQuantKVCache:
+    """int8 paged cache with per-(position, head) scales.
+
+    k, v:               int8 [L, H_kv, P, D]
+    k_scale, v_scale:   f32  [L, H_kv, P]
+    Same table/length bookkeeping as PagedKVCache; half the HBM stream.
+    """
+
+    k: jax.Array
+    k_scale: jax.Array
+    v: jax.Array
+    v_scale: jax.Array
+    block_tables: jax.Array
+    lengths: jax.Array
+    block_size: int
+
+    @classmethod
+    def init(
+        cls,
+        config,
+        batch: int,
+        max_len: int,
+        block_size: int | None = None,
+        num_blocks: int | None = None,
+    ) -> "PagedQuantKVCache":
+        bs = block_size or _fit_block_size(max_len)
+        nbps = -(-max_len // bs)
+        nb = num_blocks if num_blocks is not None else batch * nbps
+        k, v, ks, vs = _init_pools(config, nb, bs, quantized=True)
+        tables = jnp.arange(batch * nbps, dtype=jnp.int32).reshape(
+            batch, nbps
+        )
+        return cls(
+            k=k, k_scale=ks, v=v, v_scale=vs, block_tables=tables,
+            lengths=jnp.zeros((batch,), jnp.int32), block_size=bs,
+        )
+
+    @property
+    def max_len(self) -> int:
+        return self.block_tables.shape[1] * self.block_size
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k.shape[2] // self.block_size
+
+
+jax.tree_util.register_dataclass(
+    PagedQuantKVCache,
+    data_fields=["k", "k_scale", "v", "v_scale", "block_tables", "lengths"],
+    meta_fields=["block_size"],
+)
+
+
+def _fit_block_size(max_len: int) -> int:
+    """The default block size, clamped so a tiny ``max_len`` (tests) does
+    not allocate a pool dominated by one oversized block."""
+    bs = DEFAULT_BLOCK_SIZE
+    while bs > max_len and bs > 8:
+        bs //= 2
+    return bs
+
+
+def _init_pools(config, num_blocks: int, block_size: int,
+                quantized: bool = False):
+    p = num_blocks * block_size
+    shape = (config.n_layers, config.n_kv_heads, p, config.head_dim)
+    if quantized:
+        return (
+            jnp.zeros(shape, jnp.int8),
+            jnp.zeros(shape, jnp.int8),
+            jnp.zeros(shape[:-1], jnp.float32),
+            jnp.zeros(shape[:-1], jnp.float32),
+        )
+    return jnp.zeros(shape, config.dtype), jnp.zeros(shape, config.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Index arithmetic shared by the write path and the XLA attention fallback.
+# ---------------------------------------------------------------------------
+
+
+def flat_write_positions(
+    block_tables: jax.Array,   # [B, NBPS] int32
+    positions: jax.Array,      # [B, T] absolute positions (may be invalid)
+    block_size: int,
+    valid: jax.Array | None = None,   # [B, T] bool, extra mask
+) -> jax.Array:
+    """Map per-sequence absolute positions to flat pool rows [B, T].
+
+    Invalid entries (position outside the sequence's addressable span,
+    or masked by ``valid``) map to the pool row count — out of bounds,
+    so a scatter with ``mode="drop"`` skips them."""
+    span = block_tables.shape[1] * block_size
+    ok = (positions >= 0) & (positions < span)
+    if valid is not None:
+        ok = ok & valid
+    blk = jnp.take_along_axis(
+        block_tables, jnp.clip(positions, 0, span - 1) // block_size, axis=1
+    )
+    flat = blk * block_size + positions % block_size
+    return jnp.where(ok, flat, jnp.iinfo(jnp.int32).max)
+
+
+def gather_indices(block_tables: jax.Array, block_size: int) -> jax.Array:
+    """Flat pool rows [B, span] covering each sequence's whole addressable
+    window in position order (for the gather-based attention fallback)."""
+    b, nbps = block_tables.shape
+    idx = (
+        block_tables[:, :, None] * block_size
+        + jnp.arange(block_size, dtype=jnp.int32)[None, None, :]
+    )
+    return idx.reshape(b, nbps * block_size)

@@ -89,28 +89,58 @@ def quantize_params(params: dict) -> dict:
 # ---------------------------------------------------------------------------
 
 
+def _mixed_dot(x: jax.Array, q: jax.Array) -> jax.Array:
+    """Contract x's last axis with q's first axis, q staying **int8 all
+    the way into the dot**: ``lax.dot_general`` takes the mixed
+    (bf16, int8) operand pair directly with an f32 accumulator, so HBM
+    streams int8 and no bf16 weight copy is ever materialized. (The old
+    seam upcast with ``astype`` before the dot; whether that convert
+    fused into the dot's operand read was up to XLA — per-step decode
+    profiles showed it sometimes didn't, materializing the full weight
+    in bf16 every step.) Output: f32 [*x_batch, *q_out]."""
+    return jax.lax.dot_general(
+        x, q, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
 def q_einsum(pattern: str, x: jax.Array, w) -> jax.Array:
     """``einsum(pattern, x, w)`` where w may be a QuantTensor.
 
     The scale is constant over the contraction axis, so it factors out of
     the sum: einsum(x, q*scale) == einsum(x, q) * scale (scale broadcast
-    over the batch dims of the output).
+    over the batch dims of the output). Every pattern the model uses
+    contracts x's last axis with w's first ("bth,hkgd->btkgd" and
+    friends), which maps onto one mixed-dtype ``dot_general`` with the
+    weight kept int8 (see _mixed_dot); anything else falls back to a
+    generic einsum with f32 accumulation.
     """
     if isinstance(w, QuantTensor):
-        y = jnp.einsum(pattern, x, w.q.astype(x.dtype))
+        ins, out = pattern.split("->")
+        xs, ws = ins.split(",")
+        if xs[-1] == ws[0] and out == xs[:-1] + ws[1:]:
+            y = _mixed_dot(x, w.q)
+        else:
+            y = jnp.einsum(
+                pattern, x, w.q, preferred_element_type=jnp.float32
+            )
         # Drop exactly the collapsed contraction axis (axis 0 of the
         # per-layer weight); the remaining axes line up with the trailing
         # output axes.
         scale = jnp.squeeze(w.scale, axis=0)
-        return (y.astype(jnp.float32) * scale).astype(x.dtype)
+        return (y * scale).astype(x.dtype)
     return jnp.einsum(pattern, x, w)
 
 
 def q_matmul(x: jax.Array, w) -> jax.Array:
-    """``x @ w`` where w may be a QuantTensor ([K, N], scale [1, N])."""
+    """``x @ w`` where w may be a QuantTensor ([K, N], scale [1, N]).
+
+    int8 stays int8 into the dot (``_mixed_dot``): at decode this is the
+    difference between streaming the lm_head once in int8 and conjuring
+    a full bf16 copy of it every step."""
     if isinstance(w, QuantTensor):
-        y = x @ w.q.astype(x.dtype)
-        return (y.astype(jnp.float32) * w.scale[0]).astype(x.dtype)
+        y = _mixed_dot(x, w.q)
+        return (y * w.scale[0]).astype(x.dtype)
     return x @ w
 
 

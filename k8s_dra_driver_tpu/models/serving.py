@@ -1,0 +1,486 @@
+"""Continuous-batching decode engine over the paged KV cache.
+
+The serving loop the DRA-claimed slice runs under sustained traffic
+(ParvaGPU's large-scale concurrent-inference target, PAPERS.md): a fixed
+number of **batch slots** share one paged KV pool (models/paged.py), and
+requests are admitted/retired at **token granularity** — a finishing
+sequence frees its slot and blocks on the very tick it completes, and a
+waiting request starts prefilling on the next.
+
+Fixed shapes, compiled once. The engine owns exactly two jitted
+programs per weight/cache variant for its whole lifetime:
+
+- ``decode_step``: one token for every slot ([B] tokens, [B] lengths,
+  [B, NBPS] block tables, [B] active mask). Growing sequences advance
+  integers; nothing retraces. ``compile_counts`` exposes the trace
+  counter — the regression oracle for the per-shape recompile spreads
+  of BENCH_r05 (tests/test_decode.py pins it to exactly 1).
+- ``prefill_chunk``: a fixed-width right-padded window of ONE request's
+  prompt. Long prompts are fed chunk by chunk, one chunk per tick,
+  while running sequences keep decoding every tick — a long prompt
+  never stalls the batch (chunked prefill).
+
+Scheduling policy (host-side, deliberately simple and auditable):
+
+- **Admission**: FIFO; a request is admitted to a free slot only when
+  the free list covers its full prompt plus one block of headroom, so
+  admission itself can never preempt anyone.
+- **Block growth**: a running sequence crossing a block boundary
+  allocates from the free list; if the pool is dry, the engine preempts
+  to feed it (below) rather than stalling the whole batch.
+- **Preemption**: victims are chosen youngest-first (most recently
+  admitted), preferring requests still in prefill over running ones —
+  running sequences are only evicted when no prefill victim exists.
+  A preempted request is reset and requeued at the FRONT of the wait
+  queue (it keeps its arrival priority); its blocks return to the free
+  list. If preemption cannot free enough blocks (the request alone
+  exceeds the pool), a typed OutOfBlocksError surfaces the sizing bug.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .decode import _forward_with_cache
+from .paged import (
+    BlockAllocator,
+    OutOfBlocksError,
+    PagedKVCache,
+    PagedQuantKVCache,
+    _init_pools,
+)
+
+WAITING = "waiting"
+PREFILL = "prefill"
+RUNNING = "running"
+FINISHED = "finished"
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request and its scheduling state."""
+
+    rid: int
+    prompt: list[int]
+    max_new_tokens: int
+    state: str = WAITING
+    slot: int = -1
+    blocks: list[int] = dataclasses.field(default_factory=list)
+    prefilled: int = 0                 # prompt tokens written to the pool
+    generated: list[int] = dataclasses.field(default_factory=list)
+    pending: int = -1                  # sampled, kv not yet written
+    admit_seq: int = -1                # admission order (victim choice)
+    preemptions: int = 0
+    arrived_at: float = 0.0
+    first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+
+    @property
+    def done(self) -> bool:
+        return self.state == FINISHED
+
+    @property
+    def tokens(self) -> list[int]:
+        return list(self.prompt) + list(self.generated)
+
+
+@dataclasses.dataclass
+class ServingStats:
+    """Counters + latency samples for the sustained-traffic bench."""
+
+    completed: int = 0
+    preemptions: int = 0
+    ticks: int = 0
+    decode_steps: int = 0
+    prefill_chunks: int = 0
+    tokens_generated: int = 0
+    ttft_s: list = dataclasses.field(default_factory=list)
+    token_interval_s: list = dataclasses.field(default_factory=list)
+    request_latency_s: list = dataclasses.field(default_factory=list)
+
+    @staticmethod
+    def _pctl(xs, q):
+        if not xs:
+            return 0.0
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+    def p50_token_ms(self) -> float:
+        return self._pctl(self.token_interval_s, 0.50) * 1e3
+
+    def p99_token_ms(self) -> float:
+        return self._pctl(self.token_interval_s, 0.99) * 1e3
+
+    def p99_ttft_ms(self) -> float:
+        return self._pctl(self.ttft_s, 0.99) * 1e3
+
+
+class DecodeEngine:
+    """Fixed-slot continuous-batching engine. See module docstring."""
+
+    def __init__(
+        self,
+        params: dict,
+        config,
+        *,
+        batch_slots: int = 4,
+        num_blocks: int = 64,
+        block_size: int = 16,
+        max_seq_len: int | None = None,
+        prefill_chunk: int = 32,
+        quantize_cache: bool = False,
+        eos_id: int | None = None,
+        temperature: float = 0.0,
+        mesh=None,
+        clock=time.monotonic,
+    ):
+        self.params = params
+        self.config = config
+        self.batch_slots = batch_slots
+        self.block_size = block_size
+        self.prefill_chunk = prefill_chunk
+        self.quantize_cache = quantize_cache
+        self.eos_id = eos_id
+        self.temperature = temperature
+        self.mesh = mesh
+        self._clock = clock
+        span = max_seq_len or min(config.max_seq_len,
+                                  num_blocks * block_size)
+        self.max_blocks_per_seq = -(-span // block_size)
+        self.max_seq_len = self.max_blocks_per_seq * block_size
+
+        self.allocator = BlockAllocator(num_blocks)
+        pools = _init_pools(config, num_blocks, block_size,
+                            quantized=quantize_cache)
+        self._pools = tuple(pools)
+        b = batch_slots
+        self._tables = np.zeros((b, self.max_blocks_per_seq), np.int32)
+        self._lengths = np.zeros((b,), np.int32)
+        self._pending = np.zeros((b,), np.int32)
+        self._slots: list[Optional[Request]] = [None] * b
+        self._slot_last_token_t: list[float] = [0.0] * b
+        self.waiting: deque[Request] = deque()
+        self.stats = ServingStats()
+        self.compile_counts = {"decode_step": 0, "prefill_chunk": 0}
+        self._rid = 0
+        self._admit_seq = 0
+        self._rng = jax.random.PRNGKey(0)
+
+        cache_cls = PagedQuantKVCache if quantize_cache else PagedKVCache
+
+        def _mk_cache(pools, tables, lengths):
+            if quantize_cache:
+                k, v, ks, vs = pools
+                return cache_cls(
+                    k=k, k_scale=ks, v=v, v_scale=vs,
+                    block_tables=tables, lengths=lengths,
+                    block_size=block_size,
+                )
+            k, v = pools
+            return cache_cls(
+                k=k, v=v, block_tables=tables, lengths=lengths,
+                block_size=block_size,
+            )
+
+        def _pools_of(cache):
+            if quantize_cache:
+                return (cache.k, cache.v, cache.k_scale, cache.v_scale)
+            return (cache.k, cache.v)
+
+        def _decode_fn(params, pools, tables, lengths, tokens, active, key):
+            self.compile_counts["decode_step"] += 1
+            cache = _mk_cache(pools, tables, lengths)
+            logits, cache = _forward_with_cache(
+                params, tokens[:, None], cache, config,
+                positions=lengths[:, None], active=active, mesh=mesh,
+            )
+            logits = logits[:, 0]
+            if temperature > 0.0:
+                nxt = jax.random.categorical(key, logits / temperature)
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+            return nxt.astype(jnp.int32), _pools_of(cache)
+
+        def _prefill_fn(params, pools, table_row, start, n_valid, chunk,
+                        key):
+            self.compile_counts["prefill_chunk"] += 1
+            cache = _mk_cache(
+                pools, table_row[None], jnp.broadcast_to(start, (1,))
+            )
+            positions = start + jnp.arange(chunk.shape[0])
+            logits, cache = _forward_with_cache(
+                params, chunk[None], cache, config, positions[None],
+                n_valid=n_valid, mesh=mesh,
+            )
+            last = logits[0, jnp.maximum(n_valid - 1, 0)]
+            if temperature > 0.0:
+                tok = jax.random.categorical(key, last / temperature)
+            else:
+                tok = jnp.argmax(last, axis=-1)
+            return tok.astype(jnp.int32), _pools_of(cache)
+
+        # Donating the pools keeps the cache update in place on TPU; CPU
+        # ignores donation with a warning, so only ask for it there.
+        donate = (1,) if jax.default_backend() == "tpu" else ()
+        self._decode = jax.jit(_decode_fn, donate_argnums=donate)
+        self._prefill = jax.jit(_prefill_fn, donate_argnums=donate)
+
+    # -- public API --------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int) -> Request:
+        """Queue a request; returns its handle (tokens appear on it as
+        generation proceeds)."""
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        total = len(prompt) + max_new_tokens
+        if total > self.max_seq_len:
+            raise ValueError(
+                f"request needs {total} positions but the engine's "
+                f"per-sequence span is {self.max_seq_len}"
+            )
+        blocks_needed = -(-total // self.block_size)
+        if blocks_needed > self.allocator.num_blocks:
+            raise OutOfBlocksError(
+                blocks_needed, self.allocator.num_free,
+                self.allocator.num_blocks,
+            )
+        req = Request(
+            rid=self._rid, prompt=prompt, max_new_tokens=max_new_tokens,
+            arrived_at=self._clock(),
+        )
+        self._rid += 1
+        self.waiting.append(req)
+        return req
+
+    @property
+    def num_active(self) -> int:
+        return sum(1 for r in self._slots if r is not None)
+
+    @property
+    def idle(self) -> bool:
+        return self.num_active == 0 and not self.waiting
+
+    def tick(self) -> None:
+        """One scheduling round: admit, advance one prefill chunk, then
+        one decode step for every running slot."""
+        self.stats.ticks += 1
+        self._admit()
+        self._prefill_tick()
+        self._decode_tick()
+
+    def run(self, max_ticks: int = 100000) -> None:
+        """Drive ticks until every submitted request has finished."""
+        for _ in range(max_ticks):
+            if self.idle:
+                return
+            self.tick()
+        raise RuntimeError(f"engine not idle after {max_ticks} ticks")
+
+    def assert_no_leaks(self) -> None:
+        """After drain: every block is back on the free list."""
+        if not self.idle:
+            raise AssertionError("engine not idle")
+        if self.allocator.num_allocated:
+            raise AssertionError(
+                f"{self.allocator.num_allocated} block(s) leaked"
+            )
+
+    # -- scheduling internals ---------------------------------------------
+
+    def _admit(self) -> None:
+        while self.waiting:
+            free_slot = next(
+                (i for i, r in enumerate(self._slots) if r is None), None
+            )
+            if free_slot is None:
+                return
+            req = self.waiting[0]
+            # Admission covers the full prompt + one block of headroom so
+            # admitting can never preempt an already-running sequence —
+            # capped at the request's lifetime need (which submit()
+            # validated against the pool), else a prompt that exactly
+            # fills its block budget could never admit into an idle pool.
+            lifetime = -(
+                -(len(req.prompt) + req.max_new_tokens) // self.block_size
+            )
+            need = min(
+                -(-len(req.prompt) // self.block_size) + 1, lifetime
+            )
+            if self.allocator.num_free < need:
+                return
+            self.waiting.popleft()
+            req.state = PREFILL
+            req.slot = free_slot
+            req.prefilled = 0
+            req.admit_seq = self._admit_seq
+            self._admit_seq += 1
+            self._slots[free_slot] = req
+            self._lengths[free_slot] = 0
+            self._tables[free_slot, :] = 0
+
+    def _ensure_blocks(self, req: Request, positions: int) -> None:
+        """Grow ``req``'s block table to cover ``positions`` tokens,
+        preempting younger requests if the pool is dry."""
+        need = -(-positions // self.block_size)
+        while len(req.blocks) < need:
+            # A victim still in early prefill may hold zero blocks: keep
+            # preempting until a block is actually free (_preempt_for
+            # raises a typed error once nobody is left to evict).
+            while self.allocator.num_free == 0:
+                self._preempt_for(req)
+            new = self.allocator.alloc(1)[0]
+            self._tables[req.slot, len(req.blocks)] = new
+            req.blocks.append(new)
+
+    def _preempt_for(self, needy: Request) -> None:
+        """Evict the youngest other request (prefill-state preferred) and
+        recycle its blocks; typed failure when nobody can be evicted."""
+        candidates = [
+            r for r in self._slots
+            if r is not None and r is not needy
+        ]
+        if not candidates:
+            raise OutOfBlocksError(1, 0, self.allocator.num_blocks)
+        in_prefill = [r for r in candidates if r.state == PREFILL]
+        pool = in_prefill or candidates
+        victim = max(pool, key=lambda r: r.admit_seq)
+        self._evict(victim, requeue=True)
+        self.stats.preemptions += 1
+
+    def _evict(self, req: Request, requeue: bool) -> None:
+        slot = req.slot
+        self.allocator.free(req.blocks)
+        req.blocks = []
+        req.slot = -1
+        self._slots[slot] = None
+        self._lengths[slot] = 0
+        self._tables[slot, :] = 0
+        if requeue:
+            # Restart from scratch on the next admission; the handle keeps
+            # its identity (and arrival priority) but drops partial work.
+            req.prefilled = 0
+            req.generated = []
+            req.pending = -1
+            req.first_token_at = None
+            req.state = WAITING
+            req.preemptions += 1
+            self.waiting.appendleft(req)
+
+    def _finish(self, req: Request) -> None:
+        req.state = FINISHED
+        req.finished_at = self._clock()
+        self.stats.completed += 1
+        self.stats.request_latency_s.append(
+            req.finished_at - req.arrived_at
+        )
+        self._evict(req, requeue=False)
+
+    def _next_key(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _prefill_tick(self) -> None:
+        req = min(
+            (r for r in self._slots
+             if r is not None and r.state == PREFILL),
+            key=lambda r: r.admit_seq,
+            default=None,
+        )
+        if req is None:
+            return
+        lo = req.prefilled
+        chunk = req.prompt[lo:lo + self.prefill_chunk]
+        n_valid = len(chunk)
+        padded = np.zeros((self.prefill_chunk,), np.int32)
+        padded[:n_valid] = chunk
+        self._ensure_blocks(req, lo + n_valid)
+        tok, self._pools = self._prefill(
+            self.params, self._pools,
+            jnp.asarray(self._tables[req.slot]),
+            jnp.asarray(np.int32(lo)),
+            jnp.asarray(np.int32(n_valid)),
+            jnp.asarray(padded),
+            self._next_key(),
+        )
+        self.stats.prefill_chunks += 1
+        req.prefilled = lo + n_valid
+        self._lengths[req.slot] = req.prefilled
+        if req.prefilled == len(req.prompt):
+            # The last prompt logits sample the first generated token.
+            now = self._clock()
+            first = int(tok)
+            req.state = RUNNING
+            req.first_token_at = now
+            req.generated.append(first)
+            req.pending = first
+            self.stats.tokens_generated += 1
+            self.stats.ttft_s.append(now - req.arrived_at)
+            self._slot_last_token_t[req.slot] = now
+            if self._is_final(req, first):
+                self._finish(req)
+
+    def _is_final(self, req: Request, tok: int) -> bool:
+        return (
+            len(req.generated) >= req.max_new_tokens
+            or (self.eos_id is not None and tok == self.eos_id)
+        )
+
+    def _decode_tick(self) -> None:
+        running = [
+            r for r in self._slots
+            if r is not None and r.state == RUNNING
+        ]
+        if not running:
+            return
+        # The step writes each pending token's kv at position lengths[b]:
+        # make sure that position has a block under it. An earlier
+        # iteration's preemption may have evicted a later request in this
+        # snapshot — growing an evicted request (slot -1) would write a
+        # neighbour's block-table row and leak the block.
+        for r in running:
+            if r.state != RUNNING:
+                continue
+            self._ensure_blocks(r, self._lengths[r.slot] + 1)
+        # Preemption may have demoted someone mid-loop: re-collect.
+        running = [
+            r for r in self._slots
+            if r is not None and r.state == RUNNING
+        ]
+        if not running:
+            return
+        active = np.zeros((self.batch_slots,), bool)
+        for r in running:
+            active[r.slot] = True
+            self._pending[r.slot] = r.pending
+        nxt, self._pools = self._decode(
+            self.params, self._pools,
+            jnp.asarray(self._tables),
+            jnp.asarray(self._lengths),
+            jnp.asarray(self._pending),
+            jnp.asarray(active),
+            self._next_key(),
+        )
+        nxt = np.asarray(nxt)
+        now = self._clock()
+        self.stats.decode_steps += 1
+        for r in running:
+            slot = r.slot
+            self._lengths[slot] += 1
+            tok = int(nxt[slot])
+            r.generated.append(tok)
+            r.pending = tok
+            self.stats.tokens_generated += 1
+            self.stats.token_interval_s.append(
+                now - self._slot_last_token_t[slot]
+            )
+            self._slot_last_token_t[slot] = now
+            if self._is_final(r, tok):
+                self._finish(r)

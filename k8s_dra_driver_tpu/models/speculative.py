@@ -18,8 +18,8 @@ a cache after a partial acceptance is free — the cache's scalar `length`
 masks everything beyond it, and later writes overwrite in place
 (models/decode.py's attention masks on valid_len).
 
-Single-sequence (B=1): acceptance lengths are per-sequence, and a
-scalar cache length cannot rewind rows independently. Composes with the
+Single-sequence (B=1): acceptance lengths differ per sequence, and
+the rewind below moves every row's length together. Composes with the
 int8 weight/cache paths (same decode machinery underneath).
 """
 
@@ -36,9 +36,12 @@ from .moe import MoeConfig
 
 
 def _rewind(cache, length):
-    """A cache rewind is just the scalar length: entries beyond it are
-    masked in attention and overwritten by later writes."""
-    return dataclasses.replace(cache, length=length)
+    """A cache rewind is just the per-sequence length vector: entries
+    beyond it are masked in attention and overwritten by later writes —
+    block tables are untouched (the paged pool keeps the same blocks)."""
+    return dataclasses.replace(
+        cache, lengths=jnp.broadcast_to(length, cache.lengths.shape)
+    )
 
 
 def speculative_generate(
@@ -54,8 +57,11 @@ def speculative_generate(
 ):
     """Greedy generation via draft speculation; returns [1, S + N], or
     (tokens, stats) with ``return_stats`` — stats = {"rounds",
-    "accepted"}: tokens-per-round ≈ accepted/rounds + 1, the number that
-    says whether ``k`` (and the draft) pay for themselves.
+    "accepted", "acceptance_rate"}: acceptance_rate = accepted /
+    (rounds * k) in [0, 1], and tokens-per-round ≈ accepted/rounds + 1 —
+    the numbers that say whether ``k`` (and the draft) pay for
+    themselves. The decode bench surfaces acceptance_rate in its detail
+    so speculation wins and losses stay attributable.
 
     ``k`` draft tokens are proposed per verification round. Requires the
     two configs to share a vocabulary.
@@ -169,5 +175,12 @@ def speculative_generate(
     )
     tokens = jnp.concatenate([prompt, out[:, :max_new_tokens]], axis=1)
     if return_stats:
-        return tokens, {"rounds": rounds, "accepted": accepted}
+        rate = accepted.astype(jnp.float32) / jnp.maximum(
+            rounds.astype(jnp.float32) * k, 1.0
+        )
+        return tokens, {
+            "rounds": rounds,
+            "accepted": accepted,
+            "acceptance_rate": rate,
+        }
     return tokens

@@ -1,12 +1,19 @@
 """TPU ops: flash attention (Pallas), fused norms, rotary embeddings."""
 
-from .attention import attention_reference, flash_attention
+from .attention import (
+    attention_reference,
+    flash_attention,
+    paged_attention_reference,
+    paged_decode_attention,
+)
 from .norms import rmsnorm, rmsnorm_reference
 from .rotary import apply_rope, rope_frequencies
 
 __all__ = [
     "flash_attention",
     "attention_reference",
+    "paged_attention_reference",
+    "paged_decode_attention",
     "rmsnorm",
     "rmsnorm_reference",
     "apply_rope",

@@ -580,6 +580,240 @@ def attention_blocks() -> tuple[int, int, int, int]:
     return _BLOCK_Q, _BLOCK_K, _BWD_BLOCK_Q, _BWD_BLOCK_K
 
 
+# ---------------------------------------------------------------------------
+# Paged decode attention: single-token queries against a paged KV pool.
+#
+# The serving hot path (models/serving.py): each sequence's KV lives in
+# fixed-size blocks of a shared pool, addressed through a per-sequence
+# block table. The kernel walks (batch, kv-head, block) with the block
+# dim innermost — the online-softmax accumulators persist in VMEM across
+# blocks, and the block table rides in as a scalar-prefetch operand so
+# each grid step's BlockSpec index map can DMA exactly the right pool
+# block (the pattern of SNIPPETS.md [1]'s pallas_call usage, specialized
+# to table-indirect reads). int8 pools carry per-position scales: k's
+# multiplies the finished scores (constant over the contracted D axis —
+# exact), v's folds into the softmax probabilities (exact).
+# ---------------------------------------------------------------------------
+
+
+def _paged_decode_kernel(
+    tables_ref, vlen_ref,            # scalar prefetch
+    q_ref, k_ref, v_ref, *rest,
+    scale: float, block_size: int, quantized: bool,
+):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+    vlen = vlen_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Blocks wholly past the valid prefix are skipped; their table
+    # entries are sentinel 0 so the (unavoidable) prefetch DMA reads a
+    # real block whose values never enter the accumulators.
+    @pl.when(j * block_size < vlen)
+    def _step():
+        q = q_ref[0, 0]                              # [G, D]
+        k = k_ref[0].astype(q.dtype)                 # [Bs, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * (scale * LOG2E)                          # [G, Bs] f32, base-2
+        if quantized:
+            # Per-position k scale is constant over the contracted D
+            # axis: multiplying the finished scores is exact. The score
+            # is already in base-2 log space scale-wise (a pure product),
+            # so the multiply commutes with the LOG2E fold.
+            s = s * ks_ref[0][None, :]
+        kpos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_size), 1
+        )
+        s = jnp.where(kpos < vlen, s, NEG_INF)
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp2(s - m_new)                      # [G, Bs]
+        corr = jnp.exp2(m_prev - m_new)
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        if quantized:
+            # v's scale varies over the contraction axis: fold it into
+            # the probabilities (exact), contract against raw int8.
+            p = p * vs_ref[0][None, :]
+            v = v_ref[0].astype(jnp.float32)
+            pv = p
+        else:
+            v = v_ref[0]
+            pv = p.astype(v.dtype)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            pv, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = m_new
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def _paged_decode_pallas(
+    q: jax.Array,              # [B, Hq, D]
+    k_pool: jax.Array,         # [H_kv, P, D]
+    v_pool: jax.Array,
+    block_tables: jax.Array,   # [B, NBPS] int32
+    valid_len: jax.Array,      # [B] int32 (kv entries visible per seq)
+    scale: float,
+    block_size: int,
+    k_scale: jax.Array | None = None,   # [H_kv, P] f32
+    v_scale: jax.Array | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    b, hq, d = q.shape
+    hkv = k_pool.shape[0]
+    assert hq % hkv == 0, (hq, hkv)
+    g = hq // hkv
+    nbps = block_tables.shape[1]
+    quantized = k_scale is not None
+    qg = q.reshape(b, hkv, g, d)
+    kernel = functools.partial(
+        _paged_decode_kernel,
+        scale=scale, block_size=block_size, quantized=quantized,
+    )
+    kv_spec = pl.BlockSpec(
+        (1, block_size, d), lambda b_, h, j, tab, vl: (h, tab[b_, j], 0)
+    )
+    in_specs = [
+        pl.BlockSpec((1, 1, g, d), lambda b_, h, j, tab, vl: (b_, h, 0, 0)),
+        kv_spec,
+        kv_spec,
+    ]
+    operands = [qg, k_pool, v_pool]
+    if quantized:
+        sc_spec = pl.BlockSpec(
+            (1, block_size), lambda b_, h, j, tab, vl: (h, tab[b_, j])
+        )
+        in_specs += [sc_spec, sc_spec]
+        operands += [k_scale, v_scale]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hkv, nbps),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, 1, g, d), lambda b_, h, j, tab, vl: (b_, h, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((g, 1), jnp.float32),    # running max m
+            pltpu.VMEM((g, 1), jnp.float32),    # running denom l
+            pltpu.VMEM((g, d), jnp.float32),    # output accumulator
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
+        interpret=interpret,
+    )(block_tables, valid_len, *operands)
+    return out.reshape(b, hq, d)
+
+
+def paged_attention_reference(
+    q: jax.Array,              # [B, Hq, T, D]
+    k_pool: jax.Array,         # [H_kv, P, D] (bf16/f32, or int8 + scales)
+    v_pool: jax.Array,
+    block_tables: jax.Array,   # [B, NBPS] int32
+    positions: jax.Array,      # [B, T] absolute query positions
+    block_size: int,
+    scale: float | None = None,
+    k_scale: jax.Array | None = None,   # [H_kv, P] f32
+    v_scale: jax.Array | None = None,
+) -> jax.Array:
+    """Plain-XLA paged attention: gather each sequence's window from the
+    pool through its block table, then grouped-GQA masked attention.
+    Handles any query width T (prefill chunks use T>1; the Pallas kernel
+    covers only the T=1 decode shape). The numerics oracle for the
+    kernel in tests/test_ops.py."""
+    # Inside the function: models imports ops at package init, so a
+    # module-level import here would be circular.
+    from ..models.paged import gather_indices
+
+    b, hq, t, d = q.shape
+    hkv = k_pool.shape[0]
+    g = hq // hkv
+    scale = scale if scale is not None else d ** -0.5
+    span = block_tables.shape[1] * block_size
+    idx = gather_indices(block_tables, block_size)
+    # Single advanced index on axis 1 stays in place: [H_kv, B, S, D].
+    k = jnp.transpose(k_pool[:, idx, :], (1, 0, 2, 3))
+    v = jnp.transpose(v_pool[:, idx, :], (1, 0, 2, 3))
+    qg = q.reshape(b, hkv, g, t, d)
+    s = jnp.einsum(
+        "bhgtd,bhsd->bhgts", qg, k.astype(q.dtype),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    if k_scale is not None:
+        ks = jnp.transpose(k_scale[:, idx], (1, 0, 2))   # [B, H_kv, S]
+        s = s * ks[:, :, None, None, :]
+    kpos = jnp.arange(span, dtype=jnp.int32)
+    mask = kpos[None, None, :] <= positions[:, :, None]  # [B, T, S]
+    s = jnp.where(mask[:, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out_dtype = q.dtype
+    if v_scale is not None:
+        vs = jnp.transpose(v_scale[:, idx], (1, 0, 2))
+        p = p * vs[:, :, None, None, :]
+        out = jnp.einsum(
+            "bhgts,bhsd->bhgtd", p, v.astype(jnp.float32)
+        ).astype(out_dtype)
+    else:
+        out = jnp.einsum(
+            "bhgts,bhsd->bhgtd", p.astype(out_dtype), v.astype(out_dtype)
+        )
+    return out.reshape(b, hq, t, d)
+
+
+def paged_decode_attention(
+    q: jax.Array,              # [B, Hq, D] — one query token per sequence
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    block_tables: jax.Array,
+    valid_len: jax.Array,      # [B] kv entries visible (query pos + 1)
+    block_size: int,
+    scale: float | None = None,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
+    force_pallas: bool = False,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused single-token paged attention with XLA fallback.
+
+    Dispatches to the Pallas kernel on TPU (honouring the
+    ``set_attention_impl`` override) and to the gather-based reference
+    elsewhere; both read the pool through the block table and mask at
+    ``valid_len`` per sequence."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else d ** -0.5
+    on_tpu = jax.default_backend() == "tpu"
+    if force_pallas or (on_tpu and _ATTN_IMPL != "xla"):
+        return _paged_decode_pallas(
+            q, k_pool, v_pool, block_tables, valid_len, scale, block_size,
+            k_scale=k_scale, v_scale=v_scale,
+            interpret=interpret or not on_tpu,
+        )
+    out = paged_attention_reference(
+        q[:, :, None, :], k_pool, v_pool, block_tables,
+        (valid_len - 1)[:, None], block_size, scale,
+        k_scale=k_scale, v_scale=v_scale,
+    )
+    return out[:, :, 0, :]
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,

@@ -30,14 +30,20 @@ def apply_rope(
     x: jax.Array,          # [B, H, S, D]
     cos: jax.Array,        # [S, D/2] (or sliced to positions)
     sin: jax.Array,
-    positions: jax.Array | None = None,   # [S] absolute positions
+    positions: jax.Array | None = None,   # [S] shared or [B, S] per-seq
 ) -> jax.Array:
-    if positions is not None:
-        cos = cos[positions]
-        sin = sin[positions]
+    if positions is not None and positions.ndim == 2:
+        # Per-sequence positions (continuous batching: every slot sits at
+        # its own offset). Gather [B, S, D/2] and broadcast over heads.
+        cos = cos[positions][:, None]
+        sin = sin[positions][:, None]
+    else:
+        if positions is not None:
+            cos = cos[positions]
+            sin = sin[positions]
+        cos = cos[None, None, :, :]
+        sin = sin[None, None, :, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    cos = cos[None, None, :, :]
-    sin = sin[None, None, :, :]
     out = jnp.concatenate(
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
     )

@@ -1,0 +1,27 @@
+"""jax API compatibility shims shared by the parallelism modules."""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6 exports shard_map at top level
+    from jax import shard_map
+except ImportError:  # pragma: no cover - jax 0.4.x
+    from jax.experimental.shard_map import shard_map
+
+
+def shard_map_compat(*args, **kwargs):
+    """shard_map across jax versions: the replication-check kwarg was
+    renamed check_rep -> check_vma, and older jax spells the manual-axes
+    set as its complement ``auto``; translate both."""
+    try:
+        return shard_map(*args, **kwargs)
+    except TypeError:
+        kwargs = dict(kwargs)
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        if "axis_names" in kwargs:
+            mesh_ = kwargs.get(
+                "mesh", args[1] if len(args) > 1 else None
+            )
+            manual = frozenset(kwargs.pop("axis_names"))
+            kwargs["auto"] = frozenset(mesh_.axis_names) - manual
+        return shard_map(*args, **kwargs)

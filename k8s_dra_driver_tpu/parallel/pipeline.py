@@ -18,8 +18,9 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from .compat import shard_map_compat as _shard_map_compat
 
 
 def stage_params(layer_stack: Any, n_stages: int) -> Any:
@@ -118,7 +119,7 @@ def pipeline(
             set(batch_axes or ()) & set(mesh.axis_names)
         )
         kwargs["axis_names"] = frozenset(manual)
-    fn = shard_map(
+    fn = _shard_map_compat(
         local,
         mesh=mesh,
         in_specs=(spec_params, mb_spec),

@@ -20,7 +20,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+from .compat import shard_map_compat as _shard_map_compat
 
 NEG_INF = -1e30
 
@@ -309,7 +310,7 @@ def ring_attention(
             k = jnp.repeat(k, r, axis=1)
             v = jnp.repeat(v, r, axis=1)
         kv_spec = P(batch_axes, head_axis, axis_name, None)
-        fn = shard_map(
+        fn = _shard_map_compat(
             # custom_vjp nondiff args must stay positional.
             lambda q_, k_, v_: _ring_flash(
                 q_, k_, v_, axis_name, scale, causal, not on_tpu
@@ -325,7 +326,7 @@ def ring_attention(
         k = jnp.repeat(k, reps, axis=1)
         v = jnp.repeat(v, reps, axis=1)
     spec = P(batch_axes, head_axis, axis_name, None)
-    fn = shard_map(
+    fn = _shard_map_compat(
         functools.partial(
             _ring_attention_local,
             axis_name=axis_name,
@@ -385,7 +386,7 @@ def ulysses_attention(
         return a2a(oh, 2, 1)
 
     spec = P(batch_axes, None, axis_name, None)
-    fn = shard_map(
+    fn = _shard_map_compat(
         local,
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -1,11 +1,17 @@
-"""KV-cache decode tests: cached inference must match full forward."""
+"""KV-cache decode tests: cached inference must match full forward.
+
+The cache is paged (models/paged.py): these tests pin that the
+block-table indirection is invisible to numerics — prefill + stepwise
+decode through pool blocks equals the full forward — and that the
+decode step is genuinely fixed-shape (the compile-once oracle below).
+"""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from k8s_dra_driver_tpu.models.decode import (
-    KVCache,
+    PagedKVCache,
     decode_step,
     generate,
     prefill,
@@ -28,7 +34,7 @@ class TestPrefillDecode:
         full = forward(params, prompt, TINY)
         last, cache = prefill(params, prompt, TINY, max_len=32)
         np.testing.assert_allclose(last, full[:, -1], atol=1e-4, rtol=1e-4)
-        assert int(cache.length) == 12
+        assert cache.lengths.tolist() == [12, 12]
 
     def test_decode_matches_forward_incrementally(self):
         """Decoding token-by-token must equal running the full forward on
@@ -44,6 +50,42 @@ class TestPrefillDecode:
             np.testing.assert_allclose(
                 last, full[:, -1], atol=2e-4, rtol=2e-4
             )
+
+    def test_decode_across_block_boundaries(self):
+        """A small block size forces the stepwise decode to cross pool
+        block boundaries mid-generation; numerics must not notice."""
+        params, prompt = setup()
+        last, cache = prefill(params, prompt, TINY, max_len=32,
+                              block_size=8)
+        assert cache.block_size == 8
+        assert cache.block_tables.shape == (2, 4)
+        seq = prompt
+        for _ in range(6):   # crosses the 16-boundary (12 -> 18)
+            tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            seq = jnp.concatenate([seq, tok[:, None]], axis=1)
+            full = forward(params, seq, TINY)
+            last, cache = decode_step(params, tok, cache, TINY)
+            np.testing.assert_allclose(
+                last, full[:, -1], atol=2e-4, rtol=2e-4
+            )
+
+    def test_decode_step_traces_once_across_growth(self):
+        """A jitted decode_step must trace exactly once while sequences
+        grow across block boundaries — TRACE_COUNTS catches any shape
+        that still leaks sequence length (the engine-level analog is
+        TestCompileOnce below)."""
+        from k8s_dra_driver_tpu.models.decode import TRACE_COUNTS
+
+        params, prompt = setup()
+        last, cache = prefill(params, prompt, TINY, max_len=32,
+                              block_size=8)
+        step = jax.jit(lambda p, t, c: decode_step(p, t, c, TINY))
+        key = "forward:bf16:t1"
+        before = TRACE_COUNTS[key]
+        for _ in range(8):   # 12 -> 20 crosses the 16-row block boundary
+            tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            last, cache = step(params, tok, cache)
+        assert TRACE_COUNTS[key] - before == 1, TRACE_COUNTS
 
     def test_generate_greedy_matches_manual(self):
         params, prompt = setup()
@@ -66,11 +108,57 @@ class TestPrefillDecode:
         assert out.shape == (2, 15)
 
     def test_cache_init_shapes(self):
-        cache = KVCache.init(TINY, batch=3, max_len=64)
+        cache = PagedKVCache.init(TINY, batch=3, max_len=64, block_size=16)
+        # Pool: [L, H_kv, num_blocks * block_size, D]; by default every
+        # sequence pre-owns the blocks covering max_len.
         assert cache.k.shape == (
-            TINY.n_layers, 3, TINY.n_kv_heads, 64, TINY.head_dim,
+            TINY.n_layers, TINY.n_kv_heads, 3 * 64, TINY.head_dim,
         )
-        assert int(cache.length) == 0
+        assert cache.block_tables.shape == (3, 4)
+        assert cache.lengths.tolist() == [0, 0, 0]
+        assert cache.max_len == 64
+
+
+class TestCompileOnce:
+    """The regression oracle for the BENCH_r05 recompile spreads: one
+    compiled decode step must carry a sequence from its first token to
+    the engine's max length — for every serving variant."""
+
+    def _run_variant(self, quant_weights: bool, quantize_cache: bool):
+        from k8s_dra_driver_tpu.models.quant import quantize_params
+        from k8s_dra_driver_tpu.models.serving import DecodeEngine
+
+        params = init_params(TINY, jax.random.PRNGKey(0))
+        if quant_weights:
+            params = quantize_params(params)
+        eng = DecodeEngine(
+            params, TINY, batch_slots=2, num_blocks=16, block_size=8,
+            max_seq_len=40, prefill_chunk=8,
+            quantize_cache=quantize_cache,
+        )
+        # One token of prompt, decode to the span limit: lengths sweep
+        # 1..40, crossing four block boundaries.
+        req = eng.submit([5], max_new_tokens=39)
+        eng.run()
+        assert req.done and len(req.generated) == 39
+        eng.assert_no_leaks()
+        return eng.compile_counts
+
+    def test_bf16_compiles_once(self):
+        counts = self._run_variant(False, False)
+        assert counts == {"decode_step": 1, "prefill_chunk": 1}, counts
+
+    def test_int8_weights_compile_once(self):
+        counts = self._run_variant(True, False)
+        assert counts == {"decode_step": 1, "prefill_chunk": 1}, counts
+
+    def test_int8_kv_cache_compiles_once(self):
+        counts = self._run_variant(False, True)
+        assert counts == {"decode_step": 1, "prefill_chunk": 1}, counts
+
+    def test_int8_weights_and_cache_compile_once(self):
+        counts = self._run_variant(True, True)
+        assert counts == {"decode_step": 1, "prefill_chunk": 1}, counts
 
 
 class TestMoeDecode:

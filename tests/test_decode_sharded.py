@@ -16,7 +16,11 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from k8s_dra_driver_tpu.models.decode import KVCache, decode_step, prefill
+from k8s_dra_driver_tpu.models.decode import (
+    PagedKVCache,
+    decode_step,
+    prefill,
+)
 from k8s_dra_driver_tpu.models.llama import (
     PRESETS,
     forward,
@@ -62,9 +66,14 @@ def _compare_prefill_and_decode(pre, step, sh_params, sh_tokens, ref):
 
 
 def cache_specs():
-    # k,v: [L, B, H_kv, S_max, D] — batch on data, kv heads on tensor.
-    kv = P(None, ("data", "fsdp"), "tensor", None, None)
-    return KVCache(k=kv, v=kv, length=P())
+    # Paged pools k,v: [L, H_kv, P, D] — kv heads on tensor (the pool has
+    # no batch dim: blocks are shared capacity, so the serving layout
+    # shards heads Megatron-style and replicates the tiny table/length
+    # bookkeeping; batch stays sharded in tokens/logits only).
+    kv = P(None, "tensor", None, None)
+    return PagedKVCache(
+        k=kv, v=kv, block_tables=P(), lengths=P(), block_size=8,
+    )
 
 
 def test_sharded_decode_matches_unsharded():
@@ -90,7 +99,7 @@ def test_sharded_decode_matches_unsharded():
     logits_sh = NamedSharding(mesh, P(("data", "fsdp"), None))
 
     pre = jax.jit(
-        lambda p, t: prefill(p, t, CONFIG, MAX_LEN),
+        lambda p, t: prefill(p, t, CONFIG, MAX_LEN, block_size=8),
         out_shardings=(logits_sh, cache_sh),
     )
     step = jax.jit(
@@ -137,7 +146,7 @@ def test_sharded_int8_decode_matches_unsharded():
     )
     logits_sh = NamedSharding(mesh, P(("data", "fsdp"), None))
     pre = jax.jit(
-        lambda p, t: prefill(p, t, CONFIG, MAX_LEN),
+        lambda p, t: prefill(p, t, CONFIG, MAX_LEN, block_size=8),
         out_shardings=(logits_sh, cache_sh),
     )
     step = jax.jit(

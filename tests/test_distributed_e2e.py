@@ -18,6 +18,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 
 from k8s_dra_driver_tpu.cdi import CDIHandler
 from k8s_dra_driver_tpu.plugin.checkpoint import CheckpointManager
@@ -33,7 +35,10 @@ import jax
 # A DRA-scheduled pod on TPU hardware skips both updates; this simulated
 # pod pins the hermetic CPU platform the way tests/conftest.py does.
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 1)
+try:
+    jax.config.update("jax_num_cpu_devices", 1)
+except AttributeError:  # older jax: single CPU device is the default
+    pass
 
 from k8s_dra_driver_tpu.parallel.distributed import initialize_distributed
 
@@ -160,6 +165,7 @@ class TestLaunchEnvInjection:
 class TestTwoProcessBootstrap:
     def test_gang_claim_forms_jax_cluster(self, tmp_path, monkeypatch):
         outs = _run_gang_workers(tmp_path, monkeypatch, WORKER_SRC)
+        _skip_if_cpu_multiprocess_unsupported(outs)
         for rc, out, err in outs:
             assert rc == 0, f"worker failed:\n{out}\n{err}"
             # Two processes, one device each; sum over the global array is
@@ -171,7 +177,10 @@ MODEL_WORKER_SRC = """
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 1)
+try:
+    jax.config.update("jax_num_cpu_devices", 1)
+except AttributeError:  # older jax: single CPU device is the default
+    pass
 
 from k8s_dra_driver_tpu.parallel.distributed import initialize_distributed
 
@@ -209,6 +218,15 @@ logits, cache = jax.jit(
 mine = np.asarray(logits.addressable_data(0))[0]
 print("LOGITS", pid, int(mine.argmax()), float(mine[0]), flush=True)
 """
+
+
+def _skip_if_cpu_multiprocess_unsupported(outs):
+    """Old jaxlib CPU backends cannot run multiprocess collectives at
+    all; the gang bootstrap is then untestable on this machine (it works
+    on real TPU pods and on newer jaxlib CPU builds)."""
+    marker = "Multiprocess computations aren't implemented"
+    if any(marker in (out or "") + (err or "") for _, out, err in outs):
+        pytest.skip("this jaxlib has no multiprocess CPU backend")
 
 
 def _run_gang_workers(tmp_path, monkeypatch, worker_src: str):
@@ -281,6 +299,7 @@ class TestTwoProcessServing:
         }
 
         outs = _run_gang_workers(tmp_path, monkeypatch, MODEL_WORKER_SRC)
+        _skip_if_cpu_multiprocess_unsupported(outs)
 
         got = {}
         for rc, out, err in outs:

@@ -172,9 +172,9 @@ class TestQuantizedModel:
         assert (out[:, :5] == prompt).all()
 
     def test_int8_kv_cache_decode_close(self, params):
-        """QuantKVCache (int8 values + per-position scales) tracks the
-        float cache path closely through prefill + stepwise decode."""
-        from k8s_dra_driver_tpu.models.decode import QuantKVCache
+        """PagedQuantKVCache (int8 pools + per-position scales) tracks
+        the float cache path closely through prefill + stepwise decode."""
+        from k8s_dra_driver_tpu.models.decode import PagedQuantKVCache
 
         tokens = jax.random.randint(
             jax.random.PRNGKey(13), (2, 8), 0, CONFIG.vocab_size
@@ -182,7 +182,7 @@ class TestQuantizedModel:
         ref, refc = prefill(params, tokens[:, :4], CONFIG, max_len=16)
         got, qc = prefill(params, tokens[:, :4], CONFIG, max_len=16,
                           quantize_cache=True)
-        assert isinstance(qc, QuantKVCache)
+        assert isinstance(qc, PagedQuantKVCache)
         assert qc.k.dtype == jnp.int8 and qc.v.dtype == jnp.int8
         np.testing.assert_allclose(got, ref, rtol=3e-2, atol=5e-2)
         for i in range(4, 8):
@@ -200,6 +200,66 @@ class TestQuantizedModel:
         )(qparams, prompt)
         assert out.shape == (2, 11)
         assert (out[:, :5] == prompt).all()
+
+    def test_dequant_fused_into_matmul_no_bf16_weight_copy(self, qparams):
+        """The int8 decode fix: the weight must reach the dot **as int8**
+        — no upcast materializing a bf16 weight copy per step. Pinned
+        structurally: every dot_general consuming a quantized weight in
+        the traced decode step takes an int8 operand, and no convert
+        ever produces a tensor of the full weight shape."""
+        from k8s_dra_driver_tpu.models.decode import prefill as _prefill
+
+        def step(p, t):
+            return _prefill(p, t, CONFIG, max_len=8)[0]
+
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(20), (1, 4), 0, CONFIG.vocab_size
+        )
+        jaxpr = jax.make_jaxpr(step)(qparams, tokens)
+        dots = []
+
+        def walk(jx):
+            for eqn in jx.eqns:
+                if eqn.primitive.name == "dot_general":
+                    dots.append(eqn)
+                for v in eqn.params.values():
+                    vals = v if isinstance(v, (list, tuple)) else [v]
+                    for item in vals:
+                        if hasattr(item, "jaxpr"):
+                            walk(item.jaxpr)
+
+        walk(jaxpr.jaxpr)
+        int8_dots = [
+            e for e in dots
+            if any(x.aval.dtype == jnp.int8 for x in e.invars)
+        ]
+        # wqkv, gate/up, down, wo inside the layer scan + lm_head: the
+        # quantized weights all feed int8 straight into their dot.
+        assert len(int8_dots) >= 5, (
+            f"expected the quantized matmuls to consume int8 directly, "
+            f"found {len(int8_dots)} of {len(dots)} dots"
+        )
+
+    def test_int8_decode_tracks_bf16_decode(self, params, qparams):
+        """Numerics-tolerance gate for the fused int8 path: stepwise
+        int8-weight decode stays within quantization tolerance of the
+        float-weight decode at every step."""
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(21), (2, 10), 0, CONFIG.vocab_size
+        )
+        ref, refc = prefill(params, tokens[:, :5], CONFIG, max_len=16)
+        got, qc = prefill(qparams, tokens[:, :5], CONFIG, max_len=16)
+        rel = float(
+            jnp.linalg.norm(got - ref) / jnp.linalg.norm(ref)
+        )
+        assert rel < 0.1, rel
+        for i in range(5, 10):
+            ref, refc = decode_step(params, tokens[:, i], refc, CONFIG)
+            got, qc = decode_step(qparams, tokens[:, i], qc, CONFIG)
+            rel = float(
+                jnp.linalg.norm(got - ref) / jnp.linalg.norm(ref)
+            )
+            assert rel < 0.1, (i, rel)
 
     def test_greedy_tokens_mostly_agree(self, params, qparams):
         tokens = jax.random.randint(

@@ -98,6 +98,14 @@ class TestSpeculative:
         assert good_rate == k  # self-draft: every proposal accepted
         assert bad_rate < good_rate
         assert int(bad["rounds"]) >= int(good["rounds"])
+        # acceptance_rate is the bench-facing normalization of the same
+        # counters: accepted/(rounds*k) in [0, 1] (exposed in the
+        # specdecode metric detail so wins/losses stay attributable).
+        assert float(good["acceptance_rate"]) == 1.0
+        assert 0.0 <= float(bad["acceptance_rate"]) < 1.0
+        np.testing.assert_allclose(
+            float(bad["acceptance_rate"]), bad_rate / k, atol=1e-6
+        )
 
     def test_int8_cache_composes_exactly(self, target_params, prompt):
         """Requantization of identical k/v values is deterministic, so the

@@ -1,0 +1,110 @@
+#!/usr/bin/env python3
+"""Fast fixed-seed decode smoke for `make decodebench` (wired into
+`make verify`).
+
+Three gates per serving variant (bf16 / int8 weights / int8 KV cache),
+all on the hermetic CPU backend with the tiny preset:
+
+1. **Compile-once**: driving the continuous-batching engine from the
+   first token to a span-crossing length must trace exactly one decode
+   step and one prefill chunk — the regression oracle for the
+   per-shape-recompile spreads of BENCH_r05.
+2. **Determinism**: two engines fed the same seeded traffic produce
+   identical token streams (a nondeterministic scheduler would make
+   every bench number unreproducible).
+3. **Spread**: repeated timed runs of the same traffic must agree within
+   a threshold — 2% is the TPU acceptance bar; CPU wall clocks are far
+   noisier, so the default here is loose (50%) and exists to catch
+   order-of-magnitude pathologies (a recompile per step is >10x). Tune
+   with TPU_DRA_DECODE_SMOKE_SPREAD.
+
+Exit 0 = all gates pass; 1 = a gate failed.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SPREAD_LIMIT = float(os.environ.get("TPU_DRA_DECODE_SMOKE_SPREAD", "0.5"))
+SEED = int(os.environ.get("TPU_DRA_DECODE_SMOKE_SEED", "1234"))
+
+
+def build_engine(params, config, quant_kv):
+    from k8s_dra_driver_tpu.models.serving import DecodeEngine
+
+    return DecodeEngine(
+        params, config, batch_slots=2, num_blocks=12, block_size=8,
+        max_seq_len=48, prefill_chunk=8, quantize_cache=quant_kv,
+    )
+
+
+def drive(engine, prompts, n_new):
+    reqs = [engine.submit(p, max_new_tokens=n_new) for p in prompts]
+    engine.run()
+    engine.assert_no_leaks()
+    return [tuple(r.tokens) for r in reqs]
+
+
+def main() -> int:
+    import jax
+    import numpy as np
+
+    from k8s_dra_driver_tpu.models.llama import PRESETS, init_params
+    from k8s_dra_driver_tpu.models.quant import quantize_params
+
+    config = PRESETS["tiny"]
+    params = init_params(config, jax.random.PRNGKey(0))
+    qparams = quantize_params(params)
+    rng = np.random.RandomState(SEED)
+    prompts = [
+        rng.randint(0, config.vocab_size, size=n).tolist()
+        for n in (5, 11, 7)
+    ]
+
+    failures = []
+    for label, p, qkv in (
+        ("bf16", params, False),
+        ("int8", qparams, False),
+        ("kvq", params, True),
+    ):
+        eng = build_engine(p, config, qkv)
+        tokens_a = drive(eng, prompts, n_new=30)   # crosses 4 block edges
+        counts = dict(eng.compile_counts)
+        if counts != {"decode_step": 1, "prefill_chunk": 1}:
+            failures.append(f"{label}: compile counts {counts} != 1/1")
+        # Determinism: a fresh engine, same traffic, same tokens.
+        tokens_b = drive(build_engine(p, config, qkv), prompts, n_new=30)
+        if tokens_a != tokens_b:
+            failures.append(f"{label}: nondeterministic token streams")
+        # Spread: repeat the drained run on the warm engine (compile paid).
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            drive(eng, prompts, n_new=30)
+            times.append(time.perf_counter() - t0)
+        mean = sum(times) / len(times)
+        spread = (max(times) - min(times)) / 2
+        rel = spread / mean if mean else 0.0
+        status = "ok" if rel <= SPREAD_LIMIT else "FAIL"
+        print(f"decodebench {label}: compile={counts} "
+              f"spread={rel:.1%} (limit {SPREAD_LIMIT:.0%}) {status}")
+        if rel > SPREAD_LIMIT:
+            failures.append(
+                f"{label}: repeat spread {rel:.1%} > {SPREAD_LIMIT:.0%}"
+            )
+
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    print("decodebench: all variants compile once, deterministic, "
+          "spread within limit")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
