@@ -2,7 +2,7 @@
 IMAGE ?= tpu-dra-driver
 TAG ?= latest
 
-.PHONY: all native test image lint verify verify-metrics chaos chaos-slow doctor decodebench clean e2e-kind
+.PHONY: all native test image lint verify verify-metrics chaos chaos-slow doctor decodebench elastic clean e2e-kind
 
 all: native
 
@@ -44,10 +44,21 @@ doctor:
 decodebench:
 	python tools/run_decode_smoke.py
 
+# Elastic-training smoke: fixed-seed chip-unplug → gang shrink →
+# live reshard → resume (then the symmetric grow) through the real
+# Driver + allocator + ElasticTrainer on the CPU backend
+# (tools/run_elastic_smoke.py). The StateAuditor is the no-drift
+# oracle; loss continuity gates the resharding math. The long soak
+# variant is the `slow`-marked test_chaos.py::TestElasticGangResize
+# soak (run via `make chaos-slow`).
+elastic:
+	TPU_DRA_CHAOS_SEED=$(TPU_DRA_CHAOS_SEED) \
+		python tools/run_elastic_smoke.py
+
 # The full local gate: lint + unit/integration tests + chaos schedules +
-# metrics exposition + the doctor/auditor drill + the decode-engine
-# smoke. What CI runs; what a PR must pass.
-verify: lint test chaos verify-metrics doctor decodebench
+# metrics exposition + the doctor/auditor drill + the decode-engine and
+# elastic-training smokes. What CI runs; what a PR must pass.
+verify: lint test chaos verify-metrics doctor decodebench elastic
 
 # ruff when available (CI installs it; .golangci.yaml analog is
 # [tool.ruff] in pyproject.toml), else the first-party AST lint floor.

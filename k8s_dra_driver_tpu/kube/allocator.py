@@ -58,6 +58,7 @@ DEVICE_CLASS_TYPES = {
 STAGE_INVALID_SLICE = "invalid-slice"
 STAGE_CLASS_CEL = "class-cel"
 STAGE_REQUEST_CEL = "request-cel"
+STAGE_UNHEALTHY = "unhealthy"
 STAGE_RESERVED = "reserved"
 STAGE_COUNTERS = "counters"
 STAGE_CONSTRAINT = "constraint"
@@ -67,16 +68,20 @@ STAGES = (
     STAGE_INVALID_SLICE,
     STAGE_CLASS_CEL,
     STAGE_REQUEST_CEL,
+    STAGE_UNHEALTHY,
     STAGE_RESERVED,
     STAGE_COUNTERS,
     STAGE_CONSTRAINT,
     STAGE_GANG,
 )
+# Filter stages timed per candidate pass (everything before the search).
+_CANDIDATE_STAGES = STAGES[:5]
 
 # Stages applied while FILTERING candidates (before the search): a deepest
 # rejection here with survivors left means the request simply wants more
 # devices than match — reported as `shortfall`, not as the filter stage.
-_FILTER_STAGES = (STAGE_INVALID_SLICE, STAGE_CLASS_CEL, STAGE_REQUEST_CEL)
+_FILTER_STAGES = (STAGE_INVALID_SLICE, STAGE_CLASS_CEL, STAGE_REQUEST_CEL,
+                  STAGE_UNHEALTHY)
 
 # -- terminal reasons (the enum `reason` metric labels are confined to).
 #    Kept a full literal (not STAGES + extras) so tools/lint.py TPM06 can
@@ -94,6 +99,7 @@ REASONS = (
     "invalid-slice",
     "class-cel",
     "request-cel",
+    "unhealthy",
     "reserved",
     "counters",
     "constraint",
@@ -126,6 +132,12 @@ RUNBOOK_HINTS = {
         "the claim's request selectors reject every device; check the "
         "request's CEL expressions and attribute names against the "
         "published ResourceSlice attributes"
+    ),
+    "unhealthy": (
+        "every matching device sits on a chip the health poll marked "
+        "degraded; this solve required healthy devices (an elastic "
+        "gang re-solve always does) — wait for recovery, drain the sick "
+        "chips, or add capacity"
     ),
     "reserved": (
         "every matching device is already held by another claim; free "
@@ -667,14 +679,19 @@ class ReferenceAllocator:
         claim: dict,
         node_name: Optional[str] = None,
         selectors: Optional[dict[str, list[Selector]]] = None,
+        require_healthy: bool = False,
     ) -> dict:
         """Fill claim.status.allocation; returns the claim (mutated).
 
         ``selectors`` maps request name → extra Selector predicates (the
         CEL-lite substitute). ``node_name`` restricts node-local pools.
-        On failure raises :class:`AllocationError` with ``reason`` and
-        ``explanation`` populated; either way the decision is recorded
-        for ``/debug/allocations``.
+        ``require_healthy`` rejects devices whose published ``healthy``
+        attribute is false (the elastic gang re-solve: a shrink must
+        never land back on the chip that just sickened) — rejections are
+        funnel-visible at the ``unhealthy`` stage. On failure raises
+        :class:`AllocationError` with ``reason`` and ``explanation``
+        populated; either way the decision is recorded for
+        ``/debug/allocations``.
         """
         spec = claim.get("spec", {}).get("devices", {})
         requests = spec.get("requests", [])
@@ -706,7 +723,7 @@ class ReferenceAllocator:
             try:
                 results, picked_devs = self._solve(
                     requests, constraints, selectors, inventory, capacity,
-                    expl,
+                    expl, require_healthy=require_healthy,
                 )
             except Exception as e:
                 if self._backtrack_steps:
@@ -776,7 +793,7 @@ class ReferenceAllocator:
             )
 
     def _solve(self, requests, constraints, selectors, inventory, capacity,
-               expl: Explanation):
+               expl: Explanation, require_healthy: bool = False):
         """Greedy backtracking over requests with matchAttribute checks,
         shared-counter budgets, and ICI contiguity for multi-chip gangs.
 
@@ -864,7 +881,7 @@ class ReferenceAllocator:
             record = not include_reserved
             if record:
                 expl.funnel(req["name"]).entering = len(inventory)
-            stage_t = dict.fromkeys(STAGES[:4], 0.0)
+            stage_t = dict.fromkeys(_CANDIDATE_STAGES, 0.0)
             out = []
             for d in inventory:
                 dk = (d["pool"], d["name"])
@@ -908,6 +925,22 @@ class ReferenceAllocator:
                     if record:
                         expl.reject(req["name"], STAGE_REQUEST_CEL, dk, why)
                     continue
+                # Health gate (opt-in): the elastic re-solve must steer
+                # around chips the node marked degraded — a gone chip is
+                # already absent from the republished slice, but a wedged
+                # one stays published with healthy=false and would
+                # otherwise be picked right back.
+                if require_healthy:
+                    t = time.perf_counter()
+                    healthy = _attr_value(d["attributes"], "healthy")
+                    stage_t[STAGE_UNHEALTHY] += time.perf_counter() - t
+                    if healthy is False:
+                        if record:
+                            expl.reject(
+                                req["name"], STAGE_UNHEALTHY, dk,
+                                "unhealthy:published healthy=false",
+                            )
+                        continue
                 # Ordinary requests never see reserved devices; admin
                 # requests observe them (monitoring over live workloads).
                 # Checked LAST so the funnel reads "the right devices
@@ -1185,3 +1218,43 @@ class ReferenceAllocator:
                 claim_uid, []
             ):
                 self._consumed[(pool, cset, cname)] -= amount
+
+    def restore_reservations(
+        self, claim_uid: str, results: list[dict]
+    ) -> None:
+        """Re-register reservations (and counter consumption) for a
+        claim whose devices are ALREADY prepared on a node.
+
+        The elastic coordinator's failure seam: a gang re-solve starts
+        with ``deallocate``, and when every candidate size goes unsat
+        the claim keeps running on its existing devices — which must not
+        be left looking free, or the next solve double-books chips that
+        are exclusively held. ``results`` is the claim's current
+        allocation (wire form); devices already reserved by this claim
+        are skipped, so the call is idempotent.
+        """
+        with self._lock:
+            devices, _ = self._inventory()
+            by_key = {(d["pool"], d["name"]): d for d in devices}
+            for r in results:
+                key = (r["pool"], r["device"])
+                if self._reservations.get(key) == claim_uid:
+                    continue
+                holder = self._reservations.get(key)
+                if holder is not None:
+                    logger.warning(
+                        "restore_reservations: device %s/%s already held "
+                        "by %s; leaving it", key[0], key[1], holder,
+                    )
+                    continue
+                self._reservations[key] = claim_uid
+                dev = by_key.get(key)
+                if dev is None:
+                    continue
+                for pool, cset, cname, amount in _consumption_entries(dev):
+                    self._consumed[(pool, cset, cname)] = (
+                        self._consumed.get((pool, cset, cname), 0) + amount
+                    )
+                    self._claim_consumption.setdefault(
+                        claim_uid, []
+                    ).append((pool, cset, cname, amount))

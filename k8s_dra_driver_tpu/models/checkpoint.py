@@ -16,6 +16,42 @@ from typing import Any, Optional
 logger = logging.getLogger(__name__)
 
 
+class MeshShapeMismatchError(ValueError):
+    """The restore template's mesh cannot hold the saved state: some
+    array axis is partitioned more ways than it has elements (or not
+    evenly). Raised BEFORE orbax touches disk, naming the offending
+    array shape and the mesh shape — the raw alternative is an XLA
+    sharding error deep inside the restore with neither."""
+
+
+def _validate_template_meshable(template: Any) -> None:
+    """Reject templates whose shardings cannot tile their arrays.
+
+    The elastic/resume seam produces exactly this mistake: a state saved
+    from a big mesh, restored with a template anchored to a small mesh
+    whose preserved axis degrees (e.g. ``tensor``) no longer divide some
+    parameter axis. jax surfaces it as a generic divisibility error at
+    restore time; this turns it into a typed, actionable one up front.
+    """
+    import jax
+
+    for path, leaf in jax.tree_util.tree_flatten_with_path(template)[0]:
+        sharding = getattr(leaf, "sharding", None)
+        if not isinstance(sharding, jax.sharding.NamedSharding):
+            continue
+        try:
+            sharding.shard_shape(leaf.shape)
+        except Exception as e:
+            raise MeshShapeMismatchError(
+                f"saved state {jax.tree_util.keystr(path)} of shape "
+                f"{tuple(leaf.shape)} cannot be restored onto mesh "
+                f"{dict(sharding.mesh.shape)} with spec {sharding.spec} "
+                f"({e}); lower the offending mesh axis degree or restore "
+                "onto a mesh whose preserved degrees divide the saved "
+                "shapes"
+            ) from e
+
+
 def _manager(directory: str, max_to_keep: int = 3):
     import orbax.checkpoint as ocp
 
@@ -113,6 +149,10 @@ def restore_checkpoint(
         raise FileNotFoundError(
             f"no checkpoint found under {directory} (asked for step {step})"
         )
+    # Shape/mesh compatibility BEFORE the restore: an indivisible
+    # template would otherwise surface as a raw sharding error mid-
+    # restore (and, like the probe above, must not leave side effects).
+    _validate_template_meshable(template)
     mgr = _manager(os.path.abspath(directory))
     try:
         return mgr.restore(step, args=ocp.args.StandardRestore(template))

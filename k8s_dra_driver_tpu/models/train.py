@@ -128,6 +128,41 @@ def make_train_step(
     )
 
 
+def state_shardings(state: Any, mesh: Mesh):
+    """Per-leaf target NamedShardings for ``state`` on ``mesh``.
+
+    Each mesh-sharded leaf keeps its PartitionSpec but re-anchors to
+    ``mesh``; everything else (scalar optimizer leaves like the adamw
+    step count, which jitted init leaves on one device) lands replicated
+    — the same re-anchoring rule as checkpoint.restore_template, applied
+    to live arrays instead of abstract templates.
+    """
+    def leaf(x):
+        sh = getattr(x, "sharding", None)
+        spec = (
+            sh.spec if isinstance(sh, NamedSharding)
+            else jax.sharding.PartitionSpec()
+        )
+        return NamedSharding(mesh, spec)
+
+    return jax.tree.map(leaf, state)
+
+
+def reshard_train_state(state: TrainState, mesh: Mesh) -> TrainState:
+    """Live device-to-device reshard of a TrainState onto ``mesh``.
+
+    The elastic hot path: params, optimizer moments, and the step
+    counter move from the old mesh's shardings to the new mesh's with
+    ``jax.device_put`` — no checkpoint round-trip, no optimizer
+    reinitialization. The caller is responsible for checking that the
+    source shards are actually readable (every shard replicated on at
+    least one surviving device — ``elastic.state_covered``); when they
+    are not, restore from the last checkpoint instead
+    (``checkpoint.restore_template`` + ``restore_checkpoint``).
+    """
+    return jax.device_put(state, state_shardings(state, mesh))
+
+
 def make_eval_step(config: LlamaConfig, mesh: Mesh, use_ring: bool = False):
     _, loss_fn, _ = _model_fns(config)
     batch_sharding = NamedSharding(mesh, P(("data", "fsdp"), None))

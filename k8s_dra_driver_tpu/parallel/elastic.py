@@ -1,0 +1,374 @@
+"""Elastic resize coordinator: reshape the mesh, reshard the live state.
+
+The workload half of the gang-resize story (the plugin half is
+``plugin/driver.py``'s elastic coordinator). When chip health shrinks a
+gang claim — or a restored spare grows it back — the driver emits a typed
+``GangResize`` message; this module consumes the surviving device set and
+keeps training alive:
+
+1. pick the **largest valid sub-mesh** of the survivors (the model's
+   tensor/sequence/expert/pipe degrees are preserved — ``MeshConfig.
+   resize`` — and the global batch must still divide the data axes; the
+   remainder is idled, not used);
+2. **reshard the live TrainState in place** — params and optimizer
+   moments move device-to-device with ``jax.device_put`` from the old
+   mesh's shardings to the new mesh's (the Flex-MIG reshard-on-resize
+   discipline: no checkpoint round-trip on the hot path). The cold
+   fallback — ``models/checkpoint.restore_template`` + restore — runs
+   ONLY when the surviving devices cannot cover the state (some shard's
+   every replica lived on lost chips);
+3. rebuild the jitted train step for the new mesh and resume — the step
+   counter and optimizer state carry over, so the loss trajectory
+   continues where it left off.
+
+Fault sites: ``train.step`` fires at the top of every train step and
+``train.reshard`` at the top of every resize, so the chaos harness can
+land a chip-unplug (or a crash) exactly mid-step / mid-reshard the same
+way it does for ``kube.*``/``chiplib.*`` sites.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Optional, Sequence
+
+from ..utils import faults
+from ..utils.metrics import Counter, Gauge, Histogram, Registry
+
+logger = logging.getLogger(__name__)
+
+RESHARD_LIVE = "live"
+RESHARD_COLD = "cold"
+
+
+class ElasticResizeError(RuntimeError):
+    """A resize that cannot proceed: no valid sub-mesh exists for the
+    surviving devices, or the cold fallback has no checkpoint to restore
+    from. Training state is left untouched — the caller may retry with a
+    different device set (or after saving a checkpoint)."""
+
+
+def largest_usable_count(
+    n_available: int, config, global_batch: Optional[int] = None
+) -> int:
+    """Largest device count ``<= n_available`` that yields a valid mesh.
+
+    Valid means: the preserved model degrees (``config.model_degrees``)
+    divide it, and — when ``global_batch`` is given — the resulting
+    data x fsdp product still divides the batch (the train step shards
+    batches over ``("data", "fsdp")``; a dp degree that does not divide
+    the batch cannot run). Returns 0 when no count works.
+    """
+    fixed = config.model_degrees
+    n = (n_available // fixed) * fixed
+    while n >= fixed:
+        dp = n // fixed
+        if global_batch is None or global_batch % dp == 0:
+            return n
+        n -= fixed
+    return 0
+
+
+def state_covered(state: Any, available) -> bool:
+    """Can ``available`` devices reconstruct every shard of ``state``?
+
+    For each leaf, group the sharding's device→index map by index: every
+    distinct shard must have at least one replica on an available device.
+    Data-parallel replication makes shrink coverable (the surviving
+    replica holds a full copy); a pure-fsdp layout is NOT covered when
+    any of its devices is lost — that is exactly the cold-restore case.
+    """
+    import jax  # noqa: F401  (lazy: keep module importable early)
+
+    avail = set(available)
+    for leaf in jax.tree.leaves(state):
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is None:
+            continue
+        replicas: dict[tuple, bool] = {}
+        for dev, idx in sharding.devices_indices_map(leaf.shape).items():
+            key = tuple(
+                (s.start, s.stop, s.step) if isinstance(s, slice) else s
+                for s in idx
+            )
+            replicas[key] = replicas.get(key, False) or dev in avail
+        if not all(replicas.values()):
+            return False
+    return True
+
+
+@dataclasses.dataclass(frozen=True)
+class ResizeEvent:
+    """Workload-side record of one completed resize (the resize trace)."""
+
+    direction: str                 # "shrink" | "grow" | "reshape"
+    path: str                      # "live" | "cold"
+    reason: str
+    step: int                      # TrainState.step AFTER the resize
+    old_mesh: str                  # str(MeshConfig) before
+    new_mesh: str                  # str(MeshConfig) after
+    n_old: int
+    n_used: int
+    n_idled: int
+    duration_seconds: float
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class ElasticTrainer:
+    """Owns the mesh, the TrainState, and the jitted step — and survives
+    the device set changing underneath them.
+
+    ``devices`` is the gang's initial jax device list; ``resize()``
+    takes the post-resize device list (survivors, or survivors + spares)
+    in allocation order. ``global_batch`` pins the batch geometry so a
+    resize never lands on a mesh the batch cannot shard over.
+    """
+
+    def __init__(
+        self,
+        config,
+        optimizer,
+        devices: Sequence,
+        *,
+        mesh_config=None,
+        global_batch: Optional[int] = None,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: int = 0,
+        use_ring: bool = False,
+        remat: bool = True,
+        seed: int = 0,
+        registry: Optional[Registry] = None,
+    ):
+        from ..models.train import init_train_state, make_train_step
+        from .mesh import auto_mesh_config, build_mesh
+
+        self.config = config
+        self.optimizer = optimizer
+        self.use_ring = use_ring
+        self.remat = remat
+        self.global_batch = global_batch
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = checkpoint_every
+        self.devices = list(devices)
+        self.idled: list = []
+        self.mesh_config = mesh_config or auto_mesh_config(len(self.devices))
+        if self.mesh_config.num_devices != len(self.devices):
+            raise ValueError(
+                f"mesh {self.mesh_config} needs "
+                f"{self.mesh_config.num_devices} devices, got "
+                f"{len(self.devices)}"
+            )
+        self.mesh = build_mesh(self.mesh_config, self.devices)
+        self.state = init_train_state(
+            config, self.mesh, optimizer, seed=seed
+        )
+        self._step_fn = make_train_step(
+            config, self.mesh, optimizer, use_ring=use_ring, remat=remat
+        )
+        self.resize_trace: list[ResizeEvent] = []
+
+        reg = registry if registry is not None else Registry()
+        self._m_reshards = Counter(
+            "tpu_dra_elastic_reshards_total",
+            "Live-state reshards by direction, path (live/cold) and "
+            "outcome",
+            reg,
+        )
+        self._m_reshard_seconds = Histogram(
+            "tpu_dra_elastic_reshard_seconds",
+            "End-to-end resize latency: sub-mesh choice, state reshard, "
+            "and train-step rebuild",
+            reg,
+        )
+        self._m_devices = Gauge(
+            "tpu_dra_elastic_devices",
+            "Devices in the current elastic gang by role (used/idled)",
+            reg,
+        )
+        self._set_device_gauges()
+
+    def _set_device_gauges(self) -> None:
+        self._m_devices.set(len(self.devices), role="used")
+        self._m_devices.set(len(self.idled), role="idled")
+
+    # -- training ----------------------------------------------------------
+
+    @property
+    def step_count(self) -> int:
+        return int(self.state.step)
+
+    def step(self, tokens) -> float:
+        """One train step; returns the loss. Instrumented as the
+        ``train.step`` fault site so chaos schedules can unplug a chip
+        (or crash) exactly mid-training."""
+        faults.fire("train.step")
+        self.state, loss = self._step_fn(self.state, tokens)
+        if (
+            self.checkpoint_every
+            and self.checkpoint_dir
+            and self.step_count % self.checkpoint_every == 0
+        ):
+            self.save()
+        return float(loss)
+
+    def save(self) -> None:
+        if not self.checkpoint_dir:
+            raise ElasticResizeError(
+                "no checkpoint_dir configured; cannot save"
+            )
+        from ..models.checkpoint import save_checkpoint
+
+        save_checkpoint(self.checkpoint_dir, self.state,
+                        step=self.step_count)
+
+    # -- resize ------------------------------------------------------------
+
+    def resize(self, devices: Sequence, *, reason: str = "") -> ResizeEvent:
+        """Reshape the mesh onto ``devices`` and reshard the live state.
+
+        ``devices`` is the post-resize gang (survivors first is not
+        required — devices already in the old mesh are preferred for the
+        sub-mesh so transfers stay local). Devices beyond the largest
+        valid sub-mesh are idled, not dropped: they remain in the gang
+        and re-enter the mesh on the next grow.
+        """
+        t0 = time.monotonic()
+        faults.fire("train.reshard")
+        from ..models.train import make_train_step, reshard_train_state
+        from .mesh import build_mesh
+
+        devices = list(devices)
+        old_devices = list(self.devices)
+        old_config = self.mesh_config
+        n_old = len(old_devices)
+        usable = largest_usable_count(
+            len(devices), old_config, self.global_batch
+        )
+        if usable == 0:
+            self._m_reshards.inc(
+                direction="unknown", path="none", outcome="no-valid-mesh"
+            )
+            raise ElasticResizeError(
+                f"no valid sub-mesh for {len(devices)} device(s): the "
+                f"preserved degrees of {old_config} need multiples of "
+                f"{old_config.model_degrees}"
+                + (
+                    f" that divide global batch {self.global_batch}"
+                    if self.global_batch else ""
+                )
+            )
+        # Prefer devices the old mesh already used (their shards are in
+        # place), then spares — stable within each class so the driver's
+        # allocation order is respected.
+        old_set = set(old_devices)
+        ordered = (
+            [d for d in devices if d in old_set]
+            + [d for d in devices if d not in old_set]
+        )
+        used, idled = ordered[:usable], ordered[usable:]
+        new_config = old_config.resize(usable)
+        new_mesh = build_mesh(new_config, used)
+        direction = (
+            "grow" if len(devices) > n_old
+            else "shrink" if len(devices) < n_old
+            else "reshape"
+        )
+
+        # Sources readable for a live reshard: old-mesh devices that are
+        # still part of the gang. A device absent from ``devices``
+        # vanished with its HBM — its shards only survive as replicas.
+        available = old_set & set(devices)
+        path = RESHARD_LIVE
+        new_state = None
+        if state_covered(self.state, available):
+            try:
+                new_state = reshard_train_state(self.state, new_mesh)
+            except Exception:
+                logger.exception(
+                    "live reshard failed; falling back to checkpoint "
+                    "restore"
+                )
+                path = RESHARD_COLD
+        else:
+            logger.warning(
+                "surviving devices cannot cover the live state "
+                "(unreplicated shards on lost devices); cold-restoring "
+                "from checkpoint"
+            )
+            path = RESHARD_COLD
+        if new_state is None:
+            try:
+                new_state = self._cold_restore(new_mesh)
+            except Exception:
+                # ANY restore failure counts (MeshShapeMismatchError,
+                # orbax I/O errors, ...) — dashboards alerting on error
+                # outcomes must see exactly these.
+                self._m_reshards.inc(
+                    direction=direction, path=RESHARD_COLD,
+                    outcome="error",
+                )
+                raise
+        self._step_fn = make_train_step(
+            self.config, new_mesh, self.optimizer,
+            use_ring=self.use_ring, remat=self.remat,
+        )
+        self.state = new_state
+        self.mesh = new_mesh
+        self.mesh_config = new_config
+        self.devices = used
+        self.idled = idled
+        self._set_device_gauges()
+
+        event = ResizeEvent(
+            direction=direction,
+            path=path,
+            reason=reason,
+            step=self.step_count,
+            old_mesh=str(old_config),
+            new_mesh=str(new_config),
+            n_old=n_old,
+            n_used=len(used),
+            n_idled=len(idled),
+            duration_seconds=time.monotonic() - t0,
+        )
+        self.resize_trace.append(event)
+        self._m_reshards.inc(
+            direction=direction, path=path, outcome="ok"
+        )
+        self._m_reshard_seconds.observe(event.duration_seconds)
+        logger.info(
+            "elastic resize (%s, %s): %s -> %s on %d device(s) "
+            "(%d idled) at step %d in %.3fs — %s",
+            direction, path, event.old_mesh, event.new_mesh,
+            event.n_used, event.n_idled, event.step,
+            event.duration_seconds, reason or "no reason given",
+        )
+        return event
+
+    def _cold_restore(self, new_mesh):
+        """The fallback when live shards are unrecoverable: restore the
+        latest checkpoint resharded onto the new mesh. Loses the steps
+        since the last save — which is why it is never taken while a
+        live reshard can work."""
+        if not self.checkpoint_dir:
+            raise ElasticResizeError(
+                "surviving devices cannot cover the live state and no "
+                "checkpoint_dir is configured — training state is lost"
+            )
+        from ..models.checkpoint import (
+            latest_step,
+            restore_checkpoint,
+            restore_template,
+        )
+
+        if latest_step(self.checkpoint_dir) is None:
+            raise ElasticResizeError(
+                "surviving devices cannot cover the live state and no "
+                f"checkpoint exists under {self.checkpoint_dir}"
+            )
+        template = restore_template(self.state, new_mesh)
+        return restore_checkpoint(self.checkpoint_dir, template)

@@ -57,6 +57,49 @@ class MeshConfig:
             f"{a}={d}" for a, d in zip(AXES, self.shape) if d > 1
         ) or "single"
 
+    @property
+    def model_degrees(self) -> int:
+        """Product of the degrees fixed by the MODEL, not the fleet:
+        tensor/sequence/expert/pipe are architecture choices (kv-head
+        divisibility, expert count, stage splits) that an elastic resize
+        must not change. Only data x fsdp — pure replication/param
+        sharding — can absorb device-count changes."""
+        return self.pipe * self.expert * self.sequence * self.tensor
+
+    def resize(self, n_devices: int) -> "MeshConfig":
+        """Refactor this config for ``n_devices``, preserving the
+        model-mandated degrees and collapsing data/fsdp into one degree.
+
+        The elastic seam: when a gang shrinks (chip died) or grows (spare
+        admitted), the tensor/sequence/expert/pipe degrees carry over
+        unchanged — resharding must not alter the model's parallelism
+        contract mid-run — and the combined data x fsdp product collapses
+        to a single degree. WHICH axis carries it follows the source
+        config's character: an fsdp-sharded config (fsdp > 1) stays fsdp
+        — it shards params because they don't fit replicated, and a
+        resize must not blow HBM — while a pure data-parallel config
+        collapses into ``data``, keeping the parameter replication that
+        makes the NEXT shrink live-reshardable (a gang that grew into
+        fsdp sharding would lose unreplicated shards with the next dead
+        chip and be forced through the cold checkpoint path). Raises
+        ``ValueError`` when ``n_devices`` cannot hold the preserved
+        degrees; callers wanting "largest valid sub-mesh" semantics
+        should round down first (see ``elastic.largest_usable_count``).
+        """
+        fixed = self.model_degrees
+        if n_devices <= 0:
+            raise ValueError(f"cannot resize mesh to {n_devices} devices")
+        if n_devices % fixed:
+            raise ValueError(
+                f"{n_devices} devices cannot hold the preserved degrees "
+                f"of {self} (pipe*expert*sequence*tensor={fixed}); "
+                f"use a multiple of {fixed}"
+            )
+        rest = n_devices // fixed
+        if self.fsdp > 1:
+            return dataclasses.replace(self, data=1, fsdp=rest)
+        return dataclasses.replace(self, data=rest, fsdp=1)
+
 
 def auto_mesh_config(
     n_devices: int,
@@ -70,6 +113,21 @@ def auto_mesh_config(
     degree, spend the next factor on sequence if long-context, and the rest
     on fsdp (which subsumes data parallel at these scales).
     """
+    if n_devices < 1:
+        raise ValueError(f"need at least one device, got {n_devices}")
+    if model_needs_tensor < 1:
+        raise ValueError(
+            f"tensor degree must be >= 1, got {model_needs_tensor}"
+        )
+    if model_needs_tensor > n_devices:
+        # Distinct from mere indivisibility: no factorization exists at
+        # ANY device multiple — the model demands more tensor-parallel
+        # peers than the allocation holds.
+        raise ValueError(
+            f"model needs tensor={model_needs_tensor} but only "
+            f"{n_devices} device(s) are available; allocate at least "
+            f"{model_needs_tensor} devices or lower the tensor degree"
+        )
     if n_devices % model_needs_tensor:
         raise ValueError(
             f"{n_devices} devices not divisible by tensor={model_needs_tensor}"

@@ -31,6 +31,12 @@ Checks (stable ``check`` label values):
                      not precede PreparedClaim.prepared_at);
 - ``sharing``        phantom/corrupt sharing holds with no checkpointed
                      claim;
+- ``resize``         a gang-resize intent still checkpointed: the
+                     two-phase resize protocol (DeviceState.resize_claim)
+                     finalizes or rolls forward at startup, and live
+                     resizes run under the DeviceState lock this audit
+                     also takes — an observable intent is a crash
+                     leftover recovery could not complete;
 - ``slices``         published node slice devices differ from the local
                      allocatable view (stale publish; transient during a
                      blackout while republishes queue — which is exactly
@@ -59,7 +65,8 @@ from .device_state import DeviceState
 logger = logging.getLogger(__name__)
 
 # Every check name, so gauges render an explicit zero when clean.
-CHECKS = ("checkpoint", "cdi", "channels", "health", "sharing", "slices")
+CHECKS = ("checkpoint", "cdi", "channels", "health", "sharing", "resize",
+          "slices")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -164,6 +171,7 @@ class StateAuditor:
             self._check_channels(findings, ckpt)
             self._check_health_ordering(findings, ckpt)
             self._check_sharing(findings, ckpt)
+            self._check_resize(findings, ckpt)
         # The apiserver comparison runs outside the lock (network) and is
         # skipped — not reported as drift — when the server is dark.
         self._check_slices(findings)
@@ -319,6 +327,29 @@ class StateAuditor:
                     "no checkpointed claim (phantom hold; the orphan "
                     "cleaner should release it)",
                 ))
+
+    def _check_resize(self, findings, ckpt: dict) -> None:
+        """No checkpointed claim may still carry a ``resize`` intent.
+
+        Live resizes hold the DeviceState lock this pass also takes, and
+        startup recovery rolls crash-left intents forward — so any
+        intent visible here is one recovery could NOT complete (e.g. the
+        added spare vanished while the plugin was down). The claim's
+        container env and its checkpointed gang may disagree until an
+        operator re-prepares or deletes the claim."""
+        for uid, rec in sorted(ckpt.items()):
+            intent = rec.get("resize")
+            if not intent:
+                continue
+            findings.append(AuditFinding(
+                "resize", uid,
+                "gang-resize intent (started "
+                f"{intent.get('startedAt', 0.0):.3f}, target "
+                f"{intent.get('to')}) was never finalized and startup "
+                "recovery could not roll it forward; the claim's CDI "
+                "spec may not match its checkpointed gang — re-prepare "
+                "or delete the claim",
+            ))
 
     def _check_slices(self, findings) -> None:
         """Published ResourceSlice devices vs the local allocatable view.

@@ -65,6 +65,14 @@ class UnhealthyDeviceError(PrepareError):
     should re-place once the republished slices reflect the chip state."""
 
 
+class GangResizeError(PrepareError):
+    """Typed failure of the gang-resize protocol: the claim is not
+    prepared here, the target devices are unavailable/unhealthy, or the
+    claim's sharing mode cannot be resized in place (time/process
+    sharing carries per-claim runtime sessions a rewrite cannot move).
+    The claim's prepared state is left as it was."""
+
+
 # Which config kind governs which device type (role of the type-compatibility
 # switch in device_state.go:225-259).
 _CONFIG_TYPE_FOR_DEVICE = {
@@ -165,6 +173,11 @@ class DeviceState:
             self.chiplib, share_state, f"{state_dir}/process-share"
         )
         self.share_state = share_state
+
+        # Gang-resize crash consistency: a resize intent checkpointed by
+        # a previous incarnation rolls forward now that the sharing
+        # store is up (resize_claim documents the two-phase protocol).
+        self._recover_resize_intents()
 
     # ------------------------------------------------------------------
     # Health
@@ -432,29 +445,7 @@ class DeviceState:
             all_devices = [
                 d for _, (_, ms) in grouped.items() for _, d in ms
             ] + [d for _, d in admin_members]
-            common_env = claim_visibility_env(
-                [d.chip for d in all_devices if d.chip is not None],
-                [d.tensorcore for d in all_devices if d.tensorcore is not None],
-            )
-            # Cross-host launch env (IciChannelInfo contract): ONE rendezvous
-            # per claim, named by the lowest claimed channel across ALL
-            # config groups, so gang members never dial different ports.
-            channels = [
-                d.ici_channel.channel for d in all_devices
-                if d.ici_channel is not None
-            ]
-            if channels:
-                host_id = next(
-                    (d.chip.host_id for d in self.allocatable.values()
-                     if d.chip is not None),
-                    None,
-                )
-                common_env.update(
-                    ici_channel_launch_env(
-                        self.chiplib.worker_hostnames(), min(channels),
-                        host_id,
-                    )
-                )
+            common_env = self._claim_common_env(all_devices)
             self.cdi.create_claim_spec_file(claim_uid, claim_device_edits, common_env)
         except BaseException:
             # Roll back acquisitions from already-applied groups; otherwise a
@@ -478,6 +469,36 @@ class DeviceState:
             groups=groups,
             prepared_at=_time.time(),
         )
+
+    def _claim_common_env(
+        self, all_devices: list[AllocatableDevice]
+    ) -> dict[str, str]:
+        """Claim-wide container env: chip/tensorcore visibility plus —
+        for ICI claims — ONE rendezvous named by the lowest claimed
+        channel across all config groups, so gang members never dial
+        different ports. Shared by prepare and gang-resize so the two
+        writers of a claim spec cannot drift."""
+        common_env = claim_visibility_env(
+            [d.chip for d in all_devices if d.chip is not None],
+            [d.tensorcore for d in all_devices if d.tensorcore is not None],
+        )
+        channels = [
+            d.ici_channel.channel for d in all_devices
+            if d.ici_channel is not None
+        ]
+        if channels:
+            host_id = next(
+                (d.chip.host_id for d in self.allocatable.values()
+                 if d.chip is not None),
+                None,
+            )
+            common_env.update(
+                ici_channel_launch_env(
+                    self.chiplib.worker_hostnames(), min(channels),
+                    host_id,
+                )
+            )
+        return common_env
 
     def _ensure_device_healthy(self, name: str, dev: AllocatableDevice) -> None:
         """Refuse to prepare onto a chip the health poll marked unhealthy.
@@ -649,6 +670,462 @@ class DeviceState:
                 self._config_strategy(group.config),
                 [u for d in group.devices for u in d.uuids],
             )
+
+    # ------------------------------------------------------------------
+    # Gang resize (the elastic-training protocol)
+    # ------------------------------------------------------------------
+
+    def resize_claim(
+        self,
+        claim_uid: str,
+        results: list[dict],
+        desired: Optional[int] = None,
+    ) -> list[KubeletDevice]:
+        """Crash-consistent rewrite of a prepared claim's device set.
+
+        ``results`` is the claim's NEW allocation (the elastic re-solve
+        output, same wire shape as ``status.allocation.devices.results``)
+        — devices absent from it are released, new ones are acquired and
+        added, and the CDI claim spec is rewritten so the container's
+        visibility env matches the surviving gang. ``desired`` records
+        the gang size the claim WANTS (set on the first shrink so a later
+        chip recovery knows how far to grow back).
+
+        The two-phase checkpoint protocol makes this crash-safe: a
+        ``resize`` intent is checkpointed FIRST, then holds/CDI are
+        rewritten, then the finalized record replaces the intent. A crash
+        anywhere in between leaves the intent on disk; startup recovery
+        rolls it forward idempotently (releases tolerate absent holds,
+        same-claim acquires are re-entrant, the CDI write is a whole-file
+        replace), and an intent that CANNOT complete surfaces as a
+        ``resize`` audit finding instead of silent corruption.
+        """
+        with self._lock:
+            prepared_claims = self.checkpoint.read()
+            original_rec = prepared_claims.get(claim_uid)
+            if original_rec is None:
+                raise GangResizeError(
+                    f"claim {claim_uid} is not prepared on this node"
+                )
+            new_names = [
+                r["device"] for r in results
+                if r.get("driver", self.driver_name) == self.driver_name
+            ]
+            if not new_names:
+                raise GangResizeError(
+                    f"resize of claim {claim_uid} to an empty device set "
+                    "— unprepare the claim instead"
+                )
+            rec = dict(original_rec)
+            self._check_resizable(rec)
+            import time as _time
+
+            rec["resize"] = {
+                "to": new_names,
+                "requests": {
+                    r["device"]: r.get("request", "") for r in results
+                },
+                "startedAt": _time.time(),
+            }
+            if desired is not None:
+                elastic = dict(rec.get("elastic") or {})
+                elastic["desired"] = desired
+                rec["elastic"] = elastic
+            # Phase 1: intent on disk. From here a crash rolls FORWARD.
+            prepared_claims[claim_uid] = rec
+            self.checkpoint.write(prepared_claims)
+            # Phase 2: apply (holds + CDI), then finalize. A NON-crash
+            # failure here (e.g. the added spare sickened between
+            # re-solve and apply) rolls the intent BACK — the caller
+            # reports the resize as failed, so the claim must read
+            # exactly as before, not as perpetual 'resize' audit drift.
+            try:
+                new_rec = self._apply_resize(claim_uid, rec)
+            except BaseException:
+                self._rollback_resize(
+                    claim_uid, original_rec, rec["resize"],
+                    prepared_claims,
+                )
+                raise
+            prepared_claims[claim_uid] = new_rec
+            self.checkpoint.write(prepared_claims)
+            new_pc = PreparedClaim.from_dict(new_rec)
+            if self.accountant is not None:
+                # Rebuild the claim's occupancy holds around the new
+                # device set (hold duration restarts — the resize is a
+                # new placement as far as per-chip accounting goes).
+                self.accountant.note_unprepared(claim_uid)
+                self.accountant.note_prepared(new_pc)
+            return new_pc.get_devices()
+
+    def _rollback_resize(
+        self,
+        claim_uid: str,
+        original_rec: dict,
+        failed_intent: dict,
+        prepared_claims: dict,
+    ) -> None:
+        """Undo a FAILED live resize: restore sharing holds and the CDI
+        claim spec to the original gang and drop the checkpointed
+        intent.
+
+        Hold reconciliation is explicit — the partial apply may have
+        released removed-device holds and acquired added-spare holds
+        before failing, and re-applying the original device set alone
+        would not see either (every original device reads as "kept").
+        So: release holds for the failed intent's additions, re-acquire
+        every original gang hold (idempotent; we still hold the lock, so
+        nothing can have taken them), then re-apply the original record
+        to rewrite checkpoint + CDI. If any of that fails, the intent is
+        left on disk for the auditor's ``resize`` check — loud, never
+        silent. Caller re-raises the original error."""
+        work_groups = [
+            g for g in original_rec.get("groups", [])
+            if not (g.get("config") or {}).get("adminAccess")
+        ]
+        original_names = [
+            d["name"] for g in work_groups for d in g.get("devices", [])
+        ]
+        try:
+            # Holds the partial apply acquired for added spares: leaked
+            # unless released here (unprepare only releases group
+            # devices, and the spare never made it into a group).
+            for name in failed_intent.get("to", []):
+                if name in original_names:
+                    continue
+                dev = self._resolve_claimed_device(name)
+                if dev is None:
+                    continue
+                for u in dev.impl.uuids():
+                    self.share_state.release(u, claim_uid)
+            # Holds the partial apply released for removed devices: the
+            # checkpoint still records them in the gang, so they must be
+            # held again (or another claim could double-book the chip).
+            for g in work_groups:
+                for d in g.get("devices", []):
+                    for u in d.get("uuids", []):
+                        self.share_state.acquire(
+                            u, claim_uid, SHARING_EXCLUSIVE
+                        )
+            restored = self._apply_resize(claim_uid, {
+                **original_rec,
+                "resize": {"to": original_names, "requests": {}},
+            })
+            # A rollback is not a resize: keep the original elastic
+            # metadata (no generation bump, no implied desired size).
+            if "elastic" in original_rec:
+                restored["elastic"] = original_rec["elastic"]
+            else:
+                restored.pop("elastic", None)
+            prepared_claims[claim_uid] = restored
+            self.checkpoint.write(prepared_claims)
+        except Exception:
+            logger.exception(
+                "rollback of failed resize of claim %s also failed; "
+                "leaving the intent for the state auditor", claim_uid,
+            )
+
+    @staticmethod
+    def _check_resizable(rec: dict) -> None:
+        """Refuse claims the resize protocol cannot rewrite in place."""
+        work_groups = 0
+        for group in rec.get("groups", []):
+            if (group.get("config") or {}).get("adminAccess"):
+                continue
+            work_groups += 1
+            strategy = DeviceState._config_strategy(
+                group.get("config") or {}
+            )
+            if strategy in ("TimeShared", "ProcessShared"):
+                raise GangResizeError(
+                    f"claim uses {strategy} sharing; gang resize only "
+                    "supports exclusive gangs (sharing sessions carry "
+                    "runtime state a rewrite cannot move)"
+                )
+            for dev in group.get("devices", []):
+                if dev.get("channel") is not None:
+                    raise GangResizeError(
+                        "ICI channel devices cannot be gang-resized; "
+                        "re-prepare the claim instead"
+                    )
+        if work_groups > 1:
+            # Distinct groups mean distinct resolved configs; rebuilding
+            # them as one group would silently drop every config but the
+            # first. Refuse loudly instead.
+            raise GangResizeError(
+                f"claim has {work_groups} device groups with distinct "
+                "configs; gang resize only supports single-group "
+                "exclusive gangs"
+            )
+
+    def _resolve_claimed_device(
+        self, name: str
+    ) -> Optional[AllocatableDevice]:
+        """An already-claimed device's AllocatableDevice view: prefer the
+        live allocatable map, fall back to the base-spec pin (a kept
+        device may be transiently absent mid-rebind without invalidating
+        the claim that holds it)."""
+        return self.allocatable.get(name) or self._base_spec_devices.get(
+            name
+        )
+
+    def _apply_resize(self, claim_uid: str, rec: dict) -> dict:
+        """Roll a checkpointed ``resize`` intent forward; returns the
+        finalized record (intent dropped). Idempotent — both the live
+        resize path and startup crash recovery run it, any number of
+        times."""
+        intent = rec["resize"]
+        target: list[str] = list(intent["to"])
+        target_set = set(target)
+        request_names: dict[str, str] = dict(intent.get("requests") or {})
+        groups = [
+            PreparedDeviceGroup.from_dict(g) for g in rec.get("groups", [])
+        ]
+        admin_groups = [
+            g for g in groups if (g.config or {}).get("adminAccess")
+        ]
+        work_groups = [
+            g for g in groups if not (g.config or {}).get("adminAccess")
+        ]
+        if not work_groups:
+            raise GangResizeError(
+                f"claim {claim_uid} has no resizable device group"
+            )
+        kept = {
+            d.name: d for g in work_groups for d in g.devices
+            if d.name in target_set
+        }
+        removed = [
+            d for g in work_groups for d in g.devices
+            if d.name not in target_set
+        ]
+        added_names = [n for n in target if n not in kept]
+
+        # Validate additions BEFORE touching any state: a spare that
+        # sickened between re-solve and apply must fail the whole resize.
+        added: list[tuple[str, AllocatableDevice]] = []
+        for name in added_names:
+            dev = self.allocatable.get(name)
+            if dev is None:
+                raise GangResizeError(
+                    f"added device {name!r} is not allocatable here"
+                )
+            self._ensure_device_healthy(name, dev)
+            added.append((request_names.get(name, ""), dev))
+
+        # Release removed holds / acquire added ones (both idempotent).
+        for d in removed:
+            for u in d.uuids:
+                self.share_state.release(u, claim_uid)
+        for _, dev in added:
+            for u in dev.impl.uuids():
+                self.share_state.acquire(u, claim_uid, SHARING_EXCLUSIVE)
+
+        # Rebuild the work group in target order and rewrite the claim
+        # spec: per-device sharing env plus claim-wide visibility env
+        # over the post-resize gang (admin edits are preserved).
+        base_config = work_groups[0].config
+        shared_env = {"TPU_DRA_SHARING": "exclusive"}
+        new_devices: list[PreparedDevice] = []
+        claim_device_edits: dict[str, ContainerEdits] = {}
+        visible: list[AllocatableDevice] = []
+        for name in target:
+            if name in kept:
+                # Kept devices KEEP their checkpointed request name: the
+                # re-solve's synthetic request name must never overwrite
+                # the claim-spec name kubelet matches devices against.
+                pd = kept[name]
+                request = (
+                    pd.kubelet_device.request_names[0]
+                    if pd.kubelet_device.request_names else ""
+                )
+            else:
+                request = request_names.get(name, "")
+            dev = self._resolve_claimed_device(name)
+            if dev is None:
+                raise GangResizeError(
+                    f"device {name!r} of claim {claim_uid} is neither "
+                    "allocatable nor pinned in the base spec"
+                )
+            visible.append(dev)
+            cdi_ids = [
+                self.cdi.get_standard_device(name),
+                self.cdi.get_claim_device(claim_uid, name),
+            ]
+            claim_device_edits[name] = ContainerEdits(env=dict(shared_env))
+            new_devices.append(
+                self._make_prepared_device(request, dev, cdi_ids)
+            )
+        for g in admin_groups:
+            for pd in g.devices:
+                dev = self._resolve_claimed_device(pd.name)
+                if dev is None:
+                    continue
+                visible.append(dev)
+                admin_edit = ContainerEdits(env={"TPU_DRA_ADMIN": "1"})
+                existing = claim_device_edits.get(pd.name)
+                claim_device_edits[pd.name] = (
+                    existing.merge(admin_edit) if existing else admin_edit
+                )
+        common_env = self._claim_common_env(visible)
+        self.cdi.create_claim_spec_file(
+            claim_uid, claim_device_edits, common_env
+        )
+
+        new_pc = PreparedClaim(
+            claim_uid=claim_uid,
+            namespace=rec.get("namespace", ""),
+            name=rec.get("name", ""),
+            groups=[
+                PreparedDeviceGroup(devices=new_devices, config=base_config)
+            ] + admin_groups,
+            prepared_at=rec.get("preparedAt", 0.0),
+        )
+        new_rec = new_pc.to_dict()
+        elastic = dict(rec.get("elastic") or {})
+        elastic["generation"] = int(elastic.get("generation", 0)) + 1
+        elastic.setdefault(
+            "desired",
+            len([d for g in work_groups for d in g.devices]),
+        )
+        new_rec["elastic"] = elastic
+        logger.info(
+            "gang resize of claim %s applied: %d kept, %d removed, "
+            "%d added (generation %d)",
+            claim_uid, len(kept), len(removed), len(added),
+            elastic["generation"],
+        )
+        return new_rec
+
+    def _recover_resize_intents(self) -> None:
+        """Startup roll-forward of resize intents a crash left behind.
+
+        Each intent is re-applied idempotently; one that cannot complete
+        (e.g. its added device vanished while the plugin was down) is
+        LEFT IN PLACE — the state auditor's ``resize`` check reports it
+        as drift so the condition is operator-visible rather than
+        silently discarded.
+        """
+        try:
+            recs = self.checkpoint.read()
+        except Exception:
+            return
+        dirty = False
+        for uid, rec in list(recs.items()):
+            if "resize" not in rec:
+                continue
+            logger.warning(
+                "claim %s carries an in-flight resize intent (crash "
+                "mid-resize); rolling forward", uid,
+            )
+            try:
+                recs[uid] = self._apply_resize(uid, dict(rec))
+                dirty = True
+            except Exception:
+                logger.exception(
+                    "resize roll-forward of claim %s failed; leaving the "
+                    "intent for the auditor", uid,
+                )
+        if dirty:
+            self.checkpoint.write(recs)
+            # Consumers seeding from the startup state (the usage
+            # accountant's rebuild) must see the ROLLED-FORWARD gangs,
+            # not the pre-crash ones — stale records would count a
+            # released device as occupied for the claim's whole life.
+            self.startup_prepared_records = recs
+
+    @staticmethod
+    def _gang_view_of(claim_uid: str, rec: dict) -> Optional[dict]:
+        """Record → elastic-coordinator view (see gang_view)."""
+        from ..tpulib.deviceinfo import chip_uuid_of_device_uuid
+
+        devices: list[tuple[str, str]] = []
+        device_types: set[str] = set()
+        request_names: set[str] = set()
+        for group in rec.get("groups", []):
+            if (group.get("config") or {}).get("adminAccess"):
+                continue
+            for dev in group.get("devices", []):
+                if dev.get("channel") is not None:
+                    continue
+                uuids = dev.get("uuids") or [""]
+                devices.append(
+                    (dev["name"], chip_uuid_of_device_uuid(uuids[0]))
+                )
+                device_types.add(dev.get("type", ""))
+                for rn in (dev.get("device") or {}).get(
+                    "requestNames", []
+                ):
+                    request_names.add(rn)
+        if not devices:
+            return None
+        elastic = rec.get("elastic") or {}
+        return {
+            "claim_uid": claim_uid,
+            "namespace": rec.get("namespace", ""),
+            "name": rec.get("name", ""),
+            "devices": devices,
+            # The CHECKPOINTED device types (PreparedDevice.type) — the
+            # re-solve's DeviceClass must come from here, never from
+            # re-parsing device names (deviceinfo owns those forms).
+            "device_types": sorted(device_types),
+            # Claim-spec request names the gang was prepared under — the
+            # re-solve must reuse these, never invent its own.
+            "request_names": sorted(request_names),
+            "desired": elastic.get("desired"),
+            "generation": int(elastic.get("generation", 0)),
+        }
+
+    def gang_view(self, claim_uid: str) -> Optional[dict]:
+        """The elastic coordinator's view of one checkpointed claim:
+        non-admin chip/tensorcore device names in allocation order with
+        their governing chip uuids and checkpointed device types, plus
+        the claim's elastic metadata. None when the claim is unknown (or
+        holds nothing resizable)."""
+        with self._lock:
+            rec = self.checkpoint.read().get(claim_uid)
+        if rec is None:
+            return None
+        return self._gang_view_of(claim_uid, rec)
+
+    def gangs_on_chip(self, chip_uuid: str) -> list[dict]:
+        """gang_view for every checkpointed claim holding this chip
+        (directly or via a core partition) — the shrink scan's input,
+        built from ONE checkpoint read."""
+        with self._lock:
+            recs = self.checkpoint.read()
+        views = []
+        for uid, rec in recs.items():
+            uuids = [
+                u
+                for g in rec.get("groups", [])
+                for d in g.get("devices", [])
+                for u in d.get("uuids", [])
+            ]
+            if not any(
+                u == chip_uuid or u.startswith(f"{chip_uuid}-")
+                for u in uuids
+            ):
+                continue
+            v = self._gang_view_of(uid, rec)
+            if v is not None:
+                views.append(v)
+        return views
+
+    def elastic_claims(self) -> list[dict]:
+        """gang_view for every claim carrying elastic metadata (i.e. that
+        has been gang-resized at least once) — the grow scan. ONE
+        checkpoint read for the whole scan."""
+        with self._lock:
+            recs = self.checkpoint.read()
+        views = []
+        for uid, rec in recs.items():
+            if not rec.get("elastic"):
+                continue
+            v = self._gang_view_of(uid, rec)
+            if v is not None:
+                views.append(v)
+        return views
 
     # ------------------------------------------------------------------
     # Publication

@@ -10,6 +10,7 @@ API server before preparing.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import logging
 import threading
@@ -24,7 +25,7 @@ from ..kube.resourceapi import ResourceApi
 from ..kube.resourceslice import DriverResources, Pool
 from ..tpulib.chiplib import ChipLib
 from ..utils import tracing
-from ..utils.metrics import Counter, Histogram, Registry
+from ..utils.metrics import Counter, Gauge, Histogram, Registry
 from ..utils.tracing import Tracer
 from .checkpoint import CheckpointManager
 from .device_state import DeviceState
@@ -34,6 +35,43 @@ from .kubeletplugin import KubeletPlugin
 logger = logging.getLogger(__name__)
 
 DRIVER_NAME = "tpu.google.com"
+
+# Gang resizes kept for the resize trace (driver.resize_trace()).
+ELASTIC_TRACE_DEPTH = 64
+
+# Device type (PreparedDevice.type) -> DeviceClass the elastic re-solve
+# requests. ICI channels are deliberately absent: they cannot be resized.
+_ELASTIC_DEVICE_CLASSES = {
+    "chip": "tpu.google.com",
+    "tensorcore": "tensorcore.tpu.google.com",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class GangResize:
+    """The typed resize protocol message (plugin → workload).
+
+    Emitted once per COMPLETED gang resize: the claim's checkpoint, CDI
+    spec, and sharing holds already reflect ``devices`` when a listener
+    sees this. The workload side (parallel/elastic.ElasticTrainer) maps
+    ``devices`` to its jax devices and reshards; ``generation`` is the
+    claim's monotonically increasing resize counter so late/duplicate
+    deliveries are detectable."""
+
+    claim_uid: str
+    claim_name: str
+    namespace: str
+    direction: str                # "shrink" | "grow"
+    reason: str
+    removed: tuple[str, ...]      # device names dropped by this resize
+    added: tuple[str, ...]        # device names admitted by this resize
+    devices: tuple[str, ...]      # post-resize gang, allocation order
+    desired: int                  # gang size the claim wants back
+    generation: int
+    at: float                     # epoch seconds
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
 
 
 class ClaimVerifyError(RuntimeError):
@@ -150,6 +188,31 @@ class Driver(NodeServicer):
             "was unreachable (degraded mode)",
             self.registry,
         )
+        # Elastic gang-resize telemetry (populated only when
+        # enable_elastic() wires an allocator; families exist either way
+        # so dashboards see explicit zeros).
+        self._m_elastic_resizes = Counter(
+            "tpu_dra_elastic_resizes_total",
+            "Gang resizes attempted by the elastic coordinator, by "
+            "direction and outcome",
+            self.registry,
+        )
+        self._m_elastic_resize_seconds = Histogram(
+            "tpu_dra_elastic_resize_seconds",
+            "End-to-end gang-resize latency: re-solve, checkpointed "
+            "intent, holds/CDI rewrite, finalize",
+            self.registry,
+        )
+        self._m_elastic_last_resize = Gauge(
+            "tpu_dra_elastic_last_resize_timestamp_seconds",
+            "Wall-clock time of the last completed gang resize",
+            self.registry,
+        )
+        self._elastic_allocator = None
+        self._resize_trace: collections.deque = collections.deque(
+            maxlen=ELASTIC_TRACE_DEPTH
+        )
+        self._resize_listeners: list = []
         # Failures (and recoveries) become kubectl-visible Events on the
         # ResourceClaim; no-op without a kube client.
         self.events = EventRecorder(
@@ -301,7 +364,8 @@ class Driver(NodeServicer):
             try:
                 changed = self.state.refresh_allocatable()
                 self._last_inventory_ok = time.monotonic()
-                self._report_health_transitions()
+                transitions = self.state.drain_health_transitions()
+                self._report_health_transitions(transitions)
                 if changed:
                     # Trace only actual inventory changes: a root trace per
                     # idle 30s tick would evict the claim traces the ring
@@ -311,16 +375,21 @@ class Driver(NodeServicer):
                         logger.info("device inventory changed; republishing")
                         if self.config.kube_client is not None:
                             self.publish_resources()
+                # Elastic gang resize runs AFTER the republish: the
+                # re-solve reads published slices, which must already
+                # reflect the transition (a shrink re-solving against
+                # stale slices could pick the dead chip right back).
+                self._maybe_elastic_resize(transitions)
             except Exception:
                 logger.exception("device inventory refresh failed")
 
-    def _report_health_transitions(self) -> None:
+    def _report_health_transitions(self, transitions) -> None:
         """Turn health transitions into the metric and, when the chip
         carries a PREPARED claim, a Kubernetes Event on that claim — the
         operator-visible signal that a running workload's hardware
         sickened (or recovered). Republishing itself rides the ordinary
         changed-inventory path."""
-        for uuid, old_state, status in self.state.drain_health_transitions():
+        for uuid, old_state, status in transitions:
             self._m_health_transitions.inc(
                 from_state=old_state, to=status.state
             )
@@ -347,6 +416,305 @@ class Driver(NodeServicer):
                         f"{status.state}: {status.reason or 'unknown'} — "
                         "this claim holds a prepared device on it",
                     )
+
+    # ------------------------------------------------------------------
+    # Elastic gang resize (chip health → claim shrink/grow)
+    # ------------------------------------------------------------------
+
+    def enable_elastic(self, allocator) -> None:
+        """Arm chip-health-driven gang resizing.
+
+        ``allocator`` is the structured-parameters solver the coordinator
+        re-solves claims against (the ReferenceAllocator in the sim; in a
+        real cluster this seam is the scheduler). Once armed: a chip
+        going unhealthy shrinks every exclusive multi-device gang it
+        carries to the largest healthy contiguous sub-gang, and a chip
+        recovering grows previously-shrunk gangs back toward their
+        desired size. Every completed resize is checkpoint-backed
+        (DeviceState.resize_claim), lands in the resize trace, emits a
+        GangResized Event and the tpu_dra_elastic_* metrics, and is
+        delivered to listeners as a typed :class:`GangResize` message."""
+        self._elastic_allocator = allocator
+
+    def add_resize_listener(self, callback) -> None:
+        """Register ``callback(GangResize)`` — the workload-side hook.
+
+        Called on the device-watch thread after the resize is durable
+        (outside the claim lock, so prepares are never blocked — but
+        health polling IS paused while callbacks run). Callbacks must
+        return quickly: record the message and let the training loop
+        perform the actual reshard (ElasticTrainer.resize), as the
+        acceptance tests and ``make elastic`` do. Exceptions are logged,
+        never propagated into the watch loop."""
+        self._resize_listeners.append(callback)
+
+    def resize_trace(self) -> list[dict]:
+        """Newest-last gang-resize records (the operator's trace; each
+        entry is a GangResize dict)."""
+        return [m.to_dict() for m in self._resize_trace]
+
+    def _maybe_elastic_resize(self, transitions) -> None:
+        if self._elastic_allocator is None or not transitions:
+            return
+        completed: list[GangResize] = []
+        # Under the claim lock: a resize must not interleave with a
+        # concurrent Prepare/Unprepare of the same claim (same order as
+        # the RPC path: driver lock, then DeviceState lock).
+        with self._lock:
+            recovered: list[str] = []
+            for uuid, old_state, status in transitions:
+                try:
+                    if status.is_healthy():
+                        recovered.append(
+                            f"chip {uuid} recovered (was {old_state})"
+                        )
+                    else:
+                        completed.extend(
+                            self._elastic_shrink_chip(uuid, status)
+                        )
+                except Exception:
+                    logger.exception(
+                        "elastic resize for chip %s transition failed",
+                        uuid,
+                    )
+            if recovered:
+                # ONE grow scan per transition batch: a whole host
+                # coming back flips many chips healthy at once, and each
+                # scan reads the full checkpoint.
+                try:
+                    completed.extend(
+                        self._elastic_grow_all("; ".join(recovered))
+                    )
+                except Exception:
+                    logger.exception("elastic grow scan failed")
+        # Listener delivery OUTSIDE the claim lock, so a slow listener
+        # never stalls NodePrepare/NodeUnprepare RPCs. It still runs ON
+        # the device-watch thread (the resizes are already durable):
+        # listeners must return quickly and hand heavy work — the actual
+        # reshard — to the training loop (see add_resize_listener).
+        for msg in completed:
+            for cb in self._resize_listeners:
+                try:
+                    cb(msg)
+                except Exception:
+                    logger.exception("resize listener failed")
+
+    def _elastic_shrink_chip(
+        self, chip_uuid: str, status
+    ) -> list[GangResize]:
+        reason = (
+            f"chip {chip_uuid} {status.state}: "
+            f"{status.reason or 'unknown'}"
+        )
+        completed = []
+        for view in self.state.gangs_on_chip(chip_uuid):
+            health = self.state.chip_health
+            surviving = []
+            lost = []
+            for name, cuuid in view["devices"]:
+                st = health.get(cuuid)
+                if st is None or st.is_healthy():
+                    surviving.append(name)
+                else:
+                    lost.append(name)
+            if not lost:
+                continue
+            if len(view["devices"]) < 2:
+                # A single-device claim has nothing to shrink TO; the
+                # ChipUnhealthy Event already covers it.
+                continue
+            if not surviving:
+                self._elastic_failed(
+                    view, "shrink", reason + " — no surviving devices"
+                )
+                continue
+            msg = self._elastic_resize_claim(
+                view, "shrink", len(surviving), reason
+            )
+            if msg is not None:
+                completed.append(msg)
+        return completed
+
+    def _elastic_grow_all(self, reason: str) -> list[GangResize]:
+        completed = []
+        for view in self.state.elastic_claims():
+            desired = view.get("desired")
+            if not desired or len(view["devices"]) >= desired:
+                continue
+            msg = self._elastic_resize_claim(view, "grow", desired, reason)
+            if msg is not None:
+                completed.append(msg)
+        return completed
+
+    def _elastic_resize_claim(
+        self, view: dict, direction: str, want: int, reason: str
+    ) -> Optional[GangResize]:
+        """Re-solve the claim for the largest satisfiable gang size
+        ``<= want`` and apply the result through the checkpointed resize
+        protocol; returns the completed GangResize (None on failure —
+        the caller delivers messages to listeners outside the lock). The
+        descending-count retry IS the incremental re-solve: gang
+        contiguity may make the full survivor count unsat (three
+        survivors of a 2x2 block form no box) while a smaller one works."""
+        from ..kube.allocator import AllocationError
+
+        uid = view["claim_uid"]
+        t0 = time.monotonic()
+        device_class = self._elastic_device_class(view)
+        if device_class is None:
+            self._elastic_failed(
+                view, direction,
+                reason + " — gang mixes device types; not resizable",
+            )
+            return None
+        # The re-solve reuses the claim's OWN request name: results feed
+        # straight back into KubeletDevice.request_names, which kubelet
+        # matches against the ResourceClaim spec — an invented name
+        # would strand added devices on a request that does not exist.
+        req_names = view.get("request_names") or []
+        if len(req_names) != 1:
+            self._elastic_failed(
+                view, direction,
+                reason + f" — gang spans request names {req_names}; "
+                "only single-request gangs are resizable",
+            )
+            return None
+        request_name = req_names[0]
+        current = len(view["devices"])
+        floor = current + 1 if direction == "grow" else 1
+        # The claim's CURRENT allocation, for restoring allocator state
+        # when the re-solve or apply fails: its live, exclusively-held
+        # devices must not be left looking free.
+        current_results = [
+            {"request": request_name, "driver": self.config.driver_name,
+             "pool": self.config.node_name, "device": name}
+            for name, _ in view["devices"]
+        ]
+        with self.tracer.span(
+            "gang-resize", claim_uid=uid,
+            tags={"direction": direction, "reason": reason},
+        ) as span:
+            self._elastic_allocator.deallocate(uid)
+            allocated = None
+            count = want
+            last_err: Optional[Exception] = None
+            while count >= floor:
+                synth = {
+                    "metadata": {
+                        "uid": uid,
+                        "name": view["name"],
+                        "namespace": view["namespace"],
+                    },
+                    "spec": {"devices": {"requests": [{
+                        "name": request_name,
+                        "deviceClassName": device_class,
+                        "allocationMode": "ExactCount",
+                        "count": count,
+                    }]}},
+                }
+                try:
+                    allocated = self._elastic_allocator.allocate(
+                        synth,
+                        node_name=self.config.node_name,
+                        require_healthy=True,
+                    )
+                    break
+                except AllocationError as e:
+                    last_err = e
+                    count -= 1
+            if allocated is None:
+                span.set_error(str(last_err))
+                self._elastic_allocator.restore_reservations(
+                    uid, current_results
+                )
+                self._elastic_failed(
+                    view, direction,
+                    f"{reason} — re-solve unsat down to gang size "
+                    f"{floor} ({last_err})",
+                )
+                return None
+            results = (
+                allocated["status"]["allocation"]["devices"]["results"]
+            )
+            try:
+                self.state.resize_claim(
+                    uid, results,
+                    desired=view.get("desired") or current,
+                )
+            except Exception as e:
+                span.set_error(str(e))
+                # The allocator holds the NEW allocation but the claim
+                # kept its OLD gang: put the allocator back in step.
+                self._elastic_allocator.deallocate(uid)
+                self._elastic_allocator.restore_reservations(
+                    uid, current_results
+                )
+                self._elastic_failed(
+                    view, direction, f"{reason} — apply failed: {e}"
+                )
+                return None
+            span.set_tag("devices", len(results))
+
+        old_names = [n for n, _ in view["devices"]]
+        new_names = [r["device"] for r in results]
+        msg = GangResize(
+            claim_uid=uid,
+            claim_name=view["name"],
+            namespace=view["namespace"],
+            direction=direction,
+            reason=reason,
+            removed=tuple(n for n in old_names if n not in new_names),
+            added=tuple(n for n in new_names if n not in old_names),
+            devices=tuple(new_names),
+            desired=view.get("desired") or current,
+            generation=view["generation"] + 1,
+            at=time.time(),
+        )
+        self._resize_trace.append(msg)
+        self._m_elastic_resizes.inc(direction=direction, outcome="ok")
+        self._m_elastic_resize_seconds.observe(time.monotonic() - t0)
+        self._m_elastic_last_resize.set(msg.at)
+        logger.warning(
+            "gang %s of claim %s: %d -> %d device(s) (%s)",
+            direction, uid, len(old_names), len(new_names), reason,
+        )
+        self.events.normal(
+            self._elastic_claim_ref(view), "GangResized",
+            f"gang {direction} on {self.config.node_name}: "
+            f"{len(old_names)} -> {len(new_names)} device(s) "
+            f"[{', '.join(new_names)}] — {reason}",
+        )
+        return msg
+
+    def _elastic_device_class(self, view: dict) -> Optional[str]:
+        """The DeviceClass to re-solve with, from the gang's
+        CHECKPOINTED device types (PreparedDevice.type — name re-parsing
+        would misclassify non-1c tensorcore partitions); None for
+        mixed/unknown gangs."""
+        types = view.get("device_types") or []
+        if len(types) != 1:
+            return None
+        return _ELASTIC_DEVICE_CLASSES.get(types[0])
+
+    def _elastic_claim_ref(self, view: dict) -> ObjectRef:
+        return ObjectRef.claim(
+            view["name"], view["namespace"], view["claim_uid"],
+            api_version=self.resource_api.api_version,
+        )
+
+    def _elastic_failed(
+        self, view: dict, direction: str, detail: str
+    ) -> None:
+        self._m_elastic_resizes.inc(direction=direction, outcome="failed")
+        logger.error(
+            "gang %s of claim %s failed: %s",
+            direction, view["claim_uid"], detail,
+        )
+        self.events.warning(
+            self._elastic_claim_ref(view), "GangResizeFailed",
+            f"gang {direction} on {self.config.node_name} failed: "
+            f"{detail}",
+        )
 
     def _adopt_resource_api(self, api: ResourceApi) -> None:
         """Take a re-discovered dialect observed by a sibling component

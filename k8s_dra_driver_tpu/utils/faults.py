@@ -23,10 +23,13 @@ chaos soak tests. ``arm_from_env()`` lets a flag/env arm simple plans on a
 real binary (``TPU_DRA_FAULTS="checkpoint.write@2=oserror,kube.get=api503"``)
 — unset, it does nothing, which is the production state.
 
-Site naming convention: ``<component>.<operation>`` —
-``kube.<verb>``, ``chiplib.enumerate``, ``chiplib.create-channel``,
-``checkpoint.read``, ``checkpoint.write``, ``cdi.base-write``,
-``cdi.claim-write``.
+Site naming convention: ``<component>.<operation>``. The canonical
+registry of instrumented sites is :data:`ALL_SITES` (grouped by family:
+``kube.*``, ``chiplib.*``, ``checkpoint.*``, ``cdi.*``, and the
+model-side ``train.*`` family — ``train.step`` fires at the top of every
+elastic train step, ``train.reshard`` at the top of every gang resize).
+Seeded schedules should draw their site lists from it via
+:func:`sites_in` so new families are automatically soak-covered.
 """
 
 from __future__ import annotations
@@ -40,6 +43,41 @@ import threading
 from typing import Callable, Optional
 
 logger = logging.getLogger(__name__)
+
+# Canonical registry of instrumented fault sites — the seeded-schedule
+# site list. Every site name fired in production code must be listed
+# here (tests/test_faults.py cross-checks the source tree), so a chaos
+# soak drawing from a family prefix cannot silently miss a site.
+ALL_SITES = (
+    # Kubernetes API round-trips (kube/client.py).
+    "kube.get",
+    "kube.list",
+    "kube.create",
+    "kube.update",
+    "kube.delete",
+    "kube.watch",
+    # Chip library hardware probes (tpulib/chiplib.py).
+    "chiplib.enumerate",
+    "chiplib.create-channel",
+    # Prepared-claim checkpoint store (plugin/checkpoint.py).
+    "checkpoint.read",
+    "checkpoint.write",
+    # CDI spec writes (cdi/spec.py).
+    "cdi.base-write",
+    "cdi.claim-write",
+    # Model-side training loop (parallel/elastic.py): injectable like the
+    # driver sites, so chaos schedules can unplug a chip mid-step or
+    # crash mid-reshard.
+    "train.step",
+    "train.reshard",
+)
+
+
+def sites_in(*families: str) -> list[str]:
+    """Registered sites under the given family prefixes (e.g.
+    ``sites_in("kube.", "train.")``) — the building block for seeded-
+    schedule site lists."""
+    return [s for s in ALL_SITES if s.startswith(families)]
 
 
 class FaultError(RuntimeError):

@@ -33,6 +33,7 @@ from k8s_dra_driver_tpu.plugin.checkpoint import CheckpointManager
 from k8s_dra_driver_tpu.plugin.cleanup import OrphanCleaner
 from k8s_dra_driver_tpu.plugin.device_state import (
     DeviceState,
+    GangResizeError,
     PrepareError,
     UnhealthyDeviceError,
 )
@@ -649,6 +650,547 @@ def run_acceptance_schedule(tmp_path, seed):
                 driver.shutdown()
             except Exception:
                 pass
+
+
+def make_gang_claim(client, allocator, uid="uid-gang", name="train",
+                    count=4, device_class="tpu.google.com"):
+    """Allocate a count-N gang through the REAL sim allocator (so the
+    elastic re-solve later operates on genuine reservations) and create
+    the claim in the fake apiserver for the prepare path to fetch. The
+    request is deliberately NOT named "gang": the elastic re-solve must
+    reuse the claim's own request name, and a hardcoded one would hide
+    that."""
+    claim = {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {"name": name, "namespace": "default", "uid": uid},
+        "spec": {"devices": {"requests": [{
+            "name": "workers",
+            "deviceClassName": device_class,
+            "allocationMode": "ExactCount",
+            "count": count,
+        }]}},
+    }
+    allocator.allocate(claim, node_name="node-a")
+    client.create(RESOURCE_CLAIMS, claim, namespace="default")
+    return claim
+
+
+class TestElasticGangResize:
+    """The elastic-training acceptance scenario (ROADMAP item 5): a
+    seeded chip-unplug DURING a multichip train step shrinks the gang
+    claim, the allocator re-solves for the surviving topology, the mesh
+    reshapes, the live TrainState reshards device-to-device (no
+    checkpoint restore on the hot path), and training resumes with loss
+    continuity against an uninterrupted run on the surviving topology —
+    with the StateAuditor as the no-drift oracle. Then the symmetric
+    grow when the chip is restored."""
+
+    def _driver(self, tmp_path):
+        from k8s_dra_driver_tpu.kube.allocator import ReferenceAllocator
+
+        lib = FakeChipLib(generation="v5p", topology="4x1x1")
+        driver, client, lib = make_driver(tmp_path, lib=lib)
+        allocator = ReferenceAllocator(client, registry=Registry())
+        driver.enable_elastic(allocator)
+        return driver, client, lib, allocator
+
+    def test_chip_unplug_mid_step_resize_resume_and_grow(self, tmp_path):
+        import jax
+        import numpy as np
+
+        from k8s_dra_driver_tpu.models.llama import PRESETS
+        from k8s_dra_driver_tpu.models.train import (
+            make_optimizer,
+            state_shardings,
+        )
+        from k8s_dra_driver_tpu.parallel import MeshConfig
+        from k8s_dra_driver_tpu.parallel.elastic import ElasticTrainer
+
+        cfg = PRESETS["tiny"]
+        jax_devices = jax.devices()
+        assert len(jax_devices) >= 8
+        driver, client, lib, allocator = self._driver(tmp_path)
+        resizes = []
+        driver.add_resize_listener(resizes.append)
+        driver.start()
+        try:
+            assert wait_for(lambda: len(client.list(RESOURCE_SLICES)) >= 1)
+            claim = make_gang_claim(client, allocator)
+            assert prepare_via_rpc(driver, claim).error == ""
+
+            # Claim device tpu-i <-> jax device i: the workload-side view
+            # of the DRA allocation (TPU_VISIBLE_CHIPS ordering).
+            def jax_devs(names):
+                return [jax_devices[int(n.split("-")[1])] for n in names]
+
+            opt = make_optimizer(warmup_steps=1, total_steps=10)
+            trainer = ElasticTrainer(
+                cfg, opt, jax_devs(["tpu-0", "tpu-1", "tpu-2", "tpu-3"]),
+                mesh_config=MeshConfig(data=2, tensor=2), global_batch=8,
+            )
+            # Uninterrupted reference on the SURVIVING topology (the
+            # post-shrink 2-device tensor mesh), from the same init —
+            # copied through host memory so the runs share no donated
+            # buffers. (Both gangs end on 2 used devices whatever chip
+            # the seed kills: 8-token batches only divide dp=1 or 2.)
+            reference = ElasticTrainer(
+                cfg, opt, jax_devices[:2],
+                mesh_config=MeshConfig(tensor=2), global_batch=8,
+            )
+            host_init = jax.tree.map(np.array, trainer.state)
+            reference.state = jax.device_put(
+                host_init, state_shardings(reference.state, reference.mesh)
+            )
+            n_steps = 7
+            toks = [
+                jax.random.randint(
+                    jax.random.PRNGKey(100 + i), (8, 65), 0, cfg.vocab_size
+                )
+                for i in range(n_steps)
+            ]
+            ref_losses = [reference.step(t) for t in toks]
+
+            # Seeded chaos: the unplug lands at the TOP of train step 4 —
+            # mid-training, between the plugin's health polls.
+            import random
+
+            victim = random.Random(SEED).randrange(4)
+            plan = faults.FaultPlan()
+            plan.call(
+                "train.step",
+                lambda: lib.unplug_chip(victim, reason="chaos unplug"),
+                on_calls={4},
+            )
+            losses = []
+            with faults.armed(plan):
+                for t in toks[:4]:
+                    losses.append(trainer.step(t))
+            # The watch loop sees the unplug, the gang shrinks, and the
+            # typed resize message reaches the workload.
+            assert wait_for(lambda: len(resizes) >= 1, timeout=15)
+            msg = resizes[0]
+            assert msg.direction == "shrink"
+            assert msg.claim_uid == "uid-gang"
+            assert f"tpu-{victim}" in msg.removed
+            assert f"tpu-{victim}" not in msg.devices
+            assert msg.desired == 4 and msg.generation == 1
+            # The checkpointed claim matches the message (protocol truth).
+            view = driver.state.gang_view("uid-gang")
+            assert tuple(n for n, _ in view["devices"]) == msg.devices
+            # The re-solve reused the claim's OWN request name — kubelet
+            # still matches every device to the spec's "workers" request.
+            for d in driver.state.cached_devices("uid-gang"):
+                assert d.request_names == ["workers"]
+
+            # Live reshard onto the surviving gang; remainder idled.
+            event = trainer.resize(
+                jax_devs(msg.devices), reason=msg.reason
+            )
+            assert event.path == "live", (
+                "the hot path must not touch the checkpoint"
+            )
+            assert event.n_used == 2
+            assert event.n_used + event.n_idled == len(msg.devices)
+            for t in toks[4:]:
+                losses.append(trainer.step(t))
+            # Loss continuity: the interrupted-and-reshaped run lands
+            # where the uninterrupted run on the surviving topology
+            # lands (different meshes = different reduction orders, so
+            # close, not bit-exact).
+            np.testing.assert_allclose(
+                losses, ref_losses, rtol=2e-4, atol=2e-4
+            )
+            # No-drift oracle (slices comparison converges async).
+            assert wait_for(lambda: driver.auditor.run_once() == [])
+            assert driver._m_elastic_resizes.value(
+                direction="shrink", outcome="ok"
+            ) == 1
+
+            # Symmetric grow: the chip is restored, the gang grows back
+            # to its desired size, and the state reshards onto the
+            # larger mesh.
+            lib.restore_chip(victim)
+            assert wait_for(lambda: len(resizes) >= 2, timeout=15)
+            grow = resizes[1]
+            assert grow.direction == "grow"
+            assert set(grow.devices) == {
+                "tpu-0", "tpu-1", "tpu-2", "tpu-3"
+            }
+            assert grow.generation == 2
+            event = trainer.resize(jax_devs(grow.devices),
+                                   reason=grow.reason)
+            assert event.path == "live" and event.n_used == 4
+            post_grow = [trainer.step(t) for t in toks]
+            assert all(np.isfinite(loss) for loss in post_grow)
+            assert wait_for(lambda: driver.auditor.run_once() == [])
+            assert driver._m_elastic_resizes.value(
+                direction="grow", outcome="ok"
+            ) == 1
+            # Operator surfaces: the Event and the resize trace.
+            driver.events.flush()
+            assert any(
+                ev["reason"] == "GangResized"
+                and ev["involvedObject"]["name"] == "train"
+                for ev in client.list(EVENTS)
+            )
+            directions = [r["direction"] for r in driver.resize_trace()]
+            assert directions == ["shrink", "grow"]
+            assert_invariants(driver.state)
+        finally:
+            driver.shutdown()
+
+    def test_no_survivors_emits_gang_resize_failed(self, tmp_path):
+        """Every chip of the gang dying leaves nothing to shrink to —
+        the coordinator must say so (typed failure, Warning Event,
+        outcome metric), not resize to an empty gang. Driven without the
+        watch thread so BOTH deaths land in one transition batch (a
+        rack-power event, not two separate failures)."""
+        driver, client, lib, allocator = self._driver(tmp_path)
+        driver.publish_resources()
+        assert wait_for(lambda: len(client.list(RESOURCE_SLICES)) >= 1)
+        claim = make_gang_claim(client, allocator, uid="uid-all",
+                                name="doomed", count=2)
+        assert prepare_via_rpc(driver, claim).error == ""
+        names = [
+            r["device"]
+            for r in claim["status"]["allocation"]["devices"]["results"]
+        ]
+        for n in names:
+            lib.unplug_chip(int(n.split("-")[1]), reason="rack power")
+        driver.state.refresh_allocatable()
+        transitions = driver.state.drain_health_transitions()
+        assert len(transitions) >= 2
+        driver._maybe_elastic_resize(transitions)
+        assert driver._m_elastic_resizes.value(
+            direction="shrink", outcome="failed"
+        ) >= 1
+        assert driver._m_elastic_resizes.value(
+            direction="shrink", outcome="ok"
+        ) == 0
+        driver.events.flush()
+        assert any(
+            ev["reason"] == "GangResizeFailed"
+            and ev["involvedObject"]["name"] == "doomed"
+            for ev in client.list(EVENTS)
+        )
+        # The claim's prepared record is untouched.
+        view = driver.state.gang_view("uid-all")
+        assert [n for n, _ in view["devices"]] == names
+
+    def test_failed_resize_restores_allocator_reservations(
+        self, tmp_path, monkeypatch
+    ):
+        """A re-solve that goes unsat at every size must put the
+        allocator's reservations back: the claim keeps running on its
+        prepared, exclusively-held devices, which must not be left
+        looking free to the next solve."""
+        from k8s_dra_driver_tpu.kube.allocator import AllocationError
+
+        driver, client, lib, allocator = self._driver(tmp_path)
+        driver.publish_resources()
+        assert wait_for(lambda: len(client.list(RESOURCE_SLICES)) >= 1)
+        claim = make_gang_claim(client, allocator, uid="uid-res",
+                                name="res", count=2)
+        assert prepare_via_rpc(driver, claim).error == ""
+        names = [
+            r["device"]
+            for r in claim["status"]["allocation"]["devices"]["results"]
+        ]
+        keys = {("node-a", n) for n in names}
+        assert all(
+            allocator._reservations.get(k) == "uid-res" for k in keys
+        )
+
+        def unsat(*a, **k):
+            raise AllocationError("forced unsat", reason="shortfall")
+
+        monkeypatch.setattr(allocator, "allocate", unsat)
+        lib.unplug_chip(int(names[1].split("-")[1]), reason="dead")
+        driver.state.refresh_allocatable()
+        driver._maybe_elastic_resize(
+            driver.state.drain_health_transitions()
+        )
+        assert driver._m_elastic_resizes.value(
+            direction="shrink", outcome="failed"
+        ) >= 1
+        # The gang (dead member included — the claim still nominally
+        # holds it) is reserved again; nothing double-books it.
+        assert all(
+            allocator._reservations.get(k) == "uid-res" for k in keys
+        )
+        view = driver.state.gang_view("uid-res")
+        assert [n for n, _ in view["devices"]] == names
+
+    def test_device_class_from_checkpointed_types(self, tmp_path):
+        """The re-solve DeviceClass comes from PreparedDevice.type, not
+        from re-parsing device names — a tensorcore-partition gang must
+        re-solve as tensorcores, and a mixed gang must refuse."""
+        driver, client, lib, allocator = self._driver(tmp_path)
+        driver.state.prepare(
+            make_claim("uid-tc", ["tpu-0-core-0", "tpu-1-core-0"])
+        )
+        view = driver.state.gang_view("uid-tc")
+        assert view["device_types"] == ["tensorcore"]
+        assert driver._elastic_device_class(view) == (
+            "tensorcore.tpu.google.com"
+        )
+        driver.state.prepare(
+            make_claim("uid-mix", ["tpu-2", "tpu-3-core-0"], name="mix")
+        )
+        mixed = driver.state.gang_view("uid-mix")
+        assert set(mixed["device_types"]) == {"chip", "tensorcore"}
+        assert driver._elastic_device_class(mixed) is None
+
+    @pytest.mark.slow
+    def test_shrink_grow_soak(self, tmp_path):
+        """Seeded unplug/restore cycles with a live trainer riding every
+        resize; the auditor must read clean and the loss stay finite
+        after each round."""
+        import random
+
+        import jax
+        import numpy as np
+
+        from k8s_dra_driver_tpu.models.llama import PRESETS
+        from k8s_dra_driver_tpu.models.train import make_optimizer
+        from k8s_dra_driver_tpu.parallel import MeshConfig
+        from k8s_dra_driver_tpu.parallel.elastic import ElasticTrainer
+
+        cfg = PRESETS["tiny"]
+        jax_devices = jax.devices()
+        rng = random.Random(SEED)
+        driver, client, lib, allocator = self._driver(tmp_path)
+        resizes = []
+        driver.add_resize_listener(resizes.append)
+        driver.start()
+        try:
+            assert wait_for(lambda: len(client.list(RESOURCE_SLICES)) >= 1)
+            claim = make_gang_claim(client, allocator, uid="uid-soak",
+                                    name="soak")
+            assert prepare_via_rpc(driver, claim).error == ""
+            opt = make_optimizer(warmup_steps=1, total_steps=100)
+            trainer = ElasticTrainer(
+                cfg, opt,
+                [jax_devices[i] for i in range(4)],
+                mesh_config=MeshConfig(data=2, tensor=2), global_batch=8,
+            )
+            step = 0
+            for round_no in range(4):
+                victim = rng.randrange(4)
+                seen = len(resizes)
+                lib.unplug_chip(victim, reason=f"soak round {round_no}")
+                assert wait_for(lambda: len(resizes) > seen, timeout=15)
+                trainer.resize(
+                    [jax_devices[int(n.split("-")[1])]
+                     for n in resizes[-1].devices],
+                    reason=resizes[-1].reason,
+                )
+                for _ in range(2):
+                    loss = trainer.step(jax.random.randint(
+                        jax.random.PRNGKey(step), (8, 65), 0,
+                        cfg.vocab_size,
+                    ))
+                    step += 1
+                assert np.isfinite(loss)
+                seen = len(resizes)
+                lib.restore_chip(victim)
+                assert wait_for(lambda: len(resizes) > seen, timeout=15)
+                trainer.resize(
+                    [jax_devices[int(n.split("-")[1])]
+                     for n in resizes[-1].devices],
+                    reason=resizes[-1].reason,
+                )
+                assert wait_for(
+                    lambda: driver.auditor.run_once() == [], timeout=15
+                )
+            assert_invariants(driver.state)
+        finally:
+            driver.shutdown()
+
+
+class TestElasticCrashConsistency:
+    """The typed resize protocol's crash windows: the two-phase
+    checkpoint (intent → apply → finalize) must roll forward at restart,
+    and an intent recovery CANNOT complete must surface as the auditor's
+    ``resize`` drift finding — never as silent corruption."""
+
+    def _resize_results(self, names):
+        return [
+            {"request": "gang", "driver": DRIVER, "pool": "node-a",
+             "device": n}
+            for n in names
+        ]
+
+    def test_crash_before_intent_leaves_claim_untouched(self, tmp_path):
+        lib = FakeChipLib(generation="v5p", topology="4x1x1")
+        state, lib = make_state(tmp_path, lib=lib)
+        state.prepare(make_claim("uid-r", ["tpu-0", "tpu-1", "tpu-2"]))
+        plan = faults.FaultPlan().crash("checkpoint.write")
+        with faults.armed(plan):
+            with pytest.raises(faults.CrashPoint):
+                state.resize_claim(
+                    "uid-r", self._resize_results(["tpu-0", "tpu-1"])
+                )
+        restarted, _ = make_state(tmp_path, lib=lib)
+        view = restarted.gang_view("uid-r")
+        assert [n for n, _ in view["devices"]] == [
+            "tpu-0", "tpu-1", "tpu-2"
+        ]
+        assert run_audit(restarted) == []
+        assert_invariants(restarted)
+
+    def test_crash_between_intent_and_finalize_rolls_forward(
+        self, tmp_path
+    ):
+        """The narrowest window: intent checkpointed, holds/CDI
+        rewritten, crash before the finalize write. Restart recovery
+        re-applies the intent idempotently; the shrunken gang is the
+        durable truth and the auditor reads clean."""
+        lib = FakeChipLib(generation="v5p", topology="4x1x1")
+        state, lib = make_state(tmp_path, lib=lib)
+        state.prepare(make_claim("uid-r2", ["tpu-0", "tpu-1", "tpu-2"]))
+        # checkpoint.write hit 1 = the resize intent, hit 2 = finalize.
+        plan = faults.FaultPlan().crash("checkpoint.write", on_call=2)
+        with faults.armed(plan):
+            with pytest.raises(faults.CrashPoint):
+                state.resize_claim(
+                    "uid-r2", self._resize_results(["tpu-0", "tpu-1"]),
+                    desired=3,
+                )
+        # The dead incarnation left the intent on disk.
+        raw = CheckpointManager(str(tmp_path / "checkpoint.json")).read()
+        assert "resize" in raw["uid-r2"]
+
+        restarted, _ = make_state(tmp_path, lib=lib)
+        view = restarted.gang_view("uid-r2")
+        assert [n for n, _ in view["devices"]] == ["tpu-0", "tpu-1"]
+        assert view["desired"] == 3
+        assert "resize" not in restarted.checkpoint.read()["uid-r2"]
+        # Startup consumers (the usage accountant's rebuild) must see
+        # the ROLLED-FORWARD gang, not the pre-crash one.
+        startup_names = [
+            d["name"]
+            for g in restarted.startup_prepared_records["uid-r2"]["groups"]
+            for d in g["devices"]
+        ]
+        assert startup_names == ["tpu-0", "tpu-1"]
+        assert run_audit(restarted) == []
+        # The released chip is reusable immediately.
+        restarted.prepare(make_claim("uid-n", ["tpu-2"], name="n"))
+        assert_invariants(restarted)
+
+    def test_failed_live_resize_rolls_back_intent(self, tmp_path):
+        """A NON-crash apply failure (the added device is not
+        allocatable) must roll the checkpointed intent BACK: the caller
+        reports GangResizeFailed, so the claim must read exactly as it
+        was — not leave perpetual 'resize' audit drift, and not leak or
+        drop sharing holds."""
+        lib = FakeChipLib(generation="v5p", topology="4x1x1")
+        state, lib = make_state(tmp_path, lib=lib)
+        state.prepare(make_claim("uid-rb", ["tpu-0", "tpu-1"]))
+        held_before = {
+            u: state.share_state.get(u).claims
+            for u in state.share_state.list_chips()
+        }
+        with pytest.raises(GangResizeError, match="tpu-9"):
+            state.resize_claim(
+                "uid-rb",
+                self._resize_results(["tpu-0", "tpu-1", "tpu-9"]),
+            )
+        rec = state.checkpoint.read()["uid-rb"]
+        assert "resize" not in rec
+        assert "elastic" not in rec  # a rollback is not a resize
+        view = state.gang_view("uid-rb")
+        assert [n for n, _ in view["devices"]] == ["tpu-0", "tpu-1"]
+        assert run_audit(state) == []
+        # The original exclusive holds survived the round-trip.
+        held_after = {
+            u: state.share_state.get(u).claims
+            for u in state.share_state.list_chips()
+        }
+        assert held_after == held_before
+        state.unprepare("uid-rb")
+        assert run_audit(state) == []
+
+    def test_rollback_after_partial_apply_restores_every_hold(
+        self, tmp_path
+    ):
+        """The nastiest failure point: the apply has ALREADY released
+        the removed device's hold and acquired the spare's when the CDI
+        write fails. Rollback must re-acquire the removed device (or
+        another claim double-books it) and release the spare (or it
+        leaks to this claim forever) — checkpoint, CDI, and share state
+        all back to the original gang."""
+        lib = FakeChipLib(generation="v5p", topology="4x1x1")
+        state, lib = make_state(tmp_path, lib=lib)
+        state.prepare(make_claim("uid-ph", ["tpu-0", "tpu-1", "tpu-2"]))
+        # Swap tpu-2 for the spare tpu-3; the claim-spec write (which
+        # runs AFTER the hold rewrite) fails once, transiently.
+        plan = faults.FaultPlan().fail(
+            "cdi.claim-write", OSError("disk full"), times=1
+        )
+        with faults.armed(plan):
+            # The ORIGINAL error surfaces (rollback never masks it).
+            with pytest.raises(OSError, match="disk full"):
+                state.resize_claim(
+                    "uid-ph",
+                    self._resize_results(["tpu-0", "tpu-1", "tpu-3"]),
+                )
+        rec = state.checkpoint.read()["uid-ph"]
+        assert "resize" not in rec
+        view = state.gang_view("uid-ph")
+        assert [n for n, _ in view["devices"]] == [
+            "tpu-0", "tpu-1", "tpu-2"
+        ]
+        assert run_audit(state) == []
+        # tpu-2 is held again: a second claim cannot double-book it...
+        uuid2 = chip_uuid_of(state, "tpu-2")
+        assert "uid-ph" in state.share_state.get(uuid2).claims
+        # ...and the spare's hold did not leak: a new claim prepares
+        # tpu-3 cleanly.
+        uuid3 = chip_uuid_of(state, "tpu-3")
+        assert "uid-ph" not in state.share_state.get(uuid3).claims
+        state.prepare(make_claim("uid-sp", ["tpu-3"], name="sp"))
+        assert_invariants(state)
+
+    def test_kept_devices_keep_their_request_names(self, tmp_path):
+        """A resize whose results carry a different request name must
+        not overwrite KEPT devices' checkpointed request names — kubelet
+        matches devices to the ResourceClaim spec by these."""
+        lib = FakeChipLib(generation="v5p", topology="4x1x1")
+        state, lib = make_state(tmp_path, lib=lib)
+        # make_claim names requests req-0/req-1/req-2 per device.
+        state.prepare(make_claim("uid-rq", ["tpu-0", "tpu-1", "tpu-2"]))
+        state.resize_claim(
+            "uid-rq", self._resize_results(["tpu-0", "tpu-1"])
+        )
+        devices = state.cached_devices("uid-rq")
+        assert [d.request_names for d in devices] == [["req-0"], ["req-1"]]
+
+    def test_unrecoverable_intent_is_resize_drift(self, tmp_path):
+        """An intent targeting a device that vanished while the plugin
+        was down cannot roll forward: recovery leaves it in place and
+        the auditor reports it under the ``resize`` check."""
+        lib = FakeChipLib(generation="v5p", topology="4x1x1")
+        state, lib = make_state(tmp_path, lib=lib)
+        state.prepare(make_claim("uid-r3", ["tpu-0", "tpu-1"]))
+        mgr = CheckpointManager(str(tmp_path / "checkpoint.json"))
+        recs = mgr.read()
+        recs["uid-r3"]["resize"] = {
+            "to": ["tpu-0", "tpu-1", "tpu-9"],
+            "requests": {},
+            "startedAt": time.time(),
+        }
+        mgr.write(recs)
+        del state
+
+        restarted, _ = make_state(tmp_path, lib=lib)
+        found = {(f.check, f.subject) for f in run_audit(restarted)}
+        assert ("resize", "uid-r3") in found
+        # The original gang is still intact and unprepares cleanly.
+        restarted.unprepare("uid-r3")
+        assert run_audit(restarted) == []
 
 
 class TestSeededSchedules:

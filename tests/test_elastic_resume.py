@@ -140,3 +140,135 @@ class TestElasticResume:
             restore_checkpoint(str(missing), params)
         # The failed restore must not mkdir the typo'd path.
         assert not os.path.exists(missing)
+
+    def test_restore_onto_incompatible_mesh_raises_typed_error(
+        self, tmp_path, devices
+    ):
+        """A mesh whose preserved degrees cannot hold the saved state
+        must fail with the TYPED error naming both shapes — not a raw
+        JAX divisibility error from inside the restore."""
+        from k8s_dra_driver_tpu.models.checkpoint import (
+            MeshShapeMismatchError,
+        )
+
+        opt = make_optimizer(warmup_steps=1, total_steps=10)
+        mesh_a = build_mesh(MeshConfig(data=2, tensor=2),
+                            devices=devices[:4])
+        state = init_train_state(CFG, mesh_a, opt)
+        save_checkpoint(str(tmp_path / "ckpt"), state, step=0)
+
+        # tensor=8 cannot shard the tiny config's 2 kv heads (nor the
+        # other tensor-sharded axes): the template is un-meshable.
+        bad_mesh = build_mesh(MeshConfig(tensor=8), devices=devices[:8])
+        template = restore_template(state, bad_mesh)
+        with pytest.raises(MeshShapeMismatchError) as exc_info:
+            restore_checkpoint(str(tmp_path / "ckpt"), template)
+        msg = str(exc_info.value)
+        assert "cannot be restored onto mesh" in msg
+        assert "'tensor': 8" in msg  # the mesh shape is named
+        assert "shape (" in msg      # ...and the array shape
+
+
+class TestElasticLiveResize:
+    """The resize coordinator's workload half (parallel/elastic.py):
+    grow and non-power-of-two shrink through the LIVE reshard path, and
+    the cold checkpoint fallback when survivors cannot cover the state."""
+
+    def _trainer(self, devices, mesh_config, **kw):
+        from k8s_dra_driver_tpu.parallel.elastic import ElasticTrainer
+
+        opt = make_optimizer(warmup_steps=1, total_steps=10)
+        return ElasticTrainer(
+            CFG, opt, devices, mesh_config=mesh_config, global_batch=8,
+            **kw,
+        )
+
+    def test_grow_spare_joins_and_state_reshards_live(self, devices):
+        import numpy as np
+
+        trainer = self._trainer(devices[:2], MeshConfig(tensor=2))
+        toks = batches(4)
+        pre = [trainer.step(t) for t in toks[:2]]
+        before = jax.tree.map(np.array, trainer.state)
+
+        event = trainer.resize(devices[:4], reason="spares restored")
+        assert event.direction == "grow"
+        assert event.path == "live", "grow must never touch a checkpoint"
+        assert event.n_used == 4 and event.n_idled == 0
+        assert trainer.mesh_config.tensor == 2  # preserved
+        # The reshard moved the state, not changed it: every leaf is
+        # bit-identical on the larger mesh.
+        after = jax.tree.map(np.array, trainer.state)
+        for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+            np.testing.assert_array_equal(a, b)
+        post = [trainer.step(t) for t in toks[2:]]
+        assert all(np.isfinite(x) for x in pre + post)
+
+    def test_non_pow2_shrink_idles_remainder(self, devices):
+        import numpy as np
+
+        # data=4 x tensor=2: params replicated across data, so any
+        # single-device loss is covered by a surviving replica.
+        trainer = self._trainer(devices, MeshConfig(data=4, tensor=2))
+        toks = batches(4)
+        pre = [trainer.step(t) for t in toks[:2]]
+
+        # 7 survivors: 6 preserves tensor=2 but dp=3 does not divide the
+        # 8-token batch — the largest VALID sub-mesh is 4 devices, with
+        # the other 3 survivors idled (they rejoin on the next grow).
+        event = trainer.resize(devices[:7], reason="chip 7 gone")
+        assert event.direction == "shrink" and event.path == "live"
+        assert event.n_used == 4 and event.n_idled == 3
+        assert trainer.mesh_config.tensor == 2
+        assert len(trainer.idled) == 3
+        post = [trainer.step(t) for t in toks[2:]]
+        assert all(np.isfinite(x) for x in pre + post)
+
+    def test_uncoverable_shrink_falls_back_to_checkpoint(
+        self, tmp_path, devices
+    ):
+        """fsdp=4 shards every parameter across all four devices with no
+        replication: losing one device loses live shards, so the resize
+        must take the COLD path — restore the last checkpoint onto the
+        new mesh — and resume from the checkpointed step."""
+        trainer = self._trainer(
+            devices[:4], MeshConfig(fsdp=4),
+            checkpoint_dir=str(tmp_path / "ckpt"),
+        )
+        toks = batches(3)
+        trainer.step(toks[0])
+        trainer.step(toks[1])
+        trainer.save()
+        trainer.step(toks[2])  # a step past the checkpoint, lost below
+        assert trainer.step_count == 3
+
+        event = trainer.resize(devices[:2], reason="chips 2+3 gone")
+        assert event.path == "cold"
+        # The cold restore rewinds to the saved step; training resumes.
+        assert trainer.step_count == 2
+        assert trainer.mesh_config.num_devices == 2
+        loss = trainer.step(toks[2])
+        assert trainer.step_count == 3
+        import numpy as np
+
+        assert np.isfinite(loss)
+
+    def test_uncoverable_shrink_without_checkpoint_raises(self, devices):
+        from k8s_dra_driver_tpu.parallel.elastic import ElasticResizeError
+
+        trainer = self._trainer(devices[:4], MeshConfig(fsdp=4))
+        trainer.step(batches(1)[0])
+        state_before = trainer.state
+        with pytest.raises(ElasticResizeError, match="no checkpoint"):
+            trainer.resize(devices[:2], reason="chips 2+3 gone")
+        # The failed resize left the trainer fully usable on its old mesh.
+        assert trainer.state is state_before
+        assert trainer.mesh_config.num_devices == 4
+        trainer.step(batches(1)[0])
+
+    def test_no_valid_submesh_raises(self, devices):
+        from k8s_dra_driver_tpu.parallel.elastic import ElasticResizeError
+
+        trainer = self._trainer(devices[:4], MeshConfig(data=2, tensor=2))
+        with pytest.raises(ElasticResizeError, match="no valid sub-mesh"):
+            trainer.resize(devices[:1], reason="only one survivor")

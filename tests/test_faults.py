@@ -77,6 +77,85 @@ class TestSeededPlans:
         assert key(p1) != key(p2)
 
 
+class TestSiteRegistry:
+    """ALL_SITES is the canonical seeded-schedule site list — including
+    the model-side train.* family — and must track the source tree."""
+
+    def test_train_family_registered(self):
+        assert "train.step" in faults.ALL_SITES
+        assert "train.reshard" in faults.ALL_SITES
+        assert faults.sites_in("train.") == ["train.step", "train.reshard"]
+
+    def test_sites_in_filters_by_family(self):
+        assert set(faults.sites_in("checkpoint.")) == {
+            "checkpoint.read", "checkpoint.write"
+        }
+        kube = faults.sites_in("kube.")
+        assert kube and all(s.startswith("kube.") for s in kube)
+        assert set(faults.sites_in("kube.", "cdi.")) == set(
+            kube + ["cdi.base-write", "cdi.claim-write"]
+        )
+
+    def test_registry_matches_instrumented_sources(self):
+        """Every literal faults.fire("<site>") in the package is
+        registered, and no registry entry is stale — a new family (like
+        train.*) cannot silently miss the soak's site list."""
+        import pathlib
+        import re
+
+        root = pathlib.Path(faults.__file__).resolve().parents[1]
+        fired = set()
+        for p in root.rglob("*.py"):
+            fired.update(re.findall(
+                r'faults\.fire\(\s*"([^"]+)"\s*\)', p.read_text()
+            ))
+        assert fired == set(faults.ALL_SITES)
+
+    def test_train_sites_fire_like_driver_sites(self):
+        plan = faults.FaultPlan.seeded(
+            5, faults.sites_in("train."), rounds=16, fail_rate=1.0
+        )
+        assert plan.rules
+        assert {r.site for r in plan.rules} <= {
+            "train.step", "train.reshard"
+        }
+        with faults.armed(plan):
+            fired = 0
+            for _ in range(8):
+                try:
+                    faults.fire("train.step")
+                    faults.fire("train.reshard")
+                except faults.FaultError:
+                    fired += 1
+            assert fired > 0
+
+    def test_train_step_site_reaches_trainer(self, tmp_path):
+        """The elastic trainer's step is injectable end to end: a
+        schedule failing train.step surfaces from ElasticTrainer.step."""
+        jax = pytest.importorskip("jax")
+        from k8s_dra_driver_tpu.models.llama import PRESETS
+        from k8s_dra_driver_tpu.models.train import make_optimizer
+        from k8s_dra_driver_tpu.parallel.elastic import ElasticTrainer
+        from k8s_dra_driver_tpu.parallel.mesh import MeshConfig
+
+        cfg = PRESETS["tiny"]
+        trainer = ElasticTrainer(
+            cfg, make_optimizer(warmup_steps=1, total_steps=10),
+            jax.devices()[:1], mesh_config=MeshConfig(), global_batch=8,
+        )
+        toks = jax.random.randint(
+            jax.random.PRNGKey(0), (8, 65), 0, cfg.vocab_size
+        )
+        plan = faults.FaultPlan().fail(
+            "train.step", faults.FaultError("chaos"), on_calls={2}
+        )
+        with faults.armed(plan):
+            trainer.step(toks)
+            with pytest.raises(faults.FaultError):
+                trainer.step(toks)
+            trainer.step(toks)  # rule exhausted; training continues
+
+
 class TestEnvArming:
     def test_unset_env_is_noop(self, monkeypatch):
         monkeypatch.delenv("TPU_DRA_FAULTS", raising=False)

@@ -62,6 +62,86 @@ class TestMeshConfig:
             build_mesh(MeshConfig(data=16))
 
 
+class TestAutoMeshProperties:
+    """Factorization property tests: for every (n, tensor, long_context)
+    either auto_mesh_config rejects with a clear error, or the result
+    holds the three invariants — product equals the device count, the
+    tensor degree is preserved verbatim, and any sequence degree divides
+    what is left after tensor."""
+
+    @pytest.mark.parametrize("n", [1, 2, 3, 4, 6, 8, 12, 16, 32, 64, 96])
+    @pytest.mark.parametrize("tensor", [1, 2, 3, 4, 8])
+    @pytest.mark.parametrize("long_context", [False, True])
+    def test_factorization_invariants(self, n, tensor, long_context):
+        if tensor > n or n % tensor:
+            with pytest.raises(ValueError):
+                auto_mesh_config(
+                    n, model_needs_tensor=tensor,
+                    long_context=long_context,
+                )
+            return
+        cfg = auto_mesh_config(
+            n, model_needs_tensor=tensor, long_context=long_context
+        )
+        assert cfg.num_devices == n            # product invariant
+        assert cfg.tensor == tensor            # tensor preserved
+        rest = n // tensor
+        assert rest % cfg.sequence == 0        # sequence divides the rest
+        if not long_context:
+            assert cfg.sequence == 1
+
+    def test_tensor_exceeding_devices_names_the_gap(self):
+        with pytest.raises(ValueError, match="only 2 device"):
+            auto_mesh_config(2, model_needs_tensor=4)
+
+    def test_nonpositive_inputs_rejected(self):
+        with pytest.raises(ValueError, match="at least one device"):
+            auto_mesh_config(0)
+        with pytest.raises(ValueError, match="tensor degree"):
+            auto_mesh_config(4, model_needs_tensor=0)
+
+
+class TestMeshResize:
+    """MeshConfig.resize: the elastic refactorization — model degrees
+    (tensor/sequence/expert/pipe) preserved, data/fsdp collapsed."""
+
+    def test_collapses_data_fsdp_preserves_tensor(self):
+        cfg = MeshConfig(data=2, fsdp=2, tensor=2)
+        r = cfg.resize(6)
+        assert (r.data, r.fsdp, r.tensor) == (1, 3, 2)
+        assert r.num_devices == 6
+
+    def test_preserves_pipe_expert_sequence(self):
+        cfg = MeshConfig(data=2, pipe=2, sequence=2, tensor=2)
+        r = cfg.resize(8)
+        assert (r.pipe, r.sequence, r.tensor) == (2, 2, 2)
+        assert (r.data, r.fsdp) == (1, 1)
+
+    @pytest.mark.parametrize("n", [2, 4, 6, 8, 16])
+    def test_product_invariant(self, n):
+        r = MeshConfig(data=2, fsdp=2, tensor=2).resize(n)
+        assert r.num_devices == n and r.tensor == 2
+
+    def test_data_parallel_configs_keep_replication(self):
+        """A pure data-parallel source collapses into DATA, not fsdp:
+        losing the replication would strand the next shrink on the cold
+        checkpoint path (its params would shard without replicas)."""
+        cfg = MeshConfig(data=2, tensor=2)
+        grown = cfg.resize(2).resize(4)
+        assert (grown.data, grown.fsdp, grown.tensor) == (2, 1, 2)
+
+    def test_rejects_counts_that_cannot_hold_model_degrees(self):
+        with pytest.raises(ValueError, match="preserved degrees"):
+            MeshConfig(data=2, tensor=2).resize(3)
+        with pytest.raises(ValueError, match="cannot resize"):
+            MeshConfig().resize(0)
+
+    def test_resized_config_builds_a_mesh(self, devices):
+        cfg = MeshConfig(data=2, tensor=2).resize(6)
+        mesh = build_mesh(cfg, devices=devices[:6])
+        assert mesh.shape["tensor"] == 2 and mesh.shape["data"] == 3
+
+
 class TestShardingRules:
     def test_spec_for(self):
         assert spec_for("batch", "seq", "embed") == P(("data", "fsdp"), "sequence")

@@ -31,8 +31,9 @@ Plus one first-party rule with no ruff analog:
   large fleet (``make verify-metrics`` additionally bounds the rendered
   series count of such families).
 - TPM05: ``plugin/accounting.py`` may only declare ``tpu_dra_usage_*``
-  metrics and ``plugin/audit.py`` only ``tpu_dra_audit_*`` — each
-  family's home module stays coherent, so the docs catalog and the
+  metrics, ``plugin/audit.py`` only ``tpu_dra_audit_*``, and
+  ``parallel/elastic.py`` only ``tpu_dra_elastic_*`` — each family's
+  home module stays coherent, so the docs catalog and the
   verify-metrics coverage can reason per-module.
 - TPM06: ``stage=``/``reason=`` label values on the ``tpu_dra_alloc_*``
   explainability families are confined to the ``STAGES``/``REASONS``
@@ -207,6 +208,7 @@ _PER_CHIP_LABEL_MODULES = {"accounting.py", "audit.py"}
 _MODULE_FAMILY_PREFIXES = {
     "accounting.py": "tpu_dra_usage_",
     "audit.py": "tpu_dra_audit_",
+    "elastic.py": "tpu_dra_elastic_",
 }
 _METRIC_METHODS = {"inc", "set", "observe"}
 

@@ -1,0 +1,204 @@
+#!/usr/bin/env python3
+"""Elastic-training smoke: chip-unplug → gang resize → live reshard →
+resume, on the CPU backend with a fixed seed (``make elastic``).
+
+Drives the full plugin↔workload loop hermetically:
+
+1. a 4-chip FakeChipLib node publishes slices through a real Driver;
+2. a gang claim is allocated by the ReferenceAllocator and prepared
+   over the DRA RPC surface;
+3. an ElasticTrainer runs a tiny llama on the claimed chips;
+4. the seeded chaos plan unplugs a chip at the top of train step 4;
+5. the driver's elastic coordinator shrinks the claim (checkpointed
+   resize protocol), the trainer live-reshards and keeps stepping;
+6. the chip is restored, the gang grows back, the trainer reshards up;
+7. PASS requires: both resizes took the LIVE path (no checkpoint
+   restore), the loss trajectory matches an uninterrupted run on the
+   surviving topology within tolerance, the StateAuditor reports zero
+   drift after each resize, and the GangResized Events landed.
+
+Exit 0 on PASS, 1 on any violated gate. TPU_DRA_CHAOS_SEED overrides
+the seed (default 1234) — the same seed replays the same schedule.
+"""
+
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+SEED = int(os.environ.get("TPU_DRA_CHAOS_SEED", "1234"))
+
+
+def wait_for(pred, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def fail(msg):
+    print(f"FAIL: {msg}")
+    sys.exit(1)
+
+
+def main():
+    import random
+
+    import jax
+    import numpy as np
+
+    from k8s_dra_driver_tpu.kube import (
+        EVENTS,
+        NODES,
+        RESOURCE_CLAIMS,
+        RESOURCE_SLICES,
+        FakeKubeClient,
+    )
+    from k8s_dra_driver_tpu.kube.allocator import ReferenceAllocator
+    from k8s_dra_driver_tpu.kube.protos import dra_v1alpha4_pb2 as drapb
+    from k8s_dra_driver_tpu.models.llama import PRESETS
+    from k8s_dra_driver_tpu.models.train import (
+        make_optimizer,
+        state_shardings,
+    )
+    from k8s_dra_driver_tpu.parallel import MeshConfig
+    from k8s_dra_driver_tpu.parallel.elastic import ElasticTrainer
+    from k8s_dra_driver_tpu.plugin.driver import Driver, DriverConfig
+    from k8s_dra_driver_tpu.tpulib import FakeChipLib
+    from k8s_dra_driver_tpu.utils import faults
+    from k8s_dra_driver_tpu.utils.metrics import Registry
+
+    tmp = tempfile.mkdtemp(prefix="elastic-smoke-")
+    client = FakeKubeClient()
+    client.create(NODES, {"metadata": {"name": "node-a", "uid": "nu-1"}})
+    lib = FakeChipLib(generation="v5p", topology="4x1x1")
+    driver = Driver(DriverConfig(
+        node_name="node-a", chiplib=lib, kube_client=client,
+        cdi_root=f"{tmp}/cdi", plugin_root=f"{tmp}/plugin",
+        registrar_root=f"{tmp}/registry", state_root=f"{tmp}/state",
+        node_uid="nu-1", cleanup_interval_seconds=0,
+        device_watch_interval_seconds=0.05,
+    ))
+    allocator = ReferenceAllocator(client, registry=Registry())
+    driver.enable_elastic(allocator)
+    resizes = []
+    driver.add_resize_listener(resizes.append)
+    driver.start()
+    try:
+        if not wait_for(lambda: len(client.list(RESOURCE_SLICES)) >= 1):
+            fail("slices never published")
+        claim = {
+            "apiVersion": "resource.k8s.io/v1beta1",
+            "kind": "ResourceClaim",
+            "metadata": {"name": "train", "namespace": "default",
+                         "uid": "uid-gang"},
+            "spec": {"devices": {"requests": [{
+                "name": "gang", "deviceClassName": "tpu.google.com",
+                "allocationMode": "ExactCount", "count": 4}]}},
+        }
+        allocator.allocate(claim, node_name="node-a")
+        client.create(RESOURCE_CLAIMS, claim, namespace="default")
+        resp = driver.NodePrepareResources(
+            drapb.NodePrepareResourcesRequest(claims=[drapb.Claim(
+                uid="uid-gang", name="train", namespace="default")]),
+            None,
+        )
+        if resp.claims["uid-gang"].error:
+            fail(f"prepare: {resp.claims['uid-gang'].error}")
+
+        cfg = PRESETS["tiny"]
+        jax_devices = jax.devices()
+
+        def jax_devs(names):
+            return [jax_devices[int(n.split("-")[1])] for n in names]
+
+        opt = make_optimizer(warmup_steps=1, total_steps=10)
+        trainer = ElasticTrainer(
+            cfg, opt, jax_devs(["tpu-0", "tpu-1", "tpu-2", "tpu-3"]),
+            mesh_config=MeshConfig(data=2, tensor=2), global_batch=8,
+        )
+        reference = ElasticTrainer(
+            cfg, opt, jax_devices[:2], mesh_config=MeshConfig(tensor=2),
+            global_batch=8,
+        )
+        host_init = jax.tree.map(np.array, trainer.state)
+        reference.state = jax.device_put(
+            host_init, state_shardings(reference.state, reference.mesh)
+        )
+        toks = [
+            jax.random.randint(jax.random.PRNGKey(100 + i), (8, 65), 0,
+                               cfg.vocab_size)
+            for i in range(7)
+        ]
+        ref_losses = [reference.step(t) for t in toks]
+
+        victim = random.Random(SEED).randrange(4)
+        plan = faults.FaultPlan()
+        plan.call("train.step",
+                  lambda: lib.unplug_chip(victim, reason="smoke unplug"),
+                  on_calls={4})
+        losses = []
+        with faults.armed(plan):
+            for t in toks[:4]:
+                losses.append(trainer.step(t))
+        if not wait_for(lambda: len(resizes) >= 1):
+            fail("no shrink resize message")
+        msg = resizes[0]
+        print(f"shrink: {msg.devices} (removed {msg.removed}) — "
+              f"{msg.reason}")
+        event = trainer.resize(jax_devs(msg.devices), reason=msg.reason)
+        if event.path != "live":
+            fail(f"shrink took the {event.path} path, not live")
+        for t in toks[4:]:
+            losses.append(trainer.step(t))
+        try:
+            np.testing.assert_allclose(losses, ref_losses, rtol=2e-4,
+                                       atol=2e-4)
+        except AssertionError as e:
+            fail(f"loss continuity: {e}")
+        if not wait_for(lambda: driver.auditor.run_once() == []):
+            fail(f"auditor drift after shrink: {driver.auditor.findings}")
+
+        lib.restore_chip(victim)
+        if not wait_for(lambda: len(resizes) >= 2):
+            fail("no grow resize message")
+        grow = resizes[1]
+        print(f"grow: {grow.devices} (added {grow.added}) — {grow.reason}")
+        event = trainer.resize(jax_devs(grow.devices), reason=grow.reason)
+        if event.path != "live" or event.n_used != 4:
+            fail(f"grow: path={event.path} used={event.n_used}")
+        post = [trainer.step(t) for t in toks[:2]]
+        if not all(np.isfinite(x) for x in post):
+            fail(f"non-finite loss after grow: {post}")
+        if not wait_for(lambda: driver.auditor.run_once() == []):
+            fail(f"auditor drift after grow: {driver.auditor.findings}")
+        driver.events.flush()
+        reasons = [e["reason"] for e in client.list(EVENTS)]
+        if "GangResized" not in reasons:
+            fail(f"no GangResized Event (saw {sorted(set(reasons))})")
+        shrinks = driver._m_elastic_resizes.value(direction="shrink",
+                                                  outcome="ok")
+        grows = driver._m_elastic_resizes.value(direction="grow",
+                                                outcome="ok")
+        if (shrinks, grows) != (1.0, 1.0):
+            fail(f"resize metrics: shrink={shrinks} grow={grows}")
+        print(f"PASS: seed={SEED} victim=tpu-{victim} "
+              f"losses[{len(losses)}] match uninterrupted run; "
+              f"trace={[(r['direction'], len(r['devices'])) for r in driver.resize_trace()]}")
+    finally:
+        driver.shutdown()
+
+
+if __name__ == "__main__":
+    main()
