@@ -2,7 +2,7 @@
 IMAGE ?= tpu-dra-driver
 TAG ?= latest
 
-.PHONY: all native test image lint verify verify-metrics chaos chaos-slow doctor decodebench elastic clean e2e-kind
+.PHONY: all native test image lint verify verify-metrics chaos chaos-slow doctor decodebench moebench elastic clean e2e-kind
 
 all: native
 
@@ -44,6 +44,16 @@ doctor:
 decodebench:
 	python tools/run_decode_smoke.py
 
+# MoE fast-path smoke: fixed-seed CPU gates for the sparse family
+# (tools/run_moe_smoke.py) — compile-once per dispatch impl
+# (MOE_TRACE_COUNTS oracle), einsum/binned/dropless equivalence at
+# drop-free capacity, fused-kernel-vs-primitive parity
+# (ops/moe_dispatch.py in interpret mode), the `auto` impl-selection
+# policy against its recorded ranking, and a repeat-spread tripwire
+# mirroring _decodebench.spread_flags for the mixtral metrics.
+moebench:
+	python tools/run_moe_smoke.py
+
 # Elastic-training smoke: fixed-seed chip-unplug → gang shrink →
 # live reshard → resume (then the symmetric grow) through the real
 # Driver + allocator + ElasticTrainer on the CPU backend
@@ -56,9 +66,10 @@ elastic:
 		python tools/run_elastic_smoke.py
 
 # The full local gate: lint + unit/integration tests + chaos schedules +
-# metrics exposition + the doctor/auditor drill + the decode-engine and
-# elastic-training smokes. What CI runs; what a PR must pass.
-verify: lint test chaos verify-metrics doctor decodebench elastic
+# metrics exposition + the doctor/auditor drill + the decode-engine,
+# MoE fast-path, and elastic-training smokes. What CI runs; what a PR
+# must pass.
+verify: lint test chaos verify-metrics doctor decodebench moebench elastic
 
 # ruff when available (CI installs it; .golangci.yaml analog is
 # [tool.ruff] in pyproject.toml), else the first-party AST lint floor.

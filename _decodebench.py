@@ -153,8 +153,25 @@ def run_decode_bench(
             "step_ms": round(step * 1e3, 3),
             "hbm_roofline_ms": round(roofline_s * 1e3, 3),
             "compile_s": round(gen_compile_s + pre_compile_s, 1),
+            **(_moe_decode_detail(config, batch) if is_moe else {}),
         },
     }
+
+
+def _moe_decode_detail(config, batch) -> dict:
+    """Which MoE MLP impl and dispatch pipeline the decode step actually
+    runs (auto resolves per geometry — a decode batch routes through the
+    fused grouped matmul, not the one-hot einsum)."""
+    from k8s_dra_driver_tpu.models.moe import resolve_moe_impl
+    from k8s_dra_driver_tpu.ops.moe_dispatch import dispatch_impl_label
+
+    impl = resolve_moe_impl(config, batch)
+    out = {"moe_impl": impl}
+    if impl == "dropless":
+        out["moe_dispatch"] = dispatch_impl_label(
+            config.hidden, config.mlp_hidden
+        )
+    return out
 
 
 def spread_flags(metrics, rel: float = 0.02) -> list:
@@ -265,6 +282,10 @@ def run_serving_bench(
             "compile_counts": dict(engine.compile_counts),
             "num_blocks": num_blocks,
             "block_size": block_size,
+            # The engine's OWN per-program resolution (decode_step +
+            # prefill_chunk at their actual traced shapes, mesh-aware) —
+            # one source of truth, not a bench-side re-derivation.
+            **({"moe_impl": engine.moe_impl} if is_moe else {}),
         },
     }
 

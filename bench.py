@@ -63,6 +63,7 @@ def run_bench(preset, batch, seq, peak_flops, remat_policy="flash_qkv",
             effective_router_group,
             init_params,
             loss_fn,
+            resolve_moe_impl,
         )
     else:
         from k8s_dra_driver_tpu.models.llama import (
@@ -200,13 +201,10 @@ def run_bench(preset, batch, seq, peak_flops, remat_policy="flash_qkv",
         ),
         "detail": {
             **(
-                {
-                    "moe_group": effective_router_group(config, seq),
-                    "moe_impl": (
-                        "einsum" if config.moe_impl == "auto"
-                        else config.moe_impl
-                    ),
-                }
+                _moe_detail(
+                    config, batch, seq, effective_router_group,
+                    resolve_moe_impl,
+                )
                 if model == "moe" else {}
             ),
             "tokens_per_s": round(n_tokens / dt, 1),
@@ -217,6 +215,34 @@ def run_bench(preset, batch, seq, peak_flops, remat_policy="flash_qkv",
             "mfu_all": [round(v, 4) for v in mfus],
         },
     }
+
+
+def _moe_detail(config, batch, seq, effective_router_group,
+                resolve_moe_impl) -> dict:
+    """MoE bench detail: the impl `auto` actually resolved to for THIS
+    geometry, which dispatch pipeline ran (fused kernels vs the gather +
+    grouped-primitive path), and which grouped-matmul kernel the
+    primitive path would use — so round-over-round comparisons know what
+    was measured, not just what was configured."""
+    from k8s_dra_driver_tpu.ops.moe_dispatch import (
+        dispatch_impl_label,
+        grouped_matmul_label,
+    )
+
+    impl = resolve_moe_impl(config, batch * seq)
+    detail = {
+        "moe_group": effective_router_group(config, seq),
+        "moe_impl": impl,
+    }
+    if impl == "dropless":
+        detail["moe_dispatch"] = dispatch_impl_label(
+            config.hidden, config.mlp_hidden
+        )
+        detail["moe_grouped_kernel"] = grouped_matmul_label(
+            batch * seq * config.top_k, config.hidden,
+            2 * config.mlp_hidden,
+        )
+    return detail
 
 
 def extra_metrics(peak_flops, remat_policy) -> list:

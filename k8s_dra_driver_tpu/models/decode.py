@@ -75,7 +75,16 @@ def _mlp_or_moe(x, layer, config, mesh=None):
     MoeConfig (aux loss dropped — inference), dense otherwise. At decode
     (T=1) a single token can only occupy slot 0 of each chosen expert, so
     routing never overflows regardless of capacity_factor. ``mesh`` lets
-    ep-sharded serving constrain the dispatch to the expert axis."""
+    ep-sharded serving constrain the dispatch to the expert axis.
+
+    Impl selection rides moe.resolve_moe_impl: mesh-free decode and
+    prefill-chunk batches are small enough that `auto` picks the
+    dropless grouped path — on TPU the fused dispatch kernels
+    (ops/moe_dispatch.py), so a decode step runs two grouped matmuls
+    instead of the one-hot dispatch/combine einsums over E*C mostly-
+    empty slots. Expert-sharded serving meshes keep the einsum
+    formulation (its sharding constraints are what carry the expert
+    all-to-alls under GSPMD)."""
     if isinstance(config, MoeConfig):
         x, _aux = _moe_block(x, layer, config, mesh=mesh)
         return x

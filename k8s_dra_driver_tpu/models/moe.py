@@ -1,12 +1,24 @@
 """Mixtral-style sparse Mixture-of-Experts on the Llama trunk.
 
-TPU-first design: routing is CAPACITY-BASED with fully static shapes (no
-data-dependent shapes anywhere, so the whole model jits and shards like
-the dense trunk), and dispatch/combine are one-hot einsums that lower to
-MXU matmuls — the GShard/Switch formulation rather than gather/scatter.
-Expert weights carry a leading E axis sharded over the mesh "expert" axis
-(parallel/mesh.py); under jit the dispatched activations get a matching
-sharding constraint, so XLA inserts the dispatch/combine all-to-alls.
+TPU-first design with fully static shapes everywhere (no data-dependent
+shapes, so the whole model jits and shards like the dense trunk) and
+three MLP dispatch formulations sharing one router (_topk_masks):
+
+- **einsum** — GShard/Switch capacity routing; dispatch/combine are
+  one-hot einsums that lower to MXU matmuls. The formulation that
+  carries expert-sharded GSPMD meshes (the dispatched activations get an
+  "expert" sharding constraint so XLA inserts the all-to-alls) and the
+  pipeline-compatible one.
+- **binned** — einsum's exact drop semantics via sorted scatter/gather +
+  dense per-expert matmuls.
+- **dropless** — token-sort + grouped matmuls at exactly the
+  active-expert FLOPs; since the MoE fast path (docs/moe_fast_path.md)
+  this is also the FAST path: the fused dispatch kernels
+  (ops/moe_dispatch.py) fold the row gather into the grouped gate/up
+  matmul and the gate-weighted combine into the down-projection
+  epilogue, and expert parallelism runs as a ring-overlapped all-to-all
+  (_moe_block_dropless_ep_ring) with the replicate+psum formulation as
+  fallback and oracle. `auto` picks per geometry — resolve_moe_impl.
 
 Attention, norms, rope, remat policies, and the chunked cross-entropy are
 the dense trunk's own (models/llama.py) — an MoE model differs only in
@@ -16,8 +28,10 @@ the per-layer expert weights.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import math
+import os
 from typing import Optional
 
 import jax
@@ -25,6 +39,7 @@ import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..ops import moe_dispatch
 from ..ops.norms import rmsnorm
 from ..ops.rotary import rope_frequencies
 from .llama import (
@@ -57,22 +72,37 @@ class MoeConfig(LlamaConfig):
     # tighter per-group capacity is the capacity_factor knob's job).
     router_group: int = 128
     # MLP dispatch implementation:
-    # - "einsum": the GShard one-hot formulation. On TPU the one-hot
-    #   dispatch/combine lower to MXU matmuls and OUTRUN sorted-gather
-    #   dispatch (profiled ~0.1 ms/layer vs row gathers at ~30x below
-    #   memcpy bandwidth on v5e); also the only path that carries
-    #   expert-sharded meshes (the dispatched activations get an
-    #   "expert" sharding constraint so XLA inserts the all-to-alls).
+    # - "einsum": the GShard one-hot formulation. One-hot dispatch/
+    #   combine lower to MXU matmuls; the only formulation that carries
+    #   expert-sharded meshes under pure GSPMD (the dispatched
+    #   activations get an "expert" sharding constraint so XLA inserts
+    #   the all-to-alls) and the pipeline-compatible one.
     # - "binned": sort-by-expert realized as a scatter into per-
     #   (group, expert) capacity slots + dense per-expert matmuls —
     #   IDENTICAL routing/drop semantics to "einsum" (bit-equal up to
     #   matmul order), no one-hot temporaries; wins where gathers are
     #   cheap relative to matmul (not v5e).
-    # - "dropless": token-sort + grouped matmul (megablocks-style;
-    #   megablox kernel on TPU); no capacity, nothing drops, exactly
-    #   the active-expert FLOPs — the quality option.
-    # - "auto": einsum (fastest measured on-chip, and mesh-capable).
+    # - "dropless": token-sort + grouped matmul (megablocks-style); no
+    #   capacity, nothing drops, exactly the active-expert FLOPs. Since
+    #   the fused dispatch kernels (ops/moe_dispatch.py) this is also
+    #   the FAST path for small-expert geometries and decode batches:
+    #   the gather rides inside the grouped matmul and the gate-weighted
+    #   combine rides the down-projection epilogue, so the sorted row
+    #   buffers that made sorted dispatch lose on v5e never exist.
+    # - "auto": geometry-based choice — see `resolve_moe_impl`.
     moe_impl: str = "auto"
+    # Expert-parallel dropless dispatch mode (the shard_map path over
+    # the mesh "expert" axis):
+    # - "ring": tokens chunk over the expert ring; chunks rotate via
+    #   ring_permute (remote DMA on ICI) while each shard runs its
+    #   local experts on the chunk that already arrived — the
+    #   compute-overlapped all-to-all, with a worst-case row buffer of
+    #   [T*k/n_ep, H] instead of the psum path's [T*k, H].
+    # - "psum": replicate tokens, each shard selects its local pairs,
+    #   one psum combines — the fallback and the parity oracle.
+    # - "auto": ring when the token count divides the expert axis
+    #   (decode batches that don't divide fall back to psum).
+    ep_overlap: str = "auto"
 
     def num_params(self) -> int:
         h, m, v, l = self.hidden, self.mlp_hidden, self.vocab_size, self.n_layers
@@ -129,6 +159,74 @@ MOE_PRESETS: dict[str, MoeConfig] = {
         n_experts=8, top_k=2,
     ),
 }
+
+
+#: Trace counter per (impl, dispatch, token-count) key: the compile-once
+#: oracle for the MoE paths (tools/run_moe_smoke.py) — a shape leak in
+#: routing/dispatch shows up as a key tracing more than once for the
+#: same static geometry, mirroring decode.TRACE_COUNTS.
+MOE_TRACE_COUNTS: "collections.Counter[str]" = collections.Counter()
+
+# `auto` selection thresholds (see resolve_moe_impl). Measured on v5e at
+# the bench geometries (BENCH_r05/r06): the einsum path's one-hot
+# dispatch/combine plus its [.., E, C] temporaries cost a roughly fixed
+# slice of step time, so it only wins where the expert matmuls are big
+# enough to bury it — 8x7b-geometry experts (4096x14336 = 58.7M weight
+# cells/expert/proj, 1.48x baseline on einsum). Small experts
+# (8x160m: 768x2048 = 1.6M cells) sat at 0.39 MFU on einsum; the fused
+# dropless pipeline is the fix. Decode/serving batches (tens to a few
+# hundred routed tokens) always prefer the grouped path: a one-hot
+# dispatch over E*C slots for a handful of tokens is nearly all waste.
+_AUTO_DECODE_TOKENS = 512
+_AUTO_SMALL_EXPERT_CELLS = 16 << 20
+
+
+def resolve_moe_impl(
+    config: MoeConfig,
+    n_tokens: int,
+    *,
+    expert_mesh: bool = False,
+    in_pipeline: bool = False,
+) -> str:
+    """The concrete MLP dispatch impl `moe_impl="auto"` runs for this
+    invocation — public so benchmarks log the choice they measured and
+    tests pin the policy against the recorded impl rankings.
+
+    Selection table (explicit impls pass through untouched):
+
+    ==========================  =========  ==============================
+    geometry                    choice     why
+    ==========================  =========  ==============================
+    pipelined forward           einsum     dropless unsupported in the
+                                           partially-manual pipeline;
+                                           binned carries no shardings
+    expert-sharded GSPMD mesh   einsum     the formulation whose
+                                           sharding constraints make XLA
+                                           insert the all-to-alls (the
+                                           ring-dispatch dropless path
+                                           is the explicit EP opt-in)
+    <= 512 routed tokens        dropless   decode/serving: one-hot
+                                           dispatch over E*C slots for a
+                                           handful of tokens is waste —
+                                           the fused grouped matmul wins
+    small experts (h*m <= 16M)  dropless   dispatch overhead dominated
+                                           the einsum path (8x160m at
+                                           0.39 MFU); fused kernels
+                                           eliminate it
+    large experts               einsum     expert matmuls bury dispatch
+                                           (8x7b-L1 at 1.48x baseline)
+    ==========================  =========  ==============================
+    """
+    c = config
+    if c.moe_impl != "auto":
+        return c.moe_impl
+    if in_pipeline or expert_mesh:
+        return "einsum"
+    if n_tokens <= _AUTO_DECODE_TOKENS:
+        return "dropless"
+    if c.mlp_hidden * c.hidden <= _AUTO_SMALL_EXPERT_CELLS:
+        return "dropless"
+    return "einsum"
 
 
 def init_params(config: MoeConfig, key: jax.Array) -> dict:
@@ -375,231 +473,262 @@ def _moe_block_binned(x, layer, config: MoeConfig):
     return x + out.reshape(b, s, h).astype(x.dtype), aux
 
 
-def _moe_block_dropless(x, layer, config: MoeConfig,
-                        under_mesh: bool = False):
-    """Dropless sparse MLP (megablocks-style): top-k route, sort the
-    token-expert pairs by expert, run the experts as two grouped ragged
-    matmuls, then inverse-permute and sum the k contributions per token.
-
-    tpu-first: `lax.ragged_dot` keeps every expert matmul on the MXU at
-    exactly the active-parameter FLOPs — no capacity padding (the einsum
-    path wastes capacity_factor-1 of its expert compute on empty slots)
-    and no O(T*E*C*H) one-hot dispatch/combine matmuls. The data motion
-    is two gathers + one inverse-permutation of [T*k, H] rows and an
-    O(T*k log T*k) integer sort — bandwidth, not FLOPs. Shapes stay
-    fully static (sort/gather/ragged_dot are all fixed-size); only the
-    group_sizes VALUES are data-dependent, which ragged_dot is built
-    for. No tokens drop, so `capacity_factor`/`router_group` do not
-    apply on this path.
-    """
-    c = config
-    b, s, h = x.shape
-    e, k, m = c.n_experts, c.top_k, c.mlp_hidden
-    xn = rmsnorm(x, layer["ln_mlp"], c.norm_eps)
-    t = b * s
-    xf = xn.reshape(t, h)
-
-    logits = jnp.einsum("th,he->te", xf.astype(jnp.float32), layer["wr"])
+def _route_topk(xf: jax.Array, wr: jax.Array, config: MoeConfig):
+    """Router + top-k for the sorted paths: returns (gates [T, k] f32
+    renormalized, experts [T, k] int32, probs [T, E] f32, aux scalar).
+    Shared by the dropless single-device, psum-EP, and ring-EP bodies so
+    expert choice and tie-breaking are identical everywhere
+    (_topk_masks is the single source of routing truth)."""
+    logits = jnp.einsum("th,he->te", xf.astype(jnp.float32), wr)
     probs = jax.nn.softmax(logits, axis=-1)
-    masks, gate_l, aux = _topk_masks(probs, c)        # [T, E] / [T] each
+    masks, gate_l, aux = _topk_masks(probs, config)
     denom = sum(gate_l) + 1e-9
     gates = jnp.stack(gate_l, axis=1) / denom[:, None]          # [T, k]
     experts = jnp.stack(
         [jnp.argmax(mk, axis=-1) for mk in masks], axis=1
-    )                                                 # [T, k]
+    ).astype(jnp.int32)                                         # [T, k]
+    return gates, experts, probs, aux
 
-    flat_e = experts.reshape(t * k).astype(jnp.int32)
-    # Sort + inverse permutation (int ops outside the differentiable
-    # path; named so remat policies save them instead of re-sorting):
-    # inv[p] = sorted position of flat pair p (token-major).
-    order = checkpoint_name(jnp.argsort(flat_e), "moe_routing")
-    token_of = order // k                             # source token per row
-    group_sizes = jnp.bincount(flat_e, length=e).astype(jnp.int32)
-    inv = checkpoint_name(
-        jnp.zeros((t * k,), jnp.int32).at[order].set(
-            jnp.arange(t * k, dtype=jnp.int32)
-        ),
-        "moe_routing",
+
+def _pairs_mlp(
+    xf: jax.Array,             # [T, H] tokens (unsorted)
+    gates: jax.Array,          # [T, k] f32
+    experts: jax.Array,        # [T, k] int32
+    w_gu,                      # [E_loc, H, 2, M] array or QuantTensor
+    w_down,                    # [E_loc, M, H] array or QuantTensor
+    config: MoeConfig,
+    *,
+    lo: int | jax.Array = 0,
+    e_loc: Optional[int] = None,
+    pallas_ok: bool = True,
+) -> jax.Array:
+    """Expert MLP over the (token, choice) pairs whose expert lies in
+    [lo, lo + e_loc): per-token contributions [T, H] f32 (pairs outside
+    the range contribute exact zeros). The single body behind every
+    dropless path — single-device is lo=0/e_loc=E; the expert-parallel
+    shards pass their local window.
+
+    Two implementations, parity-pinned in tests/test_moe_dispatch.py:
+
+    - **fused** (ops/moe_dispatch.py, TPU or forced): the row gather
+      rides inside the grouped gate/up kernel (scalar-prefetch row ids)
+      and the gate-weighted combine rides the down-projection epilogue —
+      the sorted [T*k, H] buffers never reach HBM in either direction.
+    - **primitive** (the oracle): custom-VJP row gathers around
+      megablox/ragged_dot grouped matmuls — the original formulation,
+      and the only one legal under GSPMD meshes (``pallas_ok=False``).
+    """
+    c = config
+    t, k = gates.shape
+    h = xf.shape[1]
+    e_loc = c.n_experts if e_loc is None else e_loc
+    m = w_down.shape[1]
+    r = t * k
+
+    flat_e = experts.reshape(r)
+    local_pair = (flat_e >= lo) & (flat_e < lo + e_loc)
+    # Local experts renumber to 0..e_loc-1; every foreign pair gets the
+    # sentinel e_loc (build_plan drops it; the stable sort packs local
+    # rows first, grouped).
+    key = jnp.where(local_pair, flat_e - lo, e_loc).astype(jnp.int32)
+    gates_flat = gates.reshape(r)
+
+    if moe_dispatch.use_fused(under_mesh=not pallas_ok, h=h, m=m):
+        plan = moe_dispatch.build_plan(key, t, e_loc, k)
+        y_pairs = moe_dispatch.fused_moe_mlp(
+            xf, w_gu, w_down, gates_flat, plan
+        )
+        return jnp.sum(y_pairs.reshape(t, k, h), axis=1)
+
+    # Primitive path. Sort + inverse permutation are int ops outside the
+    # differentiable path; named so remat policies save them instead of
+    # re-sorting. inv is valid only for local pairs (foreign pairs map
+    # OOB so every later gather zero-fills them).
+    order = checkpoint_name(
+        jnp.argsort(key, stable=True).astype(jnp.int32), "moe_routing"
     )
+    group_sizes = jnp.bincount(
+        key, length=e_loc + 1
+    ).astype(jnp.int32)[:e_loc]
+    inv_all = jnp.zeros((r,), jnp.int32).at[order].set(
+        jnp.arange(r, dtype=jnp.int32)
+    )
+    inv = checkpoint_name(
+        jnp.where(local_pair, inv_all, r), "moe_routing"
+    )
+    row_local = jnp.take(local_pair, order)                     # [r]
+    token_of = jnp.where(row_local, order // k, t)
     # Gather-VJP both ways (_gather_rows): dxf[token] sums its k sorted
     # rows, found via inv — never a TPU scatter-add.
-    xs = _gather_rows(xf, token_of, inv.reshape(t, k).T)  # [T*k, H]
+    xs = _gather_rows(xf, token_of, inv.reshape(t, k).T)        # [r, H]
 
-    # Grouped matmuls over the sorted rows (megablox on TPU, ragged_dot
-    # elsewhere — see _grouped_dot_fn): exactly the active-expert FLOPs.
-    # Under a (non-expert) mesh the body runs inside GSPMD, where the
-    # Pallas kernel has no partitioning rule — use the primitive.
-    grouped_dot = _grouped_dot_fn(group_sizes, use_pallas=not under_mesh)
-
-    # (2, m) flattens u-major: [:, :m] is the gate half, [:, m:] the up.
-    w_gu = q_dequant(layer["w_gateup"], xs.dtype).reshape(e, h, 2 * m)
-    gu = grouped_dot(xs, w_gu)                        # [T*k, 2m]
+    grouped_dot = _grouped_dot_fn(group_sizes, use_pallas=pallas_ok)
+    gu = grouped_dot(xs, moe_dispatch._gu_2d(w_gu))             # [r, 2m]
     gate = jax.nn.silu(gu[:, :m].astype(jnp.float32))
     up = gu[:, m:].astype(jnp.float32)
-    ys = grouped_dot(
-        (gate * up).astype(x.dtype),
-        q_dequant(layer["w_down"], x.dtype),
-    )                                                 # [T*k, H]
+    ys = grouped_dot((gate * up).astype(xf.dtype), w_down)      # [r, H]
+    # Rows past sum(group_sizes) (foreign pairs) are UNINITIALIZED
+    # memory out of the megablox kernel (ragged_dot zero-fills, the
+    # kernel does not). The forward never reads them — but the VJP of
+    # the gate product below would multiply real upstream cotangents by
+    # that garbage and corrupt the router gradient. Mask them to zero
+    # HERE, so both directions see zeros.
+    ys = jnp.where(row_local[:, None], ys, 0)
 
-    yw = ys.astype(jnp.float32) * jnp.take(gates.reshape(t * k), order)[:, None]
+    yw = ys.astype(jnp.float32) * jnp.take(gates_flat, order)[:, None]
     # Unsort by gathering at inv; the VJP gathers back through order.
-    out = jnp.sum(
+    return jnp.sum(
         _gather_rows(yw, inv, order[None]).reshape(t, k, h), axis=1
+    )
+
+
+def _moe_block_dropless(x, layer, config: MoeConfig,
+                        under_mesh: bool = False):
+    """Dropless sparse MLP (megablocks-style): top-k route, then the
+    shared pair pipeline (_pairs_mlp) over all experts — fused dispatch
+    kernels on TPU, custom-VJP gathers + grouped primitives elsewhere.
+
+    No capacity, nothing drops, exactly the active-expert FLOPs; shapes
+    stay fully static (sort/gather/grouped matmul are all fixed-size;
+    only the group-size VALUES are data-dependent).
+    `capacity_factor`/`router_group` do not apply on this path.
+    """
+    c = config
+    b, s, h = x.shape
+    xn = rmsnorm(x, layer["ln_mlp"], c.norm_eps)
+    xf = xn.reshape(b * s, h)
+    gates, experts, _probs, aux = _route_topk(xf, layer["wr"], c)
+    out = _pairs_mlp(
+        xf, gates, experts, layer["w_gateup"], layer["w_down"], c,
+        pallas_ok=not under_mesh,
     )
     return x + out.reshape(b, s, h).astype(x.dtype), aux
 
 
 def _grouped_dot_fn(group_sizes, use_pallas: bool = True):
-    """Grouped-matmul kernel choice shared by the dropless paths: the
-    megablox Pallas kernel on TPU (tuned tiling, custom VJP = two more
-    grouped matmuls), lax.ragged_dot elsewhere. Both tolerate
+    """Grouped-matmul kernel choice shared by the dropless paths —
+    delegates to ops/moe_dispatch.grouped_matmul (megablox with a
+    divisor-aware tile search on TPU, lax.ragged_dot elsewhere, int8
+    QuantTensor rhs kept int8 into the dot). Both kernels tolerate
     ``sum(group_sizes) < rows``: tiles past the last group are skipped
-    (megablox sizes its grid from group metadata; ragged_dot zero-fills
-    — the kernel leaves those rows UNINITIALIZED, callers must mask),
-    which is what lets the expert-parallel path carry a worst-case row
-    buffer at actual-rows FLOPs.
+    (megablox leaves those rows UNINITIALIZED — callers must mask) or
+    zero-filled (ragged_dot), which is what lets the expert-parallel
+    paths carry a worst-case row buffer at actual-rows FLOPs.
 
-    ``use_pallas=False`` forces the ragged_dot primitive even on TPU:
-    required wherever the computation runs under GSPMD over a mesh the
-    kernel is not shard-aware of (a pallas_call has no partitioning
-    rule; a lax primitive degrades to replication at worst)."""
-    if use_pallas and jax.default_backend() == "tpu":
-        from jax.experimental.pallas.ops.tpu.megablox import gmm
+    ``use_pallas=False`` forces the primitive even on TPU: required
+    wherever the computation runs under GSPMD over a mesh the kernel is
+    not shard-aware of (a pallas_call has no partitioning rule; a lax
+    primitive degrades to replication at worst)."""
 
-        def grouped_dot(lhs, rhs):
-            # Tile sizes clamp to the problem; 512 is the v5e sweet spot
-            # for the production shapes. gmm masks remainder tiles on
-            # k/n but requires m % tm == 0 exactly, so the m tile must
-            # be a DIVISOR of the row count. Mosaic additionally needs
-            # every block's last dim ≡ 0 (mod 128) (or == the array
-            # dim) and second-minor ≡ 0 (mod 8) — and the kernel's VJP
-            # reuses the tiling on TRANSPOSED shapes, so both k and n
-            # must be 128-friendly. Narrow geometries (tiny test
-            # presets) fall back to the ragged_dot primitive.
-            m = lhs.shape[0]
-            kk, nn = lhs.shape[1], rhs.shape[2]
-            tm = min(512, m)
-            while m % tm:
-                tm -= 1
-            if kk % 128 or nn % 128 or tm % 8:
-                return jax.lax.ragged_dot(lhs, rhs, group_sizes)
-            return gmm(
-                lhs, rhs, group_sizes,
-                preferred_element_type=lhs.dtype,
-                tiling=(tm, min(512, kk), min(512, nn)),
-            )
-    else:
-        def grouped_dot(lhs, rhs):
-            return jax.lax.ragged_dot(lhs, rhs, group_sizes)
+    def grouped_dot(lhs, rhs):
+        return moe_dispatch.grouped_matmul(
+            lhs, rhs, group_sizes, use_pallas=use_pallas
+        )
+
     return grouped_dot
 
 
-def _moe_block_dropless_ep(x, layer, config: MoeConfig, mesh: Mesh):
-    """Expert-parallel dropless MLP: shard_map over the mesh "expert"
-    axis, manual ONLY over it (partial-manual, the pipeline idiom) so
-    tensor/fsdp/data sharding of everything else stays with GSPMD.
+def _to_transport(w):
+    """QuantTensor -> (q, scale) tuple for shard_map transport (a spec
+    prefix broadcasts over the tuple); float weights pass through. NOT
+    moe_dispatch._quant_parts, which splits any rhs into (array, scale)
+    halves for the kernels — this pair exists purely to carry the
+    QuantTensor across a shard_map boundary and back."""
+    from .quant import QuantTensor
 
-    Layout: expert weights arrive sharded over "expert" (param_specs);
-    activations are replicated ACROSS the expert axis (batch shards over
-    data/fsdp, which remain auto). Each shard therefore computes the
-    (replicated) routing itself — no dispatch all-to-all — then sorts
-    ONLY the pairs destined for its local experts to the front, runs the
-    grouped matmul over a worst-case [T*k, H] row buffer at
-    actual-rows FLOPs (sum(group_sizes) = local rows; uncovered tail
-    tiles are skipped, see _grouped_dot_fn), and inverse-permutes its
-    contributions. One psum over "expert" combines the shards — each
-    token-expert pair is processed on exactly one shard, so the sum
-    equals the single-device dropless result up to reduction order
-    (pinned by test_moe.py).
+    if isinstance(w, QuantTensor):
+        return (w.q, w.scale)
+    return w
 
-    The worst-case buffer trades memory for the no-drop guarantee: a
-    static shape must cover "every token routes to one shard". The
-    balanced case touches ~T*k/n_ep real rows; the remainder is
-    bandwidth (zero-fill gather), not FLOPs. Reference for the role:
-    the NCCL all-to-all EP dispatch the reference's stack delegates to
-    torch/Megatron (SURVEY.md §2c); re-designed here as
-    replicate+select+psum because on ICI the [T,H] psum is one
-    reduction, and the sort stays device-local.
-    """
-    c = config
+
+def _from_transport(w):
+    from .quant import QuantTensor
+
+    if isinstance(w, tuple):
+        return QuantTensor(q=w[0], scale=w[1])
+    return w
+
+
+def _ep_geometry(config: MoeConfig, mesh: Mesh):
     n_ep = mesh.shape["expert"]
-    e, k = c.n_experts, c.top_k
+    e = config.n_experts
     if e % n_ep:
         raise ValueError(
             f"n_experts={e} does not divide over expert axis size {n_ep}"
         )
-    e_loc = e // n_ep
-    b, s, h = x.shape
-    m = c.mlp_hidden
-    t = b * s
-    # The megablox kernel is legal inside the shard_map body only when
-    # every NON-manual axis is trivial: with tensor/fsdp/data auto axes
-    # active, the body still runs under GSPMD, which cannot partition a
-    # pallas_call — fall back to the ragged_dot primitive there.
-    ep_only_mesh = all(
+    # The Pallas kernels (fused dispatch, megablox, ring remote-DMA) are
+    # legal inside the shard_map body only when every NON-manual axis is
+    # trivial: with tensor/fsdp/data auto axes active, the body still
+    # runs under GSPMD, which cannot partition a pallas_call — those
+    # meshes use the lax primitives (ragged_dot, ppermute).
+    ep_only = all(
         size == 1 for name, size in mesh.shape.items() if name != "expert"
     )
+    return n_ep, e // n_ep, ep_only
 
-    # Dequant up front (identity for float weights): the shard_map body
-    # then sees plain arrays regardless of the serving quant format.
-    w_gu_full = q_dequant(layer["w_gateup"], x.dtype).reshape(e, h, 2 * m)
-    w_down_full = q_dequant(layer["w_down"], x.dtype)
 
-    def local(xb, ln, wr, w_gu, w_down):
-        shard = jax.lax.axis_index("expert")
-        lo = shard * e_loc
+def _moe_block_dropless_ep(x, layer, config: MoeConfig, mesh: Mesh):
+    """Expert-parallel dropless MLP over the mesh "expert" axis: the
+    ring-overlapped dispatch when geometry allows, the replicate+psum
+    formulation as fallback and parity oracle (config.ep_overlap)."""
+    c = config
+    n_ep, _, _ = _ep_geometry(c, mesh)
+    t = x.shape[0] * x.shape[1]
+    ring_ok = n_ep > 1 and t % n_ep == 0
+    if c.ep_overlap == "ring" and not ring_ok:
+        raise ValueError(
+            f"ep_overlap='ring' needs the token count ({t}) to divide "
+            f"the expert axis ({n_ep}); use 'auto' or 'psum'"
+        )
+    if c.ep_overlap not in ("auto", "ring", "psum"):
+        raise ValueError(
+            f"unknown ep_overlap {c.ep_overlap!r}; valid: auto, ring, "
+            "psum"
+        )
+    if c.ep_overlap != "psum" and ring_ok:
+        return _moe_block_dropless_ep_ring(x, layer, c, mesh)
+    return _moe_block_dropless_ep_psum(x, layer, c, mesh)
+
+
+def _moe_block_dropless_ep_psum(x, layer, config: MoeConfig, mesh: Mesh):
+    """Replicate-and-reduce expert parallelism: shard_map over the mesh
+    "expert" axis, manual ONLY over it (partial-manual, the pipeline
+    idiom) so tensor/fsdp/data sharding of everything else stays with
+    GSPMD.
+
+    Layout: expert weights arrive sharded over "expert" (param_specs);
+    activations are replicated ACROSS the expert axis (batch shards over
+    data/fsdp, which remain auto). Each shard computes the (replicated)
+    routing itself — no dispatch all-to-all — selects the pairs destined
+    for its local experts, runs the shared pair pipeline over a
+    worst-case [T*k, H] row buffer at actual-rows FLOPs, and one psum
+    over "expert" combines the shards. Each token-expert pair is
+    processed on exactly one shard, so the sum equals the single-device
+    dropless result up to reduction order (pinned by test_moe.py).
+
+    The worst-case buffer trades memory for the no-drop guarantee: a
+    static shape must cover "every token routes to one shard". The ring
+    path (_moe_block_dropless_ep_ring) shrinks that buffer by n_ep and
+    overlaps the data motion with expert compute; this path remains the
+    oracle, and the fallback for token counts that don't chunk evenly.
+    Quantized expert stacks stay int8 through the shard_map (q + scale
+    travel as a tuple) and into the grouped dots — no per-step bf16
+    weight copy.
+    """
+    c = config
+    n_ep, e_loc, ep_only = _ep_geometry(c, mesh)
+    b, s, h = x.shape
+    t = b * s
+
+    def local(xb, ln, wr, w_gu_p, w_down_p):
+        w_gu, w_down = _from_transport(w_gu_p), _from_transport(w_down_p)
+        lo = jax.lax.axis_index("expert") * e_loc
         xn = rmsnorm(xb, ln, c.norm_eps)
         xf = xn.reshape(t, h)
-        logits = jnp.einsum("th,he->te", xf.astype(jnp.float32), wr)
-        probs = jax.nn.softmax(logits, axis=-1)
-        masks, gate_l, aux = _topk_masks(probs, c)
-        denom = sum(gate_l) + 1e-9
-        gates = jnp.stack(gate_l, axis=1) / denom[:, None]      # [T, k]
-        experts = jnp.stack(
-            [jnp.argmax(mk, axis=-1) for mk in masks], axis=1
-        ).astype(jnp.int32)                                     # [T, k]
-
-        flat_e = experts.reshape(t * k)
-        local_pair = (flat_e >= lo) & (flat_e < lo + e_loc)
-        # Sort key: local experts 0..e_loc-1, every foreign pair the
-        # sentinel e_loc — stable sort packs local rows first, grouped.
-        key = jnp.where(local_pair, flat_e - lo, e_loc)
-        order = checkpoint_name(
-            jnp.argsort(key, stable=True).astype(jnp.int32), "moe_routing"
-        )
-        group_sizes = jnp.bincount(
-            key, length=e_loc + 1
-        ).astype(jnp.int32)[:e_loc]
-        # Sorted-position inverse, valid only for local pairs (foreign
-        # pairs map OOB so every later gather zero-fills them).
-        inv_all = jnp.zeros((t * k,), jnp.int32).at[order].set(
-            jnp.arange(t * k, dtype=jnp.int32)
-        )
-        inv = checkpoint_name(
-            jnp.where(local_pair, inv_all, t * k), "moe_routing"
-        )
-        row_local = jnp.take(local_pair, order)                 # [T*k]
-        token_of = jnp.where(row_local, order // k, t)
-        xs = _gather_rows(xf, token_of, inv.reshape(t, k).T)    # [T*k, H]
-
-        grouped_dot = _grouped_dot_fn(group_sizes, use_pallas=ep_only_mesh)
-        gu = grouped_dot(xs, w_gu)                              # [T*k, 2m]
-        gate = jax.nn.silu(gu[:, :m].astype(jnp.float32))
-        up = gu[:, m:].astype(jnp.float32)
-        ys = grouped_dot((gate * up).astype(xb.dtype), w_down)  # [T*k, H]
-        # Rows past sum(group_sizes) (foreign pairs) are UNINITIALIZED
-        # memory out of the megablox kernel (ragged_dot zero-fills, the
-        # kernel does not). The forward never reads them — but the VJP
-        # of the gate product below would multiply real upstream
-        # cotangents by that garbage and corrupt the router gradient.
-        # Mask them to zero HERE, so both directions see zeros.
-        ys = jnp.where(row_local[:, None], ys, 0)
-
-        yw = ys.astype(jnp.float32) * jnp.take(
-            gates.reshape(t * k), order
-        )[:, None]
-        contrib = jnp.sum(
-            _gather_rows(yw, inv, order[None]).reshape(t, k, h), axis=1
+        gates, experts, _probs, aux = _route_topk(xf, wr, c)
+        contrib = _pairs_mlp(
+            xf, gates, experts, w_gu, w_down, c,
+            lo=lo, e_loc=e_loc, pallas_ok=ep_only,
         )
         out = jax.lax.psum(contrib, "expert")
         # aux is computed from replicated probs: identical on every
@@ -617,35 +746,152 @@ def _moe_block_dropless_ep(x, layer, config: MoeConfig, mesh: Mesh):
         check_vma=False,
     )
     out, aux = fn(
-        x, layer["ln_mlp"], layer["wr"], w_gu_full, w_down_full
+        x, layer["ln_mlp"], layer["wr"],
+        _to_transport(layer["w_gateup"]), _to_transport(layer["w_down"]),
+    )
+    return x + out.astype(x.dtype), aux
+
+
+def _moe_block_dropless_ep_ring(x, layer, config: MoeConfig, mesh: Mesh):
+    """Ring-overlapped expert-parallel dispatch: a REAL all-to-all in
+    n_ep hops with the transfers hidden under expert compute.
+
+    Tokens chunk over the expert ring (chunk i starts on shard i); each
+    hop, a shard (1) issues the ring transfer of its current chunk to
+    the right neighbour, (2) routes the chunk and runs its LOCAL experts
+    on it through the shared pair pipeline — overlapping with the
+    in-flight transfer — and (3) adds its contribution to a carrier that
+    rotates WITH the chunk (the ring-attention dk/dv idiom), so after
+    n_ep hops chunk i's fully-combined output arrives back home on shard
+    i. One all-gather reassembles the token order.
+
+    Versus the psum path: the worst-case row buffer shrinks from
+    [T*k, H] to [T*k/n_ep, H] per hop, per-hop ICI traffic is one chunk
+    instead of a full [T, H] reduction, and every transfer is issued
+    before the compute it hides under (remote-DMA ring_permute when the
+    expert axis is the only nontrivial one, async collective-permute
+    otherwise). Each token-expert pair is still processed on exactly one
+    shard at exactly one hop — the routing partition property pinned by
+    tests.
+
+    The Switch aux statistics are linear token means, so per-chunk stats
+    pmean'd over the ring equal the full-batch statistic exactly (up to
+    f32 reduction order) — parity with the psum path's replicated aux.
+
+    Decode-safe: callers reach this path only when T divides n_ep
+    (_moe_block_dropless_ep falls back to psum otherwise).
+    """
+    c = config
+    n_ep, e_loc, ep_only = _ep_geometry(c, mesh)
+    b, s, h = x.shape
+    e = c.n_experts
+    t = b * s
+    t_loc = t // n_ep
+    # Transfers default to lax.ppermute: XLA's async collective-permute
+    # is what lets the issued-early transfer actually hide under the
+    # grouped compute (the pallas remote-DMA ring completes each call
+    # synchronously — see parallel/ring.py — so it would serialize the
+    # hops). The explicit-DMA ring is an opt-in for measurement, legal
+    # only on an expert-only REAL-TPU mesh (the interpret backend cannot
+    # discharge a remote DMA under a multi-axis mesh; the kernel gets
+    # interpret coverage on a single-axis mesh in
+    # tests/test_moe_dispatch.py).
+    ring_impl = "xla"
+    if (
+        os.environ.get("TPU_DRA_MOE_RING_IMPL") == "pallas"
+        and ep_only
+        and jax.default_backend() == "tpu"
+    ):
+        ring_impl = "pallas"
+
+    from ..parallel.compat import shard_map_compat
+    from ..parallel.ring import ring_permute
+
+    def local(xb, ln, wr, w_gu_p, w_down_p):
+        w_gu, w_down = _from_transport(w_gu_p), _from_transport(w_down_p)
+        i = jax.lax.axis_index("expert")
+        lo = i * e_loc
+        xn = rmsnorm(xb, ln, c.norm_eps).reshape(t, h)
+        x_cur = jax.lax.dynamic_slice_in_dim(xn, i * t_loc, t_loc, axis=0)
+        y = jnp.zeros((t_loc, h), jnp.float32)
+        frac = meanprob = None
+        for hop in range(n_ep):
+            # Chunk (i - hop) mod n_ep is resident; recomputing its
+            # routing locally is cheaper than shipping routing metadata
+            # around the ring (the router is [t_loc, H] x [H, E]), and
+            # bitwise identical on every shard that sees the chunk.
+            gates, experts, probs, _aux = _route_topk(x_cur, wr, c)
+            if hop == 0:
+                # Own chunk: this shard's share of the GLOBAL aux
+                # statistics (linear means — pmean below is exact).
+                frac = jnp.mean(
+                    jax.nn.one_hot(experts[:, 0], e, dtype=probs.dtype),
+                    axis=0,
+                )
+                meanprob = jnp.mean(probs, axis=0)
+            if hop < n_ep - 1:
+                # Issue the next chunk's transfer BEFORE computing on
+                # the current one: the DMA/collective-permute rides
+                # under the grouped matmuls below (double buffering —
+                # x_nxt lands while x_cur is being consumed).
+                x_nxt = ring_permute(
+                    x_cur, "expert", n_ep, impl=ring_impl
+                )
+            contrib = _pairs_mlp(
+                x_cur, gates, experts, w_gu, w_down, c,
+                lo=lo, e_loc=e_loc, pallas_ok=ep_only,
+            )
+            # The carrier rotates with its chunk; its transfer overlaps
+            # the NEXT hop's routing + dispatch up to the accumulate.
+            y = ring_permute(
+                y + contrib, "expert", n_ep, impl=ring_impl
+            )
+            if hop < n_ep - 1:
+                x_cur = x_nxt
+        out = jax.lax.all_gather(y, "expert", axis=0, tiled=True)
+        frac = jax.lax.pmean(frac, "expert")
+        meanprob = jax.lax.pmean(meanprob, "expert")
+        aux = e * jnp.sum(frac * meanprob)
+        return out.reshape(b, s, h), aux
+
+    fn = shard_map_compat(
+        local,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P("expert"), P("expert")),
+        out_specs=(P(), P()),
+        axis_names=frozenset({"expert"}),
+        check_vma=False,
+    )
+    out, aux = fn(
+        x, layer["ln_mlp"], layer["wr"],
+        _to_transport(layer["w_gateup"]), _to_transport(layer["w_down"]),
     )
     return x + out.astype(x.dtype), aux
 
 
 def _moe_block(x, layer, config: MoeConfig, mesh: Optional[Mesh],
                shard_batch: bool = True):
-    """Sparse MLP: route → dispatch einsum → per-expert fused gate/up +
-    down → combine einsum → residual. Returns (x, aux).
+    """Sparse MLP: route → dispatch → experts → combine → residual.
+    Returns (x, aux).
 
-    Dispatches per `config.moe_impl`; this einsum body is the GShard
-    capacity-based formulation that carries expert-sharded meshes.
+    Dispatches per `config.moe_impl` (with "auto" resolved by geometry —
+    resolve_moe_impl); this einsum body is the GShard capacity-based
+    formulation that carries expert-sharded meshes.
     ``shard_batch=False`` drops the data/fsdp axes from the dispatch
     constraint — required inside a partially-manual pipeline shard_map,
     where those axes are manual and may not appear in GSPMD constraints.
     """
     c = config
-    impl = c.moe_impl
-    if impl == "auto":
-        # einsum everywhere: on TPU the one-hot dispatch/combine run as
-        # MXU matmuls (~0.1 ms/layer profiled at 8x160m b8) and beat the
-        # sorted paths, whose row gathers lower ~30x below memcpy
-        # bandwidth on v5e (37.8% vs 36.5%/29.9% active MFU); it is also
-        # the fastest expert-sharded path. "binned" (same drop
-        # semantics, gather dispatch) and "dropless" (no drops, grouped
-        # matmul) remain explicit opt-ins.
-        impl = "einsum"
     # An expert axis of size 1 shards nothing — treat it as absent.
     expert_mesh = mesh is not None and mesh.shape.get("expert", 1) > 1
+    impl = resolve_moe_impl(
+        c, x.shape[0] * x.shape[1],
+        expert_mesh=expert_mesh, in_pipeline=not shard_batch,
+    )
+    MOE_TRACE_COUNTS[
+        f"{impl}:{moe_dispatch.dispatch_impl_label(c.hidden, c.mlp_hidden)}"
+        f":t{x.shape[0] * x.shape[1]}"
+    ] += 1
     if impl in ("binned", "grouped") and expert_mesh:
         # binned emits no sharding constraints: silently dropping the
         # expert axis would mean no expert all-to-alls and wrong

@@ -151,6 +151,28 @@ class DecodeEngine:
         self.temperature = temperature
         self.mesh = mesh
         self._clock = clock
+        # What the MoE MLP will actually run per program (decode steps
+        # and prefill chunks resolve independently — both are small
+        # enough for the grouped fast path mesh-free): surfaced so bench
+        # detail and operators see the measured configuration.
+        self.moe_impl = {}
+        if hasattr(config, "moe_impl"):
+            from .moe import resolve_moe_impl
+
+            expert_mesh = (
+                mesh is not None and mesh.shape.get("expert", 1) > 1
+            )
+            self.moe_impl = {
+                # Mirrors the traced shapes exactly: _decode_fn runs
+                # [batch_slots, 1] and _prefill_fn runs ONE request's
+                # [1, prefill_chunk] window.
+                "decode_step": resolve_moe_impl(
+                    config, batch_slots, expert_mesh=expert_mesh
+                ),
+                "prefill_chunk": resolve_moe_impl(
+                    config, prefill_chunk, expert_mesh=expert_mesh
+                ),
+            }
         span = max_seq_len or min(config.max_seq_len,
                                   num_blocks * block_size)
         self.max_blocks_per_seq = -(-span // block_size)

@@ -1,5 +1,7 @@
-"""TPU ops: flash attention (Pallas), fused norms, rotary embeddings."""
+"""TPU ops: flash attention (Pallas), fused MoE dispatch, fused norms,
+rotary embeddings."""
 
+from . import moe_dispatch
 from .attention import (
     attention_reference,
     flash_attention,
@@ -10,6 +12,7 @@ from .norms import rmsnorm, rmsnorm_reference
 from .rotary import apply_rope, rope_frequencies
 
 __all__ = [
+    "moe_dispatch",
     "flash_attention",
     "attention_reference",
     "paged_attention_reference",

@@ -1,0 +1,943 @@
+"""Fused ragged MoE dispatch: Pallas TPU kernels for the
+route→dispatch→expert→combine pipeline, plus the portable XLA oracle.
+
+The sorted-dispatch MoE paths (models/moe.py) historically paid for data
+motion three times around every pair of grouped matmuls: a row gather
+materializing the expert-sorted [T*k, H] buffer, a second gather-weighted
+pass applying the router gates, and an inverse-permute gather putting
+contributions back in token order — all separate XLA ops streaming the
+full activation set through HBM. This module fuses that pipeline into two
+kernels:
+
+- **gather → gate/up → SwiGLU** (`_gateup_kernel`): the expert-sorted row
+  layout never exists in HBM. Row indices ride in as a scalar-prefetch
+  operand (the discipline ops/attention.py uses for paged block tables);
+  at each m-tile the kernel DMAs exactly the rows it needs from the
+  unsorted token buffer into VMEM, runs both halves of the gate/up
+  projection against the tile's expert weights (scalar-prefetched expert
+  id driving the RHS index map), and applies SwiGLU in the epilogue.
+  Output: the sorted activation buffer [R_pad, M] — the one intermediate
+  the pipeline genuinely needs (it is the down-projection's input).
+- **down-projection → gate-weight → combine-scatter**
+  (`_down_combine_kernel`): accumulates the down projection over
+  k-tiles, multiplies the per-row router gates in the epilogue, and
+  DMA-scatters each finished row directly to its token-major pair slot —
+  the inverse permutation is fused into the write, so the gate-weighted
+  sorted buffer never materializes either. Summing the top-k pair slots
+  per token is left to XLA (one fused reshape-sum).
+
+Layout: the dispatch plan (``build_plan``) assigns every (token, expert)
+pair a slot in an expert-major buffer whose per-expert regions start at
+tile boundaries, so each m-tile belongs to exactly ONE expert and the
+kernels never straddle a group edge (the megablocks trick, realized with
+static shapes: R_pad = R rounded up + E·tile worst-case padding). Gaps
+are sentinel rows: the gather skips them (zero rows in, zero activations
+out) and the scatter drops them.
+
+Numerics oracle: ``reference_moe_mlp`` computes the identical function
+with plain gathers + ``lax.ragged_dot`` (group sizes aligned to the same
+layout) and is the parity pin in tests/test_moe_dispatch.py. The custom
+VJP recomputes the gate/up projection flash-style from the saved sorted
+activations and routes every gradient through gathers and grouped
+matmuls — never a TPU scatter-add (the models/moe.py discipline).
+
+Quantized experts: int8 weights go INTO the grouped dots (both the
+kernels and the ragged_dot fallback take an int8 RHS with an f32
+accumulator) and the per-channel scales multiply in the epilogue —
+the PR-5 ``q_matmul`` recipe, so int8 MoE serving stops materializing a
+bf16 copy of the expert stacks every step. Forward-only (serving).
+
+``grouped_matmul`` is the shared grouped-kernel chooser (megablox gmm
+with a divisor-aware tile search, ragged_dot everywhere else) used by
+models/moe.py's primitive paths and by this module's reference/backward.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+logger = logging.getLogger(__name__)
+
+# Dispatch implementation override: "auto" (fused kernels on TPU, the
+# ragged_dot primitive path elsewhere), "fused" (force the kernels;
+# interpret mode off-TPU — the test configuration), "primitive" (force
+# the gather + ragged_dot path everywhere).
+_DISPATCH_IMPL = os.environ.get("TPU_DRA_MOE_DISPATCH", "auto")
+
+# Kernel tile knobs, sweepable per generation like the attention blocks.
+_TILE_ROWS = int(os.environ.get("TPU_DRA_MOE_TILE_ROWS", "128"))
+_TILE_COLS = int(os.environ.get("TPU_DRA_MOE_TILE_COLS", "512"))
+
+# One log line per distinct grouped-matmul shape/outcome, so bench detail
+# (and operators reading logs) can see which kernel actually ran without
+# a per-step log storm.
+_LOGGED_SHAPES: set = set()
+
+
+def set_dispatch_impl(impl: str) -> None:
+    """Select the MoE dispatch backend: "auto" | "fused" | "primitive"."""
+    global _DISPATCH_IMPL
+    assert impl in ("auto", "fused", "primitive"), impl
+    _DISPATCH_IMPL = impl
+
+
+def dispatch_impl_label(h: int | None = None, m: int | None = None) -> str:
+    """What the dropless MLP will actually run on this backend (outside
+    any GSPMD mesh) — public so benchmarks record what they measured.
+    Pass ``h``/``m`` to fold in the Mosaic geometry fallback: a label
+    must never say "fused" for a run the alignment gate sent down the
+    primitive path."""
+    if _DISPATCH_IMPL == "primitive":
+        return "primitive"
+    if _DISPATCH_IMPL != "fused" and jax.default_backend() != "tpu":
+        return "primitive"
+    if (
+        not _interpret()
+        and h is not None and m is not None
+        and not fused_geometry_ok(h, m)
+    ):
+        return "primitive"
+    return "fused"
+
+
+def fused_geometry_ok(h: int, m: int) -> bool:
+    """Whether the fused kernels' blocks satisfy Mosaic's tiling rules
+    for a [.., H] x [E, H, 2, M] problem: both feature dims must be
+    128-lane aligned (the same discipline as ``grouped_matmul``'s k/n
+    check — narrow geometries like the tiny test presets fall back to
+    the primitive path in auto mode; interpret-mode tests force the
+    kernels explicitly)."""
+    return h % 128 == 0 and m % 128 == 0
+
+
+def use_fused(under_mesh: bool = False, h: int | None = None,
+              m: int | None = None) -> bool:
+    """Whether the fused Pallas pipeline is legal and selected.
+
+    ``under_mesh``: the computation runs under GSPMD over a mesh the
+    kernel is not shard-aware of — a pallas_call has no partitioning
+    rule, so the primitive path is required (same constraint as the
+    megablox kernel in ``grouped_matmul``). Pass ``h``/``m`` to also
+    gate on Mosaic tile alignment (auto mode must never hand the
+    compiler a block it will reject — the primitive path is the
+    fallback, exactly like the old tm/128 checks)."""
+    if under_mesh:
+        return False
+    if dispatch_impl_label() == "fused" and dispatch_impl_label(
+        h, m
+    ) != "fused":
+        # Selected, but the alignment gate (which only binds where
+        # Mosaic actually compiles — interpret mode takes any shape)
+        # sent this geometry down the primitive path: say so once.
+        _log_choice("primitive", -1, h or -1, m or -1,
+                    "fused dispatch needs 128-aligned H and M")
+        return False
+    return dispatch_impl_label(h, m) == "fused"
+
+
+def _interpret() -> bool:
+    """Kernels run in interpret mode anywhere but real TPU (the repo-wide
+    kernel-testing convention: same code path the TPU compiles)."""
+    return jax.default_backend() != "tpu"
+
+
+def _round_up(x: int, mult: int) -> int:
+    return -(-x // mult) * mult
+
+
+def _fit_cols(n: int, want: int) -> int:
+    """A column tile ≤ want dividing n (gcd — the _fit_block recipe)."""
+    import math
+
+    return math.gcd(n, want)
+
+
+def default_tile_rows(n_pairs: int, n_experts: int) -> int:
+    """Row-tile heuristic: big tiles amortize the per-tile row gather,
+    but R_pad grows by E·tile of padding — at decode shapes (tens of
+    pairs) a 128-row tile would make the buffer 98% padding, so clamp
+    toward the per-expert row count."""
+    per_expert = max(1, n_pairs // max(n_experts, 1))
+    return max(8, min(_TILE_ROWS, _round_up(per_expert, 8)))
+
+
+# ---------------------------------------------------------------------------
+# Dispatch plan: the static-shape sorted layout with tile-aligned groups.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class DispatchPlan:
+    """Index maps for one routed MoE layer invocation.
+
+    Slots live in an expert-major buffer of ``r_pad`` rows; expert e's
+    rows occupy [aligned_start_e, aligned_start_e + count_e) with every
+    aligned_start a multiple of ``tile_rows``. Sentinels: ``row_ids`` =
+    n_tokens (gather zero-fills), ``pair_ids`` = n_pairs (scatter
+    drops), ``slot_of_pair`` = r_pad (gather zero-fills) — a pair maps
+    to the sentinel only when its expert was foreign (the
+    expert-parallel local view passes experts >= n_experts for pairs
+    owned by other shards).
+    """
+
+    row_ids: jax.Array        # [r_pad] source token row per slot
+    pair_ids: jax.Array       # [r_pad] token-major pair id per slot
+    slot_of_pair: jax.Array   # [n_pairs] slot per pair (inverse map)
+    tile_expert: jax.Array    # [r_pad // tile_rows] expert per m-tile
+    sizes_aligned: jax.Array  # [n_experts] tile-aligned group sizes
+    tile_rows: int
+    n_tokens: int
+    n_pairs: int
+    n_experts: int
+    top_k: int
+
+    @property
+    def r_pad(self) -> int:
+        return self.row_ids.shape[0]
+
+
+jax.tree_util.register_dataclass(
+    DispatchPlan,
+    data_fields=[
+        "row_ids", "pair_ids", "slot_of_pair", "tile_expert",
+        "sizes_aligned",
+    ],
+    meta_fields=["tile_rows", "n_tokens", "n_pairs", "n_experts", "top_k"],
+)
+
+
+def build_plan(
+    experts_flat: jax.Array,   # [n_pairs] int32; >= n_experts = foreign
+    n_tokens: int,
+    n_experts: int,
+    top_k: int,
+    tile_rows: int | None = None,
+) -> DispatchPlan:
+    """Compute the dispatch layout from per-pair expert assignments.
+
+    Pure integer XLA (one stable argsort + scatters), all static shapes;
+    only the VALUES are data-dependent. Foreign pairs (expert id >=
+    ``n_experts``) get no slot — the expert-parallel shards each build a
+    plan over their local expert range.
+    """
+    r = experts_flat.shape[0]
+    e = n_experts
+    tile = tile_rows or default_tile_rows(r, e)
+    r_pad = _round_up(r, tile) + e * tile
+
+    key = jnp.where(
+        experts_flat < e, experts_flat, e
+    ).astype(jnp.int32)
+    # Stable sort: pair order within an expert is token order, the
+    # deterministic tie-break every impl-parity test relies on.
+    order = jnp.argsort(key, stable=True).astype(jnp.int32)
+    counts = jnp.bincount(key, length=e + 1)[:e].astype(jnp.int32)
+    aligned = ((counts + tile - 1) // tile) * tile
+    zero = jnp.zeros((1,), jnp.int32)
+    starts = jnp.concatenate([zero, jnp.cumsum(counts)])[:-1]
+    starts_aligned = jnp.concatenate([zero, jnp.cumsum(aligned)])[:-1]
+
+    g = jnp.take(key, order)                            # [r] sorted experts
+    rank = jnp.arange(r, dtype=jnp.int32) - jnp.take(
+        jnp.append(starts, jnp.sum(counts)), g
+    )
+    dest = jnp.where(
+        g < e,
+        jnp.take(jnp.append(starts_aligned, r_pad), g) + rank,
+        r_pad,
+    ).astype(jnp.int32)
+
+    row_ids = jnp.full((r_pad,), n_tokens, jnp.int32).at[dest].set(
+        (order // top_k).astype(jnp.int32), mode="drop"
+    )
+    pair_ids = jnp.full((r_pad,), r, jnp.int32).at[dest].set(
+        order, mode="drop"
+    )
+    slot_of_pair = jnp.full((r,), r_pad, jnp.int32).at[order].set(
+        dest, mode="drop"
+    )
+    # Groups are tile-aligned, so the expert of a tile is the expert of
+    # its first row's region; tiles past the last region clip to E-1 —
+    # harmless, their rows are all sentinels.
+    n_tiles = r_pad // tile
+    tile_expert = jnp.clip(
+        jnp.searchsorted(
+            starts_aligned,
+            jnp.arange(n_tiles, dtype=jnp.int32) * tile,
+            side="right",
+        ).astype(jnp.int32) - 1,
+        0, e - 1,
+    )
+    # Named so remat policies can save the routing (int arrays, tiny)
+    # instead of re-sorting in the backward — the models/moe.py
+    # "moe_routing" tier.
+    row_ids, pair_ids, slot_of_pair = (
+        checkpoint_name(a, "moe_routing")
+        for a in (row_ids, pair_ids, slot_of_pair)
+    )
+    return DispatchPlan(
+        row_ids=row_ids, pair_ids=pair_ids, slot_of_pair=slot_of_pair,
+        tile_expert=tile_expert, sizes_aligned=aligned,
+        tile_rows=tile, n_tokens=n_tokens, n_pairs=r, n_experts=e,
+        top_k=top_k,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Shared grouped-matmul chooser (megablox gmm on TPU, ragged_dot
+# elsewhere) — models/moe.py's `_grouped_dot_fn` delegates here.
+# ---------------------------------------------------------------------------
+
+
+def pick_m_tile(m: int, want: int = 512) -> int | None:
+    """Largest multiple of 8 that divides ``m`` and is ≤ ``want``; None
+    when no tile ≥ 8 works (prime-ish row counts). The old search walked
+    tm down one at a time — reaching tm=1 for primes and only THEN
+    hitting the tm % 8 fallback; candidates that aren't multiples of 8
+    can never pass Mosaic's second-minor rule, so only step through
+    those."""
+    for tm in range(min(want, m) // 8 * 8, 7, -8):
+        if m % tm == 0:
+            return tm
+    return None
+
+
+def _log_choice(label: str, m: int, kk: int, nn: int, why: str) -> None:
+    keyed = (label, m, kk, nn)
+    if keyed not in _LOGGED_SHAPES:
+        _LOGGED_SHAPES.add(keyed)
+        logger.info(
+            "moe grouped matmul [%d x %d x %d]: %s (%s)", m, kk, nn,
+            label, why,
+        )
+
+
+def grouped_matmul_label(m: int, kk: int, nn: int) -> str:
+    """Which grouped kernel ``grouped_matmul`` would run for a float
+    [m, kk] x [E, kk, nn] problem on this backend — public so bench
+    detail shows the kernel that actually ran."""
+    if jax.default_backend() != "tpu":
+        return "ragged_dot"
+    tm = pick_m_tile(m)
+    if tm is None or kk % 128 or nn % 128:
+        return "ragged_dot"
+    return "megablox"
+
+
+def _quant_parts(rhs):
+    """(q, scale) for a QuantTensor-shaped rhs, else (rhs, None). Duck
+    typed + lazily imported: ops must not import models at module scope
+    (models imports ops at package init)."""
+    from ..models.quant import QuantTensor
+
+    if isinstance(rhs, QuantTensor):
+        return rhs.q, rhs.scale
+    return rhs, None
+
+
+def _row_scale(scale: jax.Array, group_sizes: jax.Array,
+               rows: int) -> jax.Array:
+    """Per-row dequant scale for a grouped product: row r belongs to the
+    group covering its position in the (cumulative) group layout; rows
+    past the last group get the final group's scale — they are zero
+    anyway."""
+    e = group_sizes.shape[0]
+    bounds = jnp.cumsum(group_sizes)
+    row_group = jnp.clip(
+        jnp.searchsorted(
+            bounds, jnp.arange(rows, dtype=jnp.int32), side="right"
+        ),
+        0, e - 1,
+    )
+    # scale: [E, 1, N] (contraction axis collapsed) -> [E, N] -> [rows, N]
+    return jnp.take(scale.reshape(e, -1), row_group, axis=0)
+
+
+def grouped_matmul(
+    lhs: jax.Array,            # [rows, K]
+    rhs,                       # [E, K, N] array or QuantTensor
+    group_sizes: jax.Array,    # [E] int32, cumulative layout
+    *,
+    use_pallas: bool = True,
+    interpret: bool = False,
+) -> jax.Array:
+    """Grouped matmul with kernel choice: megablox gmm on TPU (divisor-
+    aware tile search, custom VJP = two more grouped matmuls),
+    ``lax.ragged_dot`` elsewhere. Both tolerate ``sum(group_sizes) <
+    rows``: tiles past the last group are skipped (megablox) or
+    zero-filled (ragged_dot) — megablox leaves those rows UNINITIALIZED,
+    callers must mask.
+
+    An int8 ``QuantTensor`` rhs stays int8 INTO the dot (f32 accumulator,
+    per-channel scales in the epilogue) — no bf16 weight copy; that path
+    always uses the ragged_dot primitive (megablox is same-dtype only).
+
+    ``use_pallas=False`` forces the primitive even on TPU: required
+    wherever the computation runs under GSPMD over a mesh the kernel is
+    not shard-aware of.
+    """
+    q, scale = _quant_parts(rhs)
+    m, kk = lhs.shape
+    nn = q.shape[2]
+    if scale is not None:
+        y = jax.lax.ragged_dot(
+            lhs, q, group_sizes, preferred_element_type=jnp.float32
+        )
+        y = y * _row_scale(scale, group_sizes, m)
+        _log_choice("ragged_dot-int8", m, kk, nn, "int8 rhs stays int8")
+        return y.astype(lhs.dtype)
+    if use_pallas and jax.default_backend() == "tpu" and not interpret:
+        tm = pick_m_tile(m)
+        if tm is None:
+            _log_choice("ragged_dot", m, kk, nn,
+                        "no m-tile >= 8 divides the row count")
+        elif kk % 128 or nn % 128:
+            _log_choice("ragged_dot", m, kk, nn,
+                        "k/n not 128-aligned for Mosaic")
+        else:
+            from jax.experimental.pallas.ops.tpu.megablox import gmm
+
+            _log_choice("megablox", m, kk, nn, f"tm={tm}")
+            return gmm(
+                lhs, q, group_sizes,
+                preferred_element_type=lhs.dtype,
+                tiling=(tm, min(512, kk), min(512, nn)),
+            )
+    return jax.lax.ragged_dot(lhs, q, group_sizes)
+
+
+def grouped_weight_grad(
+    lhs: jax.Array,            # [rows, K] forward operand
+    rhs: jax.Array,            # [rows, N] cotangent
+    group_sizes: jax.Array,    # [E]
+    row_group: jax.Array,      # [rows] group per row (padding rows: any)
+    n_groups: int,
+    *,
+    use_pallas: bool = True,
+    interpret: bool = False,
+) -> jax.Array:
+    """dW[e] = lhs_e^T @ rhs_e → [E, K, N]: megablox tgmm on TPU, masked
+    per-group matmuls elsewhere (E is small and static; padding rows
+    carry zero lhs so no masking of THEM is needed, only group
+    separation)."""
+    if use_pallas and jax.default_backend() == "tpu" and not interpret:
+        kk, nn = lhs.shape[1], rhs.shape[1]
+        if kk % 128 == 0 and nn % 128 == 0 and pick_m_tile(
+            lhs.shape[0]
+        ) is not None:
+            from jax.experimental.pallas.ops.tpu.megablox.gmm import tgmm
+
+            return tgmm(
+                lhs.T, rhs, group_sizes,
+                preferred_element_type=jnp.float32,
+            )
+    lhs32 = lhs.astype(jnp.float32)
+    rhs32 = rhs.astype(jnp.float32)
+    return jnp.stack([
+        jnp.einsum(
+            "rk,rn->kn", lhs32 * (row_group == g)[:, None], rhs32,
+            preferred_element_type=jnp.float32,
+        )
+        for g in range(n_groups)
+    ])
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernels.
+# ---------------------------------------------------------------------------
+
+
+def _gather_rows_dma(ids_ref, base: int, count: int, limit,
+                     src_any, dst_vmem, sem) -> None:
+    """DMA rows ``src_any[ids_ref[base + j]] -> dst_vmem[j]`` for j in
+    [0, count), skipping sentinel ids >= limit. Start-all-then-wait-all
+    so the row transfers overlap each other."""
+
+    def _start(j, _):
+        idx = ids_ref[base + j]
+
+        @pl.when(idx < limit)
+        def _():
+            pltpu.make_async_copy(
+                src_any.at[pl.ds(idx, 1)], dst_vmem.at[pl.ds(j, 1)], sem
+            ).start()
+
+        return 0
+
+    def _wait(j, _):
+        idx = ids_ref[base + j]
+
+        @pl.when(idx < limit)
+        def _():
+            pltpu.make_async_copy(
+                src_any.at[pl.ds(idx, 1)], dst_vmem.at[pl.ds(j, 1)], sem
+            ).wait()
+
+        return 0
+
+    jax.lax.fori_loop(0, count, _start, 0)
+    jax.lax.fori_loop(0, count, _wait, 0)
+
+
+def _gateup_kernel(
+    row_ids_ref, tile_expert_ref,     # scalar prefetch
+    x_any, w_ref, *rest,
+    tile_rows: int, n_tokens: int, quantized: bool,
+):
+    if quantized:
+        scale_ref, act_ref, x_tile, sem = rest
+    else:
+        act_ref, x_tile, sem = rest
+        scale_ref = None
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+
+    # The row gather happens once per m-tile (j == 0); the VMEM tile
+    # persists across the inner n-tiles — DMA cost is amortized over the
+    # whole 2M-wide projection. Sentinel rows stay zero: their SwiGLU
+    # output is silu(0)*0 = 0, and the combine kernel drops their slots.
+    @pl.when(j == 0)
+    def _gather():
+        x_tile[...] = jnp.zeros_like(x_tile)
+        _gather_rows_dma(
+            row_ids_ref, i * tile_rows, tile_rows, n_tokens, x_any,
+            x_tile, sem,
+        )
+
+    x = x_tile[...]
+    w = w_ref[0]                                    # [H, 2, tn]
+    # bf16 (or bf16 x int8) into the dots, f32 out — the MXU discipline
+    # of every kernel in ops/ (see _flash_kernel).
+    g = jax.lax.dot_general(
+        x, w[:, 0, :], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    u = jax.lax.dot_general(
+        x, w[:, 1, :], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if quantized:
+        s = scale_ref[0, 0]                         # [2, tn]
+        g = g * s[0][None, :]
+        u = u * s[1][None, :]
+    act_ref[...] = (
+        (g * jax.nn.sigmoid(g)) * u
+    ).astype(act_ref.dtype)
+
+
+def _down_combine_kernel(
+    pair_ids_ref, tile_expert_ref,    # scalar prefetch
+    act_ref, w_ref, gates_ref, *rest,
+    tile_rows: int, n_pairs: int, quantized: bool,
+):
+    # The zero-init operand aliases the output; its input ref is unused
+    # (the kernel only ever writes through ``out_any``).
+    if quantized:
+        scale_ref, _zeros_ref, out_any, acc, sem = rest
+    else:
+        _zeros_ref, out_any, acc, sem = rest
+        scale_ref = None
+    i = pl.program_id(0)
+    kk = pl.program_id(1)
+    nk = pl.num_programs(1)
+
+    @pl.when(kk == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+
+    acc[...] += jax.lax.dot_general(
+        act_ref[...], w_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(kk == nk - 1)
+    def _finish():
+        y = acc[...]
+        if quantized:
+            y = y * scale_ref[0, 0][None, :]        # [H] per-channel
+        # Gate-weighted combine fused into the epilogue, then each row
+        # DMA-scatters straight to its token-major pair slot: the
+        # inverse permutation IS the write pattern.
+        acc[...] = y * gates_ref[...]
+        _scatter_rows_dma(
+            pair_ids_ref, i * tile_rows, tile_rows, n_pairs, acc,
+            out_any, sem,
+        )
+
+
+def _scatter_rows_dma(ids_ref, base: int, count: int, limit,
+                      src_vmem, dst_any, sem) -> None:
+    def _start(j, _):
+        idx = ids_ref[base + j]
+
+        @pl.when(idx < limit)
+        def _():
+            pltpu.make_async_copy(
+                src_vmem.at[pl.ds(j, 1)], dst_any.at[pl.ds(idx, 1)], sem
+            ).start()
+
+        return 0
+
+    def _wait(j, _):
+        idx = ids_ref[base + j]
+
+        @pl.when(idx < limit)
+        def _():
+            pltpu.make_async_copy(
+                src_vmem.at[pl.ds(j, 1)], dst_any.at[pl.ds(idx, 1)], sem
+            ).wait()
+
+        return 0
+
+    jax.lax.fori_loop(0, count, _start, 0)
+    jax.lax.fori_loop(0, count, _wait, 0)
+
+
+def _gateup_pallas(xf, w4, scale, plan: DispatchPlan,
+                   interpret: bool) -> jax.Array:
+    """Fused gather + gate/up + SwiGLU. xf: [T, H]; w4: [E, H, 2, M]
+    (int8 when ``scale`` is given, scale [E, 1, 2, M]). Returns the
+    sorted activation buffer [r_pad, M] in xf's dtype."""
+    e, h, _, m = w4.shape
+    tile = plan.tile_rows
+    tn = _fit_cols(m, _TILE_COLS)
+    quantized = scale is not None
+    kernel = functools.partial(
+        _gateup_kernel,
+        tile_rows=tile, n_tokens=plan.n_tokens, quantized=quantized,
+    )
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.ANY),
+        pl.BlockSpec(
+            (1, h, 2, tn), lambda i, j, ids, te: (te[i], 0, 0, j)
+        ),
+    ]
+    operands = [xf, w4]
+    if quantized:
+        in_specs.append(pl.BlockSpec(
+            (1, 1, 2, tn), lambda i, j, ids, te: (te[i], 0, 0, j)
+        ))
+        operands.append(scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(plan.r_pad // tile, m // tn),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((tile, tn), lambda i, j, ids, te: (i, j)),
+        scratch_shapes=[
+            pltpu.VMEM((tile, h), xf.dtype),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((plan.r_pad, m), xf.dtype),
+        interpret=interpret,
+    )(plan.row_ids, plan.tile_expert, *operands)
+
+
+def _down_combine_pallas(act, w_down, scale, gates_pad,
+                         plan: DispatchPlan, interpret: bool) -> jax.Array:
+    """Fused down-projection + gate weighting + combine scatter.
+    act: [r_pad, M] sorted activations; w_down: [E, M, H] (int8 when
+    ``scale`` [E, 1, H] is given); gates_pad: [r_pad, 1] f32. Returns
+    token-major pair contributions [n_pairs, H] f32 (zero-initialized:
+    pair slots whose expert was foreign — the EP local view — stay
+    exactly zero)."""
+    e, m, h = w_down.shape
+    tile = plan.tile_rows
+    tk = _fit_cols(m, _TILE_COLS)
+    quantized = scale is not None
+    kernel = functools.partial(
+        _down_combine_kernel,
+        tile_rows=tile, n_pairs=plan.n_pairs, quantized=quantized,
+    )
+    in_specs = [
+        pl.BlockSpec((tile, tk), lambda i, kk, ids, te: (i, kk)),
+        pl.BlockSpec((1, tk, h), lambda i, kk, ids, te: (te[i], kk, 0)),
+        pl.BlockSpec((tile, 1), lambda i, kk, ids, te: (i, 0)),
+    ]
+    operands = [act, w_down, gates_pad]
+    if quantized:
+        in_specs.append(pl.BlockSpec(
+            (1, 1, h), lambda i, kk, ids, te: (te[i], 0, 0)
+        ))
+        operands.append(scale)
+    # The zero buffer aliases the output: the kernel writes only live
+    # pair slots, so foreign/sentinel slots read back as true zeros
+    # (aliasing indices count ALL operands, scalar-prefetch included).
+    zeros = jnp.zeros((plan.n_pairs, h), jnp.float32)
+    in_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))
+    operands.append(zeros)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(plan.r_pad // tile, m // tk),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((tile, h), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((plan.n_pairs, h), jnp.float32),
+        input_output_aliases={2 + len(operands) - 1: 0},
+        interpret=interpret,
+    )(plan.pair_ids, plan.tile_expert, *operands)
+
+
+# ---------------------------------------------------------------------------
+# Reference (XLA) pipeline — the numerics oracle and the backward's
+# recompute building block.
+# ---------------------------------------------------------------------------
+
+
+def _gu_2d(w_gu):
+    """[E, H, 2, M] -> [E, H, 2M] for the grouped primitives; u-major
+    flatten, so [:, :M] of the product is the gate half (the
+    models/moe.py convention). QuantTensors reshape both leaves."""
+    from ..models.quant import QuantTensor
+
+    if isinstance(w_gu, QuantTensor):
+        e, h, _, m = w_gu.q.shape
+        return QuantTensor(
+            q=w_gu.q.reshape(e, h, 2 * m),
+            scale=w_gu.scale.reshape(e, 1, 2 * m),
+        )
+    e, h, _, m = w_gu.shape
+    return w_gu.reshape(e, h, 2 * m)
+
+
+def _reference_parts(xf, w_gu, w_down, gates, plan: DispatchPlan,
+                     use_pallas: bool, interpret: bool):
+    """(sorted activations, token-major pair outputs) via gathers +
+    grouped primitives over the SAME tile-aligned layout the kernels
+    use — outputs match the fused pipeline up to matmul reduction
+    order."""
+    m = _quant_parts(w_down)[0].shape[1]
+    xs = jnp.take(xf, plan.row_ids, axis=0, mode="fill", fill_value=0)
+    gu = grouped_matmul(
+        xs, _gu_2d(w_gu), plan.sizes_aligned,
+        use_pallas=use_pallas, interpret=interpret,
+    )
+    gate = jax.nn.silu(gu[:, :m].astype(jnp.float32))
+    up = gu[:, m:].astype(jnp.float32)
+    act = (gate * up).astype(xf.dtype)
+    ys = grouped_matmul(
+        act, w_down, plan.sizes_aligned,
+        use_pallas=use_pallas, interpret=interpret,
+    )
+    gates_pad = jnp.take(
+        gates, plan.pair_ids, mode="fill", fill_value=0.0
+    )
+    yw = ys.astype(jnp.float32) * gates_pad[:, None]
+    # megablox leaves rows past the covered groups uninitialized; the
+    # unsort gather below only reads covered slots (slot_of_pair never
+    # points past a group), so no masking is needed HERE — the backward
+    # masks via the same index maps (the moe.py:591-597 hazard class).
+    y_pairs = jnp.take(
+        yw, plan.slot_of_pair, axis=0, mode="fill", fill_value=0.0
+    )
+    return act, y_pairs
+
+
+def reference_moe_mlp(xf, w_gu, w_down, gates, plan: DispatchPlan):
+    """Oracle: plain-XLA dispatch pipeline over the plan's layout.
+    Differentiable end to end (take/ragged_dot autodiff) — the grads
+    pin for the custom VJP in tests."""
+    _, y_pairs = _reference_parts(
+        xf, w_gu, w_down, gates, plan, use_pallas=False, interpret=True
+    )
+    return y_pairs
+
+
+# ---------------------------------------------------------------------------
+# The differentiable fused op.
+# ---------------------------------------------------------------------------
+
+
+def _forward(statics, xf, w_gu, w_down, gates, plan: DispatchPlan):
+    use_pallas, interpret = statics
+    q_gu, s_gu = _quant_parts(w_gu)
+    q_dn, s_dn = _quant_parts(w_down)
+    if use_pallas:
+        act = _gateup_pallas(xf, q_gu, s_gu, plan, interpret)
+        gates_pad = jnp.take(
+            gates, plan.pair_ids, mode="fill", fill_value=0.0
+        ).astype(jnp.float32)[:, None]
+        y_pairs = _down_combine_pallas(
+            act, q_dn, s_dn, gates_pad, plan, interpret
+        )
+        return act, y_pairs
+    return _reference_parts(
+        xf, w_gu, w_down, gates, plan, use_pallas=True,
+        interpret=interpret,
+    )
+
+
+# The custom-vjp boundary passes the plan's index arrays POSITIONALLY
+# (rebuilt into a DispatchPlan inside): integer-array args may get a
+# plain ``None`` cotangent (the proven _gather_rows pattern in
+# models/moe.py), whereas a None for a whole dataclass subtree is not a
+# structure custom_vjp accepts.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _fused_mlp(statics, xf, w_gu, w_down, gates,
+               row_ids, pair_ids, slot_of_pair, tile_expert,
+               sizes_aligned):
+    plan = _plan_of(statics, row_ids, pair_ids, slot_of_pair,
+                    tile_expert, sizes_aligned)
+    return _forward(statics[:2], xf, w_gu, w_down, gates, plan)[1]
+
+
+def _plan_of(statics, row_ids, pair_ids, slot_of_pair, tile_expert,
+             sizes_aligned) -> DispatchPlan:
+    _, _, tile_rows, n_tokens, n_pairs, n_experts, top_k = statics
+    return DispatchPlan(
+        row_ids=row_ids, pair_ids=pair_ids, slot_of_pair=slot_of_pair,
+        tile_expert=tile_expert, sizes_aligned=sizes_aligned,
+        tile_rows=tile_rows, n_tokens=n_tokens, n_pairs=n_pairs,
+        n_experts=n_experts, top_k=top_k,
+    )
+
+
+def _fused_mlp_fwd(statics, xf, w_gu, w_down, gates,
+                   row_ids, pair_ids, slot_of_pair, tile_expert,
+                   sizes_aligned):
+    plan = _plan_of(statics, row_ids, pair_ids, slot_of_pair,
+                    tile_expert, sizes_aligned)
+    act, y_pairs = _forward(statics[:2], xf, w_gu, w_down, gates, plan)
+    # The sorted activations are the flash-style residual: saving them
+    # skips the gather+gate/up recompute entirely; the gate/up product
+    # itself is recomputed blockwise in the backward (one grouped
+    # matmul) for the SwiGLU jacobian.
+    res = (xf, w_gu, w_down, gates, plan, checkpoint_name(act, "moe_act"))
+    return y_pairs, res
+
+
+def _fused_mlp_bwd(statics, res, dy):
+    use_pallas, interpret = statics[:2]
+    xf, w_gu, w_down, gates, plan, act = res
+    e = plan.n_experts
+    t, k = plan.n_tokens, plan.top_k
+    m = w_down.shape[1]
+    sizes = plan.sizes_aligned
+    gm = functools.partial(
+        grouped_matmul, use_pallas=use_pallas, interpret=interpret
+    )
+
+    # All index motion is gathers through the plan's maps — the VJP of
+    # every scatter in the forward is a gather here, never a TPU
+    # scatter-add (the _gather_rows discipline).
+    dyw = jnp.take(
+        dy, plan.pair_ids, axis=0, mode="fill", fill_value=0.0
+    )                                                   # [r_pad, H] f32
+    gates_pad = jnp.take(
+        gates, plan.pair_ids, mode="fill", fill_value=0.0
+    )
+    # One grouped product serves both the gate grad and the activation
+    # grad: q = dyw @ W_down^T; dgate = act . q; dact = gate * q.
+    q = gm(
+        dyw.astype(xf.dtype), jnp.swapaxes(w_down, 1, 2), sizes
+    ).astype(jnp.float32)                               # [r_pad, M]
+    # Rows past the covered groups are uninitialized out of megablox
+    # (ragged_dot zero-fills): every downstream use below multiplies by
+    # this row-validity mask, the same hazard the psum EP path masks.
+    valid = (plan.pair_ids < plan.n_pairs)[:, None]
+    q = jnp.where(valid, q, 0.0)
+    act32 = act.astype(jnp.float32)
+    dgates_pad = jnp.sum(act32 * q, axis=-1)
+    dgates = jnp.take(
+        dgates_pad, plan.slot_of_pair, mode="fill", fill_value=0.0
+    )
+    dact = q * gates_pad[:, None]
+
+    # SwiGLU jacobian from a blockwise recompute of the gate/up product.
+    xs = jnp.take(xf, plan.row_ids, axis=0, mode="fill", fill_value=0)
+    w2 = _gu_2d(w_gu)
+    gu = jnp.where(
+        valid, gm(xs, w2, sizes).astype(jnp.float32), 0.0
+    )
+    g_lin, u = gu[:, :m], gu[:, m:]
+    sg = jax.nn.sigmoid(g_lin)
+    dg = dact * u * (sg * (1.0 + g_lin * (1.0 - sg)))
+    du = dact * (g_lin * sg)
+    dgu = jnp.concatenate([dg, du], axis=1)             # [r_pad, 2M]
+
+    dxs = gm(
+        dgu.astype(xf.dtype), jnp.swapaxes(w2, 1, 2), sizes
+    ).astype(jnp.float32)
+    dxs = jnp.where(valid, dxs, 0.0)
+    slots = plan.slot_of_pair.reshape(t, k)
+    dxf = sum(
+        jnp.take(dxs, slots[:, j], axis=0, mode="fill", fill_value=0.0)
+        for j in range(k)
+    ).astype(xf.dtype)
+
+    row_group = jnp.repeat(
+        plan.tile_expert, plan.tile_rows, total_repeat_length=plan.r_pad
+    )
+    dw2 = grouped_weight_grad(
+        xs, dgu, sizes, row_group, e,
+        use_pallas=use_pallas, interpret=interpret,
+    )
+    dw_gu = dw2.reshape(w_gu.shape).astype(w_gu.dtype)
+    dys = dyw * gates_pad[:, None]
+    dw_down = grouped_weight_grad(
+        act, dys, sizes, row_group, e,
+        use_pallas=use_pallas, interpret=interpret,
+    ).astype(w_down.dtype)
+    return (dxf, dw_gu, dw_down, dgates, None, None, None, None, None)
+
+
+_fused_mlp.defvjp(_fused_mlp_fwd, _fused_mlp_bwd)
+
+
+def fused_moe_mlp(
+    xf: jax.Array,             # [T, H] tokens (unsorted)
+    w_gu,                      # [E, H, 2, M] array or QuantTensor
+    w_down,                    # [E, M, H] array or QuantTensor
+    gates: jax.Array,          # [T*k] f32, token-major pair order
+    plan: DispatchPlan,
+    *,
+    interpret: bool | None = None,
+    force_pallas: bool = False,
+) -> jax.Array:
+    """The fused dispatch pipeline: returns token-major pair
+    contributions [T*k, H] f32 (sum the k slots per token and add the
+    residual outside — one XLA reshape-sum).
+
+    Float weights are fully differentiable (custom VJP above).
+    Quantized weights run the forward-only serving path — int8 into the
+    dots, scales in the epilogues.
+    """
+    interpret = _interpret() if interpret is None else interpret
+    use_pallas = force_pallas or dispatch_impl_label() == "fused"
+    quantized = _quant_parts(w_gu)[1] is not None or (
+        _quant_parts(w_down)[1] is not None
+    )
+    if quantized:
+        return _forward(
+            (use_pallas, interpret), xf, w_gu, w_down, gates, plan
+        )[1]
+    statics = (
+        use_pallas, interpret, plan.tile_rows, plan.n_tokens,
+        plan.n_pairs, plan.n_experts, plan.top_k,
+    )
+    return _fused_mlp(
+        statics, xf, w_gu, w_down, gates,
+        plan.row_ids, plan.pair_ids, plan.slot_of_pair,
+        plan.tile_expert, plan.sizes_aligned,
+    )

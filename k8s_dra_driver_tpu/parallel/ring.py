@@ -341,6 +341,125 @@ def ring_attention(
     return fn(q, k, v)
 
 
+# ---------------------------------------------------------------------------
+# Ring permute: one rotation of a per-shard buffer along a mesh axis —
+# the building block of the overlapped expert all-to-all
+# (models/moe._moe_block_dropless_ep_ring). Two implementations:
+#
+# - "xla": `lax.ppermute` — portable, differentiable, and the one legal
+#   under partial-manual shard_maps where other mesh axes stay with
+#   GSPMD. XLA's async collective-permute start/done pair lets the
+#   transfer overlap independent compute between issue and use — this
+#   is the impl that delivers the ring-EP overlap schedule, and the
+#   default.
+# - "pallas": explicit inter-chip RDMA via `make_async_remote_copy`
+#   (the SNIPPETS.md [1]/[2] right-permute pattern): the whole shard
+#   moves HBM→HBM in one remote DMA, no XLA collective runtime on the
+#   critical path. Legal only when the ring axis is the SOLE nontrivial
+#   mesh axis (a pallas_call has no partitioning rule) — the caller
+#   gates this, same discipline as the megablox kernel. The LOGICAL
+#   device id equals the ring-axis index exactly because every other
+#   axis is trivial. HONEST LIMIT: start() and wait() sit in the same
+#   kernel, so each call completes its DMA before returning — the
+#   transfer CANNOT overlap compute outside the pallas_call. It exists
+#   as the measured alternative for runtimes where the XLA collective
+#   path underperforms, and as the building block for a future fused
+#   hop kernel (grouped matmul between start and wait).
+#
+# The pallas kernel gets a custom VJP: the cotangent of a rotation is
+# the inverse rotation (shift negated).
+# ---------------------------------------------------------------------------
+
+
+def _ring_permute_pallas_call(x, axis_name: str, n: int, shift: int,
+                              interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(x_ref, o_ref, send_sem, recv_sem):
+        me = jax.lax.axis_index(axis_name)
+        nbr = jax.lax.rem(me + shift + n, n)
+        op = pltpu.make_async_remote_copy(
+            src_ref=x_ref,
+            dst_ref=o_ref,
+            send_sem=send_sem,
+            recv_sem=recv_sem,
+            device_id=nbr,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        op.start()
+        op.wait()
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA] * 2,
+    )
+    kwargs = {}
+    try:
+        # Remote DMA needs a collective id for its barrier semaphore on
+        # real TPU; interpret mode ignores compiler params entirely.
+        kwargs["compiler_params"] = pltpu.TPUCompilerParams(
+            collective_id=0
+        )
+    except AttributeError:  # pragma: no cover - older pallas
+        pass
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+        **kwargs,
+    )(x)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _ring_permute_pallas(x, axis_name, n, shift, interpret):
+    return _ring_permute_pallas_call(x, axis_name, n, shift, interpret)
+
+
+def _ring_permute_pallas_fwd(x, axis_name, n, shift, interpret):
+    return _ring_permute_pallas_call(x, axis_name, n, shift, interpret), None
+
+
+def _ring_permute_pallas_bwd(axis_name, n, shift, interpret, _res, g):
+    return (_ring_permute_pallas_call(g, axis_name, n, -shift, interpret),)
+
+
+_ring_permute_pallas.defvjp(_ring_permute_pallas_fwd,
+                            _ring_permute_pallas_bwd)
+
+
+def ring_permute(
+    x: jax.Array,
+    axis_name: str,
+    n: int,
+    *,
+    shift: int = 1,
+    impl: str = "auto",
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Move shard i's ``x`` to shard (i + shift) mod n along
+    ``axis_name`` (call inside a shard_map manual over that axis).
+
+    ``impl``: "xla"/"auto" = ppermute (async collective-permute — the
+    overlappable default); "pallas" = the explicit remote-DMA kernel
+    (ring axis must be the only nontrivial mesh axis — caller's
+    contract — and each call completes its DMA before returning, see
+    the section comment).
+    """
+    assert impl in ("auto", "pallas", "xla"), impl
+    on_tpu = jax.default_backend() == "tpu"
+    if impl == "pallas":
+        return _ring_permute_pallas(
+            x, axis_name, n, shift % n,
+            (not on_tpu) if interpret is None else interpret,
+        )
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
 def ulysses_attention(
     q: jax.Array,
     k: jax.Array,

@@ -1,0 +1,178 @@
+#!/usr/bin/env python3
+"""Fast fixed-seed MoE smoke for `make moebench` (wired into
+`make verify`).
+
+Four gates, all on the hermetic CPU backend with the tiny preset — the
+MoE analog of tools/run_decode_smoke.py:
+
+1. **Compile-once**: running the jitted train step (and the jitted
+   forward) twice with identical shapes must not re-trace any MoE block
+   (moe.MOE_TRACE_COUNTS is the oracle, mirroring decode.TRACE_COUNTS) —
+   a shape leak in routing/dispatch metadata would show up here long
+   before it shows up as bench spread on a TPU.
+2. **Impl parity**: at drop-free capacity, einsum / binned / dropless
+   compute the same function (the equivalence contract every `auto`
+   re-selection relies on), and the FUSED dropless dispatch
+   (ops/moe_dispatch.py kernels, interpret mode) matches the primitive
+   gather + ragged_dot path — the kernel-vs-oracle gate.
+3. **Auto policy**: `resolve_moe_impl` picks the recorded fast impl for
+   the bench geometries (never slower than einsum — see the ranking
+   table in tests/test_moe.py::TestAutoPolicy).
+4. **Spread**: repeated timed runs of the same jitted step must agree
+   within a threshold, mirroring `_decodebench.spread_flags` for the
+   `mixtral_*` train metrics. 2% is the TPU acceptance bar; CPU wall
+   clocks are far noisier, so the default here is loose (50%) and
+   exists to catch order-of-magnitude pathologies (a recompile per
+   step). Tune with TPU_DRA_MOE_SMOKE_SPREAD.
+
+Exit 0 = all gates pass; 1 = a gate failed.
+"""
+
+import dataclasses
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SPREAD_LIMIT = float(os.environ.get("TPU_DRA_MOE_SMOKE_SPREAD", "0.5"))
+SEED = int(os.environ.get("TPU_DRA_MOE_SMOKE_SEED", "1234"))
+
+
+def spread_flags(metrics, rel: float = 0.02) -> list:
+    """`_decodebench.spread_flags` for the mixtral train metrics: flag
+    any metric whose repeat spread exceeds ``rel`` of its mean."""
+    flagged = []
+    for m in metrics:
+        if not m.get("metric", "").startswith("mixtral_"):
+            continue
+        if m.get("spread", 0.0) > rel * (m.get("value") or 1e-30):
+            m["spread_flag"] = True
+            flagged.append(m["metric"])
+    return flagged
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from k8s_dra_driver_tpu.models.moe import (
+        MOE_PRESETS,
+        MOE_TRACE_COUNTS,
+        forward,
+        init_params,
+        loss_fn,
+        resolve_moe_impl,
+    )
+    from k8s_dra_driver_tpu.ops import moe_dispatch
+
+    failures = []
+    base = MOE_PRESETS["tiny-moe"]
+    params = init_params(base, jax.random.PRNGKey(SEED))
+    rng = np.random.RandomState(SEED)
+    tokens = jnp.asarray(
+        rng.randint(0, base.vocab_size, size=(2, 65)), jnp.int32
+    )
+
+    # Gate 1+4: compile-once and spread, per impl.
+    metrics = []
+    for impl in ("einsum", "binned", "dropless"):
+        cfg = dataclasses.replace(base, moe_impl=impl)
+        step = jax.jit(jax.value_and_grad(
+            lambda p, cfg=cfg: loss_fn(p, tokens, cfg, remat=True)
+        ))
+        loss, _ = step(params)
+        float(loss)
+        before = dict(MOE_TRACE_COUNTS)
+        # Time CHAINS of steps, not single ~20ms dispatches: a lone CPU
+        # step is dominated by scheduler noise, and this gate hunts for
+        # recompiles (10x+), not microseconds.
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(5):
+                loss, _ = step(params)
+            float(loss)
+            times.append(time.perf_counter() - t0)
+        if dict(MOE_TRACE_COUNTS) != before:
+            failures.append(
+                f"{impl}: retraced on identical shapes "
+                f"({before} -> {dict(MOE_TRACE_COUNTS)})"
+            )
+        mean = sum(times) / len(times)
+        spread = (max(times) - min(times)) / 2
+        metrics.append({
+            "metric": f"mixtral_tiny-moe-{impl}_train_step",
+            "value": mean,
+            "spread": spread,
+        })
+        print(f"moebench {impl}: 5-step chain {mean * 1e3:.1f} ms "
+              f"spread {spread / mean:.1%} loss {float(loss):.4f}")
+
+    for name in spread_flags(metrics, rel=SPREAD_LIMIT):
+        failures.append(f"{name}: repeat spread exceeds "
+                        f"{SPREAD_LIMIT:.0%} of the mean")
+
+    # Gate 2: impl parity at drop-free capacity...
+    ample = dataclasses.replace(
+        base, capacity_factor=8.0, router_group=0
+    )
+    outs = {}
+    for impl in ("einsum", "binned", "dropless"):
+        cfg = dataclasses.replace(ample, moe_impl=impl)
+        out, _aux = jax.jit(
+            lambda p, cfg=cfg: forward(p, tokens[:, :-1], cfg)
+        )(params)
+        outs[impl] = np.asarray(out)
+    for impl in ("binned", "dropless"):
+        err = float(np.max(np.abs(outs[impl] - outs["einsum"])))
+        if err > 5e-4:
+            failures.append(
+                f"{impl} diverges from einsum at ample capacity: {err}"
+            )
+    print(f"moebench parity: binned/dropless match einsum "
+          f"(max {max(float(np.max(np.abs(outs[i] - outs['einsum']))) for i in ('binned', 'dropless')):.2e})")
+
+    # ...and fused dispatch kernels (interpret) vs the primitive path.
+    cfg_d = dataclasses.replace(ample, moe_impl="dropless")
+    moe_dispatch.set_dispatch_impl("fused")
+    try:
+        fused, _ = jax.jit(
+            lambda p: forward(p, tokens[:, :-1], cfg_d)
+        )(params)
+    finally:
+        moe_dispatch.set_dispatch_impl("auto")
+    err = float(np.max(np.abs(np.asarray(fused) - outs["dropless"])))
+    if err > 5e-4:
+        failures.append(f"fused dispatch diverges from primitive: {err}")
+    print(f"moebench fused-vs-primitive: max {err:.2e}")
+
+    # Gate 3: the auto policy picks the recorded winners.
+    for preset, batch, seq, want in (
+        ("8x160m", 8, 2048, "dropless"),     # small experts: fused path
+        ("8x7b-L1", 4, 2048, "einsum"),      # big experts: einsum holds
+        ("8x160m", 8, 1, "dropless"),        # decode batch
+    ):
+        got = resolve_moe_impl(MOE_PRESETS[preset], batch * seq)
+        if got != want:
+            failures.append(
+                f"auto({preset}, t={batch * seq}) = {got}, want {want}"
+            )
+    print("moebench auto policy: ok" if not any(
+        f.startswith("auto(") for f in failures
+    ) else "moebench auto policy: FAIL")
+
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    print("moebench: compile-once, impl parity, fused-kernel parity, "
+          "auto policy, spread within limit")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
