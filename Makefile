@@ -2,7 +2,7 @@
 IMAGE ?= tpu-dra-driver
 TAG ?= latest
 
-.PHONY: all native test image lint verify verify-metrics chaos chaos-slow doctor decodebench moebench elastic clean e2e-kind
+.PHONY: all native test image lint verify verify-metrics chaos chaos-slow doctor decodebench moebench elastic allocbench allocbench-smoke clean e2e-kind
 
 all: native
 
@@ -65,11 +65,27 @@ elastic:
 	TPU_DRA_CHAOS_SEED=$(TPU_DRA_CHAOS_SEED) \
 		python tools/run_elastic_smoke.py
 
+# Allocator throughput + fragmentation bench (tools/run_alloc_bench.py):
+# incremental-index solves/sec vs the from-scratch baseline (gated >=10x
+# on the full profile), p50/p99 solve latency, and the scored-vs-first-fit
+# large-gang admission comparison under seeded churn (gated: the scorer
+# must not admit fewer). The full profile (10k devices / 1k claims)
+# writes ALLOC_r01.json next to the BENCH files; `make verify` runs the
+# small fixed-seed smoke profile.
+ALLOC_BENCH_SEED ?= 1234
+allocbench:
+	ALLOC_BENCH_SEED=$(ALLOC_BENCH_SEED) \
+		python tools/run_alloc_bench.py --profile full
+
+allocbench-smoke:
+	ALLOC_BENCH_SEED=$(ALLOC_BENCH_SEED) \
+		python tools/run_alloc_bench.py --profile smoke
+
 # The full local gate: lint + unit/integration tests + chaos schedules +
 # metrics exposition + the doctor/auditor drill + the decode-engine,
-# MoE fast-path, and elastic-training smokes. What CI runs; what a PR
-# must pass.
-verify: lint test chaos verify-metrics doctor decodebench moebench elastic
+# MoE fast-path, elastic-training, and allocator-bench smokes. What CI
+# runs; what a PR must pass.
+verify: lint test chaos verify-metrics doctor decodebench moebench elastic allocbench-smoke
 
 # ruff when available (CI installs it; .golangci.yaml analog is
 # [tool.ruff] in pyproject.toml), else the first-party AST lint floor.

@@ -139,6 +139,7 @@ class NodeScrape:
     traces_text: str = ""
     readyz_text: str = ""
     allocations_text: str = ""
+    defrag: Optional[dict] = None
     errors: list = dataclasses.field(default_factory=list)
 
     @property
@@ -223,6 +224,15 @@ def collect_node(name: str, url: str, timeout: float = 5.0) -> NodeScrape:
         # processes do) — absence is normal, not a collection error.
         if getattr(e, "code", None) != 404:
             scrape.errors.append(f"/debug/allocations: {e}")
+    try:
+        scrape.defrag = json.loads(
+            _fetch(scrape.url + "/debug/defrag", timeout)
+        )
+    except Exception as e:
+        # Same contract as /debug/allocations: the planner only runs
+        # beside an allocator, so a 404 is a normal node plugin.
+        if getattr(e, "code", None) != 404:
+            scrape.errors.append(f"/debug/defrag: {e}")
     reported = (scrape.usage or {}).get("node")
     if reported and reported != name:
         scrape.errors.append(
@@ -341,6 +351,19 @@ def fleet_findings(
             findings.append(DoctorFinding(
                 SEVERITY_DRIFT, "explain", subject, detail,
             ))
+            # Defrag cross-check: a gang stuck on FRAGMENTATION (not
+            # capacity) whose node has a computed migration plan is
+            # actionable — say so next to the unsat finding instead of
+            # making the operator correlate two endpoints by hand.
+            if reason in ("gang", "shortfall"):
+                plan = _defrag_plan_for(nodes, uid)
+                if plan is not None and plan.get("outcome") == "planned":
+                    findings.append(DoctorFinding(
+                        SEVERITY_INFO, "defrag", subject,
+                        f"defrag plan available: {plan.get('detail')} — "
+                        "see /debug/defrag on the serving node; "
+                        "execution reuses the elastic resize protocol",
+                    ))
 
     if cluster is None:
         return findings
@@ -437,6 +460,22 @@ def fleet_findings(
             f"{published_channels} published",
         ))
     return findings
+
+
+def _defrag_plan_for(
+    nodes: list[NodeScrape], claim_uid: str
+) -> Optional[dict]:
+    """The newest defrag plan any node serves for this claim uid."""
+    best = None
+    for node in nodes:
+        for plan in ((node.defrag or {}).get("plans") or []):
+            if not isinstance(plan, dict):
+                continue
+            if (plan.get("claim") or {}).get("uid") != claim_uid:
+                continue
+            if best is None or plan.get("ts", 0) >= best.get("ts", 0):
+                best = plan
+    return best
 
 
 def _is_channel_result(result: dict) -> bool:
@@ -591,6 +630,9 @@ def write_bundle(
             if node.allocations_text:
                 add(tar, f"{base}/allocations.jsonl",
                     node.allocations_text)
+            if node.defrag is not None:
+                add(tar, f"{base}/defrag.json",
+                    json.dumps(node.defrag, indent=2, sort_keys=True))
             if node.errors:
                 add(tar, f"{base}/errors.txt", "\n".join(node.errors) + "\n")
         if cluster is not None:

@@ -25,11 +25,45 @@ land in a bounded ring buffer served as JSONL at ``/debug/allocations``
 answerable from a scrape instead of a debugger (kube-scheduler's
 ``Unschedulable`` filter messages are the model; docs/operations.md maps
 each terminal reason to an operator action).
+
+**Fleet-scale solving.** Three mechanisms keep the solver fast and the
+fleet defragmented at north-star scale (thousands of nodes, high claim
+churn):
+
+- *Incremental re-solve* (:class:`InventoryIndex`): the flattened
+  inventory, shared-counter capacities, and the static filter verdicts
+  (invalid-slice / class CEL / request CEL per device) persist across
+  solves in a generation-keyed index, invalidated per-pool by
+  ResourceSlice deltas detected with a cheap ``list_meta`` signature
+  probe. Steady-state solves re-evaluate nothing; only the delta after a
+  health transition / device add/remove is re-filtered. Reservation
+  changes never invalidate the index — the ``reserved`` stage is applied
+  per solve on top of the cached survivors. ``incremental=False`` forces
+  a from-scratch rebuild per solve (the bench baseline and the parity
+  oracle in tests/test_allocator_scale.py).
+- *Topology-aware placement* (:meth:`ReferenceAllocator._score_placement`):
+  instead of first-fit in inventory order, multi-chip gangs are placed
+  best-fit into the smallest free contiguous sub-mesh that satisfies
+  them, with a corner/edge bias (ParvaGPU's spatial-packing discipline),
+  so churn stops shredding the large contiguous boxes future gangs need.
+  The chosen box and its score land in the decision record
+  (``placements``) so ``/debug/allocations`` explains *why this
+  placement* as well as why-not.
+- *Batch solving* (:meth:`ReferenceAllocator.allocate_batch`): queued
+  claims solve most-constrained-first (largest gangs before singles)
+  under one :meth:`ReferenceAllocator.snapshot`, sharing one index
+  refresh instead of re-probing per claim; every claim still emits its
+  own funnel.
+
+When a gang goes unsat with terminal reason ``gang``/``shortfall`` and a
+:class:`~.defrag.DefragPlanner` is attached (``self.defrag``), a
+read-only migration plan is computed and surfaced at ``/debug/defrag``.
 """
 
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 import json
 import logging
@@ -38,6 +72,13 @@ import threading
 import time
 from typing import Any, Optional
 
+from ..tpulib.topology import (
+    Coord,
+    MeshShape,
+    box_shapes,
+    free_components,
+    is_contiguous_submesh,
+)
 from ..utils.metrics import Counter, Histogram, Registry
 from ..utils.tracing import child_span
 from .cel import CelError, evaluate_detailed as cel_evaluate_detailed
@@ -191,6 +232,13 @@ RUNBOOK_HINTS = {
 }
 assert set(RUNBOOK_HINTS) == set(REASONS)
 
+# Distinct request shapes whose static filter verdicts the inventory
+# index retains (LRU): each record holds one verdict per device, so
+# per-claim-unique selectors (coord pins etc.) must recycle old records
+# instead of leaking one O(#devices) map per claim forever — and every
+# retained record is re-filtered against a pool's devices on each delta,
+# so the bound also caps delta-application work.
+MAX_FILTER_RECORDS = 64
 # A pathological claim (dense matchAttribute groups over a fragmented
 # slice) can drive the backtracking search exponential. The budget turns
 # that into a typed `backtrack-budget` failure instead of a wedged
@@ -261,6 +309,9 @@ class Explanation:
         self.duration_seconds = 0.0
         self.stage_seconds: dict[str, float] = {}
         self.timestamp = 0.0
+        # request name -> placement-score record (the topology scorer's
+        # "why THIS placement" half of the explanation).
+        self.placements: dict[str, dict] = {}
         self._funnels: dict[str, RequestFunnel] = {}
         self._seen: set = set()
         self._fail_depth = -1
@@ -379,6 +430,7 @@ class Explanation:
                 k: round(v, 6)
                 for k, v in sorted(self.stage_seconds.items())
             },
+            "placements": {k: dict(v) for k, v in self.placements.items()},
             "funnels": [f.to_dict() for f in self._funnels.values()],
         }
 
@@ -422,10 +474,18 @@ def _attr_value(attrs: dict, name: str):
 
 def _consumption_entries(dev: dict):
     """(pool, counter set, counter, amount) for each counter a device
-    consumes."""
+    consumes. Index-built devices carry the parsed list precomputed
+    (``_consumes``); plain dicts fall back to parsing."""
+    cached = dev.get("_consumes")
+    if cached is not None:
+        return cached
+    out = []
     for cc in dev.get("consumes", []):
         for cname, cval in cc.get("counters", {}).items():
-            yield dev["pool"], cc["counterSet"], cname, int(cval["value"])
+            out.append(
+                (dev["pool"], cc["counterSet"], cname, int(cval["value"]))
+            )
+    return out
 
 
 def _gang_contiguous(chosen: list[dict]) -> tuple[bool, str]:
@@ -445,8 +505,6 @@ def _gang_contiguous(chosen: list[dict]) -> tuple[bool, str]:
     ]
     if len(chips) < 2:
         return True, ""
-    from ..tpulib.topology import Coord, is_contiguous_submesh
-
     slice_ids = {_attr_value(d["attributes"], "sliceId") for d in chips}
     if len(slice_ids) > 1:
         return False, f"gang:chips span ICI slices {sorted(map(str, slice_ids))}"
@@ -468,6 +526,362 @@ def _cel_mismatch_detail(expr: str, why: str) -> str:
     return f"cel:mismatch expr={expr!r}" + (f" ({why})" if why else "")
 
 
+class _FilterRecord:
+    """Static filter verdicts for one request shape: per device key,
+    ``None`` (survivor) or ``(stage, detail)`` for the rejecting stage.
+    The shape is (device class, CEL selector expressions, programmatic
+    selector signature) — everything about a request that is stable
+    across solves. Reservations and health gating are deliberately NOT
+    part of the record; they are applied per solve on top."""
+
+    __slots__ = ("class_name", "cel_exprs", "prog_selectors", "by_device")
+
+    def __init__(self, class_name, cel_exprs, prog_selectors):
+        self.class_name = class_name
+        self.cel_exprs = cel_exprs
+        self.prog_selectors = prog_selectors
+        self.by_device: dict[tuple, Optional[tuple[str, str]]] = {}
+
+
+class InventoryIndex:
+    """Persistent, generation-keyed view of the published inventory.
+
+    Replaces the per-solve ``_inventory()`` pass: the flattened device
+    dicts, shared-counter capacities, per-slice topology metadata, and
+    the per-request-shape static filter verdicts all survive across
+    solves. ``refresh()`` probes slice (name, resourceVersion)
+    signatures via ``KubeClient.list_meta`` — O(#slices), no device
+    copying — and rebuilds only the pools whose slices changed,
+    re-filtering only those pools' devices into every cached
+    :class:`_FilterRecord`. ``generation`` increments on every applied
+    delta, so solve records can say which inventory they solved against.
+
+    All access runs under the owning allocator's lock.
+    """
+
+    def __init__(self, allocator: "ReferenceAllocator"):
+        self._alloc = allocator
+        self.generation = 0
+        self.devices: list[dict] = []
+        self.by_key: dict[tuple[str, str], dict] = {}
+        self.capacity: dict[tuple[str, str, str], int] = {}
+        # Observability: list_meta probes vs pools actually rebuilt, and
+        # CEL evaluated eagerly while applying deltas (bench + tests).
+        self.probes = 0
+        self.rebuilds = 0
+        self.refresh_cel_evaluations = 0
+        self._sig: dict[str, str] = {}
+        self._slice_pool: dict[str, str] = {}         # slice name -> pool
+        self._pool_slices: dict[str, list[dict]] = {}  # pool -> slice dicts
+        self._pool_devices: dict[str, list[dict]] = {}
+        self._filters: dict[tuple, _FilterRecord] = {}
+        # sliceId -> (MeshShape, {coord tuple: chip device dict})
+        self._slice_meta: dict[str, tuple[MeshShape, dict]] = {}
+
+    # -- refresh ----------------------------------------------------------
+
+    def refresh(self, force: bool = False) -> bool:
+        """Bring the index up to date; returns True when anything
+        changed. ``force`` rebuilds everything (the from-scratch
+        baseline), dropping every cached verdict."""
+        client, api = self._alloc.client, self._alloc.api
+        self.probes += 1
+        sig = dict(client.list_meta(api.slices))
+        if not force and sig == self._sig:
+            return False
+        slices = [
+            api.slice_from_wire(s)
+            for s in client.list(api.slices)
+            if s["spec"].get("driver") == self._alloc.driver_name
+        ]
+        by_pool: dict[str, list[dict]] = {}
+        slice_pool: dict[str, str] = {}
+        for s in slices:
+            pool = s["spec"]["pool"]["name"]
+            by_pool.setdefault(pool, []).append(s)
+            name = (s.get("metadata") or {}).get("name", "")
+            if name:
+                slice_pool[name] = pool
+        if force:
+            affected = set(by_pool) | set(self._pool_devices)
+            self._filters.clear()
+        else:
+            changed = {
+                n for n in set(sig) | set(self._sig)
+                if sig.get(n) != self._sig.get(n)
+            }
+            affected = {
+                self._slice_pool[n] for n in changed if n in self._slice_pool
+            } | {
+                slice_pool[n] for n in changed if n in slice_pool
+            }
+        self._sig = sig
+        self._slice_pool = slice_pool
+        if not affected:
+            # Foreign-driver churn only: signatures moved, our pools
+            # did not.
+            return False
+        for pool in sorted(affected):
+            self._rebuild_pool(pool, by_pool.get(pool, []))
+        self._reflatten()
+        self.generation += 1
+        return True
+
+    def _rebuild_pool(self, pool: str, pool_slices: list[dict]) -> None:
+        self.rebuilds += 1
+        old = self._pool_devices.pop(pool, [])
+        old_keys = [d["_key"] for d in old]
+        self._pool_slices.pop(pool, None)
+        for key in [k for k in self.capacity if k[0] == pool]:
+            del self.capacity[key]
+        new_devs: list[dict] = []
+        if pool_slices:
+            # Highest pool generation wins — a half-rolled-out republish
+            # must not double-count devices.
+            gen = max(s["spec"]["pool"]["generation"] for s in pool_slices)
+            live = sorted(
+                (s for s in pool_slices
+                 if s["spec"]["pool"]["generation"] == gen),
+                key=lambda s: (s.get("metadata") or {}).get("name", ""),
+            )
+            self._pool_slices[pool] = live
+            for s in live:
+                for cs in s["spec"].get("sharedCounters", []):
+                    for cname, cval in cs.get("counters", {}).items():
+                        self.capacity[(pool, cs["name"], cname)] = int(
+                            cval["value"]
+                        )
+            for s in live:
+                for dev in s["spec"].get("devices", []):
+                    new_devs.append(self._build_device(pool, s, dev))
+            for d in new_devs:
+                self._finalize_device(d)
+            self._pool_devices[pool] = new_devs
+        # Update every cached filter record for just this pool's delta:
+        # stale verdicts out, fresh devices evaluated in. A record whose
+        # selectors cannot be evaluated any more (CEL error, vanished
+        # device class) is dropped and will rebuild — and raise its
+        # typed failure — on the next solve that wants it.
+        for fkey, rec in list(self._filters.items()):
+            for k in old_keys:
+                rec.by_device.pop(k, None)
+            try:
+                for d in new_devs:
+                    rec.by_device[d["_key"]] = self.static_verdict(
+                        d, rec.class_name, rec.prog_selectors,
+                        rec.cel_exprs, on_cel_miss=self._count_refresh_cel,
+                    )
+            except AllocationError:
+                del self._filters[fkey]
+
+    def _count_refresh_cel(self) -> None:
+        self.refresh_cel_evaluations += 1
+
+    def _build_device(self, pool: str, s: dict, dev: dict) -> dict:
+        basic = dev.get("basic", {})
+        d = {
+            "pool": pool,
+            "node": s["spec"].get("nodeName", ""),
+            "node_selector": s["spec"].get("nodeSelector"),
+            "name": dev["name"],
+            "attributes": basic.get("attributes", {}),
+            "capacity": basic.get("capacity", {}),
+            "consumes": basic.get("consumesCounters", []),
+        }
+        d["_key"] = (pool, dev["name"])
+        attrs = d["attributes"]
+        d["_type"] = _attr_value(attrs, "type")
+        d["_healthy"] = _attr_value(attrs, "healthy")
+        d["_slice_id"] = _attr_value(attrs, "sliceId")
+        coord = _attr_value(attrs, "coord")
+        d["_coord"] = Coord.parse(coord) if coord is not None else None
+        d["_consumes"] = [
+            (pool, cc["counterSet"], cname, int(cval["value"]))
+            for cc in d["consumes"]
+            for cname, cval in cc.get("counters", {}).items()
+        ]
+        d["_cel"] = {}
+        return d
+
+    def _finalize_device(self, d: dict) -> None:
+        """Invalid-slice detection (undeclared counters), against the
+        pool's freshly rebuilt capacity."""
+        missing = [
+            (cset, cname)
+            for _, cset, cname, _ in d["_consumes"]
+            if (d["pool"], cset, cname) not in self.capacity
+        ]
+        if missing:
+            d["invalid"] = True
+            warned = self._alloc._warned_invalid
+            if d["_key"] not in warned:
+                warned.add(d["_key"])
+                logger.warning(
+                    "device %r in pool %r consumes undeclared counters "
+                    "%s; treating device as unallocatable",
+                    d["name"], d["pool"], missing,
+                )
+
+    def _reflatten(self) -> None:
+        ordered = []
+        for pool in sorted(self._pool_devices):
+            ordered.extend(self._pool_devices[pool])
+        self.devices = ordered
+        self.by_key = {d["_key"]: d for d in ordered}
+        meta: dict[str, tuple[MeshShape, dict]] = {}
+        coords: dict[str, dict] = {}
+        for d in ordered:
+            if d["_type"] == "chip" and d["_coord"] is not None \
+                    and d["_slice_id"]:
+                coords.setdefault(str(d["_slice_id"]), {})[
+                    d["_coord"].as_tuple()
+                ] = d
+        for slice_id, cells in coords.items():
+            shape = MeshShape(
+                max(c[0] for c in cells) + 1,
+                max(c[1] for c in cells) + 1,
+                max(c[2] for c in cells) + 1,
+            )
+            meta[slice_id] = (shape, cells)
+        self._slice_meta = meta
+
+    # -- reading ----------------------------------------------------------
+
+    def slice_meta(
+        self, slice_id
+    ) -> Optional[tuple[MeshShape, dict]]:
+        """(mesh shape, {coord tuple -> chip device}) for a published
+        ICI slice, or None when it publishes no grounded chip coords."""
+        return self._slice_meta.get(str(slice_id))
+
+    def slice_ids(self) -> list[str]:
+        return sorted(self._slice_meta)
+
+    # -- static filtering -------------------------------------------------
+
+    def cel_on(self, d: dict, expr: str, on_miss=None) -> tuple[bool, str]:
+        """CEL verdict for one (expression, device), cached on the device
+        dict — rebuilt devices shed their cache with their dict. CelError
+        maps to the allocator's typed cel-error contract."""
+        cache = d["_cel"]
+        hit = cache.get(expr)
+        if hit is None:
+            if on_miss is not None:
+                on_miss()
+            try:
+                hit = cel_evaluate_detailed(
+                    expr, self._alloc.driver_name, d["attributes"],
+                    d.get("capacity"),
+                )
+            except CelError as e:
+                raise AllocationError(
+                    f"invalid CEL selector: {e}",
+                    reason=REASON_CEL_ERROR,
+                ) from e
+            cache[expr] = hit
+        return hit
+
+    def class_verdict(
+        self, class_name: str, d: dict, on_miss=None
+    ) -> tuple[bool, str]:
+        device_classes = self._alloc.device_classes
+        if device_classes is not None:
+            exprs = device_classes.get(class_name)
+            if exprs is None:
+                raise AllocationError(
+                    f"unknown device class {class_name!r}",
+                    reason=REASON_UNKNOWN_CLASS,
+                )
+            for e in exprs:
+                ok, why = self.cel_on(d, e, on_miss)
+                if not ok:
+                    return False, _cel_mismatch_detail(e, why)
+            return True, ""
+        dtype = DEVICE_CLASS_TYPES.get(class_name)
+        if dtype is None:
+            raise AllocationError(
+                f"unknown device class {class_name!r}",
+                reason=REASON_UNKNOWN_CLASS,
+            )
+        if d["_type"] != dtype:
+            return False, f"class:device type {d['_type']!r} != {dtype!r}"
+        return True, ""
+
+    def static_verdict(
+        self, d: dict, class_name: str, prog_selectors, cel_exprs,
+        on_cel_miss=None, stage_seconds: Optional[dict] = None,
+    ) -> Optional[tuple[str, str]]:
+        """The request-independent-of-state filter pipeline for one
+        device: invalid-slice -> class CEL -> request selectors. Returns
+        (stage, detail) for a rejection, None for a survivor. With
+        ``stage_seconds`` the per-stage cost is accumulated (the
+        cache-build pass keeps the PR-4 stage-latency contract)."""
+        t = time.perf_counter()
+        invalid = d.get("invalid", False)
+        if stage_seconds is not None:
+            stage_seconds[STAGE_INVALID_SLICE] += time.perf_counter() - t
+        if invalid:
+            return (
+                STAGE_INVALID_SLICE,
+                "slice:device consumes counters its slice never declared",
+            )
+        t = time.perf_counter()
+        ok, why = self.class_verdict(class_name, d, on_cel_miss)
+        if stage_seconds is not None:
+            stage_seconds[STAGE_CLASS_CEL] += time.perf_counter() - t
+        if not ok:
+            return (STAGE_CLASS_CEL, why)
+        t = time.perf_counter()
+        why = ""
+        for s in prog_selectors:
+            if not s.matches(d["attributes"]):
+                why = (
+                    f"selector:{s.attribute} {s.op} {s.value!r} mismatch"
+                )
+                break
+        if not why:
+            for e in cel_exprs:
+                ok, cel_why = self.cel_on(d, e, on_cel_miss)
+                if not ok:
+                    why = _cel_mismatch_detail(e, cel_why)
+                    break
+        if stage_seconds is not None:
+            stage_seconds[STAGE_REQUEST_CEL] += time.perf_counter() - t
+        if why:
+            return (STAGE_REQUEST_CEL, why)
+        return None
+
+    def filter_record(
+        self, class_name: str, prog_selectors, cel_exprs,
+        on_cel_miss=None, stage_seconds: Optional[dict] = None,
+    ) -> _FilterRecord:
+        """The cached static verdicts for a request shape, building (and
+        persisting) them on first sight. The build pass covers the WHOLE
+        index, not just a node scope — the record must be reusable by
+        any later solve."""
+        prog_sig = tuple(
+            (s.attribute, s.op, repr(s.value)) for s in prog_selectors
+        )
+        key = (class_name, tuple(cel_exprs), prog_sig)
+        rec = self._filters.get(key)
+        if rec is not None:
+            # LRU touch (dicts iterate in insertion order).
+            del self._filters[key]
+            self._filters[key] = rec
+            return rec
+        rec = _FilterRecord(class_name, list(prog_selectors),
+                            list(cel_exprs))
+        for d in self.devices:
+            rec.by_device[d["_key"]] = self.static_verdict(
+                d, class_name, prog_selectors, cel_exprs,
+                on_cel_miss=on_cel_miss, stage_seconds=stage_seconds,
+            )
+        while len(self._filters) >= MAX_FILTER_RECORDS:
+            self._filters.pop(next(iter(self._filters)))
+        self._filters[key] = rec
+        return rec
+
+
 class ReferenceAllocator:
     """Allocates claims against published ResourceSlices."""
 
@@ -480,6 +894,8 @@ class ReferenceAllocator:
         registry: Optional[Registry] = None,
         recorder=None,
         max_backtrack_steps: Optional[int] = None,
+        incremental: bool = True,
+        placement_scoring: Optional[bool] = None,
     ):
         """``device_classes`` maps DeviceClass name → CEL selector
         expressions (from the class spec). When given, class membership is
@@ -493,7 +909,12 @@ class ReferenceAllocator:
         Warning on the claim for every failed solve.
         ``max_backtrack_steps`` bounds the search (default
         ``TPU_DRA_MAX_BACKTRACK_STEPS`` env or
-        ``DEFAULT_MAX_BACKTRACK_STEPS``).
+        ``DEFAULT_MAX_BACKTRACK_STEPS``). ``incremental=False`` disables
+        the persistent inventory index — every solve rebuilds and
+        re-filters from scratch (the bench baseline; production wants the
+        default). ``placement_scoring`` toggles the topology-aware
+        best-fit scorer (default: ``TPU_DRA_PLACEMENT_SCORING`` env, on
+        unless set to ``0``); off means first-fit in inventory order.
         """
         self.client = client
         self.driver_name = driver_name
@@ -505,7 +926,19 @@ class ReferenceAllocator:
                 "TPU_DRA_MAX_BACKTRACK_STEPS", DEFAULT_MAX_BACKTRACK_STEPS
             ))
         self.max_backtrack_steps = max_backtrack_steps
-        self._lock = threading.Lock()
+        self.incremental = incremental
+        if placement_scoring is None:
+            placement_scoring = os.environ.get(
+                "TPU_DRA_PLACEMENT_SCORING", "1"
+            ) != "0"
+        self.placement_scoring = placement_scoring
+        # A DefragPlanner (kube/defrag.py) attaches itself here; gang/
+        # shortfall unsats then get a read-only migration plan computed.
+        self.defrag = None
+        # Re-entrant: snapshot() holds the lock across a batch while the
+        # per-claim allocate() calls re-enter it.
+        self._lock = threading.RLock()
+        self._snapshot_depth = 0
         reg = registry if registry is not None else Registry()
         self._m_attempts = Counter(
             "tpu_dra_allocation_attempts_total",
@@ -541,8 +974,12 @@ class ReferenceAllocator:
                 "TPU_DRA_ALLOC_DECISION_BUFFER", DEFAULT_DECISION_BUFFER
             ))
         )
-        # (pool, device) -> claim uid holding it
+        # (pool, device) -> claim uid holding it. reservation_version
+        # bumps on every mutation — cheap change detection for the
+        # defrag planner's retry dedup (hashing 10k reservations per
+        # unsat would cost more than the planning it avoids).
         self._reservations: dict[tuple[str, str], str] = {}
+        self.reservation_version = 0
         # (pool, counter set, counter) -> amount consumed by reservations.
         self._consumed: dict[tuple[str, str, str], int] = {}
         # claim uid -> [(pool, counter set, counter, amount)] for release.
@@ -550,70 +987,42 @@ class ReferenceAllocator:
         # (pool, device) pairs already warned about misconfigured counters,
         # so a static slice defect is diagnosed once, not per allocate().
         self._warned_invalid: set[tuple[str, str]] = set()
+        # The persistent inventory index (see module docstring): flattened
+        # devices, capacities, topology metadata, and static filter
+        # verdicts, invalidated by ResourceSlice deltas only.
+        self.index = InventoryIndex(self)
 
     # -- inventory ---------------------------------------------------------
 
     def _inventory(self) -> tuple[list[dict], dict[tuple[str, str, str], int]]:
-        """One pass over the current slices (highest pool generation only):
-        flattened (pool, node, device) inventory + shared-counter
-        capacities keyed (pool, counter set, counter)."""
-        slices = [
-            self.api.slice_from_wire(s)
-            for s in self.client.list(self.api.slices)
-            if s["spec"].get("driver") == self.driver_name
-        ]
-        max_gen: dict[str, int] = {}
-        for s in slices:
-            pool = s["spec"]["pool"]
-            max_gen[pool["name"]] = max(
-                max_gen.get(pool["name"], 0), pool["generation"]
-            )
-        devices = []
-        capacity: dict[tuple[str, str, str], int] = {}
-        for s in slices:
-            pool = s["spec"]["pool"]
-            if pool["generation"] != max_gen[pool["name"]]:
-                continue
-            for dev in s["spec"].get("devices", []):
-                devices.append(
-                    {
-                        "pool": pool["name"],
-                        "node": s["spec"].get("nodeName", ""),
-                        "node_selector": s["spec"].get("nodeSelector"),
-                        "name": dev["name"],
-                        "attributes": dev.get("basic", {}).get("attributes", {}),
-                        "capacity": dev.get("basic", {}).get("capacity", {}),
-                        "consumes": dev.get("basic", {}).get(
-                            "consumesCounters", []
-                        ),
-                    }
-                )
-            for cs in s["spec"].get("sharedCounters", []):
-                for cname, cval in cs.get("counters", {}).items():
-                    capacity[(pool["name"], cs["name"], cname)] = int(
-                        cval["value"]
-                    )
-        # A device consuming a counter its slice never declared is a
-        # misconfigured slice; the upstream DRA allocator treats such a
-        # device as invalid. Flag it ONCE here — not in the solver's
-        # backtracking hot path, which would re-diagnose (and re-log) the
-        # same static defect per candidate probe.
-        for dev in devices:
-            missing = [
-                (cset, cname)
-                for _, cset, cname, _ in _consumption_entries(dev)
-                if (dev["pool"], cset, cname) not in capacity
-            ]
-            if missing:
-                dev["invalid"] = True
-                if (dev["pool"], dev["name"]) not in self._warned_invalid:
-                    self._warned_invalid.add((dev["pool"], dev["name"]))
-                    logger.warning(
-                        "device %r in pool %r consumes undeclared counters "
-                        "%s; treating device as unallocatable",
-                        dev["name"], dev["pool"], missing,
-                    )
-        return devices, capacity
+        """The index-backed inventory: flattened (pool, node, device)
+        dicts + shared-counter capacities keyed (pool, counter set,
+        counter). Refreshes the index first unless a snapshot is pinned
+        (``snapshot()``); with ``incremental=False`` every call rebuilds
+        from scratch."""
+        if self._snapshot_depth == 0:
+            self.index.refresh(force=not self.incremental)
+        return self.index.devices, self.index.capacity
+
+    @contextlib.contextmanager
+    def snapshot(self):
+        """Pin ONE refreshed inventory snapshot across several solves.
+
+        The batch path and the elastic descending re-solve both probe
+        many candidate solutions against the same moment-in-time
+        inventory; re-probing the apiserver per attempt buys nothing but
+        latency (and lets the inventory shift mid-descent). Re-entrant
+        and lock-holding: a snapshot serializes against concurrent
+        solves by construction. Reservations still move inside a
+        snapshot — only the published inventory is pinned.
+        """
+        with self._lock:
+            self.index.refresh(force=not self.incremental)
+            self._snapshot_depth += 1
+            try:
+                yield self.index
+            finally:
+                self._snapshot_depth -= 1
 
     # -- decision record ---------------------------------------------------
 
@@ -743,6 +1152,20 @@ class ReferenceAllocator:
                     )
                 self._m_unsat.inc(reason=expl.reason)
                 sp.set_tag("reason", expl.reason)
+                # Fragmentation diagnosis: a gang/shortfall unsat on a
+                # fleet whose free capacity would fit the claim gets a
+                # read-only migration plan (kube/defrag.py). Planning is
+                # best-effort; it must never turn an unsat into a crash.
+                if self.defrag is not None and expl.reason in (
+                    REASON_SHORTFALL, STAGE_GANG,
+                ):
+                    try:
+                        self.defrag.note_unsat(
+                            claim, expl, selectors=selectors,
+                            require_healthy=require_healthy,
+                        )
+                    except Exception:
+                        logger.exception("defrag planning failed")
                 raise
             if self._backtrack_steps:
                 self._m_backtracks.inc(self._backtrack_steps)
@@ -751,6 +1174,7 @@ class ReferenceAllocator:
             self._m_attempts.inc(result="ok")
             sp.set_tag("devices", len(picked_devs))
             uid = claim["metadata"]["uid"]
+            self.reservation_version += 1
             for r, d in zip(results, picked_devs):
                 if r["request"] in admin_reqs:
                     continue
@@ -770,6 +1194,62 @@ class ReferenceAllocator:
             }
         }
         return claim
+
+    def allocate_batch(
+        self,
+        claims: list[dict],
+        node_name: Optional[str] = None,
+        selectors_by_claim: Optional[dict[str, dict[str, list["Selector"]]]] = None,
+        require_healthy: bool = False,
+    ) -> list[tuple[dict, Optional[AllocationError]]]:
+        """Solve a queue of pending claims as one batch.
+
+        All claims share a single index snapshot (one inventory probe,
+        one filter-cache warmup) and solve in descending constrainedness
+        order — largest device ask first, constraint count as the
+        tie-break — because a big gang placed after the singles have
+        shredded the mesh is a self-inflicted ``gang`` unsat. Every
+        claim still runs through :meth:`allocate`, so per-claim funnels,
+        metrics, and ``/debug/allocations`` records are emitted exactly
+        as in the one-at-a-time path.
+
+        Returns ``[(claim, error-or-None)]`` in the INPUT order;
+        successfully allocated claims carry ``status.allocation``.
+        ``selectors_by_claim`` maps claim uid -> the per-request Selector
+        lists ``allocate`` takes.
+        """
+        selectors_by_claim = selectors_by_claim or {}
+
+        def constrainedness(claim: dict) -> tuple[int, int]:
+            spec = claim.get("spec", {}).get("devices", {})
+            wanted = 0
+            for r in spec.get("requests", []):
+                if r.get("adminAccess"):
+                    continue
+                if r.get("allocationMode", "ExactCount") == "ExactCount":
+                    wanted += int(r.get("count", 1))
+            return (wanted, len(spec.get("constraints", [])))
+
+        order = sorted(
+            range(len(claims)),
+            key=lambda i: constrainedness(claims[i]),
+            reverse=True,
+        )
+        outcomes: list[Optional[AllocationError]] = [None] * len(claims)
+        with self.snapshot():
+            for i in order:
+                claim = claims[i]
+                uid = claim.get("metadata", {}).get("uid", "")
+                try:
+                    self.allocate(
+                        claim,
+                        node_name=node_name,
+                        selectors=selectors_by_claim.get(uid),
+                        require_healthy=require_healthy,
+                    )
+                except AllocationError as e:
+                    outcomes[i] = e
+        return [(claims[i], outcomes[i]) for i in range(len(claims))]
 
     def _carry_config(self, spec: dict) -> list[dict]:
         """Claim-spec configs become FromClaim allocation configs (the
@@ -812,57 +1292,16 @@ class ReferenceAllocator:
         # Counters consumed by the in-progress solution, on top of the
         # amounts already reserved by other claims.
         tentative: dict[tuple[str, str, str], int] = {}
-        # Per-solve memos: (expression, device identity) → (ok, why_not)
-        # and (request name, include_reserved) → candidate list. Both are
-        # sound because everything they read — inventory, reservations,
-        # selectors — is frozen for the duration of the solve.
-        cel_memo: dict[tuple, tuple[bool, str]] = {}
+        # Per-solve candidate memo: (request name, include_reserved) →
+        # candidate list — the search re-enters candidates() on every
+        # probe. The static filtering BEHIND it (class/request CEL,
+        # invalid-slice) persists across solves in the InventoryIndex;
+        # only health and reservations are re-checked here.
         cand_memo: dict[tuple, list] = {}
+        index = self.index
 
-        def cel_matches(expr: str, d: dict) -> tuple[bool, str]:
-            key = (expr, id(d))
-            hit = cel_memo.get(key)
-            if hit is None:
-                expl.cel_evaluations += 1
-                try:
-                    hit = cel_evaluate_detailed(
-                        expr, self.driver_name, d["attributes"],
-                        d.get("capacity"),
-                    )
-                except CelError as e:
-                    # Bad expressions make the claim unallocatable,
-                    # matching the solver's error contract for malformed
-                    # specs; the CelError names the offending expression.
-                    raise AllocationError(
-                        f"invalid CEL selector: {e}",
-                        reason=REASON_CEL_ERROR,
-                    ) from e
-                cel_memo[key] = hit
-            return hit
-
-        def class_matches(class_name: str, d: dict) -> tuple[bool, str]:
-            if self.device_classes is not None:
-                exprs = self.device_classes.get(class_name)
-                if exprs is None:
-                    raise AllocationError(
-                        f"unknown device class {class_name!r}",
-                        reason=REASON_UNKNOWN_CLASS,
-                    )
-                for e in exprs:
-                    ok, why = cel_matches(e, d)
-                    if not ok:
-                        return False, _cel_mismatch_detail(e, why)
-                return True, ""
-            dtype = DEVICE_CLASS_TYPES.get(class_name)
-            if dtype is None:
-                raise AllocationError(
-                    f"unknown device class {class_name!r}",
-                    reason=REASON_UNKNOWN_CLASS,
-                )
-            have = _attr_value(d["attributes"], "type")
-            if have != dtype:
-                return False, f"class:device type {have!r} != {dtype!r}"
-            return True, ""
+        def on_cel_miss():
+            expl.cel_evaluations += 1
 
         def candidates(req, include_reserved=False):
             memo_key = (req["name"], bool(include_reserved))
@@ -875,95 +1314,71 @@ class ReferenceAllocator:
                 if "cel" in s
             ]
             admin = req.get("adminAccess", False)
+            # Static verdicts, cached across solves; the build pass (a
+            # cold request shape, or a from-scratch solve) records exact
+            # per-stage latencies through static_verdict. CelError and
+            # unknown-class surface from here as typed AllocationErrors.
+            stage_t = dict.fromkeys(_CANDIDATE_STAGES, 0.0)
+            rec = index.filter_record(
+                req.get("deviceClassName", ""),
+                selectors.get(req["name"], []),
+                cel_selectors,
+                on_cel_miss=on_cel_miss,
+                stage_seconds=stage_t,
+            )
             # Only the primary pass populates the funnel: the
             # include_reserved variant exists solely for allocationMode=
             # All's completeness check.
             record = not include_reserved
             if record:
                 expl.funnel(req["name"]).entering = len(inventory)
-            stage_t = dict.fromkeys(_CANDIDATE_STAGES, 0.0)
+            t0 = time.perf_counter()
             out = []
+            reservations = self._reservations
             for d in inventory:
-                dk = (d["pool"], d["name"])
-                t = time.perf_counter()
-                invalid = d.get("invalid", False)
-                stage_t[STAGE_INVALID_SLICE] += time.perf_counter() - t
-                if invalid:
-                    # Misconfigured slice: unallocatable, and it must not
-                    # inflate allocationMode=All's target count.
+                dk = d["_key"]
+                verdict = rec.by_device.get(dk)
+                if verdict is not None:
+                    # Misconfigured slice / class CEL / request CEL —
+                    # replayed from the cached verdict so the funnel
+                    # reads identically to a from-scratch solve.
                     if record:
-                        expl.reject(
-                            req["name"], STAGE_INVALID_SLICE, dk,
-                            "slice:device consumes counters its slice "
-                            "never declared",
-                        )
-                    continue
-                t = time.perf_counter()
-                ok, why = class_matches(req.get("deviceClassName", ""), d)
-                stage_t[STAGE_CLASS_CEL] += time.perf_counter() - t
-                if not ok:
-                    if record:
-                        expl.reject(req["name"], STAGE_CLASS_CEL, dk, why)
-                    continue
-                t = time.perf_counter()
-                why = ""
-                for s in selectors.get(req["name"], []):
-                    if not s.matches(d["attributes"]):
-                        why = (
-                            f"selector:{s.attribute} {s.op} "
-                            f"{s.value!r} mismatch"
-                        )
-                        break
-                if not why:
-                    for e in cel_selectors:
-                        ok, cel_why = cel_matches(e, d)
-                        if not ok:
-                            why = _cel_mismatch_detail(e, cel_why)
-                            break
-                stage_t[STAGE_REQUEST_CEL] += time.perf_counter() - t
-                if why:
-                    if record:
-                        expl.reject(req["name"], STAGE_REQUEST_CEL, dk, why)
+                        expl.reject(req["name"], verdict[0], dk,
+                                    verdict[1])
                     continue
                 # Health gate (opt-in): the elastic re-solve must steer
                 # around chips the node marked degraded — a gone chip is
                 # already absent from the republished slice, but a wedged
                 # one stays published with healthy=false and would
                 # otherwise be picked right back.
-                if require_healthy:
-                    t = time.perf_counter()
-                    healthy = _attr_value(d["attributes"], "healthy")
-                    stage_t[STAGE_UNHEALTHY] += time.perf_counter() - t
-                    if healthy is False:
-                        if record:
-                            expl.reject(
-                                req["name"], STAGE_UNHEALTHY, dk,
-                                "unhealthy:published healthy=false",
-                            )
-                        continue
+                if require_healthy and d["_healthy"] is False:
+                    if record:
+                        expl.reject(
+                            req["name"], STAGE_UNHEALTHY, dk,
+                            "unhealthy:published healthy=false",
+                        )
+                    continue
                 # Ordinary requests never see reserved devices; admin
                 # requests observe them (monitoring over live workloads).
                 # Checked LAST so the funnel reads "the right devices
                 # exist but are held", not "nothing matched".
-                t = time.perf_counter()
-                reserved = (
-                    not (admin or include_reserved)
-                    and dk in self._reservations
-                )
-                stage_t[STAGE_RESERVED] += time.perf_counter() - t
-                if reserved:
+                if not (admin or include_reserved) and dk in reservations:
                     if record:
                         expl.reject(
                             req["name"], STAGE_RESERVED, dk,
                             "reserved:held by claim "
-                            f"{self._reservations[dk]}",
+                            f"{reservations[dk]}",
                         )
                     continue
                 out.append(d)
+            # Replay + per-solve gates run as ONE fused pass (that is the
+            # hot-path point); its cost is amortized evenly across the
+            # candidate stages, on top of the exact build-pass times.
+            share = (time.perf_counter() - t0) / len(_CANDIDATE_STAGES)
             if record:
                 expl.funnel(req["name"]).survivors = len(out)
-                for stage, secs in stage_t.items():
-                    expl.add_stage_seconds(stage, secs)
+                for stage in _CANDIDATE_STAGES:
+                    expl.add_stage_seconds(stage, stage_t[stage] + share)
             cand_memo[memo_key] = out
             return out
 
@@ -1083,6 +1498,41 @@ class ReferenceAllocator:
                     reason=REASON_UNKNOWN_MODE,
                 )
             expl.funnel(req["name"]).wanted = count
+            # Topology scoring: order candidates so the DFS lands the
+            # gang best-fit into the smallest free contiguous sub-mesh
+            # (corner-biased) instead of first-fit in inventory order.
+            # Pure reordering — the search stays complete, so anything
+            # first-fit could satisfy, the scored order can too. The one
+            # exception is deliberate: for a pure chip gang (every
+            # candidate a coordinate-grounded chip, count >= 2) the box
+            # enumeration is COMPLETE — a contiguous sub-mesh IS a dense
+            # axis-aligned box — so "no box anywhere" proves the gang
+            # unsat and short-circuits what would otherwise be an
+            # exponential doomed backtracking search.
+            if (
+                self.placement_scoring and not admin
+                and mode == "ExactCount" and len(cands) > count > 0
+            ):
+                t = time.perf_counter()
+                cands, placement, provably_unsat = self._score_placement(
+                    req["name"], cands, count, match_groups
+                )
+                expl.add_stage_seconds(
+                    STAGE_GANG, time.perf_counter() - t
+                )
+                if placement is not None:
+                    expl.placements[req["name"]] = placement
+                if provably_unsat and count >= 2:
+                    last = cands[-1]
+                    expl.reject(
+                        req["name"], STAGE_GANG,
+                        (last["pool"], last["name"]),
+                        f"gang:no free contiguous {count}-chip sub-mesh "
+                        "on any slice (scored placement exhausted every "
+                        "box)",
+                    )
+                    expl.note_request_failure(ri, req["name"])
+                    return False
 
             def pick_n(chosen: list) -> bool:
                 if len(chosen) == count:
@@ -1187,6 +1637,17 @@ class ReferenceAllocator:
                 f"no satisfying allocation found: {detail}",
                 reason=reason,
             )
+        if expl.placements:
+            # Did the search land on the scorer's box, or did later
+            # stages (counters, constraints, other requests) push it
+            # elsewhere? /debug/allocations should say which.
+            picked_by_req: dict[str, set] = {}
+            for name, dev in picked:
+                picked_by_req.setdefault(name, set()).add(dev["name"])
+            for rname, pl in expl.placements.items():
+                pl["applied"] = (
+                    set(pl.get("devices", ())) == picked_by_req.get(rname)
+                )
         return [
             {
                 "request": name,
@@ -1207,10 +1668,159 @@ class ReferenceAllocator:
                 return False
         return True
 
+    # -- topology scoring --------------------------------------------------
+
+    def _score_placement(
+        self, req_name: str, cands: list[dict], count: int, match_groups,
+    ) -> tuple[list[dict], Optional[dict], bool]:
+        """Best-fit gang placement over the free ICI topology.
+
+        Enumerates every dense ``count``-cell box over each slice's free
+        candidate cells and scores it ``(free-component size, corner
+        distance)``, both minimized: the smallest free contiguous
+        sub-mesh that still fits the gang is consumed first (ParvaGPU's
+        best-fit spatial packing), and within it the box hugs a mesh
+        corner, so the remaining free cells stay one large unbroken
+        region instead of a ring. Boxes that would break a
+        ``matchAttribute`` group containing this request are skipped
+        up front rather than discovered by backtracking.
+
+        Returns ``(candidates, placement, provably_unsat)``: the
+        candidate list reordered (box cells first) plus the placement
+        record for ``/debug/allocations``. ``(cands, None, False)``
+        when the request is not scorable (non-chip devices, missing or
+        duplicated coords) — the solver then behaves exactly as before.
+        ``provably_unsat`` is True only when the enumeration covered the
+        ENTIRE candidate space (every candidate a scorable chip) and no
+        dense box exists: since a contiguous sub-mesh is exactly a
+        dense axis-aligned box on one slice, the caller may fail the
+        gang immediately instead of backtracking through doomed
+        combinations.
+        """
+        chips = [
+            d for d in cands
+            if d.get("_type") == "chip" and d.get("_coord") is not None
+            and d.get("_slice_id")
+        ]
+        if len(chips) != len(cands) or len(chips) < count:
+            return cands, None, False
+        per_slice: dict[str, list[dict]] = {}
+        for d in chips:
+            per_slice.setdefault(str(d["_slice_id"]), []).append(d)
+        group_attrs = [
+            attr for group, attr in match_groups if req_name in group
+        ]
+        best = None  # (comp size, corner, slice_id, origin, dims, cells)
+        # Best-fit at slice granularity first: slices ordered by free
+        # candidate count ascending, and the scan STOPS at the first
+        # slice that yields any box — the tightest slice that still fits
+        # the gang absorbs it, keeping emptier slices whole for larger
+        # gangs. (Scanning every slice per solve was the allocator's
+        # hottest path at 10k devices; provable unsat still requires —
+        # and gets — the full scan, because no slice yields a box.)
+        ordered_slices = sorted(
+            per_slice.items(), key=lambda kv: (len(kv[1]), kv[0])
+        )
+        for slice_id, devs in ordered_slices:
+            if len(devs) < count:
+                continue
+            meta = self.index.slice_meta(slice_id)
+            if meta is None:
+                continue
+            shape, _ = meta
+            by_coord = {d["_coord"].as_tuple(): d for d in devs}
+            if len(by_coord) != len(devs):
+                return cands, None, False  # duplicated coords: not scorable
+            free = set(by_coord)
+            comp_size: dict[tuple, int] = {}
+            for comp in free_components(free):
+                if len(comp) < count:
+                    continue  # a count-cell box cannot fit there anyway
+                for cell in comp:
+                    comp_size[cell] = len(comp)
+            for dx, dy, dz in box_shapes(count, shape):
+                for ox in range(shape.x - dx + 1):
+                    for oy in range(shape.y - dy + 1):
+                        for oz in range(shape.z - dz + 1):
+                            origin = (ox, oy, oz)
+                            comp = comp_size.get(origin)
+                            if comp is None:
+                                continue
+                            cells = [
+                                (ox + ix, oy + iy, oz + iz)
+                                for ix in range(dx)
+                                for iy in range(dy)
+                                for iz in range(dz)
+                            ]
+                            if not free.issuperset(cells):
+                                continue
+                            if group_attrs and not self._box_uniform(
+                                by_coord, cells, group_attrs
+                            ):
+                                continue
+                            corner = (
+                                min(ox, shape.x - ox - dx)
+                                + min(oy, shape.y - oy - dy)
+                                + min(oz, shape.z - oz - dz)
+                            )
+                            key = (comp, corner, slice_id, origin,
+                                   (dx, dy, dz), cells)
+                            if best is None or key[:4] < best[:4]:
+                                best = key
+                                if comp == count and corner == 0:
+                                    break  # perfect fit; stop searching
+                        else:
+                            continue
+                        break
+                    else:
+                        continue
+                    break
+                if best is not None and best[0] == count and best[1] == 0:
+                    break
+            if best is not None:
+                break  # tightest fitting slice found; emptier ones stay whole
+        if best is None:
+            # Provable only without matchAttribute involvement: a box
+            # skipped for group non-uniformity would fail the solver at
+            # the `constraint` stage, and that terminal reason (not
+            # `gang`) is the explainability contract for it.
+            return cands, None, not group_attrs
+        comp, corner, slice_id, origin, dims, cells = best
+        chosen = {
+            c: d for c, d in (
+                (d["_coord"].as_tuple(), d) for d in per_slice[slice_id]
+            ) if c in set(cells)
+        }
+        ordered = [chosen[c] for c in cells]
+        ordered_keys = {d["_key"] for d in ordered}
+        rest = [d for d in cands if d["_key"] not in ordered_keys]
+        placement = {
+            "strategy": "best-fit",
+            "sliceId": slice_id,
+            "origin": f"{origin[0]},{origin[1]},{origin[2]}",
+            "box": f"{dims[0]}x{dims[1]}x{dims[2]}",
+            "score": {"freeComponent": comp, "cornerDistance": corner},
+            "devices": [d["name"] for d in ordered],
+            "applied": False,
+        }
+        return ordered + rest, placement, False
+
+    @staticmethod
+    def _box_uniform(by_coord, cells, group_attrs) -> bool:
+        """Every matchAttribute group value uniform across the box."""
+        for attr in group_attrs:
+            vals = {
+                _attr_value(by_coord[c]["attributes"], attr) for c in cells
+            }
+            if len(vals) > 1:
+                return False
+        return True
+
     # -- release -----------------------------------------------------------
 
     def deallocate(self, claim_uid: str) -> None:
         with self._lock:
+            self.reservation_version += 1
             self._reservations = {
                 k: v for k, v in self._reservations.items() if v != claim_uid
             }
@@ -1234,6 +1844,7 @@ class ReferenceAllocator:
         are skipped, so the call is idempotent.
         """
         with self._lock:
+            self.reservation_version += 1
             devices, _ = self._inventory()
             by_key = {(d["pool"], d["name"]): d for d in devices}
             for r in results:

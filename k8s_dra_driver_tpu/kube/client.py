@@ -195,6 +195,23 @@ class KubeClient(abc.ABC):
 
     # -- conveniences shared by impls --------------------------------------
 
+    def list_meta(
+        self,
+        gvr: GVR,
+        namespace: str = "",
+        label_selector: str | None = None,
+    ) -> list[tuple[str, str]]:
+        """(name, resourceVersion) pairs for a collection — the cheap
+        change-detection probe behind the allocator's incremental
+        inventory index: comparing signatures per solve must not pay for
+        deep-copying 10k device specs. Default: derived from ``list()``
+        (full cost); ``FakeKubeClient`` overrides with a copy-free scan."""
+        out = []
+        for obj in self.list(gvr, namespace, label_selector):
+            md = obj.get("metadata") or {}
+            out.append((md.get("name", ""), md.get("resourceVersion", "")))
+        return out
+
     def api_group_versions(self, group: str) -> list[str]:
         """Versions the server serves for an API group, preferred first
         (k8s group discovery, GET ``/apis/<group>``). Empty when the group
@@ -380,6 +397,28 @@ class FakeKubeClient(KubeClient):
             if obj is None:
                 raise NotFoundError(f"{gvr.resource}/{name} not found")
             self._notify(gvr, "DELETED", obj)
+
+    def list_meta(
+        self,
+        gvr: GVR,
+        namespace: str = "",
+        label_selector: str | None = None,
+    ) -> list[tuple[str, str]]:
+        # Same filters and fault site as list(), but no deep copies: the
+        # point of the probe is to be cheap at 10k-device inventories.
+        self._maybe_fault("list", gvr, "")
+        with self._lock:
+            out = []
+            for (res, ns, _), obj in sorted(self._store.items()):
+                if res != gvr.resource:
+                    continue
+                if gvr.namespaced and namespace and ns != namespace:
+                    continue
+                if not matches_labels(obj, label_selector):
+                    continue
+                md = obj.get("metadata") or {}
+                out.append((md.get("name", ""), md.get("resourceVersion", "")))
+            return out
 
     def watch(
         self,
@@ -769,7 +808,8 @@ class RealKubeClient(KubeClient):
             url += "?" + urllib.parse.urlencode(query)
         return url
 
-    def _request(self, method: str, url: str, body: dict | None = None) -> dict:
+    def _request(self, method: str, url: str, body: dict | None = None,
+                 accept: str | None = None) -> dict:
         """One API verb, with overload retries: 429/503 responses are
         retried after the server's Retry-After (priority-and-fairness load
         shedding tells clients exactly when to come back; ignoring it turns
@@ -779,7 +819,7 @@ class RealKubeClient(KubeClient):
         reauthed = False
         while True:
             try:
-                out = self._request_once(method, url, body)
+                out = self._request_once(method, url, body, accept=accept)
                 self._m_requests.inc(verb=method, code="2xx")
                 return out
             except ApiError as e:
@@ -823,12 +863,13 @@ class RealKubeClient(KubeClient):
                 )
                 time.sleep(delay)
 
-    def _request_once(self, method: str, url: str, body: dict | None = None) -> dict:
+    def _request_once(self, method: str, url: str, body: dict | None = None,
+                      accept: str | None = None) -> dict:
         self._maybe_refresh_exec()
         self._limiter.acquire()
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(url, data=data, method=method)
-        req.add_header("Accept", "application/json")
+        req.add_header("Accept", accept or "application/json")
         if data is not None:
             req.add_header("Content-Type", "application/json")
         if self.config.token:
@@ -960,6 +1001,45 @@ class RealKubeClient(KubeClient):
     ) -> list[dict]:
         faults.fire("kube.list")
         return self._list_raw(gvr, namespace, label_selector).get("items", [])
+
+    # Content negotiation for metadata-only lists: the apiserver
+    # transcodes any resource list to meta.k8s.io PartialObjectMetadata
+    # when asked — names + resourceVersions without the (large) specs.
+    # The trailing plain type is the fallback for servers/proxies that
+    # ignore the negotiation: they return full objects, which the item
+    # loop below handles identically (metadata is metadata either way).
+    _META_ACCEPT = (
+        "application/json;as=PartialObjectMetadataList;"
+        "g=meta.k8s.io;v=v1,application/json"
+    )
+
+    def list_meta(
+        self,
+        gvr: GVR,
+        namespace: str = "",
+        label_selector: str | None = None,
+    ) -> list[tuple[str, str]]:
+        """Change-detection probe for the allocator's incremental index:
+        a metadata-only list (PartialObjectMetadataList), so polling for
+        slice deltas does not re-download 10k device specs per solve.
+        Any failure falls back to the base full-list derivation — the
+        probe must never be less available than list() itself."""
+        faults.fire("kube.list")
+        query: dict = {}
+        if label_selector:
+            query["labelSelector"] = label_selector
+        try:
+            out = self._request(
+                "GET", self._url(gvr, namespace, query=query or None),
+                accept=self._META_ACCEPT,
+            )
+            return [
+                ((item.get("metadata") or {}).get("name", ""),
+                 (item.get("metadata") or {}).get("resourceVersion", ""))
+                for item in out.get("items", [])
+            ]
+        except ApiError:
+            return super().list_meta(gvr, namespace, label_selector)
 
     def create(self, gvr: GVR, obj: dict, namespace: str = "") -> dict:
         faults.fire("kube.create")

@@ -11,6 +11,7 @@ API server before preparing.
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 import logging
 import threading
@@ -590,10 +591,21 @@ class Driver(NodeServicer):
              "pool": self.config.node_name, "device": name}
             for name, _ in view["devices"]
         ]
+        # The whole descent runs under ONE allocator snapshot: the
+        # republish already happened, so every candidate size must solve
+        # against the same moment-in-time slices — and re-probing the
+        # apiserver per attempt made the descent O(sizes × inventory)
+        # for nothing. Each attempt still emits its own funnel into
+        # /debug/allocations (the snapshot pins inventory, not records).
+        # A FakeAllocator in tests may not implement snapshot();
+        # fall back to the old per-attempt refresh there.
+        snapshot = getattr(
+            self._elastic_allocator, "snapshot", contextlib.nullcontext
+        )
         with self.tracer.span(
             "gang-resize", claim_uid=uid,
             tags={"direction": direction, "reason": reason},
-        ) as span:
+        ) as span, snapshot():
             self._elastic_allocator.deallocate(uid)
             allocated = None
             count = want

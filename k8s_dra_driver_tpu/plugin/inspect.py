@@ -68,6 +68,7 @@ def collect_live(http_url: str, timeout: float = 3.0) -> dict[str, Any]:
     if queued:
         out["queuedSliceRepublishDetail"] = queued
     out.update(_collect_unsat_allocations(http_url, timeout))
+    out.update(_collect_defrag_plans(http_url, timeout))
     return out
 
 
@@ -116,6 +117,40 @@ def _collect_unsat_allocations(
             "hint": RUNBOOK_HINTS.get(reason, ""),
         })
     return {"unsatAllocations": unsat[-keep:]} if unsat else {}
+
+
+def _collect_defrag_plans(
+    http_url: str, timeout: float, keep: int = 3
+) -> dict[str, Any]:
+    """Recent defrag plans from ``/debug/defrag`` — the actionable half
+    of a ``gang``/``shortfall`` unsat. Same error split as the
+    allocations scrape: 404 means no planner runs here (normal), any
+    other failure is surfaced in-band."""
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+            http_url.rstrip("/") + "/debug/defrag", timeout=timeout
+        ) as resp:
+            doc = json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return {}
+        return {"defragPlansError": f"HTTP {e.code}"}
+    except Exception as e:
+        return {"defragPlansError": str(e) or type(e).__name__}
+    plans = [
+        {
+            "claim": f"{(p.get('claim') or {}).get('namespace', '?')}/"
+                     f"{(p.get('claim') or {}).get('name', '?')}",
+            "outcome": p.get("outcome", "?"),
+            "migrations": len(p.get("migrations") or []),
+            "detail": p.get("detail", ""),
+        }
+        for p in (doc.get("plans") or []) if isinstance(p, dict)
+    ]
+    return {"defragPlans": plans[-keep:]} if plans else {}
 
 
 def collect(
@@ -349,6 +384,22 @@ def render(state: dict[str, Any]) -> str:
                     )
                     if u.get("hint"):
                         lines.append(f"    runbook: {u['hint']}")
+            if live.get("defragPlansError"):
+                lines.append(
+                    "  /debug/defrag scrape FAILED "
+                    f"({live['defragPlansError']}) — defrag-plan view "
+                    "unavailable, NOT known-empty"
+                )
+            plans = live.get("defragPlans") or []
+            if plans:
+                lines.append("")
+                lines.append(f"recent defrag plans: {len(plans)}")
+                for p in plans:
+                    lines.append(
+                        f"  {p['claim']}: {p['outcome']} "
+                        f"({p['migrations']} migration(s)) — "
+                        f"{p.get('detail') or 'no detail'}"
+                    )
     return "\n".join(lines)
 
 

@@ -167,6 +167,114 @@ def enumerate_submeshes(
                 yield origin, members
 
 
+def box_shapes(volume: int, within: MeshShape) -> list[tuple[int, int, int]]:
+    """Every axis-aligned box (dx, dy, dz) of exactly ``volume`` cells
+    that fits inside ``within``, most-cubical first.
+
+    This is the gang-shape enumeration behind the allocator's placement
+    scorer and the defrag planner: a claim for N chips is satisfiable by
+    any dense N-cell box, and trying compact shapes first keeps the ICI
+    hop diameter (and therefore collective latency) low.
+    """
+    out = []
+    for dx in range(1, min(volume, within.x) + 1):
+        if volume % dx:
+            continue
+        rem = volume // dx
+        for dy in range(1, min(rem, within.y) + 1):
+            if rem % dy:
+                continue
+            dz = rem // dy
+            if dz <= within.z:
+                out.append((dx, dy, dz))
+    out.sort(key=lambda d: (max(d) - min(d), d))
+    return out
+
+
+def free_components(free: set[tuple[int, int, int]]) -> list[set[tuple[int, int, int]]]:
+    """Connected components of the free cell set under ICI adjacency
+    (6-neighbour). The component a placement lands in is the scorer's
+    best-fit unit: packing a gang into the smallest component that still
+    fits it preserves the larger components for future large gangs."""
+    seen: set[tuple[int, int, int]] = set()
+    comps = []
+    for start in free:
+        if start in seen:
+            continue
+        comp = set()
+        stack = [start]
+        seen.add(start)
+        while stack:
+            x, y, z = stack.pop()
+            comp.add((x, y, z))
+            for nb in ((x + 1, y, z), (x - 1, y, z), (x, y + 1, z),
+                       (x, y - 1, z), (x, y, z + 1), (x, y, z - 1)):
+                if nb in free and nb not in seen:
+                    seen.add(nb)
+                    stack.append(nb)
+        comps.append(comp)
+    return comps
+
+
+def largest_free_submesh(
+    shape: MeshShape, free: set[tuple[int, int, int]]
+) -> int:
+    """Volume of the largest fully-free axis-aligned box in ``shape``.
+
+    The fleet fragmentation metric: under churn this number decays even
+    while total free capacity stays flat, and it bounds the largest gang
+    claim that can still be satisfied without defragmentation. Uses a 3D
+    prefix sum so every (dims, origin) probe is O(1); total cost is
+    O(|dims| * |origins|), fine for per-slice meshes.
+    """
+    if not free:
+        return 0
+    nx, ny, nz = shape.x, shape.y, shape.z
+    # p[x][y][z] = free cells in the [0,x) x [0,y) x [0,z) prefix box.
+    p = [[[0] * (nz + 1) for _ in range(ny + 1)] for _ in range(nx + 1)]
+    for x in range(nx):
+        for y in range(ny):
+            row = p[x + 1][y + 1]
+            prow = p[x][y + 1]
+            srow = p[x + 1][y]
+            drow = p[x][y]
+            for z in range(nz):
+                row[z + 1] = (
+                    (1 if (x, y, z) in free else 0)
+                    + prow[z + 1] + srow[z + 1] + row[z]
+                    - drow[z + 1] - prow[z] - srow[z] + drow[z]
+                )
+
+    def box_free(ox, oy, oz, dx, dy, dz) -> bool:
+        x1, y1, z1 = ox + dx, oy + dy, oz + dz
+        total = (
+            p[x1][y1][z1] - p[ox][y1][z1] - p[x1][oy][z1] - p[x1][y1][oz]
+            + p[ox][oy][z1] + p[ox][y1][oz] + p[x1][oy][oz] - p[ox][oy][oz]
+        )
+        return total == dx * dy * dz
+
+    best = 1  # free is non-empty, so a 1-cell box always exists
+    for dx in range(1, nx + 1):
+        for dy in range(1, ny + 1):
+            for dz in range(1, nz + 1):
+                vol = dx * dy * dz
+                if vol <= best or vol > len(free):
+                    continue
+                hit = False
+                for ox in range(nx - dx + 1):
+                    for oy in range(ny - dy + 1):
+                        for oz in range(nz - dz + 1):
+                            if box_free(ox, oy, oz, dx, dy, dz):
+                                best = vol
+                                hit = True
+                                break
+                        if hit:
+                            break
+                    if hit:
+                        break
+    return best
+
+
 def default_slice_shapes(generation: str, num_chips: int) -> MeshShape:
     """Best-effort physical shape for a slice of `num_chips` chips."""
     spec = GENERATIONS.get(generation, GENERATIONS["v4"])

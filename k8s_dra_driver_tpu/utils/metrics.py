@@ -327,8 +327,10 @@ class MetricsServer:
     otherwise). ``/debug/allocations`` streams the allocator's solve
     decisions (candidate funnels, terminal reasons) as JSONL when a
     provider was registered with ``set_allocations_provider`` (404
-    otherwise). All routes are GET-only; other methods get ``405`` with
-    an ``Allow: GET`` header — the scrape surface mutates nothing.
+    otherwise). ``/debug/defrag`` serves the defrag planner's JSON plan
+    buffer when a provider was registered with ``set_defrag_provider``
+    (404 otherwise). All routes are GET-only; other methods get ``405``
+    with an ``Allow: GET`` header — the scrape surface mutates nothing.
     """
 
     def __init__(self, registry: Registry, host: str = "0.0.0.0",
@@ -337,6 +339,7 @@ class MetricsServer:
         self.tracer = tracer
         self.usage_provider: Optional[Callable] = None
         self.allocations_provider: Optional[Callable] = None
+        self.defrag_provider: Optional[Callable] = None
         registry_ref = registry
         health = self._health = {"ok": True}
         self._ready_checks: dict[str, Callable] = {}
@@ -393,6 +396,25 @@ class MetricsServer:
                             body = (
                                 f"allocations snapshot failed: {e}\n"
                             ).encode()
+                            status = 500
+                            ctype = "text/plain"
+                elif self.path == "/debug/defrag":
+                    provider = server_ref.defrag_provider
+                    if provider is None:
+                        body = b"defrag planning not enabled\n"
+                        status = 404
+                        ctype = "text/plain"
+                    else:
+                        import json as _json
+
+                        try:
+                            body = (
+                                _json.dumps(provider(), sort_keys=True)
+                                + "\n"
+                            ).encode()
+                            ctype = "application/json"
+                        except Exception as e:
+                            body = f"defrag snapshot failed: {e}\n".encode()
                             status = 500
                             ctype = "text/plain"
                 elif self.path == "/healthz":
@@ -494,6 +516,12 @@ class MetricsServer:
         ``ReferenceAllocator.export_allocations_jsonl``) at
         ``/debug/allocations``. Safe to call after ``start()``."""
         self.allocations_provider = provider
+
+    def set_defrag_provider(self, provider: Callable) -> None:
+        """Serve ``provider()`` (a JSON-serializable dict, e.g.
+        ``DefragPlanner.export_json``) at ``/debug/defrag``. Safe to
+        call after ``start()``."""
+        self.defrag_provider = provider
 
     def add_readiness_check(self, name: str, check: Callable,
                             critical: bool = True) -> None:

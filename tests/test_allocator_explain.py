@@ -412,6 +412,88 @@ class TestDecisionRecord:
         n, _ = alloc._m_solve_seconds.summary()
         assert n == 1
 
+    def test_gang_solve_records_placement_score(self):
+        """The topology scorer's 'why THIS placement' half: a scored
+        gang solve records the chosen box, its best-fit score, and
+        whether the search actually landed on it."""
+        client = FakeKubeClient()
+        publish_host(client, "node-0", topology="4x1x1")
+        reg = Registry()
+        alloc = ReferenceAllocator(client, registry=reg)
+        alloc.allocate(chip_claim("uid-pair", count=2))
+        rec = alloc.recent_decisions()[-1]
+        placement = rec["placements"]["r0"]
+        assert placement["strategy"] == "best-fit"
+        assert placement["box"] == "2x1x1"
+        assert placement["origin"] == "0,0,0"  # corner-biased
+        assert placement["applied"] is True
+        assert placement["score"]["cornerDistance"] == 0
+        assert placement["score"]["freeComponent"] == 4
+        results = alloc.recent_decisions()[-1]
+        granted = {
+            f["request"] for f in results["funnels"]
+        }
+        assert granted == {"r0"}
+        # And the granted devices ARE the scored box.
+        claim_devs = set(placement["devices"])
+        assert claim_devs == {"tpu-0", "tpu-1"}
+
+    def test_first_fit_mode_records_no_placement(self):
+        client = FakeKubeClient()
+        publish_host(client, "node-0", topology="4x1x1")
+        alloc = ReferenceAllocator(
+            client, registry=Registry(), placement_scoring=False,
+        )
+        alloc.allocate(chip_claim("uid-pair", count=2))
+        assert alloc.recent_decisions()[-1]["placements"] == {}
+
+    def test_scorer_packs_into_smallest_free_component(self):
+        """Best-fit: with a 1-cell-wide hole and a large free region
+        both available, a single lands in the hole, preserving the big
+        contiguous block for future gangs."""
+        client = FakeKubeClient()
+        publish_host(client, "node-0", topology="4x1x1")
+        reg = Registry()
+        alloc = ReferenceAllocator(client, registry=reg)
+        # Occupy cell 1: free = {0} (component of 1) + {2,3} (of 2).
+        alloc.allocate(
+            chip_claim("uid-mid"),
+            selectors={"r0": [Selector("coord", "eq", "1,0,0")]},
+        )
+        single = chip_claim("uid-one")
+        alloc.allocate(single)
+        results = single["status"]["allocation"]["devices"]["results"]
+        assert results[0]["device"] == "tpu-0"  # the 1-cell hole
+        placement = alloc.recent_decisions()[-1]["placements"]["r0"]
+        assert placement["score"]["freeComponent"] == 1
+
+    def test_scorer_proves_gang_unsat_without_backtracking(self):
+        """The checkerboard case: when no contiguous box exists for a
+        pure chip gang, the scorer's exhaustive box enumeration proves
+        it and the solve fails at the gang stage in O(mesh) — no
+        exponential doomed search, zero backtracks."""
+        client = FakeKubeClient()
+        publish_host(client, "node-0", topology="4x4x1")
+        reg = Registry()
+        alloc = ReferenceAllocator(client, registry=reg)
+        for x in range(4):
+            for y in range(4):
+                if (x + y) % 2 == 0:
+                    continue
+                alloc.allocate(
+                    chip_claim(f"uid-cb-{x}{y}"),
+                    selectors={"r0": [
+                        Selector("coord", "eq", f"{x},{y},0"),
+                    ]},
+                )
+        with pytest.raises(AllocationError) as ei:
+            alloc.allocate(chip_claim("uid-pair", count=2))
+        assert ei.value.reason == "gang"
+        rec = alloc.recent_decisions()[-1]
+        assert rec["backtracks"] == 0
+        samples = rec["funnels"][0]["reasons"]["gang"]
+        assert any("scored placement exhausted" in s for s in samples)
+
     def test_ring_buffer_is_bounded(self, monkeypatch):
         monkeypatch.setenv("TPU_DRA_ALLOC_DECISION_BUFFER", "3")
         client = FakeKubeClient()

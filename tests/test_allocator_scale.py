@@ -6,8 +6,16 @@ backtracking stays tractable and correct at the scale a real v5p-128
 sets across 16 node pools. Guards against pathological backtracking
 (a bounded wall-clock budget) and against contiguity/counter bugs that
 only appear off the toy topology.
+
+Plus the incremental-index contracts: the PARITY ORACLE (a seeded churn
+schedule replayed through an incremental and a from-scratch allocator
+must produce identical outcomes, device sets, and funnels after every
+delta), delta-driven invalidation (steady-state solves re-evaluate
+nothing; a slice delta rebuilds exactly the affected pool), and batch
+solving (one snapshot, constrainedness order, per-claim funnels).
 """
 
+import random
 import time
 
 import pytest
@@ -209,6 +217,188 @@ class TestAllocatorScale:
         with pytest.raises(AllocationError):
             frag.allocate(claim, selectors={"pair": [corners]})
         assert frag._m_backtracks.value() > 0
+
+    def test_steady_state_solve_reuses_cached_filters(self, monkeypatch):
+        """With no ResourceSlice delta between solves, the SECOND solve
+        of the same request shape runs zero CEL evaluations — the
+        incremental index's whole point. A delta then re-evaluates only
+        the changed pool's devices."""
+        import k8s_dra_driver_tpu.kube.allocator as allocator_mod
+
+        calls = {"n": 0}
+        real = allocator_mod.cel_evaluate_detailed
+
+        def counting(*args, **kwargs):
+            calls["n"] += 1
+            return real(*args, **kwargs)
+
+        monkeypatch.setattr(
+            allocator_mod, "cel_evaluate_detailed", counting
+        )
+        client = FakeKubeClient()
+        publish_cluster(client)
+        class_expr = "device.attributes['tpu.google.com'].type == 'chip'"
+        alloc = ReferenceAllocator(
+            client, driver_name=DRIVER,
+            device_classes={DRIVER: [class_expr]},
+        )
+        alloc.allocate(gang_claim("uid-warm", 4))
+        warm_calls = calls["n"]
+        assert warm_calls > 0
+        gen = alloc.index.generation
+        alloc.allocate(gang_claim("uid-steady", 4))
+        assert calls["n"] == warm_calls, (
+            "a steady-state solve re-ran CEL despite no slice delta"
+        )
+        assert alloc.recent_decisions()[-1]["celEvaluations"] == 0
+        assert alloc.index.generation == gen  # no delta observed
+        # One slice delta (device attribute change via republish):
+        # exactly the changed pool re-filters — bounded by its device
+        # count, nowhere near the fleet's.
+        api = alloc.api
+        slices = [
+            s for s in client.list(api.slices)
+            if s["spec"]["pool"]["name"] == "node-03"
+        ]
+        assert slices
+        target = slices[0]
+        dev0 = target["spec"]["devices"][0]
+        attrs = dev0.setdefault("basic", dev0.get("basic", {})).setdefault(
+            "attributes", {}
+        )
+        attrs["healthy"] = {"bool": False}
+        client.update(api.slices, target)
+        alloc.allocate(gang_claim("uid-after-delta", 4))
+        assert alloc.index.generation == gen + 1
+        pool_devices = sum(
+            1 for d in alloc.index.devices if d["pool"] == "node-03"
+        )
+        delta_calls = calls["n"] - warm_calls
+        assert 0 < delta_calls <= pool_devices, (
+            f"{delta_calls} CEL evaluations after a one-pool delta "
+            f"(pool has {pool_devices} devices)"
+        )
+
+    def test_parity_oracle_incremental_vs_from_scratch(self):
+        """The regression oracle for the incremental solver: one seeded
+        churn schedule (allocations, releases, health-flip slice deltas,
+        healthy-only solves) replayed through an incremental and a
+        from-scratch allocator over the same cluster. After EVERY step
+        the two must agree: same satisfiability, same granted device
+        sets, same terminal reason and funnel shape on unsat."""
+        client = FakeKubeClient()
+        publish_cluster(client)
+        inc = ReferenceAllocator(client, driver_name=DRIVER)
+        scratch = ReferenceAllocator(
+            client, driver_name=DRIVER, incremental=False,
+        )
+        api = inc.api
+        rng = random.Random(20260804)
+        live: list[str] = []
+        flipped = False
+        serial = 0
+        unsats = 0
+        for step in range(70):
+            r = rng.random()
+            if r < 0.12:
+                # Slice delta: toggle one chip's healthy attribute on a
+                # random pool (the republish shape of a health flip).
+                pool = f"node-{rng.randrange(HOSTS):02d}"
+                target = next(
+                    s for s in client.list(api.slices)
+                    if s["spec"]["pool"]["name"] == pool
+                )
+                dev = rng.choice(target["spec"]["devices"])
+                attrs = dev.setdefault("basic", {}).setdefault(
+                    "attributes", {}
+                )
+                old = attrs.get("healthy", {}).get("bool", True)
+                attrs["healthy"] = {"bool": not old}
+                client.update(api.slices, target)
+                flipped = True
+                continue
+            if r < 0.45 and live:
+                uid = live.pop(rng.randrange(len(live)))
+                inc.deallocate(uid)
+                scratch.deallocate(uid)
+                continue
+            serial += 1
+            uid = f"uid-churn-{serial:03d}"
+            count = rng.choice((1, 2, 4, 4, 8, 16, 16, 32))
+            healthy_only = rng.random() < 0.3
+            outcomes = []
+            for alloc in (inc, scratch):
+                claim = gang_claim(uid, count)
+                try:
+                    alloc.allocate(claim, require_healthy=healthy_only)
+                    results = frozenset(
+                        (res["pool"], res["device"]) for res in
+                        claim["status"]["allocation"]["devices"]["results"]
+                    )
+                    outcomes.append(("ok", results, None))
+                except AllocationError as e:
+                    rec = alloc.recent_decisions()[-1]
+                    funnel_shape = tuple(sorted(
+                        (f["request"], tuple(sorted(f["rejected"].items())),
+                         f["entering"], f["survivors"], f["wanted"])
+                        for f in rec["funnels"]
+                    ))
+                    outcomes.append((e.reason, None, funnel_shape))
+            assert outcomes[0] == outcomes[1], (
+                f"step {step} (uid {uid}, count {count}, "
+                f"healthy_only {healthy_only}): incremental "
+                f"{outcomes[0]} != from-scratch {outcomes[1]}"
+            )
+            if outcomes[0][0] == "ok":
+                live.append(uid)
+            else:
+                unsats += 1
+        # The schedule must actually have exercised the interesting
+        # paths, or the oracle proves nothing.
+        assert flipped, "schedule produced no slice delta"
+        assert unsats > 0, "schedule produced no unsat solves"
+        assert inc.index.generation > 0
+        # And the incremental side must have been incremental: it never
+        # force-rebuilds, so its pool rebuilds stay far below the
+        # from-scratch side's (which rebuilds every pool every solve).
+        assert inc.index.rebuilds < scratch.index.rebuilds / 4
+
+    def test_allocate_batch_shares_one_snapshot_and_orders_by_size(self):
+        """Batch solving: the queue solves most-constrained-first over
+        ONE inventory snapshot, so a big gang is not shredded by the
+        singles ahead of it in FIFO order; results return in input
+        order with per-claim funnels intact."""
+        client = FakeKubeClient()
+        publish_cluster(client)
+
+        # FIFO baseline: 32 singles scattered first make the 16-gang
+        # (which needs a contiguous 4x4x1 / 2x2x4 box) harder than it
+        # has to be; the batch order solves it first instead.
+        alloc = ReferenceAllocator(client, driver_name=DRIVER)
+        claims = [gang_claim(f"uid-s{i:02d}", 1) for i in range(32)]
+        claims.append(gang_claim("uid-gang16", 16))
+        claims.append(gang_claim("uid-gang8", 8))
+        probes_before = alloc.index.probes
+        decisions_before = len(alloc.recent_decisions())
+        outcomes = alloc.allocate_batch(claims)
+        # One snapshot = one signature probe for the whole batch.
+        assert alloc.index.probes == probes_before + 1
+        # Input order preserved; every claim produced a decision record.
+        assert [c["metadata"]["uid"] for c, _ in outcomes] \
+            == [c["metadata"]["uid"] for c in claims]
+        assert len(alloc.recent_decisions()) - decisions_before \
+            == len(claims)
+        # The big gangs solved (they went first); their devices are
+        # contiguous boxes despite 32 singles in the same batch.
+        by_uid = {c["metadata"]["uid"]: err for c, err in outcomes}
+        assert by_uid["uid-gang16"] is None
+        assert by_uid["uid-gang8"] is None
+        assert sum(1 for err in by_uid.values() if err is None) \
+            == len(claims)  # 32 + 16 + 8 = 56 <= 64 chips: all fit
+        # Per-claim funnels: each record names its own claim.
+        recent = alloc.recent_decisions()[decisions_before:]
+        assert {r["claim"]["uid"] for r in recent} \
+            == {c["metadata"]["uid"] for c in claims}
 
     def test_cel_memo_keeps_evaluations_linear(self, monkeypatch):
         """The per-solve (expression, device) memo: a 4-chip gang over

@@ -1009,6 +1009,71 @@ class TestElasticGangResize:
             driver.shutdown()
 
 
+class TestElasticSnapshotDescent:
+    """Satellite regression for the descending re-solve: the shrink
+    loop's whole descent shares ONE allocator inventory snapshot (one
+    apiserver read, not one per candidate size), while every attempt
+    stays individually funnel-visible in /debug/allocations."""
+
+    def test_descent_reuses_one_snapshot_with_funnel_per_attempt(
+        self, tmp_path
+    ):
+        import threading
+
+        from k8s_dra_driver_tpu.kube.allocator import ReferenceAllocator
+
+        lib = FakeChipLib(generation="v5p", topology="4x1x1")
+        driver, client, lib = make_driver(tmp_path, lib=lib, interval=0)
+        allocator = ReferenceAllocator(client, registry=Registry())
+        driver.enable_elastic(allocator)
+        driver.start()
+        real_list = client.list
+        try:
+            assert wait_for(lambda: len(client.list(RESOURCE_SLICES)) >= 1)
+            claim = make_gang_claim(client, allocator)
+            assert prepare_via_rpc(driver, claim).error == ""
+            # Wedge the second chip: survivors {0,2,3} hold no
+            # contiguous 3-box around the hole, so the descent must try
+            # size 3 (unsat) before settling on the [2,3] pair.
+            lib.wedge_chip(1, reason="snapshot descent test")
+            assert driver.state.refresh_allocatable()
+            transitions = driver.state.drain_health_transitions()
+            assert transitions
+            driver.publish_resources()
+
+            me = threading.current_thread()
+            list_calls = {"n": 0}
+
+            def counting_list(*args, **kwargs):
+                if threading.current_thread() is me:
+                    list_calls["n"] += 1
+                return real_list(*args, **kwargs)
+
+            client.list = counting_list
+            before = len(allocator.recent_decisions())
+            driver._maybe_elastic_resize(transitions)
+            attempts = allocator.recent_decisions()[before:]
+            # Regression on attempt counts: each size of the descent is
+            # its own decision record with its own funnel.
+            assert [a["outcome"] for a in attempts] == ["unsat", "ok"]
+            assert attempts[0]["funnels"][0]["wanted"] == 3
+            assert attempts[0]["reason"] == "gang"
+            assert attempts[1]["funnels"][0]["wanted"] == 2
+            # The unhealthy chip was funnel-visible in both attempts.
+            for a in attempts:
+                assert a["funnels"][0]["rejected"].get("unhealthy") == 1
+            # ONE inventory read (the snapshot's delta refresh) for the
+            # whole descent — previously one full re-list per attempt.
+            assert list_calls["n"] <= 1, (
+                f"descent re-read the inventory {list_calls['n']} times"
+            )
+            view = driver.state.gang_view("uid-gang")
+            assert [n for n, _ in view["devices"]] == ["tpu-2", "tpu-3"]
+        finally:
+            client.list = real_list
+            driver.shutdown()
+
+
 class TestElasticCrashConsistency:
     """The typed resize protocol's crash windows: the two-phase
     checkpoint (intent → apply → finalize) must roll forward at restart,

@@ -1,0 +1,303 @@
+"""Defrag planner: read-only migration plans for fragmented gangs.
+
+A gang unsat with terminal reason ``gang``/``shortfall`` on a fleet
+whose free capacity would fit it gets a plan: which movable claims to
+re-place where (scored with the allocator's own best-fit discipline) so
+a contiguous box frees up. The plan travels ``tpu_dra_defrag_*``
+metrics, ``/debug/defrag`` (GET-only JSON), and the doctor's ``defrag``
+cross-check finding next to the ``explain`` unsat finding.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from test_allocator_explain import chip_claim, publish_host
+
+from k8s_dra_driver_tpu.kube import FakeKubeClient
+from k8s_dra_driver_tpu.kube.allocator import (
+    AllocationError,
+    ReferenceAllocator,
+    Selector,
+)
+from k8s_dra_driver_tpu.kube.defrag import OUTCOMES, DefragPlanner
+from k8s_dra_driver_tpu.utils.metrics import MetricsServer, Registry
+
+
+def fragmented_4x1(reg=None):
+    """4x1x1 slice with the two middle chips held: the two free corners
+    cannot form a contiguous pair."""
+    client = FakeKubeClient()
+    publish_host(client, "node-0", topology="4x1x1")
+    reg = reg or Registry()
+    alloc = ReferenceAllocator(client, registry=reg)
+    planner = DefragPlanner(alloc, registry=reg)
+    for i, coord in enumerate(("1,0,0", "2,0,0")):
+        alloc.allocate(
+            chip_claim(f"uid-mid-{i}"),
+            selectors={"r0": [Selector("coord", "eq", coord)]},
+        )
+    return client, alloc, planner, reg
+
+
+class TestPlanner:
+    def test_fragmented_gang_gets_a_plan(self):
+        client, alloc, planner, reg = fragmented_4x1()
+        with pytest.raises(AllocationError) as ei:
+            alloc.allocate(chip_claim("uid-gang", count=2))
+        assert ei.value.reason == "gang"
+        plan = planner.recent_plans()[-1]
+        assert plan["outcome"] == "planned"
+        assert plan["claim"]["uid"] == "uid-gang"
+        assert plan["reason"] == "gang"
+        assert plan["wanted"] == 2
+        assert len(plan["migrations"]) == 1
+        mig = plan["migrations"][0]
+        # One middle claim moves to a free corner; the freed box is the
+        # other corner's pair.
+        assert mig["claimUid"] in ("uid-mid-0", "uid-mid-1")
+        assert mig["devices"] in (["tpu-1"], ["tpu-2"])
+        assert mig["to"][0] in ("tpu-0", "tpu-3")
+        assert mig["score"]["freeComponent"] >= 1
+        assert plan["box"] == "2x1x1"
+        # Metrics: outcome-labelled counter, latest-plan gauges.
+        text = reg.render()
+        assert 'tpu_dra_defrag_plans_total{outcome="planned"} 1' in text
+        assert "tpu_dra_defrag_last_plan_migrations 1" in text
+        assert "tpu_dra_defrag_last_plan_freed_devices 2" in text
+
+    def test_capacity_shortfall_is_not_fragmentation(self):
+        client = FakeKubeClient()
+        publish_host(client, "node-0", topology="4x1x1")
+        alloc = ReferenceAllocator(client, registry=Registry())
+        planner = DefragPlanner(alloc, registry=Registry())
+        with pytest.raises(AllocationError) as ei:
+            alloc.allocate(chip_claim("uid-big", count=5))
+        assert ei.value.reason == "shortfall"
+        plan = planner.recent_plans()[-1]
+        assert plan["outcome"] == "insufficient-capacity"
+        assert plan["migrations"] == []
+        assert "capacity problem" in plan["detail"]
+
+    def test_immovable_blockers_read_unplannable(self):
+        """Blockers holding devices the planner cannot re-place (a
+        second chip on ANOTHER slice in the same claim) make every box
+        unfreeable; the plan is a typed unplannable — never a bogus
+        migration of a claim that cannot move."""
+        client = FakeKubeClient()
+        publish_host(client, "node-a", topology="4x1x1", slice_id="s-a")
+        publish_host(client, "node-b", topology="2x1x1", slice_id="s-b")
+        alloc = ReferenceAllocator(client, registry=Registry())
+        planner = DefragPlanner(alloc, registry=Registry())
+        for i, coord in enumerate(("1,0,0", "2,0,0")):
+            claim = chip_claim(f"uid-mixed-{i}")
+            claim["spec"]["devices"]["requests"].append({
+                "name": "r1", "deviceClassName": "tpu.google.com",
+            })
+            alloc.allocate(
+                claim,
+                selectors={
+                    "r0": [Selector("sliceId", "eq", "s-a"),
+                           Selector("coord", "eq", coord)],
+                    "r1": [Selector("sliceId", "eq", "s-b")],
+                },
+            )
+        with pytest.raises(AllocationError) as ei:
+            alloc.allocate(
+                chip_claim("uid-gang", count=2),
+                selectors={"r0": [Selector("sliceId", "eq", "s-a")]},
+            )
+        assert ei.value.reason == "gang"
+        plan = planner.recent_plans()[-1]
+        assert plan["outcome"] == "unplannable"
+        assert plan["migrations"] == []
+
+    def test_non_chip_gang_reads_no_topology(self):
+        client = FakeKubeClient()
+        publish_host(client, "node-0", topology="4x1x1")
+        alloc = ReferenceAllocator(client, registry=Registry())
+        planner = DefragPlanner(alloc, registry=Registry())
+        core = chip_claim(
+            "uid-cores", count=9,  # 8 partitions exist: shortfall
+            device_class="tensorcore.tpu.google.com",
+        )
+        with pytest.raises(AllocationError) as ei:
+            alloc.allocate(core)
+        assert ei.value.reason == "shortfall"
+        plan = planner.recent_plans()[-1]
+        assert plan["outcome"] == "no-topology"
+
+    def test_plan_respects_the_claims_selectors(self):
+        """A gang pinned to one slice by its selectors must never get a
+        'planned' proposal on some OTHER slice it could not use: the
+        target box is restricted to claim-eligible devices."""
+        client = FakeKubeClient()
+        publish_host(client, "node-a", topology="4x1x1", slice_id="s-a")
+        # A wide-open second slice the claim's selector excludes.
+        publish_host(client, "node-b", topology="4x1x1", slice_id="s-b")
+        alloc = ReferenceAllocator(client, registry=Registry())
+        planner = DefragPlanner(alloc, registry=Registry())
+        pin = [Selector("sliceId", "eq", "s-a")]
+        for i, coord in enumerate(("1,0,0", "2,0,0")):
+            alloc.allocate(
+                chip_claim(f"uid-mid-{i}"),
+                selectors={"r0": pin + [Selector("coord", "eq", coord)]},
+            )
+        with pytest.raises(AllocationError) as ei:
+            alloc.allocate(
+                chip_claim("uid-gang", count=2), selectors={"r0": pin},
+            )
+        assert ei.value.reason == "gang"
+        plan = planner.recent_plans()[-1]
+        # Still planned — but ON the pinned slice, by migration, not by
+        # pointing at s-b's free cells.
+        assert plan["outcome"] == "planned"
+        assert plan["sliceId"] == "s-a"
+        assert plan["migrations"]
+
+    def test_healthy_only_unsat_excludes_unhealthy_cells(self):
+        """An elastic (require_healthy) unsat must not get a target box
+        containing the wedged chip the re-solve is steering around."""
+        client = FakeKubeClient()
+
+        def sicken(devices, counters):
+            # Chip at 0,0,0 published unhealthy.
+            for d in devices:
+                attrs = d.get("basic", {}).get("attributes", {})
+                if attrs.get("coord", {}).get("string") == "0,0,0" \
+                        and attrs.get("type", {}).get("string") == "chip":
+                    attrs["healthy"] = {"bool": False}
+            return devices, counters
+
+        publish_host(client, "node-0", topology="4x1x1", mutate=sicken)
+        alloc = ReferenceAllocator(client, registry=Registry())
+        planner = DefragPlanner(alloc, registry=Registry())
+        # Hold chip 2: healthy free = {1, 3}, non-contiguous.
+        alloc.allocate(
+            chip_claim("uid-mid"),
+            selectors={"r0": [Selector("coord", "eq", "2,0,0")]},
+        )
+        with pytest.raises(AllocationError) as ei:
+            alloc.allocate(
+                chip_claim("uid-gang", count=2), require_healthy=True,
+            )
+        assert ei.value.reason == "gang"
+        plan = planner.recent_plans()[-1]
+        assert plan["outcome"] == "planned"
+        # The only healthy 2-box is [1,2] (tpu-0 is sick, tpu-3 is its
+        # lone healthy neighbour... cells 1,2 adjacent): the box must
+        # not contain tpu-0.
+        moved_to_free = {d for m in plan["migrations"] for d in m["to"]}
+        assert "tpu-0" not in moved_to_free or plan["origin"] != "0,0,0"
+        assert plan["origin"] in ("1,0,0", "2,0,0")
+
+    def test_retry_dedup_returns_cached_plan(self):
+        """A scheduler retrying a stuck gang must not re-plan (or
+        re-append plans, evicting other claims') while the inventory
+        generation and reservations are unchanged."""
+        client, alloc, planner, reg = fragmented_4x1()
+        for _ in range(3):
+            with pytest.raises(AllocationError):
+                alloc.allocate(chip_claim("uid-gang", count=2))
+        assert len(planner.recent_plans()) == 1
+        text = reg.render()
+        assert 'tpu_dra_defrag_plans_total{outcome="planned"} 1' in text
+        # A reservation change invalidates the dedup: re-planned.
+        alloc.deallocate("uid-mid-0")
+        alloc.allocate(
+            chip_claim("uid-mid-0b"),
+            selectors={"r0": [Selector("coord", "eq", "1,0,0")]},
+        )
+        with pytest.raises(AllocationError):
+            alloc.allocate(chip_claim("uid-gang", count=2))
+        assert len(planner.recent_plans()) == 2
+
+    def test_outcomes_confined_to_enum(self):
+        client, alloc, planner, _ = fragmented_4x1()
+        with pytest.raises(AllocationError):
+            alloc.allocate(chip_claim("uid-2", count=2))
+        assert planner.recent_plans()
+        for plan in planner.recent_plans():
+            assert plan["outcome"] in OUTCOMES
+
+
+class TestDebugEndpoint:
+    def test_debug_defrag_json_and_405(self):
+        reg = Registry()
+        client, alloc, planner, reg = fragmented_4x1(reg)
+        with pytest.raises(AllocationError):
+            alloc.allocate(chip_claim("uid-gang", count=2))
+        srv = MetricsServer(reg, host="127.0.0.1", port=0)
+        srv.set_defrag_provider(planner.export_json)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            body = urllib.request.urlopen(
+                f"{base}/debug/defrag"
+            ).read().decode()
+            doc = json.loads(body)
+            assert doc["plans"][-1]["claim"]["uid"] == "uid-gang"
+            assert doc["plans"][-1]["outcome"] == "planned"
+            assert "note" in doc
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{base}/debug/defrag", data=b"x")
+            assert ei.value.code == 405
+            assert "GET" in ei.value.headers.get("Allow", "")
+        finally:
+            srv.stop()
+
+    def test_404_without_provider(self):
+        srv = MetricsServer(Registry(), host="127.0.0.1", port=0)
+        srv.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/debug/defrag"
+                )
+            assert ei.value.code == 404
+        finally:
+            srv.stop()
+
+
+class TestDoctorCrossCheck:
+    def test_defrag_finding_rides_next_to_explain(self):
+        """A node serving both an unsat gang decision and a planned
+        defrag proposal for the same claim gets the INFO `defrag`
+        finding pointing the operator at the plan."""
+        from k8s_dra_driver_tpu.doctor import NodeScrape, fleet_findings
+
+        client, alloc, planner, _ = fragmented_4x1()
+        with pytest.raises(AllocationError):
+            alloc.allocate(chip_claim("uid-gang", count=2))
+        scrape = NodeScrape(
+            name="node-0",
+            url="http://test",
+            readyz_text="ready\n",
+            allocations_text=alloc.export_allocations_jsonl(),
+            defrag=planner.export_json(),
+        )
+        findings = fleet_findings([scrape], None, "tpu.google.com")
+        explain = [f for f in findings if f.check == "explain"]
+        defrag = [f for f in findings if f.check == "defrag"]
+        assert any("gang" in f.detail for f in explain)
+        assert len(defrag) == 1
+        assert "defrag plan available" in defrag[0].detail
+        assert defrag[0].severity == "info"
+
+    def test_no_defrag_finding_without_a_planned_plan(self):
+        from k8s_dra_driver_tpu.doctor import NodeScrape, fleet_findings
+
+        client, alloc, planner, _ = fragmented_4x1()
+        with pytest.raises(AllocationError):
+            alloc.allocate(chip_claim("uid-big", count=9))  # capacity
+        scrape = NodeScrape(
+            name="node-0",
+            url="http://test",
+            readyz_text="ready\n",
+            allocations_text=alloc.export_allocations_jsonl(),
+            defrag=planner.export_json(),
+        )
+        findings = fleet_findings([scrape], None, "tpu.google.com")
+        assert not [f for f in findings if f.check == "defrag"]

@@ -25,6 +25,17 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import pytest
 import yaml
 
+# The mTLS fixtures mint a real PKI, which needs the `cryptography`
+# package — present in CI, absent in sandboxes without cert tooling.
+# Skip the module with a clear reason there instead of erroring at
+# fixture time (the suite is about kubeconfig parsing + TLS handshakes;
+# nothing can run without certs).
+pytest.importorskip(
+    "cryptography",
+    reason="kubeconfig mTLS tests need the 'cryptography' package "
+           "(cert tooling not available in this environment)",
+)
+
 from k8s_dra_driver_tpu.kube.client import (
     RESOURCE_SLICES,
     ExecAuthConfig,

@@ -343,6 +343,19 @@ class TestRealClientCrud:
         client.list(RESOURCE_SLICES)
         assert stub.auth_headers[-1] == "Bearer tok-123"
 
+    def test_list_meta_names_and_versions(self, api):
+        """The incremental index's change-detection probe: (name,
+        resourceVersion) pairs, asking for PartialObjectMetadataList.
+        The stub ignores the content negotiation (as an old server
+        would) and returns full objects — the probe must work either
+        way, since metadata is metadata in both shapes."""
+        stub, client = api
+        client.create(RESOURCE_SLICES, mkslice("s1"))
+        client.create(RESOURCE_SLICES, mkslice("s2"))
+        assert client.list_meta(RESOURCE_SLICES) == [
+            ("s1", "1"), ("s2", "2"),
+        ]
+
     def test_label_selector_passed_and_filtered(self, api):
         stub, client = api
         client.create(RESOURCE_SLICES, mkslice("a", {"scope": "x"}))

@@ -204,11 +204,16 @@ _HISTOGRAM_UNIT_SUFFIXES = ("_seconds", "_bytes", "_celsius", "_ratio")
 # by the node's chip count by construction).
 _PER_CHIP_LABELS = {"chip", "uuid", "device"}
 _PER_CHIP_LABEL_MODULES = {"accounting.py", "audit.py"}
-# TPM05: module-owned family prefixes.
+# TPM05: module-owned family prefixes. allocator.py's prefix is the
+# shared stem of its two families (tpu_dra_alloc_* explainability +
+# tpu_dra_allocation_* attempt/backtrack counters); defrag.py owns the
+# planner's tpu_dra_defrag_* families.
 _MODULE_FAMILY_PREFIXES = {
     "accounting.py": "tpu_dra_usage_",
     "audit.py": "tpu_dra_audit_",
     "elastic.py": "tpu_dra_elastic_",
+    "allocator.py": "tpu_dra_alloc",
+    "defrag.py": "tpu_dra_defrag_",
 }
 _METRIC_METHODS = {"inc", "set", "observe"}
 

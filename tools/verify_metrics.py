@@ -263,6 +263,64 @@ def _self_test_scrape() -> tuple[str, list[str]]:
                 f"unsat reason {e.reason!r} outside the REASONS enum"
             )
 
+    # The defrag families (tpu_dra_defrag_*), populated through a REAL
+    # fragmented-gang unsat: a 4x1x1 slice with the middle chips held
+    # leaves two free corners that form no contiguous pair, and the
+    # attached planner must propose a migration plan for it.
+    from k8s_dra_driver_tpu.kube.allocator import Selector
+    from k8s_dra_driver_tpu.kube.defrag import OUTCOMES, DefragPlanner
+
+    planner = DefragPlanner(allocator, registry=registry)
+    client.create(NODES, {"metadata": {"name": "verify-frag",
+                                       "uid": "u-vf"}})
+    frag_lib = FakeChipLib(generation="v5p", topology="4x1x1",
+                           slice_id="frag-slice")
+    frag_devs = frag_lib.enumerate_all_possible_devices({"chip"})
+    frag_ctrl = ResourceSliceController(
+        client, "tpu.google.com", scope="verify-frag",
+        owner={"kind": "Node", "name": "verify-frag", "uid": "u-vf"},
+    )
+    frag_ctrl.update(DriverResources(pools={"verify-frag": Pool(
+        devices=[d.get_device() for _, d in sorted(frag_devs.items())],
+        shared_counters=counter_sets(frag_devs),
+        node_name="verify-frag",
+    )}))
+    frag_ctrl.sync_once()
+    for i, coord in enumerate(("1,0,0", "2,0,0")):
+        allocator.allocate(
+            _verify_claim(f"uid-frag-hold-{i}", 1),
+            selectors={"r0": [Selector("sliceId", "eq", "frag-slice"),
+                              Selector("coord", "eq", coord)]},
+        )
+    try:
+        allocator.allocate(
+            _verify_claim("uid-frag-gang", 2),
+            selectors={"r0": [Selector("sliceId", "eq", "frag-slice")]},
+        )
+        alloc_errors.append("fragmented gang unexpectedly allocated")
+    except AllocationError as e:
+        if e.reason != "gang":
+            alloc_errors.append(
+                f"fragmented gang failed with reason {e.reason!r}, "
+                "want 'gang'"
+            )
+    frag_plans = planner.recent_plans()
+    if not frag_plans:
+        alloc_errors.append("defrag planner recorded no plan")
+    else:
+        newest_plan = frag_plans[-1]
+        if newest_plan.get("outcome") not in OUTCOMES:
+            alloc_errors.append(
+                f"defrag outcome {newest_plan.get('outcome')!r} outside "
+                "the OUTCOMES enum"
+            )
+        if newest_plan.get("outcome") != "planned" \
+                or not newest_plan.get("migrations"):
+            alloc_errors.append(
+                "defrag plan for the fragmented gang is not 'planned' "
+                f"with migrations: {newest_plan.get('outcome')!r}"
+            )
+
     tracer = Tracer()
     with tracer.span("verify", claim_uid="uid-verify"):
         pass
@@ -272,6 +330,7 @@ def _self_test_scrape() -> tuple[str, list[str]]:
     srv.add_readiness_check("self-test", lambda: (True, "ok"))
     srv.set_usage_provider(lambda: snapshot)
     srv.set_allocations_provider(allocator.export_allocations_jsonl)
+    srv.set_defrag_provider(planner.export_json)
     srv.start()
     try:
         base = f"http://127.0.0.1:{srv.port}"
@@ -309,10 +368,10 @@ def _self_test_scrape() -> tuple[str, list[str]]:
                 errors.append(
                     f"/debug/allocations: undecodable line {line!r}"
                 )
-        if len(records) != 2:
+        if len(records) != 5:
             errors.append(
-                f"/debug/allocations: {len(records)} records (want 2: "
-                "one ok, one unsat)"
+                f"/debug/allocations: {len(records)} records (want 5: "
+                "three ok, the shortfall unsat, the gang unsat)"
             )
         else:
             newest = records[-1]
@@ -338,9 +397,35 @@ def _self_test_scrape() -> tuple[str, list[str]]:
                             f"/debug/allocations: funnel stages {bad} "
                             "outside the STAGES enum"
                         )
+        # /debug/defrag: decodable JSON whose newest plan is the
+        # fragmented-gang proposal with enum-confined outcome.
+        defrag_body = urllib.request.urlopen(
+            f"{base}/debug/defrag"
+        ).read().decode()
+        try:
+            defrag_doc = json.loads(defrag_body)
+        except ValueError:
+            errors.append("/debug/defrag: body is not JSON")
+        else:
+            served = defrag_doc.get("plans") or []
+            if not served:
+                errors.append("/debug/defrag: no plans served")
+            else:
+                if served[-1].get("claim", {}).get("uid") \
+                        != "uid-frag-gang":
+                    errors.append(
+                        "/debug/defrag: newest plan is not the "
+                        "fragmented gang's"
+                    )
+                for p in served:
+                    if p.get("outcome") not in OUTCOMES:
+                        errors.append(
+                            f"/debug/defrag: outcome "
+                            f"{p.get('outcome')!r} outside OUTCOMES"
+                        )
         # The scrape surface is GET-only by contract — /metrics and the
         # debug endpoints alike.
-        for route in ("/metrics", "/debug/allocations"):
+        for route in ("/metrics", "/debug/allocations", "/debug/defrag"):
             try:
                 urllib.request.urlopen(base + route, data=b"x")
                 errors.append(f"{route} accepted a POST (want 405)")
@@ -359,7 +444,10 @@ def _self_test_scrape() -> tuple[str, list[str]]:
                    "tpu_dra_audit_runs_total",
                    "tpu_dra_alloc_solve_seconds",
                    "tpu_dra_alloc_funnel_rejections_total",
-                   "tpu_dra_alloc_unsat_total"):
+                   "tpu_dra_alloc_unsat_total",
+                   "tpu_dra_defrag_plans_total",
+                   "tpu_dra_defrag_plan_seconds",
+                   "tpu_dra_defrag_last_plan_migrations"):
         if f"\n{family}" not in body and not body.startswith(family):
             errors.append(f"expected family {family} missing from scrape")
     # The rendered stage/reason label values stay inside the enums the
