@@ -16,9 +16,11 @@ Knobs (script mode): TPU_DRA_DECODE_PRESET (e.g. 160m-gqa, 1b, or a
 MoE preset like 8x160m), TPU_DRA_DECODE_PROMPT (long-context cache
 costs), TPU_DRA_DECODE_QUANT ("int8" = weights, "int8-kv" = KV cache,
 "int8,int8-kv" = both), TPU_DRA_DECODE_SERVING=1 (also run the
-sustained-traffic continuous-batching bench: requests/s at measured
-p99 token latency). Any decode metric whose repeat spread exceeds 2%
-of its mean is flagged (spread_flags) — the recompile tripwire.
+sustained-traffic continuous-batching bench — requests/s at measured
+p99 token latency — plus the shared-prefix profile served cache-on vs
+cache-off for the prefix-cache speedup + hit rate). Any decode metric
+whose repeat spread exceeds 2% of its mean is flagged (spread_flags) —
+the recompile tripwire.
 """
 import os
 import time
@@ -195,6 +197,42 @@ def spread_flags(metrics, rel: float = 0.02) -> list:
     return flagged
 
 
+def _serving_traffic(profile, prompt_lens, n_requests, config, seed):
+    """Prompt list for a serving profile.
+
+    - ``mixed``: independent random prompts of rotating lengths (the
+      original BENCH continuity series).
+    - ``shared-prefix``: 16 fixed system prompts x short random tails —
+      the production shape (system prompts, few-shot templates, agent
+      loops re-sending history) the prefix cache exists for. Every
+      request beyond the first per system prompt can serve its prefix
+      from cached blocks.
+    """
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    if profile == "mixed":
+        return [
+            rng.randint(0, config.vocab_size,
+                        size=int(prompt_lens[i % len(prompt_lens)])).tolist()
+            for i in range(n_requests)
+        ]
+    if profile == "shared-prefix":
+        n_sys = 16
+        sys_len = max(prompt_lens)
+        tail_len = max(8, min(prompt_lens) // 2)
+        systems = [
+            rng.randint(0, config.vocab_size, size=sys_len).tolist()
+            for _ in range(n_sys)
+        ]
+        return [
+            systems[i % n_sys]
+            + rng.randint(0, config.vocab_size, size=tail_len).tolist()
+            for i in range(n_requests)
+        ]
+    raise ValueError(f"unknown serving profile {profile!r}")
+
+
 def run_serving_bench(
     preset: str = "160m",
     batch_slots: int = 8,
@@ -205,16 +243,21 @@ def run_serving_bench(
     quant: bool = False,
     quant_kv: bool = False,
     seed: int = 0,
+    profile: str = "mixed",
+    prefix_cache: bool = True,
+    overlap: bool = True,
+    prefill_chunk: int | None = None,
 ) -> dict:
-    """Sustained mixed traffic through the continuous-batching engine:
+    """Sustained traffic through the continuous-batching engine:
     requests/s completed at a measured p99 per-token latency.
 
     Unlike the steady-state decode number, this measures the whole
-    serving loop — chunked prefill interleaving, admissions, block churn
-    — under prompts of mixed length, the shape production traffic has.
+    serving loop — chunked prefill interleaving, admissions, block
+    churn, prefix-cache hits — under the ``profile``'s traffic shape
+    (see ``_serving_traffic``). ``prefix_cache=False`` is the A/B
+    baseline for the shared-prefix profile (the cache-disabled engine
+    the >= 1.5x req/s acceptance gate compares against).
     """
-    import numpy as np
-
     from k8s_dra_driver_tpu.models.llama import PRESETS, init_params
     from k8s_dra_driver_tpu.models.moe import MOE_PRESETS
     from k8s_dra_driver_tpu.models.moe import init_params as moe_init_params
@@ -228,30 +271,37 @@ def run_serving_bench(
     if quant:
         params = jax.jit(quantize_params)(params)
 
-    rng = np.random.RandomState(seed)
-    prompts = [
-        rng.randint(0, config.vocab_size,
-                    size=int(prompt_lens[i % len(prompt_lens)])).tolist()
-        for i in range(n_requests)
-    ]
-    span = max(prompt_lens) + max_new_tokens
+    prompts = _serving_traffic(profile, prompt_lens, n_requests, config,
+                               seed)
+    span = max(len(p) for p in prompts) + max_new_tokens
     # Pool sized so roughly half the requests fit concurrently: block
     # churn and admission control are part of what's being measured.
     num_blocks = max(
         batch_slots * (-(-span // block_size)),
         -(-sum(len(p) + max_new_tokens for p in prompts) // (2 * block_size)),
     )
+    if prefill_chunk is None:
+        # The chunk is the prefill-savings granularity: a cache hit can
+        # only skip whole chunks, so the shared profile keeps chunks at
+        # block width (cold system prompts take many ticks, hot tails
+        # one); the mixed profile keeps the wide low-overhead default.
+        prefill_chunk = (
+            max(block_size, 16) if profile == "shared-prefix"
+            else min(128, max(len(p) for p in prompts))
+        )
     engine = DecodeEngine(
         params, config, batch_slots=batch_slots, num_blocks=num_blocks,
         block_size=block_size, max_seq_len=span,
-        prefill_chunk=min(128, max(prompt_lens)),
-        quantize_cache=quant_kv,
+        prefill_chunk=prefill_chunk,
+        quantize_cache=quant_kv, prefix_cache=prefix_cache,
+        overlap=overlap,
     )
     # Warm the two compiled programs so the timed window measures the
     # serving loop, not the compiler; latency stats reset after.
     from k8s_dra_driver_tpu.models.serving import ServingStats
 
-    engine.submit(prompts[0][: prompt_lens[0]], max_new_tokens=2)
+    engine.submit(prompts[0][: min(len(prompts[0]), prompt_lens[0])],
+                  max_new_tokens=2)
     engine.run()
     engine.stats = ServingStats()
     for p in prompts:
@@ -265,17 +315,37 @@ def run_serving_bench(
         t for t, on in (("-int8", quant), ("-kvq", quant_kv)) if on
     )
     family = "mixtral" if is_moe else "llama3"
+    suffix = "_shared" if profile == "shared-prefix" else ""
+    if not prefix_cache:
+        suffix += "_nocache"
     return {
-        "metric": f"{family}_{preset}{tags}_serving_rps_b{batch_slots}",
+        "metric": (
+            f"{family}_{preset}{tags}_serving_rps{suffix}_b{batch_slots}"
+        ),
         "value": round(n_requests / wall, 2),
         "unit": "requests_per_s",
         # p99 token latency is the SLO leg of "requests/s at fixed p99".
         "vs_baseline": 0.0,
         "detail": {
+            "profile": profile,
             "p99_token_ms": round(s.p99_token_ms(), 2),
             "p50_token_ms": round(s.p50_token_ms(), 2),
             "p99_ttft_ms": round(s.p99_ttft_ms(), 2),
             "toks_per_s": round(s.tokens_generated / wall, 1),
+            # Prefill-vs-decode throughput split: where the wall time's
+            # token work went (prefill_toks counts computed prompt
+            # tokens; cache hits don't compute, so saved tokens move
+            # req/s instead of this number).
+            "prefill_toks_per_s": round(s.prefill_tokens / wall, 1),
+            "decode_toks_per_s": round(s.tokens_generated / wall, 1),
+            # Prefix-cache observability (zeros when disabled).
+            "prefix_cache": prefix_cache,
+            "prefix_hit_rate": round(s.hit_rate(), 4),
+            "prefill_tokens_saved": s.prefix_hit_tokens,
+            "cow_recomputes": s.cow_recomputes,
+            "queue_depth_mean": round(s.queue_depth_mean(), 2),
+            "queue_depth_max": s.queue_depth_max(),
+            "overlap": overlap,
             "preemptions": s.preemptions,
             "decode_steps": s.decode_steps,
             "prefill_chunks": s.prefill_chunks,
@@ -288,6 +358,46 @@ def run_serving_bench(
             **({"moe_impl": engine.moe_impl} if is_moe else {}),
         },
     }
+
+
+def run_prefix_cache_bench(
+    preset: str = "160m",
+    batch_slots: int = 8,
+    n_requests: int = 96,
+    prompt_lens=(32, 128, 256),
+    max_new_tokens: int = 12,
+    block_size: int = 64,
+    quant: bool = False,
+    quant_kv: bool = False,
+    seed: int = 0,
+) -> dict:
+    """The prefix-cache acceptance pair: the shared-prefix profile
+    served twice through otherwise identical engines — cache on vs
+    cache off — reporting the req/s speedup at the measured p99 token
+    latencies plus the hit rate. The BENCH_r06 before/after lives in
+    one metric: ``value`` is the cache-on req/s, ``detail.speedup_rps``
+    the ratio (acceptance gate: >= 1.5x at equal p99)."""
+    base = run_serving_bench(
+        preset=preset, batch_slots=batch_slots, n_requests=n_requests,
+        prompt_lens=prompt_lens, max_new_tokens=max_new_tokens,
+        block_size=block_size, quant=quant, quant_kv=quant_kv, seed=seed,
+        profile="shared-prefix", prefix_cache=False,
+    )
+    hot = run_serving_bench(
+        preset=preset, batch_slots=batch_slots, n_requests=n_requests,
+        prompt_lens=prompt_lens, max_new_tokens=max_new_tokens,
+        block_size=block_size, quant=quant, quant_kv=quant_kv, seed=seed,
+        profile="shared-prefix", prefix_cache=True,
+    )
+    hot["detail"]["speedup_rps"] = round(
+        hot["value"] / max(base["value"], 1e-9), 3
+    )
+    hot["detail"]["rps_cache_off"] = base["value"]
+    hot["detail"]["p99_token_ms_cache_off"] = (
+        base["detail"]["p99_token_ms"]
+    )
+    hot["detail"]["p99_ttft_ms_cache_off"] = base["detail"]["p99_ttft_ms"]
+    return hot
 
 
 def run_speculative_bench(
@@ -388,6 +498,20 @@ def main():
             f"p99 token {s['detail']['p99_token_ms']} ms, "
             f"p99 ttft {s['detail']['p99_ttft_ms']} ms, "
             f"{s['detail']['preemptions']} preemptions", flush=True,
+        )
+        p = run_prefix_cache_bench(
+            preset=os.environ.get("TPU_DRA_DECODE_PRESET", "160m"),
+            quant="int8" in quant_modes,
+            quant_kv="int8-kv" in quant_modes,
+        )
+        print(
+            f"prefix-cache {p['metric']}: {p['value']} req/s "
+            f"({p['detail']['speedup_rps']}x vs cache-off "
+            f"{p['detail']['rps_cache_off']} req/s), "
+            f"hit rate {p['detail']['prefix_hit_rate']:.0%}, "
+            f"p99 token {p['detail']['p99_token_ms']} ms "
+            f"(off: {p['detail']['p99_token_ms_cache_off']} ms)",
+            flush=True,
         )
 
 

@@ -305,6 +305,12 @@ def extra_metrics(peak_flops, remat_policy) -> list:
         # Their detail IS the payload (p99/acceptance), so it stays.
         for name, fn_name, kwargs in (
             ("serving", "run_serving_bench", dict(preset=decode_preset)),
+            # Shared-prefix traffic (16 system prompts x many tails)
+            # served cache-on vs cache-off: the BENCH_r06 before/after
+            # for prefix-cache KV reuse (req/s at measured p99, hit
+            # rate, speedup in detail).
+            ("prefix-cache", "run_prefix_cache_bench",
+             dict(preset=decode_preset)),
             ("speculative", "run_speculative_bench",
              dict(preset=decode_preset)),
         ):

@@ -43,8 +43,9 @@ from .decode import (
     decode_step,
     generate,
     prefill,
+    prefill_cached,
 )
-from .paged import BlockAllocator, OutOfBlocksError
+from .paged import BlockAllocator, OutOfBlocksError, PrefixCache
 from .quant import QuantTensor, quantize_params, quantize_specs
 from .serving import DecodeEngine, Request, ServingStats
 from .speculative import speculative_generate
@@ -55,11 +56,13 @@ __all__ += [
     "PagedQuantKVCache",
     "BlockAllocator",
     "OutOfBlocksError",
+    "PrefixCache",
     "DecodeEngine",
     "Request",
     "ServingStats",
     "QuantTensor",
     "prefill",
+    "prefill_cached",
     "decode_step",
     "generate",
     "quantize_params",

@@ -37,6 +37,7 @@ from .paged import (
     OutOfBlocksError,
     PagedKVCache,
     PagedQuantKVCache,
+    PrefixCache,
     flat_write_positions,
 )
 from .quant import QuantTensor, q_lookup, q_matmul, quantize_tensor
@@ -46,7 +47,9 @@ __all__ = [
     "PagedQuantKVCache",
     "BlockAllocator",
     "OutOfBlocksError",
+    "PrefixCache",
     "prefill",
+    "prefill_cached",
     "decode_step",
     "generate",
     "TRACE_COUNTS",
@@ -258,6 +261,79 @@ def prefill(
         params, tokens, cache, config, positions, mesh=mesh
     )
     return logits[:, -1], cache
+
+
+def prefill_cached(
+    params: dict,
+    prompt,                        # sequence of int token ids (one request)
+    config: LlamaConfig,
+    max_len: int,
+    pools: tuple,                  # shared pool arrays (paged._init_pools)
+    allocator: BlockAllocator,
+    block_size: int,
+    prefix_cache: "PrefixCache | None" = None,
+    quantize_cache: bool = False,
+    mesh=None,
+):
+    """Single-sequence prefill over a caller-owned shared pool, reusing
+    the prefix cache: the longest cached full-block prefix of ``prompt``
+    is mapped into the block table (incref'd — zero prefill for the
+    matched span) and only the tail is computed. When the cache covers
+    the whole prompt, the trailing matched block is dropped from the
+    mapping and recomputed into a private block — copy-on-write by
+    recompute: the tail's KV writes (and any later decode/speculative
+    writes, which land at positions >= len(prompt) - tail) can then
+    never mutate a shared block, and the recomputed content is
+    bit-identical to the cached copy.
+
+    Returns ``(last_logits [1, V], cache, blocks, hit_tokens)``. The
+    cache spans ``max_len`` positions (fixed reservation for the tail:
+    speculative decoding's k+1 headroom fits without further growth);
+    ``blocks`` carries one owner-ref per block — release with
+    ``allocator.free(blocks)``, after ``prefix_cache.insert(tokens,
+    blocks)`` if the sequence should be retained. The serving engine
+    (models/serving.py) implements the same discipline tick-wise; this
+    is the solo-API counterpart for speculative decoding and tests."""
+    prompt = [int(t) for t in prompt]
+    s = len(prompt)
+    if not 0 < s < max_len:
+        raise ValueError(
+            f"prompt of {s} tokens needs 0 < len < max_len={max_len}"
+        )
+    bs = block_size
+    nbps = -(-max_len // bs)
+    hit: list[int] = []
+    if prefix_cache is not None:
+        hit = prefix_cache.lookup(prompt)[:nbps]
+        if hit and len(hit) * bs >= s:
+            hit = hit[:-1]             # COW: recompute the trailing block
+    hit_tokens = len(hit) * bs
+    allocator.share(hit)
+    try:
+        fresh = allocator.alloc(nbps - len(hit))
+    except OutOfBlocksError:
+        allocator.free(hit)
+        raise
+    blocks = list(hit) + fresh
+    tables = jnp.asarray([blocks], jnp.int32)
+    lengths = jnp.asarray([hit_tokens], jnp.int32)
+    if quantize_cache:
+        k, v, ks, vs = pools
+        cache = PagedQuantKVCache(
+            k=k, k_scale=ks, v=v, v_scale=vs, block_tables=tables,
+            lengths=lengths, block_size=bs,
+        )
+    else:
+        k, v = pools
+        cache = PagedKVCache(
+            k=k, v=v, block_tables=tables, lengths=lengths, block_size=bs,
+        )
+    tail = jnp.asarray([prompt[hit_tokens:]], jnp.int32)
+    positions = hit_tokens + jnp.arange(s - hit_tokens)
+    logits, cache = _forward_with_cache(
+        params, tail, cache, config, positions, mesh=mesh
+    )
+    return logits[:, -1], cache, blocks, hit_tokens
 
 
 def decode_step(

@@ -1,9 +1,10 @@
-"""Paged KV cache: block pool, per-sequence block tables, free-list allocator.
+"""Paged KV cache: block pool, block tables, ref-counted allocator,
+and a radix prefix index for cross-request KV reuse.
 
 vLLM-style memory management for the decode engine (models/serving.py):
 the KV cache is one flat pool of fixed-size blocks shared by every
 sequence, and each sequence maps its logical positions onto pool blocks
-through a small int32 block table. Two properties fall out:
+through a small int32 block table. Three properties fall out:
 
 - **Capacity is decoupled from batch slots.** A long sequence takes many
   blocks, a short one few; the pool is sized for expected total tokens,
@@ -13,6 +14,15 @@ through a small int32 block table. Two properties fall out:
   sequence advances integers. One compiled decode step serves the whole
   engine lifetime (the recompile-per-shape spreads in BENCH_r05 cannot
   happen structurally).
+- **Blocks are shareable.** Sharing a KV prefix between requests is pure
+  table indirection: several sequences' block tables point at the same
+  pool block. The allocator ref-counts blocks (``incref``/``share``;
+  ``free`` is a decref), and the :class:`PrefixCache` keeps retired
+  requests' full blocks indexed by their token ids so a later request
+  with the same prefix skips prefill for the matched span. Zero-ref
+  cached blocks are reclaimed LRU-leaf-first, and only under allocation
+  pressure — a warm cache costs nothing until the pool actually runs
+  dry.
 
 Layout: pools are ``[L, H_kv, P, D]`` where ``P = num_blocks *
 block_size`` flat token rows — block ``n`` owns rows ``[n*bs, (n+1)*bs)``,
@@ -31,6 +41,8 @@ sees the resulting tables.
 from __future__ import annotations
 
 import dataclasses
+from collections import OrderedDict
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -42,36 +54,64 @@ DEFAULT_BLOCK_SIZE = 64
 
 
 class OutOfBlocksError(RuntimeError):
-    """The pool has no free blocks for a required allocation.
+    """The pool cannot cover a required allocation.
 
-    Raised by :meth:`BlockAllocator.alloc` when the free list runs dry,
-    and by the serving engine when preemption cannot reclaim enough
-    blocks (a single request larger than the whole pool). Typed so
-    schedulers can catch it and shed load instead of crashing."""
+    Raised by :meth:`BlockAllocator.alloc` when the free list plus the
+    reclaimable prefix-cached blocks run dry, and by the serving engine
+    when preemption cannot reclaim enough blocks (a single request
+    larger than the whole pool). Typed so schedulers can catch it and
+    shed load instead of crashing; carries ``reclaimable`` (zero-ref
+    cached blocks evictable under pressure) alongside ``free`` so the
+    caller can tell a genuinely full pool from one hogged by cache."""
 
-    def __init__(self, requested: int, free: int, total: int):
+    def __init__(self, requested: int, free: int, total: int,
+                 reclaimable: int = 0):
         self.requested = requested
         self.free = free
         self.total = total
+        self.reclaimable = reclaimable
         super().__init__(
             f"requested {requested} KV block(s) but only {free} of "
-            f"{total} are free"
+            f"{total} are free ({reclaimable} more reclaimable from the "
+            f"prefix cache)"
         )
 
 
 class BlockAllocator:
-    """Free-list allocator over ``num_blocks`` fixed-size cache blocks.
+    """Ref-counted allocator over ``num_blocks`` fixed-size cache blocks.
 
-    LIFO reuse: freshly freed blocks are handed out first, so a steady
-    admit/retire workload keeps touching the same hot pool region
-    instead of sweeping cold HBM."""
+    A block is in exactly one of three states:
+
+    - **free** — on the free list (LIFO reuse: freshly freed blocks are
+      handed out first, so a steady admit/retire workload keeps touching
+      the same hot pool region instead of sweeping cold HBM);
+    - **held** — refcount >= 1. ``alloc`` hands out blocks at refcount 1;
+      ``incref``/``share`` add owners (prefix sharing is table
+      indirection plus a refcount); ``free`` is a decref — double-free
+      and foreign ids still fail loudly (a leaked or double-owned block
+      silently corrupts a neighbour sequence's cache);
+    - **cached** — refcount 0 but registered by the prefix cache
+      (``mark_cached``): the block keeps its KV content and sits in an
+      LRU, reclaimed only when ``alloc`` finds the free list dry. An
+      ``incref`` revives a cached block into the held state (a cache
+      hit).
+
+    ``on_evict(block)`` fires when a cached block is reclaimed so the
+    prefix index can drop its entry; ``evict_filter(block)`` lets the
+    index steer reclamation (the radix cache evicts leaf blocks first so
+    widely shared prefix roots survive longest)."""
 
     def __init__(self, num_blocks: int):
         if num_blocks <= 0:
             raise ValueError(f"num_blocks must be positive, got {num_blocks}")
         self.num_blocks = num_blocks
         self._free = list(range(num_blocks - 1, -1, -1))
-        self._allocated: set[int] = set()
+        self._refs: dict[int, int] = {}
+        self._cached_flag: set[int] = set()
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        self.on_evict: Optional[Callable[[int], None]] = None
+        self.evict_filter: Optional[Callable[[int], bool]] = None
+        self.evictions = 0
 
     @property
     def num_free(self) -> int:
@@ -79,29 +119,223 @@ class BlockAllocator:
 
     @property
     def num_allocated(self) -> int:
-        return len(self._allocated)
+        """Blocks held by at least one owner (refcount >= 1)."""
+        return len(self._refs)
+
+    @property
+    def num_cached(self) -> int:
+        """Zero-ref blocks retained by the prefix cache (reclaimable)."""
+        return len(self._lru)
+
+    @property
+    def num_available(self) -> int:
+        """Blocks an ``alloc`` could obtain: free + reclaimable-cached."""
+        return len(self._free) + len(self._lru)
+
+    def ref_count(self, block: int) -> int:
+        return self._refs.get(block, 0)
+
+    def is_cached(self, block: int) -> bool:
+        return block in self._cached_flag
 
     def alloc(self, n: int = 1) -> list[int]:
-        """Take ``n`` blocks off the free list; all-or-nothing."""
+        """Take ``n`` blocks at refcount 1; all-or-nothing. When the free
+        list runs dry, zero-ref cached blocks are evicted LRU-first
+        (leaf-first when the prefix cache installs its filter) — the
+        only path that ever drops cached KV."""
         if n < 0:
             raise ValueError(f"cannot allocate {n} blocks")
-        if n > len(self._free):
-            raise OutOfBlocksError(n, len(self._free), self.num_blocks)
-        out = [self._free.pop() for _ in range(n)]
-        self._allocated.update(out)
+        if n > self.num_available:
+            raise OutOfBlocksError(n, len(self._free), self.num_blocks,
+                                   reclaimable=len(self._lru))
+        out = []
+        for _ in range(n):
+            if not self._free:
+                self._reclaim_one()
+            b = self._free.pop()
+            self._refs[b] = 1
+            out.append(b)
         return out
 
-    def free(self, blocks) -> None:
-        """Return blocks to the free list; double-free and foreign ids
-        fail loudly (a leaked or double-owned block silently corrupts a
-        neighbour sequence's cache)."""
+    def _reclaim_one(self) -> None:
+        victim = None
+        if self.evict_filter is not None:
+            for b in self._lru:          # oldest first
+                if self.evict_filter(b):
+                    victim = b
+                    break
+        if victim is None:
+            victim = next(iter(self._lru))
+        del self._lru[victim]
+        self._cached_flag.discard(victim)
+        self.evictions += 1
+        if self.on_evict is not None:
+            # The index drops its entry; orphaned descendants come back
+            # through uncache() and may grow the free list further.
+            self.on_evict(victim)
+        self._free.append(victim)
+
+    def incref(self, block: int) -> None:
+        """Add an owner to a held block, or revive a cached one."""
+        if block in self._refs:
+            self._refs[block] += 1
+        elif block in self._lru:
+            del self._lru[block]
+            self._refs[block] = 1
+        else:
+            raise ValueError(
+                f"block {block} is neither held nor cached (foreign id)"
+            )
+
+    def share(self, blocks) -> None:
+        """incref each of ``blocks`` (mapping a cached prefix)."""
         for b in blocks:
-            if b not in self._allocated:
+            self.incref(b)
+
+    def free(self, blocks) -> None:
+        """Drop one owner per block (decref). At refcount 0 a block
+        returns to the free list — unless the prefix cache registered it,
+        in which case it parks in the reclaimable LRU with its KV intact.
+        Double-free and foreign ids fail loudly."""
+        for b in blocks:
+            r = self._refs.get(b)
+            if r is None:
                 raise ValueError(
                     f"block {b} is not allocated (double free or foreign id)"
                 )
-            self._allocated.discard(b)
-            self._free.append(b)
+            if r > 1:
+                self._refs[b] = r - 1
+            else:
+                del self._refs[b]
+                if b in self._cached_flag:
+                    self._lru[b] = None   # newest LRU entry
+                else:
+                    self._free.append(b)
+
+    def mark_cached(self, block: int) -> None:
+        """Register ``block`` with the prefix cache: when its refcount
+        reaches 0 it is retained (reclaimable) instead of freed."""
+        if block not in self._refs and block not in self._lru:
+            raise ValueError(f"block {block} is not allocated")
+        self._cached_flag.add(block)
+
+    def uncache(self, block: int) -> None:
+        """Withdraw the cache registration; a zero-ref block returns to
+        the free list immediately."""
+        self._cached_flag.discard(block)
+        if block in self._lru:
+            del self._lru[block]
+            self._free.append(block)
+
+
+class _RadixNode:
+    __slots__ = ("key", "block", "parent", "children")
+
+    def __init__(self, key, block, parent):
+        self.key = key
+        self.block = block
+        self.parent = parent
+        self.children: dict[tuple, "_RadixNode"] = {}
+
+
+class PrefixCache:
+    """Block-granularity radix index over cached KV blocks, keyed on
+    token ids.
+
+    Each edge consumes exactly ``block_size`` token ids (a full block's
+    worth); a node owns the pool block holding that span's KV. ``lookup``
+    walks a prompt's full blocks root-down and returns the longest run of
+    cached blocks — the caller maps them into its block table and
+    increfs them (``BlockAllocator.share``). ``insert`` registers a
+    finished (or fully prefilled) request's full blocks; first writer
+    wins, so a prefix is backed by one canonical block no matter how many
+    requests computed it.
+
+    Eviction is driven entirely by the allocator under allocation
+    pressure: the cache installs ``evict_filter`` (leaf blocks first —
+    refcounts are monotone non-increasing root-to-leaf because requests
+    map prefix-closed runs, so a zero-ref interior node's whole subtree
+    is zero-ref and the deepest, least-shared spans go first) and
+    ``on_evict`` (drop the radix entry; any orphaned descendants are
+    uncached and recycled to the free list)."""
+
+    def __init__(self, allocator: BlockAllocator, block_size: int):
+        self.allocator = allocator
+        self.block_size = block_size
+        self._root = _RadixNode(None, -1, None)
+        self._by_block: dict[int, _RadixNode] = {}
+        allocator.on_evict = self._on_evict
+        allocator.evict_filter = self._evictable
+        self.lookups = 0
+        self.hit_blocks = 0
+        self.inserted_blocks = 0
+        self.evicted_blocks = 0
+
+    def __len__(self) -> int:
+        return len(self._by_block)
+
+    def lookup(self, tokens) -> list[int]:
+        """Longest cached full-block prefix of ``tokens``: pool block ids
+        in position order. Pure — the caller increfs on commit."""
+        bs = self.block_size
+        node = self._root
+        out: list[int] = []
+        for i in range(len(tokens) // bs):
+            child = node.children.get(tuple(tokens[i * bs:(i + 1) * bs]))
+            if child is None:
+                break
+            out.append(child.block)
+            node = child
+        self.lookups += 1
+        self.hit_blocks += len(out)
+        return out
+
+    def insert(self, tokens, blocks) -> int:
+        """Register the full blocks of ``tokens`` backed by ``blocks``
+        (one pool id per full block, position order; a shorter ``blocks``
+        just registers fewer). Existing entries win — a duplicate block
+        keeps its owner's refs and frees normally. Returns the number of
+        newly indexed blocks."""
+        bs = self.block_size
+        node = self._root
+        new = 0
+        for i in range(min(len(tokens) // bs, len(blocks))):
+            key = tuple(tokens[i * bs:(i + 1) * bs])
+            child = node.children.get(key)
+            if child is None:
+                b = blocks[i]
+                if b in self._by_block:
+                    break   # content already indexed under another key
+                child = _RadixNode(key, b, node)
+                node.children[key] = child
+                self._by_block[b] = child
+                self.allocator.mark_cached(b)
+                new += 1
+            node = child
+        self.inserted_blocks += new
+        return new
+
+    def _evictable(self, block: int) -> bool:
+        node = self._by_block.get(block)
+        return node is None or not node.children
+
+    def _on_evict(self, block: int) -> None:
+        node = self._by_block.pop(block, None)
+        if node is None:
+            return
+        if node.parent is not None:
+            node.parent.children.pop(node.key, None)
+        self.evicted_blocks += 1
+        # Orphaned descendants are unreachable by lookup: recycle them.
+        # (Leaf-first eviction makes this rare; it only triggers when a
+        # refcount-ordering assumption is violated by an external user.)
+        stack = list(node.children.values())
+        while stack:
+            d = stack.pop()
+            self._by_block.pop(d.block, None)
+            self.evicted_blocks += 1
+            self.allocator.uncache(d.block)
+            stack.extend(d.children.values())
 
 
 @dataclasses.dataclass

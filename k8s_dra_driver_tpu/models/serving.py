@@ -1,4 +1,5 @@
-"""Continuous-batching decode engine over the paged KV cache.
+"""Continuous-batching decode engine over the paged KV cache, with
+cross-request prefix reuse and an overlapped serving tick.
 
 The serving loop the DRA-claimed slice runs under sustained traffic
 (ParvaGPU's large-scale concurrent-inference target, PAPERS.md): a fixed
@@ -6,6 +7,34 @@ number of **batch slots** share one paged KV pool (models/paged.py), and
 requests are admitted/retired at **token granularity** — a finishing
 sequence frees its slot and blocks on the very tick it completes, and a
 waiting request starts prefilling on the next.
+
+**Prefix-cache KV reuse.** Production traffic is redundant — system
+prompts, few-shot templates, agent loops re-sending conversation
+history — so retired requests return their full KV blocks to a
+block-granularity radix index (models/paged.PrefixCache) instead of the
+free list. Admission looks up the longest cached full-block prefix of
+the new prompt and maps those blocks straight into the request's block
+table (table indirection + a refcount — the fused paged decode-attention
+kernel needs no changes); chunked prefill only runs for the tail. When
+the cache covers the whole prompt, the final matched block is dropped
+from the mapping and recomputed into a private copy — copy-on-write by
+recompute: the request's first KV write would otherwise land inside a
+shared block, and the recompute reuses the existing prefill program
+instead of adding a third compiled copy kernel (content is
+bit-identical, so cache-hot serving stays token-for-token equal to
+cache-cold). Zero-ref cached blocks are evicted LRU-leaf-first, and only
+under allocation pressure.
+
+**Overlapped tick.** The decode step for tick N+1 is dispatched *before*
+the host consumes tick N's tokens: the previous step's on-device output
+feeds the next step's token input directly (no host round trip), and the
+host then does its per-request bookkeeping — one batched token fetch per
+tick, no per-request blocking ``device_get`` — while the device runs
+N+1. A request that finishes by EOS after its next step was already
+dispatched drains for one tick (the wasted token is discarded) before
+its blocks are released; length-bounded finishes are predicted on the
+host and never dispatch a wasted step, so greedy token streams are
+identical with the overlap on or off.
 
 Fixed shapes, compiled once. The engine owns exactly two jitted
 programs per weight/cache variant for its whole lifetime:
@@ -23,18 +52,22 @@ programs per weight/cache variant for its whole lifetime:
 Scheduling policy (host-side, deliberately simple and auditable):
 
 - **Admission**: FIFO; a request is admitted to a free slot only when
-  the free list covers its full prompt plus one block of headroom, so
-  admission itself can never preempt anyone.
+  free + reclaimable-cached blocks cover its full prompt (minus any
+  cached prefix) plus one block of headroom, so admission itself can
+  never preempt anyone.
 - **Block growth**: a running sequence crossing a block boundary
-  allocates from the free list; if the pool is dry, the engine preempts
-  to feed it (below) rather than stalling the whole batch.
+  allocates from the free list (evicting cold cached blocks if dry); if
+  nothing is reclaimable, the engine preempts to feed it (below) rather
+  than stalling the whole batch.
 - **Preemption**: victims are chosen youngest-first (most recently
   admitted), preferring requests still in prefill over running ones —
   running sequences are only evicted when no prefill victim exists.
-  A preempted request is reset and requeued at the FRONT of the wait
-  queue (it keeps its arrival priority); its blocks return to the free
-  list. If preemption cannot free enough blocks (the request alone
-  exceeds the pool), a typed OutOfBlocksError surfaces the sizing bug.
+  Preempting a request that maps shared prefix blocks *decrefs* them
+  (the cached copies survive, so its re-admission is usually a cache
+  hit); a preempted request is reset and requeued at the FRONT of the
+  wait queue. If preemption cannot free enough blocks (the request
+  alone exceeds the pool), a typed OutOfBlocksError surfaces the sizing
+  bug.
 """
 
 from __future__ import annotations
@@ -54,12 +87,14 @@ from .paged import (
     OutOfBlocksError,
     PagedKVCache,
     PagedQuantKVCache,
+    PrefixCache,
     _init_pools,
 )
 
 WAITING = "waiting"
 PREFILL = "prefill"
 RUNNING = "running"
+DRAINING = "draining"   # finished, but a dispatched step still uses its blocks
 FINISHED = "finished"
 
 
@@ -74,6 +109,7 @@ class Request:
     slot: int = -1
     blocks: list[int] = dataclasses.field(default_factory=list)
     prefilled: int = 0                 # prompt tokens written to the pool
+    cached_tokens: int = 0             # prompt tokens served from the cache
     generated: list[int] = dataclasses.field(default_factory=list)
     pending: int = -1                  # sampled, kv not yet written
     admit_seq: int = -1                # admission order (victim choice)
@@ -101,6 +137,17 @@ class ServingStats:
     decode_steps: int = 0
     prefill_chunks: int = 0
     tokens_generated: int = 0
+    # Prefix-cache observability: lookups/hits are per admission;
+    # hit_tokens are prompt tokens served straight from cached blocks
+    # (== prefill tokens saved); cow_recomputes counts full-prompt hits
+    # whose trailing block was recomputed into a private copy.
+    prefix_lookups: int = 0
+    prefix_hits: int = 0
+    prefix_hit_tokens: int = 0
+    cow_recomputes: int = 0
+    prompt_tokens: int = 0             # admitted prompt tokens
+    prefill_tokens: int = 0            # prompt tokens actually computed
+    queue_depth: list = dataclasses.field(default_factory=list)
     ttft_s: list = dataclasses.field(default_factory=list)
     token_interval_s: list = dataclasses.field(default_factory=list)
     request_latency_s: list = dataclasses.field(default_factory=list)
@@ -121,9 +168,26 @@ class ServingStats:
     def p99_ttft_ms(self) -> float:
         return self._pctl(self.ttft_s, 0.99) * 1e3
 
+    def hit_rate(self) -> float:
+        """Fraction of admitted prompt tokens served from the cache."""
+        return self.prefix_hit_tokens / max(self.prompt_tokens, 1)
+
+    def queue_depth_mean(self) -> float:
+        return (sum(self.queue_depth) / len(self.queue_depth)
+                if self.queue_depth else 0.0)
+
+    def queue_depth_max(self) -> int:
+        return max(self.queue_depth) if self.queue_depth else 0
+
 
 class DecodeEngine:
-    """Fixed-slot continuous-batching engine. See module docstring."""
+    """Fixed-slot continuous-batching engine. See module docstring.
+
+    ``prefix_cache=False`` disables cross-request KV reuse (the bench
+    baseline); ``overlap=False`` consumes every decode step's tokens
+    synchronously (the pre-overlap tick, kept for A/B timing — token
+    streams are identical at temperature 0 either way).
+    """
 
     def __init__(
         self,
@@ -138,6 +202,8 @@ class DecodeEngine:
         quantize_cache: bool = False,
         eos_id: int | None = None,
         temperature: float = 0.0,
+        prefix_cache: bool = True,
+        overlap: bool = True,
         mesh=None,
         clock=time.monotonic,
     ):
@@ -149,6 +215,7 @@ class DecodeEngine:
         self.quantize_cache = quantize_cache
         self.eos_id = eos_id
         self.temperature = temperature
+        self.overlap = overlap
         self.mesh = mesh
         self._clock = clock
         # What the MoE MLP will actually run per program (decode steps
@@ -179,13 +246,16 @@ class DecodeEngine:
         self.max_seq_len = self.max_blocks_per_seq * block_size
 
         self.allocator = BlockAllocator(num_blocks)
+        self.prefix_cache = (
+            PrefixCache(self.allocator, block_size) if prefix_cache
+            else None
+        )
         pools = _init_pools(config, num_blocks, block_size,
                             quantized=quantize_cache)
         self._pools = tuple(pools)
         b = batch_slots
         self._tables = np.zeros((b, self.max_blocks_per_seq), np.int32)
         self._lengths = np.zeros((b,), np.int32)
-        self._pending = np.zeros((b,), np.int32)
         self._slots: list[Optional[Request]] = [None] * b
         self._slot_last_token_t: list[float] = [0.0] * b
         self.waiting: deque[Request] = deque()
@@ -194,6 +264,10 @@ class DecodeEngine:
         self._rid = 0
         self._admit_seq = 0
         self._rng = jax.random.PRNGKey(0)
+        # Double-buffer state: (on-device [B] next-token array, [(req,
+        # slot), ...] it was dispatched for). At most one step in flight.
+        self._inflight = None
+        self._zero_tokens = None
 
         cache_cls = PagedQuantKVCache if quantize_cache else PagedKVCache
 
@@ -216,8 +290,15 @@ class DecodeEngine:
                 return (cache.k, cache.v, cache.k_scale, cache.v_scale)
             return (cache.k, cache.v)
 
-        def _decode_fn(params, pools, tables, lengths, tokens, active, key):
+        def _decode_fn(params, pools, tables, lengths, prev_tokens,
+                       override, use_override, active, key):
             self.compile_counts["decode_step"] += 1
+            # Overlapped tick: slots carried from the previous step read
+            # their pending token straight from that step's on-device
+            # output (prev_tokens); everyone else (fresh prefill, re-
+            # admission, post-drain) is overridden from host state. The
+            # merge lives inside the one compiled program.
+            tokens = jnp.where(use_override, override, prev_tokens)
             cache = _mk_cache(pools, tables, lengths)
             logits, cache = _forward_with_cache(
                 params, tokens[:, None], cache, config,
@@ -273,6 +354,7 @@ class DecodeEngine:
             raise OutOfBlocksError(
                 blocks_needed, self.allocator.num_free,
                 self.allocator.num_blocks,
+                reclaimable=self.allocator.num_cached,
             )
         req = Request(
             rid=self._rid, prompt=prompt, max_new_tokens=max_new_tokens,
@@ -288,12 +370,15 @@ class DecodeEngine:
 
     @property
     def idle(self) -> bool:
-        return self.num_active == 0 and not self.waiting
+        return (self.num_active == 0 and not self.waiting
+                and self._inflight is None)
 
     def tick(self) -> None:
         """One scheduling round: admit, advance one prefill chunk, then
-        one decode step for every running slot."""
+        dispatch one decode step for every running slot (consuming the
+        previous step's tokens while the new one runs on device)."""
         self.stats.ticks += 1
+        self.stats.queue_depth.append(len(self.waiting))
         self._admit()
         self._prefill_tick()
         self._decode_tick()
@@ -307,17 +392,34 @@ class DecodeEngine:
         raise RuntimeError(f"engine not idle after {max_ticks} ticks")
 
     def assert_no_leaks(self) -> None:
-        """After drain: every block is back on the free list."""
+        """After drain: pool-exact accounting. No block is held by any
+        request (refcount > 0), and free + prefix-cached blocks cover
+        the pool exactly — cached blocks are zero-ref and reclaimable,
+        not leaks."""
         if not self.idle:
             raise AssertionError("engine not idle")
-        if self.allocator.num_allocated:
+        a = self.allocator
+        if a.num_allocated:
             raise AssertionError(
-                f"{self.allocator.num_allocated} block(s) leaked"
+                f"{a.num_allocated} block(s) leaked (held refs after "
+                f"drain)"
+            )
+        if a.num_free + a.num_cached != a.num_blocks:
+            raise AssertionError(
+                f"pool accounting broken: {a.num_free} free + "
+                f"{a.num_cached} cached != {a.num_blocks} total"
             )
 
     # -- scheduling internals ---------------------------------------------
 
     def _admit(self) -> None:
+        # Budget: admissions reserve their headroom for the whole loop —
+        # blocks are allocated lazily at prefill, so two same-tick
+        # admissions must not both count the same available blocks. The
+        # reservation is per-tick only: across ticks, running requests'
+        # block growth may still outrun an admitted-but-unprefilled
+        # request's headroom, and the preemption path absorbs that.
+        budget = self.allocator.num_available
         while self.waiting:
             free_slot = next(
                 (i for i, r in enumerate(self._slots) if r is None), None
@@ -325,38 +427,77 @@ class DecodeEngine:
             if free_slot is None:
                 return
             req = self.waiting[0]
-            # Admission covers the full prompt + one block of headroom so
-            # admitting can never preempt an already-running sequence —
-            # capped at the request's lifetime need (which submit()
+            bs = self.block_size
+            lifetime = -(-(len(req.prompt) + req.max_new_tokens) // bs)
+            hit: list[int] = []
+            cow = False
+            if self.prefix_cache is not None:
+                hit = self.prefix_cache.lookup(
+                    req.prompt
+                )[: self.max_blocks_per_seq]
+                if hit and len(hit) * bs >= len(req.prompt):
+                    # Full-prompt cover. The last prompt token must still
+                    # run (its logits sample the first output) and its KV
+                    # write would land inside the final matched block —
+                    # copy-on-write: drop that block from the mapping and
+                    # let chunked prefill recompute it into a private
+                    # copy (bit-identical, no extra compiled program).
+                    hit = hit[:-1]
+                    cow = True
+            # Admission covers the uncached prompt span + one block of
+            # headroom so admitting can never preempt a running sequence
+            # — capped at the request's lifetime need (which submit()
             # validated against the pool), else a prompt that exactly
             # fills its block budget could never admit into an idle pool.
-            lifetime = -(
-                -(len(req.prompt) + req.max_new_tokens) // self.block_size
-            )
+            # Hit blocks sitting in the reclaimable LRU are about to be
+            # revived by share() and must not double as headroom (a hit
+            # held by another live request costs nothing extra).
             need = min(
-                -(-len(req.prompt) // self.block_size) + 1, lifetime
+                -(-len(req.prompt) // bs) + 1, lifetime
+            ) - len(hit)
+            revived = sum(
+                1 for b in hit if self.allocator.ref_count(b) == 0
             )
-            if self.allocator.num_free < need:
+            if budget - revived < need:
                 return
+            budget -= need + revived
             self.waiting.popleft()
             req.state = PREFILL
             req.slot = free_slot
-            req.prefilled = 0
             req.admit_seq = self._admit_seq
             self._admit_seq += 1
             self._slots[free_slot] = req
-            self._lengths[free_slot] = 0
             self._tables[free_slot, :] = 0
+            st = self.stats
+            st.prompt_tokens += len(req.prompt)
+            if self.prefix_cache is not None:
+                st.prefix_lookups += 1
+            if hit:
+                # Sharing is table indirection plus a refcount: the
+                # matched blocks' KV is read in place, zero prefill.
+                self.allocator.share(hit)
+                req.blocks = list(hit)
+                self._tables[free_slot, : len(hit)] = hit
+                req.prefilled = len(hit) * bs
+                st.prefix_hits += 1
+                st.prefix_hit_tokens += req.prefilled
+            else:
+                req.prefilled = 0
+            req.cached_tokens = req.prefilled
+            st.cow_recomputes += int(cow)
+            self._lengths[free_slot] = req.prefilled
 
     def _ensure_blocks(self, req: Request, positions: int) -> None:
         """Grow ``req``'s block table to cover ``positions`` tokens,
-        preempting younger requests if the pool is dry."""
+        preempting younger requests if the pool (free + reclaimable
+        cached) is dry."""
         need = -(-positions // self.block_size)
         while len(req.blocks) < need:
-            # A victim still in early prefill may hold zero blocks: keep
-            # preempting until a block is actually free (_preempt_for
-            # raises a typed error once nobody is left to evict).
-            while self.allocator.num_free == 0:
+            # A victim still in early prefill may hold zero blocks, and
+            # preempting a prefix-sharing victim only decrefs: keep
+            # preempting until a block is actually obtainable
+            # (_preempt_for raises a typed error once nobody is left).
+            while self.allocator.num_available == 0:
                 self._preempt_for(req)
             new = self.allocator.alloc(1)[0]
             self._tables[req.slot, len(req.blocks)] = new
@@ -364,13 +505,25 @@ class DecodeEngine:
 
     def _preempt_for(self, needy: Request) -> None:
         """Evict the youngest other request (prefill-state preferred) and
-        recycle its blocks; typed failure when nobody can be evicted."""
+        recycle its blocks; typed failure when nobody can be evicted.
+        Draining requests are not victims — their blocks are still read
+        by the in-flight step — but consuming that step releases them,
+        so try that before giving up."""
         candidates = [
             r for r in self._slots
             if r is not None and r is not needy
+            and r.state in (PREFILL, RUNNING)
         ]
         if not candidates:
-            raise OutOfBlocksError(1, 0, self.allocator.num_blocks)
+            if self._inflight is not None and any(
+                r.state == DRAINING for r, _ in self._inflight[1]
+            ):
+                self._consume_inflight()
+                return
+            raise OutOfBlocksError(
+                1, 0, self.allocator.num_blocks,
+                reclaimable=self.allocator.num_cached,
+            )
         in_prefill = [r for r in candidates if r.state == PREFILL]
         pool = in_prefill or candidates
         victim = max(pool, key=lambda r: r.admit_seq)
@@ -379,6 +532,9 @@ class DecodeEngine:
 
     def _evict(self, req: Request, requeue: bool) -> None:
         slot = req.slot
+        # Uniform release: private blocks were alloc'd at refcount 1 and
+        # shared prefix blocks were incref'd at admission, so a decref
+        # per held block is exact — cached copies survive eviction.
         self.allocator.free(req.blocks)
         req.blocks = []
         req.slot = -1
@@ -388,7 +544,10 @@ class DecodeEngine:
         if requeue:
             # Restart from scratch on the next admission; the handle keeps
             # its identity (and arrival priority) but drops partial work.
+            # (Its prompt blocks usually survive in the prefix cache, so
+            # the restart is typically a cache hit.)
             req.prefilled = 0
+            req.cached_tokens = 0
             req.generated = []
             req.pending = -1
             req.first_token_at = None
@@ -396,13 +555,34 @@ class DecodeEngine:
             req.preemptions += 1
             self.waiting.appendleft(req)
 
-    def _finish(self, req: Request) -> None:
-        req.state = FINISHED
+    def _complete(self, req: Request, slot: int) -> None:
+        """The request's final token was just consumed: record stats,
+        then release its blocks — unless a newer dispatched step still
+        references them (EOS surprise under the overlapped tick), in
+        which case it drains for one tick first."""
         req.finished_at = self._clock()
         self.stats.completed += 1
         self.stats.request_latency_s.append(
             req.finished_at - req.arrived_at
         )
+        if self._covered_by_inflight(req, slot):
+            req.state = DRAINING
+        else:
+            self._release(req)
+
+    def _covered_by_inflight(self, req: Request, slot: int) -> bool:
+        return self._inflight is not None and any(
+            r is req and s == slot for r, s in self._inflight[1]
+        )
+
+    def _release(self, req: Request) -> None:
+        """Retire: return blocks to the prefix cache instead of freeing.
+        Only full blocks whose KV is guaranteed written in every tick
+        mode are indexed (the last generated token's KV may not be), so
+        cache content is identical with the overlap on or off."""
+        req.state = FINISHED
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(req.tokens[:-1], req.blocks)
         self._evict(req, requeue=False)
 
     def _next_key(self):
@@ -424,18 +604,28 @@ class DecodeEngine:
         padded = np.zeros((self.prefill_chunk,), np.int32)
         padded[:n_valid] = chunk
         self._ensure_blocks(req, lo + n_valid)
+        # The row is copied, not viewed: a still-running overlapped
+        # decode step may alias self._tables host memory (see
+        # _decode_tick), and this tick's growth just mutated it.
         tok, self._pools = self._prefill(
             self.params, self._pools,
-            jnp.asarray(self._tables[req.slot]),
+            jnp.asarray(self._tables[req.slot].copy()),
             jnp.asarray(np.int32(lo)),
             jnp.asarray(np.int32(n_valid)),
             jnp.asarray(padded),
             self._next_key(),
         )
         self.stats.prefill_chunks += 1
+        self.stats.prefill_tokens += n_valid
         req.prefilled = lo + n_valid
         self._lengths[req.slot] = req.prefilled
         if req.prefilled == len(req.prompt):
+            if self.prefix_cache is not None:
+                # Promote the prompt's full blocks right away so
+                # concurrent same-prefix requests share them without
+                # waiting for this one to retire (first writer wins; a
+                # COW-recomputed duplicate is simply not indexed).
+                self.prefix_cache.insert(req.prompt, req.blocks)
             # The last prompt logits sample the first generated token.
             now = self._clock()
             first = int(tok)
@@ -447,7 +637,7 @@ class DecodeEngine:
             self.stats.ttft_s.append(now - req.arrived_at)
             self._slot_last_token_t[req.slot] = now
             if self._is_final(req, first):
-                self._finish(req)
+                self._complete(req, req.slot)
 
     def _is_final(self, req: Request, tok: int) -> bool:
         return (
@@ -455,47 +645,120 @@ class DecodeEngine:
             or (self.eos_id is not None and tok == self.eos_id)
         )
 
+    def _prev_tokens_input(self):
+        if self._inflight is not None:
+            return self._inflight[0]
+        if self._zero_tokens is None:
+            self._zero_tokens = jnp.zeros((self.batch_slots,), jnp.int32)
+        return self._zero_tokens
+
     def _decode_tick(self) -> None:
-        running = [
-            r for r in self._slots
-            if r is not None and r.state == RUNNING
+        def runnable():
+            return [
+                r for r in self._slots
+                if r is not None and r.state == RUNNING
+            ]
+
+        inflight_slots = (
+            {id(r): s for r, s in self._inflight[1]}
+            if self._inflight is not None else {}
+        )
+
+        def carried(r):
+            return inflight_slots.get(id(r)) == r.slot
+
+        # A slot whose unconsumed in-flight token is certain to reach
+        # max_new_tokens finishes when that token lands: dispatching it
+        # again would only compute a discarded token (EOS is the one
+        # surprise the draining path absorbs).
+        dispatch = [
+            r for r in runnable()
+            if not (carried(r)
+                    and len(r.generated) + 1 >= r.max_new_tokens)
         ]
-        if not running:
-            return
         # The step writes each pending token's kv at position lengths[b]:
         # make sure that position has a block under it. An earlier
         # iteration's preemption may have evicted a later request in this
         # snapshot — growing an evicted request (slot -1) would write a
         # neighbour's block-table row and leak the block.
-        for r in running:
+        for r in dispatch:
             if r.state != RUNNING:
                 continue
-            self._ensure_blocks(r, self._lengths[r.slot] + 1)
-        # Preemption may have demoted someone mid-loop: re-collect.
-        running = [
-            r for r in self._slots
-            if r is not None and r.state == RUNNING
+            self._ensure_blocks(r, int(self._lengths[r.slot]) + 1)
+        # Preemption (or a forced drain) may have demoted someone
+        # mid-loop: re-collect against the same dispatch policy.
+        inflight_slots = (
+            {id(r): s for r, s in self._inflight[1]}
+            if self._inflight is not None else {}
+        )
+        dispatch = [
+            r for r in dispatch
+            if r.state == RUNNING and not (
+                carried(r) and len(r.generated) + 1 >= r.max_new_tokens
+            )
         ]
-        if not running:
+        if not dispatch:
+            self._consume_inflight()
             return
-        active = np.zeros((self.batch_slots,), bool)
-        for r in running:
+        b = self.batch_slots
+        active = np.zeros((b,), bool)
+        override = np.zeros((b,), np.int32)
+        use_override = np.zeros((b,), bool)
+        for r in dispatch:
             active[r.slot] = True
-            self._pending[r.slot] = r.pending
+            if not carried(r):
+                # Fresh from prefill / re-admission / post-drain: the
+                # pending token lives on the host, not in prev_tokens.
+                use_override[r.slot] = True
+                override[r.slot] = r.pending
+        prev_tokens = self._prev_tokens_input()
+        # Snapshot copies, not views: device_put of a numpy array can be
+        # zero-copy (the buffer aliases host memory), and with the
+        # overlapped tick the host mutates _tables/_lengths while the
+        # dispatched step may still be reading them.
         nxt, self._pools = self._decode(
             self.params, self._pools,
-            jnp.asarray(self._tables),
-            jnp.asarray(self._lengths),
-            jnp.asarray(self._pending),
+            jnp.asarray(self._tables.copy()),
+            jnp.asarray(self._lengths.copy()),
+            prev_tokens,
+            jnp.asarray(override),
+            jnp.asarray(use_override),
             jnp.asarray(active),
             self._next_key(),
         )
-        nxt = np.asarray(nxt)
-        now = self._clock()
+        # Committed-on-device length advances at dispatch: the write at
+        # position lengths[b] is in flight from here on.
+        for r in dispatch:
+            self._lengths[r.slot] += 1
         self.stats.decode_steps += 1
-        for r in running:
-            slot = r.slot
-            self._lengths[slot] += 1
+        prev, self._inflight = (
+            self._inflight, (nxt, [(r, r.slot) for r in dispatch])
+        )
+        if prev is not None:
+            # The device is now running step N+1; the host bookkeeping
+            # for step N below overlaps with it.
+            self._consume(prev)
+        if not self.overlap:
+            self._consume_inflight()
+
+    def _consume_inflight(self) -> None:
+        if self._inflight is not None:
+            cur, self._inflight = self._inflight, None
+            self._consume(cur)
+
+    def _consume(self, inflight) -> None:
+        nxt_dev, ran = inflight
+        nxt = np.asarray(nxt_dev)     # the single batched fetch per tick
+        now = self._clock()
+        for r, slot in ran:
+            if r.state == DRAINING and r.slot == slot:
+                # The wasted step of a request that EOS-finished after
+                # this step was dispatched: discard the token; its
+                # blocks are no longer referenced on device.
+                self._release(r)
+                continue
+            if r.state != RUNNING or r.slot != slot:
+                continue              # preempted since dispatch
             tok = int(nxt[slot])
             r.generated.append(tok)
             r.pending = tok
@@ -505,4 +768,4 @@ class DecodeEngine:
             )
             self._slot_last_token_t[slot] = now
             if self._is_final(r, tok):
-                self._finish(r)
+                self._complete(r, slot)

@@ -54,6 +54,9 @@ def speculative_generate(
     k: int = 4,
     quantize_cache: bool = False,
     return_stats: bool = False,
+    target_state=None,
+    draft_cache=None,
+    return_caches: bool = False,
 ):
     """Greedy generation via draft speculation; returns [1, S + N], or
     (tokens, stats) with ``return_stats`` — stats = {"rounds",
@@ -65,6 +68,22 @@ def speculative_generate(
 
     ``k`` draft tokens are proposed per verification round. Requires the
     two configs to share a vocabulary.
+
+    **Shared/COW prefix blocks.** ``target_state=(last_logits, cache)``
+    and ``draft_cache`` let the caller start from caches prefilled via
+    ``decode.prefill_cached`` over a shared paged pool, so a cached
+    prompt prefix is reused instead of re-prefilled. This is safe
+    against cached blocks by construction: every write this loop issues
+    (draft proposals, verification chunks, post-rewind overwrites)
+    lands at positions >= len(prompt), and ``prefill_cached``'s
+    copy-on-write rule guarantees mapped shared blocks only cover
+    positions strictly below the first recomputed tail token — so a
+    draft or verify write can never mutate a cached block; it always
+    hits a private (COW-materialized or freshly allocated) one. The
+    caches must span ``s + max_new_tokens + k + 1`` positions.
+    ``return_caches`` appends the final ``(target_cache, draft_cache)``
+    to the return value (the cached-block-immutability regression test
+    checksums pool rows through it).
     """
     b, s = prompt.shape
     if b != 1:
@@ -99,14 +118,30 @@ def speculative_generate(
         else target_config
     )
 
-    logits_t, cache_t = prefill(
-        target_params, prompt, target_config, max_len,
-        quantize_cache=quantize_cache,
-    )
-    _, cache_d = prefill(
-        draft_params, prompt, draft_config, max_len,
-        quantize_cache=quantize_cache,
-    )
+    if target_state is None:
+        logits_t, cache_t = prefill(
+            target_params, prompt, target_config, max_len,
+            quantize_cache=quantize_cache,
+        )
+    else:
+        logits_t, cache_t = target_state
+        if cache_t.max_len < max_len:
+            raise ValueError(
+                f"target cache spans {cache_t.max_len} positions but the "
+                f"run needs {max_len} (= s + max_new_tokens + k + 1)"
+            )
+    if draft_cache is None:
+        _, cache_d = prefill(
+            draft_params, prompt, draft_config, max_len,
+            quantize_cache=quantize_cache,
+        )
+    else:
+        cache_d = draft_cache
+        if cache_d.max_len < max_len:
+            raise ValueError(
+                f"draft cache spans {cache_d.max_len} positions but the "
+                f"run needs {max_len} (= s + max_new_tokens + k + 1)"
+            )
     first = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)  # [1]
 
     out = jnp.zeros((1, max_new_tokens + k + 1), jnp.int32)
@@ -170,17 +205,20 @@ def speculative_generate(
 
     n0 = jnp.asarray(1, jnp.int32)
     zero = jnp.asarray(0, jnp.int32)
-    _, _, _, _, out, rounds, accepted = jax.lax.while_loop(
+    _, _, cache_t, cache_d, out, rounds, accepted = jax.lax.while_loop(
         cond, body, (n0, first, cache_t, cache_d, out, zero, zero)
     )
     tokens = jnp.concatenate([prompt, out[:, :max_new_tokens]], axis=1)
+    result = [tokens]
     if return_stats:
         rate = accepted.astype(jnp.float32) / jnp.maximum(
             rounds.astype(jnp.float32) * k, 1.0
         )
-        return tokens, {
+        result.append({
             "rounds": rounds,
             "accepted": accepted,
             "acceptance_rate": rate,
-        }
-    return tokens
+        })
+    if return_caches:
+        result.append((cache_t, cache_d))
+    return result[0] if len(result) == 1 else tuple(result)

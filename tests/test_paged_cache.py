@@ -1,9 +1,10 @@
-"""Paged-cache block allocator + index arithmetic invariants.
+"""Paged-cache block allocator + prefix-cache index invariants.
 
 The allocator is the safety boundary of the shared KV pool: a leaked or
 double-owned block silently corrupts a neighbour sequence's cache, so
-every transition (alloc/free/reuse/eviction/exhaustion) is pinned here,
-alongside the flat-index math the write path and gather fallback share.
+every transition (alloc/share/decref/cache/evict/exhaustion) is pinned
+here, alongside the radix prefix index and the flat-index math the
+write path and gather fallback share.
 """
 
 import jax.numpy as jnp
@@ -15,6 +16,7 @@ from k8s_dra_driver_tpu.models.paged import (
     OutOfBlocksError,
     PagedKVCache,
     PagedQuantKVCache,
+    PrefixCache,
     flat_write_positions,
     gather_indices,
 )
@@ -90,6 +92,179 @@ class TestBlockAllocator:
             a.alloc(1)
         # Zero-block request still succeeds at exhaustion.
         assert a.alloc(0) == []
+
+
+class TestRefCounting:
+    def test_share_then_decref_frees_only_at_zero(self):
+        a = BlockAllocator(4)
+        (b,) = a.alloc(1)
+        a.share([b])
+        assert a.ref_count(b) == 2
+        a.free([b])                      # decref: still held
+        assert a.ref_count(b) == 1 and a.num_free == 3
+        a.free([b])                      # last owner: back on free list
+        assert a.ref_count(b) == 0 and a.num_free == 4
+
+    def test_double_free_still_loud_with_refcounts(self):
+        a = BlockAllocator(4)
+        (b,) = a.alloc(1)
+        a.share([b])
+        a.free([b])
+        a.free([b])
+        with pytest.raises(ValueError, match="double free"):
+            a.free([b])
+
+    def test_incref_on_foreign_block_rejected(self):
+        a = BlockAllocator(4)
+        with pytest.raises(ValueError, match="foreign"):
+            a.incref(2)
+
+    def test_cached_block_parks_in_lru_and_revives(self):
+        a = BlockAllocator(4)
+        (b,) = a.alloc(1)
+        a.mark_cached(b)
+        a.free([b])
+        # Zero-ref but cached: reclaimable, not free.
+        assert a.num_free == 3 and a.num_cached == 1
+        assert a.num_available == 4 and a.num_allocated == 0
+        a.incref(b)                      # cache hit: revived at ref 1
+        assert a.ref_count(b) == 1 and a.num_cached == 0
+        a.free([b])                      # still cache-flagged: parks again
+        assert a.num_cached == 1
+
+    def test_alloc_reclaims_cached_lru_under_pressure_only(self):
+        a = BlockAllocator(4)
+        evicted = []
+        a.on_evict = evicted.append
+        held = a.alloc(2)
+        cached = a.alloc(2)
+        for b in cached:
+            a.mark_cached(b)
+        a.free(cached)                   # both park in the LRU
+        a.free([held[0]])                # one genuinely free block
+        assert a.num_free == 1 and a.num_cached == 2
+        (x,) = a.alloc(1)                # served from the free list...
+        assert evicted == []             # ...no eviction without pressure
+        (y,) = a.alloc(1)                # free list dry: evict LRU-oldest
+        assert evicted == [cached[0]]
+        assert y == cached[0]
+        assert a.num_cached == 1
+
+    def test_out_of_blocks_reports_reclaimable(self):
+        a = BlockAllocator(4)
+        blocks = a.alloc(4)
+        a.mark_cached(blocks[0])
+        a.free([blocks[0]])
+        with pytest.raises(OutOfBlocksError) as ei:
+            a.alloc(2)
+        assert ei.value.requested == 2
+        assert ei.value.free == 0
+        assert ei.value.reclaimable == 1
+        assert ei.value.total == 4
+        assert "reclaimable" in str(ei.value)
+
+    def test_uncache_returns_zero_ref_block_to_free_list(self):
+        a = BlockAllocator(2)
+        (b,) = a.alloc(1)
+        a.mark_cached(b)
+        a.free([b])
+        assert a.num_cached == 1
+        a.uncache(b)
+        assert a.num_cached == 0 and a.num_free == 2
+
+    def test_pool_exact_accounting_under_churn(self):
+        """free + cached + held == num_blocks after arbitrary
+        alloc/share/decref/cache interleavings."""
+        rng = np.random.RandomState(3)
+        a = BlockAllocator(12)
+        refs: list[int] = []    # one entry per owner-ref
+        for _ in range(400):
+            op = rng.rand()
+            if op < 0.4 and a.num_available:
+                refs.extend(a.alloc(1))
+            elif op < 0.6 and refs:
+                b = refs[rng.randint(len(refs))]
+                a.incref(b)     # share: a second owner of the same block
+                refs.append(b)
+            elif op < 0.8 and refs:
+                b = refs.pop(rng.randint(len(refs)))
+                if rng.rand() < 0.3:
+                    a.mark_cached(b)
+                a.free([b])
+            assert (a.num_free + a.num_cached + a.num_allocated
+                    == a.num_blocks)
+            assert a.num_allocated == len(set(refs))
+
+
+class TestPrefixCacheIndex:
+    def _mk(self, num_blocks=8, bs=4):
+        a = BlockAllocator(num_blocks)
+        return a, PrefixCache(a, bs)
+
+    def test_lookup_walks_longest_full_block_prefix(self):
+        a, pc = self._mk()
+        blocks = a.alloc(3)
+        tokens = list(range(12))
+        assert pc.insert(tokens, blocks) == 3
+        # Full match, partial match, diverging match, and a sub-block
+        # remainder that cannot match.
+        assert pc.lookup(tokens) == blocks
+        assert pc.lookup(tokens[:8]) == blocks[:2]
+        assert pc.lookup(tokens[:4] + [99, 99, 99, 99]) == blocks[:1]
+        assert pc.lookup(tokens[:6]) == blocks[:1]
+        assert pc.lookup([99] * 12) == []
+
+    def test_insert_first_writer_wins(self):
+        a, pc = self._mk()
+        first = a.alloc(2)
+        dup = a.alloc(2)
+        tokens = list(range(8))
+        assert pc.insert(tokens, first) == 2
+        assert pc.insert(tokens, dup) == 0     # duplicates not indexed
+        assert pc.lookup(tokens) == first
+        # The duplicate owner's blocks free normally (not cache-flagged),
+        # while the indexed originals stay held by their owner.
+        a.free(dup)
+        assert a.num_free == 6 and a.num_cached == 0
+        assert a.num_allocated == 2
+
+    def test_eviction_drops_radix_entry(self):
+        a, pc = self._mk(num_blocks=2, bs=2)
+        blocks = a.alloc(2)
+        pc.insert([1, 2, 3, 4], blocks)
+        a.free(blocks)                         # both cached, ref 0
+        got = a.alloc(2)                       # pressure: evict both
+        assert sorted(got) == sorted(blocks)
+        assert pc.lookup([1, 2, 3, 4]) == []
+        assert pc.evicted_blocks == 2
+
+    def test_eviction_prefers_leaves_over_shared_roots(self):
+        """The leaf filter: the chain root entered the LRU first (freed
+        first) but the deepest block must go first so the widely shared
+        prefix survives."""
+        a, pc = self._mk(num_blocks=4, bs=2)
+        chain = a.alloc(3)
+        pc.insert([1, 2, 3, 4, 5, 6], chain)
+        a.free(chain)                          # LRU order: root..leaf
+        (got,) = a.alloc(1)                    # one free block exists
+        assert got not in chain
+        (evicted,) = a.alloc(1)                # pressure: must pick leaf
+        assert evicted == chain[2]
+        assert pc.lookup([1, 2, 3, 4, 5, 6]) == chain[:2]
+
+    def test_shared_block_never_reclaimed(self):
+        a, pc = self._mk(num_blocks=3, bs=2)
+        blocks = a.alloc(2)
+        pc.insert([1, 2, 3, 4], blocks)
+        a.share(blocks)                        # a second owner maps them
+        a.free(blocks)                         # first owner retires
+        assert a.num_allocated == 2            # still held by the sharer
+        (x,) = a.alloc(1)
+        with pytest.raises(OutOfBlocksError):
+            a.alloc(1)                         # held blocks are not food
+        a.free(blocks)                         # sharer retires: now cached
+        assert a.num_cached == 2
+        assert sorted(a.alloc(2)) == sorted(blocks)
 
 
 class TestNoLeaksAfterEviction:

@@ -248,6 +248,11 @@ class TestPreemption:
         b = eng.submit([1, 2, 3], max_new_tokens=4)
         c = eng.submit([1, 2, 3], max_new_tokens=4)
         eng._admit()
+        # Same-tick admissions are budgeted (prompt+1 headroom each), so
+        # the third request admits on the next round — blocks are
+        # allocated lazily, which is how growth can still outrun a
+        # not-yet-prefilled request's headroom (the scenario below).
+        eng._admit()
         # Hand-build the state: a and c RUNNING holding two blocks each
         # (pool dry), b freshly admitted in PREFILL holding none.
         for req in (a, c):
@@ -334,6 +339,230 @@ class TestFixedShape:
         assert eng.compile_counts == {
             "decode_step": 1, "prefill_chunk": 1,
         }
+
+
+class TestPrefixReuse:
+    """Cross-request KV reuse: whatever the cache does — radix hits,
+    shared-block mapping, COW recompute, LRU eviction — every request's
+    tokens stay equal to solo generate(), and serving the same prefix
+    twice must actually skip prefill the second time."""
+
+    def test_cache_hot_matches_cold_and_saves_prefill(self, params):
+        prompt = _prompts(40, (20,))[0]
+        eng = DecodeEngine(
+            params, TINY, batch_slots=2, num_blocks=16, block_size=8,
+            max_seq_len=64, prefill_chunk=8,
+        )
+        a = eng.submit(prompt, max_new_tokens=N_NEW)
+        eng.run()
+        chunks_cold = eng.stats.prefill_chunks
+        b = eng.submit(prompt, max_new_tokens=N_NEW)
+        eng.run()
+        eng.assert_no_leaks()
+        ref = _reference(params, prompt)
+        assert a.tokens == ref
+        assert b.tokens == ref                      # token-for-token
+        assert b.cached_tokens > 0
+        assert eng.stats.prefix_hit_tokens == b.cached_tokens
+        # The hot pass prefilled strictly fewer chunks than the cold one.
+        assert eng.stats.prefill_chunks - chunks_cold < chunks_cold
+        assert eng.stats.hit_rate() > 0
+
+    def test_full_cover_prompt_triggers_cow_recompute(self, params):
+        """A block-aligned fully cached prompt maps all but its trailing
+        block (copy-on-write by recompute): the final prompt token still
+        runs, tokens stay exact, and the cached block is not mutated."""
+        prompt = _prompts(41, (16,))[0]             # 2 full blocks of 8
+        eng = DecodeEngine(
+            params, TINY, batch_slots=1, num_blocks=12, block_size=8,
+            max_seq_len=48, prefill_chunk=8,
+        )
+        a = eng.submit(prompt, max_new_tokens=N_NEW)
+        eng.run()
+        hit_blocks = eng.prefix_cache.lookup(prompt)
+        assert len(hit_blocks) == 2                 # full cover cached
+        import numpy as np
+
+        pool_k = np.asarray(eng._pools[0])
+        rows = slice(hit_blocks[-1] * 8, hit_blocks[-1] * 8 + 8)
+        before = pool_k[:, :, rows, :].copy()
+        b = eng.submit(prompt, max_new_tokens=N_NEW)
+        eng.run()
+        eng.assert_no_leaks()
+        assert b.tokens == a.tokens == _reference(params, prompt)
+        assert eng.stats.cow_recomputes == 1
+        assert b.cached_tokens == 8                 # mapped 1 of 2 blocks
+        after = np.asarray(eng._pools[0])[:, :, rows, :]
+        np.testing.assert_array_equal(after, before)
+
+    def test_shared_system_prompt_family(self, params):
+        """The production shape: one system prompt, many tails. Every
+        request matches solo generate; later requests hit the cache."""
+        rng = np.random.RandomState(42)
+        system = list(rng.randint(0, TINY.vocab_size, size=16))
+        prompts = [
+            system + list(rng.randint(0, TINY.vocab_size, size=5))
+            for _ in range(4)
+        ]
+        eng = DecodeEngine(
+            params, TINY, batch_slots=2, num_blocks=24, block_size=8,
+            max_seq_len=64, prefill_chunk=8,
+        )
+        reqs = [eng.submit(p, max_new_tokens=N_NEW) for p in prompts]
+        eng.run()
+        eng.assert_no_leaks()
+        for r, p in zip(reqs, prompts):
+            assert r.tokens == _reference(params, p), r.rid
+        assert eng.stats.prefix_hits >= 2
+        assert eng.stats.prefix_hit_tokens >= 2 * 16
+
+    def test_disabled_cache_is_bitwise_identical_to_enabled(self, params):
+        """Flag gate: prefix_cache=False serves the same tokens (the
+        bench baseline engine)."""
+        prompts = _prompts(43, (9, 21, 9))          # a repeat in traffic
+        outs = []
+        for flag in (True, False):
+            eng = DecodeEngine(
+                params, TINY, batch_slots=2, num_blocks=16, block_size=8,
+                max_seq_len=48, prefill_chunk=8, prefix_cache=flag,
+            )
+            reqs = [eng.submit(p, max_new_tokens=N_NEW) for p in prompts]
+            eng.run()
+            eng.assert_no_leaks()
+            outs.append([r.tokens for r in reqs])
+        assert outs[0] == outs[1]
+
+    def test_preempting_shared_request_decrefs_not_frees(self, params):
+        """Satellite: eviction paths understand refcounts. Preempt a
+        request that maps cached blocks; the cached copies must survive
+        (no double-free crash, pool-exact after drain) and its restart
+        should hit the cache again."""
+        prompt = _prompts(44, (16,))[0]
+        eng = DecodeEngine(
+            params, TINY, batch_slots=3, num_blocks=7, block_size=8,
+            max_seq_len=56, prefill_chunk=8,
+        )
+        a = eng.submit(prompt, max_new_tokens=8)
+        eng.run()                                    # seeds the cache
+        # Same prompt again plus heavy private traffic on a starved pool.
+        b = eng.submit(prompt, max_new_tokens=8)
+        others = [eng.submit(p, max_new_tokens=10)
+                  for p in _prompts(45, (12, 14))]
+        eng.run()
+        eng.assert_no_leaks()
+        assert eng.stats.preemptions > 0, "scenario must exercise eviction"
+        assert b.tokens == a.tokens == _reference(params, prompt, 8)
+        for r, p in zip(others, _prompts(45, (12, 14))):
+            assert r.tokens == _reference(params, p, 10)
+
+    def test_admission_headroom_discounts_own_revived_hit_blocks(
+        self, params
+    ):
+        """Regression (review-found): hit blocks sitting in the
+        reclaimable LRU were counted as available headroom AND revived
+        by the admission's share() — so a cache-hit request could admit
+        into a pool too dry for its tail and then preempt a RUNNING
+        request, violating the admission-never-preempts invariant."""
+        big = _prompts(60, (16,))[0]
+        eng = DecodeEngine(
+            params, TINY, batch_slots=2, num_blocks=3, block_size=8,
+            max_seq_len=24, prefill_chunk=8,
+        )
+        a = eng.submit(big, max_new_tokens=8)
+        eng.run()                   # seeds the cache: 2 blocks in LRU
+        assert eng.allocator.num_cached == 2
+        small = _prompts(61, (6,))[0]
+        b = eng.submit(small, max_new_tokens=2)   # takes the free block
+        c = eng.submit(big, max_new_tokens=8)     # full-cover cache hit
+        eng.run()
+        eng.assert_no_leaks()
+        # c must have WAITED for b's block instead of preempting it.
+        assert eng.stats.preemptions == 0
+        assert b.tokens == _reference(params, small, 2)
+        assert c.tokens == a.tokens == _reference(params, big, 8)
+
+    def test_leak_oracle_under_shared_and_private_churn(self, params):
+        """Satellite: churn shared and private requests through a small
+        pool (admissions, cache hits, COW, preemptions, LRU evictions)
+        and assert pool-exact accounting after every drain."""
+        rng = np.random.RandomState(7)
+        system = list(rng.randint(0, TINY.vocab_size, size=8))
+        eng = DecodeEngine(
+            params, TINY, batch_slots=3, num_blocks=8, block_size=8,
+            max_seq_len=48, prefill_chunk=8,
+        )
+        for round_ in range(4):
+            prompts = []
+            for i in range(3):
+                tail = list(rng.randint(0, TINY.vocab_size,
+                                        size=3 + (round_ + i) % 5))
+                prompts.append(system + tail if i % 2 == 0 else tail)
+            reqs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+            eng.run()
+            eng.assert_no_leaks()
+            alloc = eng.allocator
+            assert alloc.num_allocated == 0
+            assert alloc.num_free + alloc.num_cached == alloc.num_blocks
+            for r, p in zip(reqs, prompts):
+                assert r.tokens == _reference(params, p, 6), (
+                    round_, r.rid, r.preemptions
+                )
+        assert eng.stats.prefix_hits > 0
+        assert eng.allocator.evictions > 0, (
+            "churn must exercise LRU eviction under pressure"
+        )
+
+
+class TestOverlap:
+    """The double-buffered tick: dispatch N+1 while consuming N. Token
+    streams must be identical to the synchronous tick, EOS after an
+    already-dispatched step must drain cleanly, and the two-programs
+    contract must hold."""
+
+    def _serve(self, params, overlap, prompts, eos_id=None, n_new=8):
+        eng = DecodeEngine(
+            params, TINY, batch_slots=2, num_blocks=12, block_size=8,
+            max_seq_len=48, prefill_chunk=8, overlap=overlap,
+            eos_id=eos_id,
+        )
+        reqs = [eng.submit(p, max_new_tokens=n_new) for p in prompts]
+        eng.run()
+        eng.assert_no_leaks()
+        return eng, [r.tokens for r in reqs]
+
+    def test_overlap_matches_synchronous_tick(self, params):
+        prompts = _prompts(50, (5, 17, 9, 4))
+        eng_a, toks_a = self._serve(params, True, prompts)
+        eng_b, toks_b = self._serve(params, False, prompts)
+        assert toks_a == toks_b
+        assert eng_a.compile_counts == {
+            "decode_step": 1, "prefill_chunk": 1,
+        }
+
+    def test_eos_surprise_drains_wasted_step(self, params):
+        """EOS lands while the next step is in flight: the request
+        drains one tick, the wasted token is discarded, and its stream
+        still matches the synchronous engine's."""
+        prompt = _prompts(51, (6,))[0]
+        ref = _reference(params, prompt, 12)
+        eos = ref[len(prompt) + 3]                  # 4th generated token
+        eng_o, toks_o = self._serve(params, True, [prompt],
+                                    eos_id=eos, n_new=12)
+        eng_s, toks_s = self._serve(params, False, [prompt],
+                                    eos_id=eos, n_new=12)
+        assert toks_o == toks_s
+        assert toks_o[0] == ref[: len(prompt) + 4]
+        # The wasted in-flight token was computed but never committed.
+        assert eng_o.stats.decode_steps > eng_s.stats.decode_steps
+        assert eng_o.stats.tokens_generated == eng_s.stats.tokens_generated
+
+    def test_length_bounded_finish_never_wastes_a_step(self, params):
+        """max_new_tokens finishes are predicted host-side: overlapped
+        and synchronous engines run the same number of decode steps."""
+        prompts = _prompts(52, (5, 9))
+        eng_o, _ = self._serve(params, True, prompts)
+        eng_s, _ = self._serve(params, False, prompts)
+        assert eng_o.stats.decode_steps == eng_s.stats.decode_steps
 
 
 class TestStats:

@@ -8,6 +8,7 @@ random init), plus composition with the int8 cache.
 """
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -123,6 +124,118 @@ class TestSpeculative:
             )
         )(target_params, draft, prompt)
         np.testing.assert_array_equal(np.asarray(out), quant_ref)
+
+
+class TestSharedPrefixBlocks:
+    """Speculative decoding against shared/COW prefix blocks
+    (decode.prefill_cached over a shared paged pool): draft and verify
+    writes must trigger COW — never mutate a cached block — and the
+    cache-hot run must be token-exact against the cache-cold one and
+    against the plain (non-cached) path."""
+
+    def _pool(self, config, num_blocks, bs):
+        from k8s_dra_driver_tpu.models.paged import (
+            BlockAllocator,
+            PrefixCache,
+            _init_pools,
+        )
+
+        alloc = BlockAllocator(num_blocks)
+        return (tuple(_init_pools(config, num_blocks, bs)), alloc,
+                PrefixCache(alloc, bs))
+
+    def test_cache_hot_exact_and_cached_blocks_immutable(
+        self, target_params, prompt
+    ):
+        import dataclasses
+
+        from k8s_dra_driver_tpu.models.decode import prefill_cached
+        from k8s_dra_driver_tpu.models.speculative import (
+            speculative_generate,
+        )
+
+        bs, k, n_new = 4, 3, 8
+        # Block-aligned 8-token prompt: a full-cover cache hit, so both
+        # models' trailing matched blocks take the COW-recompute path.
+        prompt = jnp.concatenate([prompt, prompt[:, :2]], axis=1)
+        s = prompt.shape[1]
+        prompt_list = [int(t) for t in np.asarray(prompt)[0]]
+        max_len = s + n_new + k + 1
+        draft_cfg = dataclasses.replace(
+            CONFIG, hidden=32, n_layers=1, n_heads=2, n_kv_heads=1,
+            mlp_hidden=64,
+        )
+        draft_params = init_params(draft_cfg, jax.random.PRNGKey(7))
+        reference = np.asarray(speculative_generate(
+            target_params, draft_params, prompt, CONFIG, draft_cfg,
+            n_new, k=k,
+        ))
+
+        pools_t, alloc_t, pc_t = self._pool(CONFIG, 12, bs)
+        pools_d, alloc_d, pc_d = self._pool(draft_cfg, 12, bs)
+
+        def prefill_both(pt, pd):
+            lt, ct, bt, ht = prefill_cached(
+                target_params, prompt_list, CONFIG, max_len, pt,
+                alloc_t, bs, prefix_cache=pc_t,
+            )
+            ld, cd, bd, hd = prefill_cached(
+                draft_params, prompt_list, draft_cfg, max_len, pd,
+                alloc_d, bs, prefix_cache=pc_d,
+            )
+            return (lt, ct, bt, ht), (cd, bd, hd)
+
+        # Cache-cold pass seeds both prefix caches.
+        (lt, ct, bt, hit_t0), (cd, bd, hit_d0) = prefill_both(
+            pools_t, pools_d
+        )
+        assert hit_t0 == 0 and hit_d0 == 0
+        out_cold = np.asarray(speculative_generate(
+            target_params, draft_params, prompt, CONFIG, draft_cfg,
+            n_new, k=k, target_state=(lt, ct), draft_cache=cd,
+        ))
+        np.testing.assert_array_equal(out_cold, reference)
+        pools_t2, pools_d2 = (ct.k, ct.v), (cd.k, cd.v)
+        pc_t.insert(prompt_list, bt)
+        pc_d.insert(prompt_list, bd)
+        alloc_t.free(bt)
+        alloc_d.free(bd)
+
+        # Cache-hot pass: full-cover hit, trailing block COW-recomputed.
+        (lt2, ct2, bt2, hit_t), (cd2, bd2, hit_d) = prefill_both(
+            pools_t2, pools_d2
+        )
+        assert hit_t == s - bs and hit_d == s - bs
+        n_shared = hit_t // bs
+        assert bt2[:n_shared] == bt[:n_shared]     # same physical blocks
+        assert bt2[n_shared] != bt[n_shared]       # COW'd a private copy
+
+        def rows_of(blocks):
+            return [r for b in blocks[:n_shared]
+                    for r in range(b * bs, (b + 1) * bs)]
+
+        rows_t, rows_d = rows_of(bt2), rows_of(bd2)
+        before_t = np.asarray(ct2.k)[:, :, rows_t, :].copy()
+        before_d = np.asarray(cd2.k)[:, :, rows_d, :].copy()
+        out_hot, (fct, fcd) = speculative_generate(
+            target_params, draft_params, prompt, CONFIG, draft_cfg,
+            n_new, k=k, target_state=(lt2, ct2), draft_cache=cd2,
+            return_caches=True,
+        )
+        np.testing.assert_array_equal(np.asarray(out_hot), reference)
+        # Draft proposals and verification chunks wrote plenty — but
+        # never into a cached block.
+        np.testing.assert_array_equal(
+            np.asarray(fct.k)[:, :, rows_t, :], before_t
+        )
+        np.testing.assert_array_equal(
+            np.asarray(fcd.k)[:, :, rows_d, :], before_d
+        )
+        alloc_t.free(bt2)
+        alloc_d.free(bd2)
+        # Pool-exact: every non-cached block is back on the free list.
+        assert alloc_t.num_allocated == 0
+        assert alloc_t.num_free + alloc_t.num_cached == alloc_t.num_blocks
 
 
 class TestMoeTarget:

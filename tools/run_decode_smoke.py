@@ -2,17 +2,25 @@
 """Fast fixed-seed decode smoke for `make decodebench` (wired into
 `make verify`).
 
-Three gates per serving variant (bf16 / int8 weights / int8 KV cache),
+Four gates per serving variant (bf16 / int8 weights / int8 KV cache),
 all on the hermetic CPU backend with the tiny preset:
 
 1. **Compile-once**: driving the continuous-batching engine from the
    first token to a span-crossing length must trace exactly one decode
    step and one prefill chunk — the regression oracle for the
-   per-shape-recompile spreads of BENCH_r05.
+   per-shape-recompile spreads of BENCH_r05. The prefix cache and the
+   overlapped tick are both ON here: cache hits, COW recomputes, and
+   double-buffered dispatch must not add programs.
 2. **Determinism**: two engines fed the same seeded traffic produce
    identical token streams (a nondeterministic scheduler would make
    every bench number unreproducible).
-3. **Spread**: repeated timed runs of the same traffic must agree within
+3. **Shared-prefix determinism**: the same request served cache-cold
+   and then cache-hot (its prefix blocks mapped from the radix cache,
+   trailing block COW-recomputed) must produce identical sampled
+   tokens — prefix reuse may only change WHEN work happens, never what
+   comes out. The gate also requires the hot pass to actually hit
+   (prefill tokens saved > 0), so a silently dead cache fails loudly.
+4. **Spread**: repeated timed runs of the same traffic must agree within
    a threshold — 2% is the TPU acceptance bar; CPU wall clocks are far
    noisier, so the default here is loose (50%) and exists to catch
    order-of-magnitude pathologies (a recompile per step is >10x). Tune
@@ -80,6 +88,32 @@ def main() -> int:
         tokens_b = drive(build_engine(p, config, qkv), prompts, n_new=30)
         if tokens_a != tokens_b:
             failures.append(f"{label}: nondeterministic token streams")
+        # Shared-prefix determinism: the same request cache-cold vs
+        # cache-hot. The second submission of an identical prompt maps
+        # its prefix blocks from the radix cache (COW-recomputing the
+        # trailing block) and must emit identical tokens.
+        hot_eng = build_engine(p, config, qkv)
+        shared = prompts[1]                  # 11 tokens: one full block
+        (cold,) = drive(hot_eng, [shared], n_new=12)
+        saved_before = hot_eng.stats.prefix_hit_tokens
+        (hot,) = drive(hot_eng, [shared], n_new=12)
+        saved = hot_eng.stats.prefix_hit_tokens - saved_before
+        if cold != hot:
+            failures.append(
+                f"{label}: cache-hot tokens diverge from cache-cold"
+            )
+        if saved <= 0:
+            failures.append(
+                f"{label}: cache-hot pass saved no prefill tokens "
+                f"(prefix cache dead?)"
+            )
+        if dict(hot_eng.compile_counts) != {
+            "decode_step": 1, "prefill_chunk": 1,
+        }:
+            failures.append(
+                f"{label}: prefix-cache path compiled extra programs: "
+                f"{hot_eng.compile_counts}"
+            )
         # Spread: repeat the drained run on the warm engine (compile paid).
         times = []
         for _ in range(3):
