@@ -14,6 +14,14 @@ from .configs import (
     decode_config,
 )
 from .quantity import InvalidQuantityError, parse_quantity, to_mebibytes_string
+from .slo import (
+    BATCH_CLASS,
+    DEFAULT_LATENCY_CLASS,
+    INTERACTIVE_CLASS,
+    LATENCY_CLASSES,
+    REALTIME_CLASS,
+    SloConfig,
+)
 from .sharing import (
     DEFAULT_INTERVAL,
     EXCLUSIVE,
@@ -42,4 +50,6 @@ __all__ = [
     "DEFAULT_INTERVAL", "SHORT_INTERVAL", "MEDIUM_INTERVAL", "LONG_INTERVAL",
     "INTERVALS", "TpuSharing", "TimeSharedConfig", "ProcessSharedConfig",
     "PerChipHbmLimit", "ErrInvalidDeviceSelector", "ErrInvalidLimit",
+    "SloConfig", "LATENCY_CLASSES", "DEFAULT_LATENCY_CLASS",
+    "REALTIME_CLASS", "INTERACTIVE_CLASS", "BATCH_CLASS",
 ]

@@ -23,6 +23,7 @@ import re
 from typing import Optional
 
 from .quantity import InvalidQuantityError, parse_quantity, to_mebibytes_string
+from .slo import SloConfig
 
 # Strategies (sharing.go:28-31 analog).
 EXCLUSIVE = "Exclusive"
@@ -161,12 +162,17 @@ class ProcessSharedConfig:
     default_active_core_percentage: Optional[int] = None
     default_hbm_limit: Optional[str] = None
     per_chip_hbm_limit: Optional[PerChipHbmLimit] = None
+    # Dynamic-sharing contract (slo.py): min/burst shares, latency
+    # class, priority — what the rebalancer is allowed to do to the
+    # static grants above, and what it owes the claim.
+    slo: Optional[SloConfig] = None
 
     FIELDS = {
         "maxProcesses": "max_processes",
         "defaultActiveCorePercentage": "default_active_core_percentage",
         "defaultHbmLimit": "default_hbm_limit",
         "perChipHbmLimit": "per_chip_hbm_limit",
+        "slo": "slo",
     }
 
     @classmethod
@@ -180,6 +186,8 @@ class ProcessSharedConfig:
             kwargs["per_chip_hbm_limit"] = PerChipHbmLimit.from_dict(
                 kwargs["per_chip_hbm_limit"]
             )
+        if kwargs.get("slo") is not None:
+            kwargs["slo"] = SloConfig.from_dict(kwargs["slo"])
         return cls(**kwargs)
 
     def to_dict(self) -> dict:
@@ -192,11 +200,15 @@ class ProcessSharedConfig:
             out["defaultHbmLimit"] = self.default_hbm_limit
         if self.per_chip_hbm_limit is not None:
             out["perChipHbmLimit"] = self.per_chip_hbm_limit.to_dict()
+        if self.slo is not None:
+            out["slo"] = self.slo.to_dict()
         return out
 
     def normalize(self) -> None:
         if self.max_processes is None:
             self.max_processes = 2
+        if self.slo is not None:
+            self.slo.normalize()
 
     def validate(self) -> None:
         if self.max_processes is not None and not (1 <= self.max_processes <= 64):
@@ -218,6 +230,8 @@ class ProcessSharedConfig:
                 raise ErrInvalidLimit(str(e)) from e
         if self.per_chip_hbm_limit is not None:
             self.per_chip_hbm_limit.validate()
+        if self.slo is not None:
+            self.slo.validate()
 
 
 @dataclasses.dataclass

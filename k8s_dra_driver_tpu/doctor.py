@@ -25,7 +25,11 @@ ResourceClaims; then it re-runs the audit cross-checks FLEET-wide:
 - ICI channel occupancy vs the controller's published pools;
 - unsatisfiable allocation decisions surfaced by ``/debug/allocations``
   (the ``explain`` check), each mapped to a runbook hint answering "why
-  won't my claim schedule?".
+  won't my claim schedule?";
+- SLO starvation surfaced by ``/debug/rebalance`` (the ``slo`` check):
+  a claim below its declared min share for longer than its latency
+  class allows, with the node's recent rebalance decisions bundled as
+  the evidence trail.
 
 ``--bundle`` additionally writes a tar of every raw document (metrics,
 usage JSON, traces JSONL, readyz, cluster objects, findings) for
@@ -140,6 +144,7 @@ class NodeScrape:
     readyz_text: str = ""
     allocations_text: str = ""
     defrag: Optional[dict] = None
+    rebalance: Optional[dict] = None
     errors: list = dataclasses.field(default_factory=list)
 
     @property
@@ -233,6 +238,17 @@ def collect_node(name: str, url: str, timeout: float = 5.0) -> NodeScrape:
         # beside an allocator, so a 404 is a normal node plugin.
         if getattr(e, "code", None) != 404:
             scrape.errors.append(f"/debug/defrag: {e}")
+    try:
+        scrape.rebalance = json.loads(
+            _fetch(scrape.url + "/debug/rebalance", timeout)
+        )
+    except Exception as e:
+        # 404 = the dynamic-sharing rebalancer is simply not wired on
+        # this process (disabled, or an older plugin) — benign. Any
+        # OTHER failure is loud: silence must mean "no SLO trouble",
+        # never "couldn't look".
+        if getattr(e, "code", None) != 404:
+            scrape.errors.append(f"/debug/rebalance: {e}")
     reported = (scrape.usage or {}).get("node")
     if reported and reported != name:
         scrape.errors.append(
@@ -305,6 +321,30 @@ def fleet_findings(
             findings.append(DoctorFinding(
                 SEVERITY_ERROR, "readiness", node.name,
                 f"unrecognized /readyz state {node.readiness!r}",
+            ))
+        # SLO starvation, from the rebalancer's own share view
+        # (/debug/rebalance): a claim below its declared min share for
+        # longer than its latency class allows is a violation the
+        # rebalancer could not (or was not allowed to) heal.
+        for uid, claim in sorted(
+            ((node.rebalance or {}).get("claims") or {}).items()
+        ):
+            if not isinstance(claim, dict):
+                continue
+            below = claim.get("belowMinSeconds") or 0
+            grace = claim.get("graceSeconds")
+            if grace is None or below <= grace:
+                continue
+            findings.append(DoctorFinding(
+                SEVERITY_DRIFT, "slo",
+                f"{node.name}/{claim.get('namespace', '?')}/"
+                f"{claim.get('name', '?')}",
+                f"claim below its declared min share for {below:.0f}s "
+                f"(latency class {claim.get('latencyClass', '?')} "
+                f"allows {grace:.0f}s) — read the node's "
+                "/debug/rebalance decisions: co-tenants pinned at "
+                "their own min means the node is oversubscribed; "
+                "failed decisions mean the apply path is broken",
             ))
 
     claims_by_uid = {
@@ -633,6 +673,9 @@ def write_bundle(
             if node.defrag is not None:
                 add(tar, f"{base}/defrag.json",
                     json.dumps(node.defrag, indent=2, sort_keys=True))
+            if node.rebalance is not None:
+                add(tar, f"{base}/rebalance.json",
+                    json.dumps(node.rebalance, indent=2, sort_keys=True))
             if node.errors:
                 add(tar, f"{base}/errors.txt", "\n".join(node.errors) + "\n")
         if cluster is not None:

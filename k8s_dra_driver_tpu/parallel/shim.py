@@ -31,11 +31,22 @@ import contextlib
 import dataclasses
 import errno
 import fcntl
+import json
 import logging
 import os
 from typing import IO, Iterator, MutableMapping, Optional
 
 logger = logging.getLogger(__name__)
+
+# The generation-stamped limits document the node plugin renders into
+# the claim's shared dir (plugin/sharing.py LIMITS_FILE) and the marker
+# env var recording the last generation THIS process applied.
+_LIMITS_FILE = "limits.json"
+_GENERATION_MARKER = "TPU_DRA_SHIM_GENERATION"
+# Set when the OPERATOR pre-set XLA_PYTHON_CLIENT_MEM_FRACTION in the
+# pod spec: an explicit operator override outranks the driver's derived
+# fraction, at startup and across every later rebalance generation.
+_FRACTION_PINNED_MARKER = "TPU_DRA_MEM_FRACTION_PINNED"
 
 # Quantum hint level (TPU_DRA_TIMESHARE_QUANTUM, api/v1alpha1/sharing.py
 # INTERVALS) → advisory lease seconds.
@@ -151,9 +162,19 @@ def apply_sharing_env(
                 rt.visible_chips = part
         limit = int(env.get("TPU_DRA_HBM_LIMIT_BYTES", "0") or 0)
         hbm = int(env.get("TPU_DRA_CHIP_HBM_BYTES", "0") or 0)
-        if limit > 0 and hbm > 0:
-            frac = min(limit / hbm, 1.0)
-            env.setdefault("XLA_PYTHON_CLIENT_MEM_FRACTION", f"{frac:.4f}")
+        derived = (f"{min(limit / hbm, 1.0):.4f}"
+                   if limit > 0 and hbm > 0 else None)
+        preset = env.get("XLA_PYTHON_CLIENT_MEM_FRACTION")
+        if preset is not None and preset != derived:
+            # A fraction that does NOT match the value the driver would
+            # derive from its own injected budget is an OPERATOR
+            # override (the CDI claim spec injects the derived value
+            # verbatim, so the driver's own injection compares equal):
+            # pin it, so neither this setup nor any later rebalance
+            # generation clobbers it.
+            env[_FRACTION_PINNED_MARKER] = "1"
+        if derived is not None:
+            env.setdefault("XLA_PYTHON_CLIENT_MEM_FRACTION", derived)
             rt.mem_fraction = float(env["XLA_PYTHON_CLIENT_MEM_FRACTION"])
         logger.info(
             "process-shared claim: slot %d/%d, visible=%s, mem_fraction=%s",
@@ -161,6 +182,13 @@ def apply_sharing_env(
             rt.mem_fraction,
         )
         env[_APPLIED_MARKER] = "1"
+        # A rebalance may have moved the claim's limits since the claim
+        # spec env above was rendered; the session's limits file is the
+        # fresher truth, so a process starting mid-rebalance begins on
+        # the current generation instead of the prepare-time one.
+        update = poll_sharing_update(env)
+        if update is not None and update.mem_fraction is not None:
+            rt.mem_fraction = update.mem_fraction
         if environ is None:
             _active = rt
         return rt
@@ -183,6 +211,136 @@ def apply_sharing_env(
 
     logger.warning("unknown TPU_DRA_SHARING mode %r ignored", mode)
     return None
+
+
+@dataclasses.dataclass
+class SharingUpdate:
+    """A newly observed limits generation, already applied to the env."""
+
+    generation: int
+    tensorcore_percent: Optional[int] = None
+    hbm_limit_bytes: Optional[int] = None
+    mem_fraction: Optional[float] = None
+
+
+def poll_sharing_update(
+    environ: Optional[MutableMapping[str, str]] = None,
+) -> Optional[SharingUpdate]:
+    """Observe the claim's limits file and re-apply a newer generation.
+
+    The node plugin's rebalancer resizes a process-shared claim's limits
+    by re-rendering ``limits.json`` in the shared dir with a bumped
+    ``generation`` (plugin/sharing.py ``ProcessShareSession.resize``).
+    This is the workload half of that contract: call it at a SAFE STEP
+    BOUNDARY (between training steps, between serving batches — anywhere
+    the process can tolerate its allocator budget changing) and, when it
+    returns an update, re-apply what the env now says (a changed
+    ``XLA_PYTHON_CLIENT_MEM_FRACTION`` only binds a freshly initialized
+    client; a running program keeps its allocation until the workload
+    rebuilds it, which is exactly why the boundary is the caller's).
+
+    Returns None when there is nothing new (no envelope, no file, or the
+    generation was already applied) — so a loop can call it every step
+    for free. Idempotent per generation via the ``TPU_DRA_SHIM_GENERATION``
+    marker.
+    """
+    env = environ if environ is not None else os.environ
+    if env.get("TPU_DRA_SHARING", "") != "process-shared":
+        return None
+    shared_dir = env.get("TPU_DRA_SHARED_DIR", "")
+    if not shared_dir:
+        return None
+    try:
+        with open(os.path.join(shared_dir, _LIMITS_FILE)) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        # No file yet (pre-rebalancer plugin) or a torn read the atomic
+        # writer makes impossible in practice: nothing to apply.
+        return None
+    try:
+        generation = int(doc.get("generation", 0))
+    except (TypeError, ValueError):
+        return None
+    applied = int(env.get(_GENERATION_MARKER, "0") or 0)
+    if generation <= applied:
+        return None
+    from ..utils import faults
+
+    faults.fire("rebalance.shim-apply")
+    update = SharingUpdate(generation=generation)
+    pinned = env.get(_FRACTION_PINNED_MARKER) == "1"
+    limit = doc.get("hbmLimitBytes")
+    chip_hbm = doc.get("chipHbmBytes") or int(
+        env.get("TPU_DRA_CHIP_HBM_BYTES", "0") or 0
+    )
+    if limit:
+        update.hbm_limit_bytes = int(limit)
+        env["TPU_DRA_HBM_LIMIT_BYTES"] = str(int(limit))
+        if chip_hbm and not pinned:
+            frac = min(int(limit) / int(chip_hbm), 1.0)
+            env["XLA_PYTHON_CLIENT_MEM_FRACTION"] = f"{frac:.4f}"
+            env["TPU_DRA_CHIP_HBM_BYTES"] = str(int(chip_hbm))
+            update.mem_fraction = frac
+    else:
+        # A null limit is a CLEAR (e.g. a rollback restoring an
+        # uncapped claim), not "nothing to say": leaving the aborted
+        # cap in the env would enforce limits the checkpoint no longer
+        # grants.
+        env.pop("TPU_DRA_HBM_LIMIT_BYTES", None)
+        if not pinned:
+            env.pop("XLA_PYTHON_CLIENT_MEM_FRACTION", None)
+    pct = doc.get("tensorcorePercent")
+    if pct is not None:
+        update.tensorcore_percent = int(pct)
+        env["TPU_DRA_ACTIVE_CORE_PERCENTAGE"] = str(int(pct))
+    else:
+        env.pop("TPU_DRA_ACTIVE_CORE_PERCENTAGE", None)
+    env[_GENERATION_MARKER] = str(generation)
+    logger.info(
+        "sharing limits generation %d applied: tensorcore=%s%%, "
+        "mem_fraction=%s",
+        generation, update.tensorcore_percent, update.mem_fraction,
+    )
+    return update
+
+
+def report_usage(
+    busy_fraction: float,
+    hbm_fraction: Optional[float] = None,
+    environ: Optional[MutableMapping[str, str]] = None,
+) -> bool:
+    """Publish this process's recent device utilization into the shared
+    dir — the demand signal the node-side rebalancer reads
+    (plugin/rebalancer.py ``FileDemandSource``). ``busy_fraction`` is
+    how much of the process's CURRENT grant it actually used over the
+    last window (0..1): ~1.0 means pressure (wants more), ~0.0 means
+    idle (can donate). Optional ``hbm_fraction`` is the analogous HBM
+    signal. Free no-op off process-shared claims, so library code can
+    call it unconditionally next to its step loop. Returns True when a
+    sample was written."""
+    env = environ if environ is not None else os.environ
+    if env.get("TPU_DRA_SHARING", "") != "process-shared":
+        return False
+    shared_dir = env.get("TPU_DRA_SHARED_DIR", "")
+    if not shared_dir:
+        return False
+    import time
+
+    slot = env.get("TPU_DRA_PROCESS_SLOT", "0")
+    doc: dict = {"ts": time.time(), "busy": float(busy_fraction)}
+    if hbm_fraction is not None:
+        doc["hbm"] = float(hbm_fraction)
+    try:
+        from ..utils.fs import atomic_write_json
+
+        atomic_write_json(
+            os.path.join(shared_dir, f"usage-slot-{slot}.json"), doc,
+            indent=None,
+        )
+    except OSError as e:
+        logger.warning("usage report failed: %s", e)
+        return False
+    return True
 
 
 @contextlib.contextmanager

@@ -31,6 +31,12 @@ Checks (stable ``check`` label values):
                      not precede PreparedClaim.prepared_at);
 - ``sharing``        phantom/corrupt sharing holds with no checkpointed
                      claim;
+- ``sharing-limits`` a ProcessShared claim's per-chip store meta
+                     (limits + generation the workload shim is being
+                     served) disagrees with its checkpointed config —
+                     a half-applied rebalance that escaped the
+                     two-phase resize protocol, or a hold the resize
+                     never reached;
 - ``resize``         a gang-resize intent still checkpointed: the
                      two-phase resize protocol (DeviceState.resize_claim)
                      finalizes or rolls forward at startup, and live
@@ -65,8 +71,8 @@ from .device_state import DeviceState
 logger = logging.getLogger(__name__)
 
 # Every check name, so gauges render an explicit zero when clean.
-CHECKS = ("checkpoint", "cdi", "channels", "health", "sharing", "resize",
-          "slices")
+CHECKS = ("checkpoint", "cdi", "channels", "health", "sharing",
+          "sharing-limits", "resize", "slices")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -171,6 +177,7 @@ class StateAuditor:
             self._check_channels(findings, ckpt)
             self._check_health_ordering(findings, ckpt)
             self._check_sharing(findings, ckpt)
+            self._check_sharing_limits(findings, ckpt)
             self._check_resize(findings, ckpt)
         # The apiserver comparison runs outside the lock (network) and is
         # skipped — not reported as drift — when the server is dark.
@@ -327,6 +334,90 @@ class StateAuditor:
                     "no checkpointed claim (phantom hold; the orphan "
                     "cleaner should release it)",
                 ))
+
+    def _check_sharing_limits(self, findings, ckpt: dict) -> None:
+        """Checkpointed per-claim limits vs the sharing store's meta.
+
+        The limits-resize protocol (DeviceState.resize_claim_limits)
+        rewrites three renderings of one truth — the checkpointed
+        config, the per-chip store meta, and the session limits file —
+        under a checkpointed intent. A disagreement between the first
+        two visible here is a half-applied rebalance the protocol did
+        not cover (or external mutation): the workload shim may be
+        enforcing limits the checkpoint never granted. Claims still
+        carrying a ``resize`` intent are skipped — the ``resize`` check
+        owns those, and their store is legitimately mid-flight."""
+        from ..tpulib.deviceinfo import chip_uuid_of_device_uuid
+        from .sharing import CorruptShareStateError
+
+        store = self.state.share_state
+        for uid, rec in sorted(ckpt.items()):
+            if rec.get("resize"):
+                continue
+            expected_gen = int(
+                (rec.get("sharing") or {}).get("generation", 1)
+            )
+            for group in rec.get("groups", []):
+                cfg = group.get("config") or {}
+                sharing = cfg.get("sharing") or {}
+                if sharing.get("strategy") != "ProcessShared":
+                    continue
+                psc = sharing.get("processSharedConfig") or {}
+                expected = {
+                    "maxProcesses": psc.get("maxProcesses"),
+                    "tensorcorePercent": psc.get(
+                        "defaultActiveCorePercentage"
+                    ),
+                    "hbmLimit": psc.get("defaultHbmLimit"),
+                    "generation": expected_gen,
+                }
+                chips = sorted({
+                    chip_uuid_of_device_uuid(u)
+                    for dev in group.get("devices", [])
+                    for u in dev.get("uuids", [])
+                })
+                for chip in chips:
+                    try:
+                        st = store.get(chip)
+                    except CorruptShareStateError:
+                        continue  # the sharing check owns corruption
+                    meta = st.claims.get(uid)
+                    if meta is None:
+                        findings.append(AuditFinding(
+                            "sharing-limits", uid,
+                            f"claim checkpointed ProcessShared on chip "
+                            f"{chip} but the sharing store records no "
+                            "hold for it",
+                        ))
+                        continue
+                    if expected_gen == 1 and "generation" not in meta:
+                        # A pre-limits-resize binary wrote this hold
+                        # (meta was just {"maxProcesses": N} then): a
+                        # never-rebalanced claim from before the
+                        # upgrade is legacy rendering, not drift —
+                        # compare only the field both versions wrote.
+                        diffs = (
+                            {"maxProcesses": (
+                                meta.get("maxProcesses"),
+                                expected["maxProcesses"],
+                            )}
+                            if meta.get("maxProcesses")
+                            != expected["maxProcesses"] else {}
+                        )
+                    else:
+                        diffs = {
+                            k: (meta.get(k), v)
+                            for k, v in expected.items()
+                            if meta.get(k) != v
+                        }
+                    if diffs:
+                        findings.append(AuditFinding(
+                            "sharing-limits", uid,
+                            f"chip {chip} sharing meta disagrees with "
+                            "the checkpointed limits "
+                            f"(store vs checkpoint: {diffs}) — "
+                            "half-applied rebalance?",
+                        ))
 
     def _check_resize(self, findings, ckpt: dict) -> None:
         """No checkpointed claim may still carry a ``resize`` intent.

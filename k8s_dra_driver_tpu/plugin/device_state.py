@@ -73,6 +73,18 @@ class GangResizeError(PrepareError):
     The claim's prepared state is left as it was."""
 
 
+class LimitResizeError(PrepareError):
+    """Typed failure of the limits-resize protocol (the rebalancer's
+    apply path): the claim is not prepared here, is not a single-group
+    ProcessShared claim, or the requested limits do not validate. The
+    claim's prepared state is left as it was."""
+
+
+# Sentinel for resize_claim_limits: REMOVE the limit (back to uncapped)
+# rather than keep it (None) or set it. Maps to a null in the
+# checkpointed intent, which _apply_limits_intent pops from the config.
+CLEAR_LIMIT = "__clear-limit__"
+
 # Which config kind governs which device type (role of the type-compatibility
 # switch in device_state.go:225-259).
 _CONFIG_TYPE_FOR_DEVICE = {
@@ -873,8 +885,12 @@ class DeviceState:
         """Roll a checkpointed ``resize`` intent forward; returns the
         finalized record (intent dropped). Idempotent — both the live
         resize path and startup crash recovery run it, any number of
-        times."""
+        times. Dispatches on the intent's shape: ``limits`` intents are
+        the rebalancer's per-claim share rewrites, ``to`` intents the
+        elastic gang's device-set rewrites."""
         intent = rec["resize"]
+        if "limits" in intent:
+            return self._apply_limits_intent(claim_uid, rec)
         target: list[str] = list(intent["to"])
         target_set = set(target)
         request_names: dict[str, str] = dict(intent.get("requests") or {})
@@ -1033,6 +1049,253 @@ class DeviceState:
             # not the pre-crash ones — stale records would count a
             # released device as occupied for the claim's whole life.
             self.startup_prepared_records = recs
+
+    # ------------------------------------------------------------------
+    # Limits resize (the dynamic-sharing rebalance protocol)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _limits_group_index(rec: dict) -> int:
+        """Index of the single ProcessShared work group a limits resize
+        may rewrite; typed refusal for every other claim shape (the
+        rebalancer must never touch exclusive/time-shared/channel
+        claims, and multi-group claims carry configs a single limits
+        rewrite would silently conflate)."""
+        idx: Optional[int] = None
+        for i, group in enumerate(rec.get("groups", [])):
+            cfg = group.get("config") or {}
+            if cfg.get("adminAccess"):
+                continue
+            strategy = DeviceState._config_strategy(cfg)
+            if strategy != "ProcessShared":
+                raise LimitResizeError(
+                    "limits resize requires ProcessShared sharing; "
+                    f"claim group uses {strategy or 'a channel config'}"
+                )
+            for dev in group.get("devices", []):
+                if dev.get("channel") is not None:
+                    raise LimitResizeError(
+                        "ICI channel devices carry no per-claim limits"
+                    )
+            if idx is not None:
+                raise LimitResizeError(
+                    "claim has multiple device groups with distinct "
+                    "configs; limits resize only supports single-group "
+                    "claims"
+                )
+            idx = i
+        if idx is None:
+            raise LimitResizeError(
+                "claim has no ProcessShared device group"
+            )
+        return idx
+
+    def resize_claim_limits(
+        self,
+        claim_uid: str,
+        tensorcore_percent: Any = None,
+        hbm_limit: Any = None,
+    ) -> dict:
+        """Crash-consistent rewrite of a prepared ProcessShared claim's
+        per-claim limits — the rebalancer's apply path.
+
+        Reuses the gang-resize two-phase protocol verbatim, extended
+        from device-set changes to limit changes: a ``resize`` intent
+        carrying the new ``limits`` is checkpointed FIRST, then the
+        sharing session re-renders (store meta + generation-stamped
+        limits file) and the CDI claim spec env is rewritten, then the
+        finalized record (updated config, bumped ``sharing.generation``)
+        replaces the intent. A crash anywhere between intent and
+        finalize rolls forward at startup (``_recover_resize_intents``
+        dispatches limits intents too); a NON-crash apply failure rolls
+        the intent back, restoring the original limits under a further
+        generation bump so workloads that glimpsed the half-applied
+        limits re-apply the restored ones. The device set, holds, and
+        running workload processes are untouched throughout — this is
+        the hitless half of a rebalance.
+
+        Each limit is one of: None (keep as is), a value (set), or
+        :data:`CLEAR_LIMIT` (remove — back to uncapped).
+
+        Returns ``{"generation": G, ...applied limits...}``.
+        """
+        if tensorcore_percent is None and hbm_limit is None:
+            raise LimitResizeError("no limit changes requested")
+        with self._lock:
+            prepared_claims = self.checkpoint.read()
+            original_rec = prepared_claims.get(claim_uid)
+            if original_rec is None:
+                raise LimitResizeError(
+                    f"claim {claim_uid} is not prepared on this node"
+                )
+            rec = dict(original_rec)
+            self._limits_group_index(rec)  # typed shape refusal, early
+            limits: dict[str, Any] = {}
+            if tensorcore_percent == CLEAR_LIMIT:
+                limits["tensorcorePercent"] = None
+            elif tensorcore_percent is not None:
+                limits["tensorcorePercent"] = int(tensorcore_percent)
+            if hbm_limit == CLEAR_LIMIT:
+                limits["hbmLimit"] = None
+            elif hbm_limit is not None:
+                limits["hbmLimit"] = hbm_limit
+            import time as _time
+
+            rec["resize"] = {"limits": limits, "startedAt": _time.time()}
+            # Phase 1: intent on disk. From here a crash rolls FORWARD.
+            prepared_claims[claim_uid] = rec
+            self.checkpoint.write(prepared_claims)
+            # Phase 2: apply (session re-render + CDI env), then
+            # finalize. A non-crash failure rolls the intent BACK.
+            try:
+                new_rec = self._apply_limits_intent(claim_uid, rec)
+            except BaseException:
+                self._rollback_limits_resize(
+                    claim_uid, original_rec, prepared_claims
+                )
+                raise
+            prepared_claims[claim_uid] = new_rec
+            self.checkpoint.write(prepared_claims)
+            generation = (new_rec.get("sharing") or {}).get("generation")
+            logger.info(
+                "limits resize of claim %s applied: %s (generation %s)",
+                claim_uid, limits, generation,
+            )
+            return {"generation": generation, **limits}
+
+    def _apply_limits_intent(self, claim_uid: str, rec: dict) -> dict:
+        """Roll a checkpointed limits intent forward; returns the
+        finalized record. Idempotent — the live path, rollback, and
+        startup crash recovery all run it, any number of times. The
+        generation is derived from the PRE-finalize record (or the
+        intent's explicit override, used by rollback), so replays land
+        on the same number."""
+        import json as _json
+
+        intent = rec["resize"]
+        limits = intent["limits"]
+        gi = self._limits_group_index(rec)
+        groups = rec.get("groups", [])
+        group = groups[gi]
+        config = _json.loads(_json.dumps(group.get("config") or {}))
+        psc = config.setdefault("sharing", {}).setdefault(
+            "processSharedConfig", {}
+        )
+        for wire, key in (("tensorcorePercent",
+                           "defaultActiveCorePercentage"),
+                          ("hbmLimit", "defaultHbmLimit")):
+            if wire not in limits:
+                continue
+            if limits[wire] is None:
+                psc.pop(key, None)
+            else:
+                psc[key] = limits[wire]
+        cfg = decode_config(config)
+        cfg.normalize()
+        cfg.validate()
+        generation = int(
+            intent.get("generation")
+            or int((rec.get("sharing") or {}).get("generation", 1)) + 1
+        )
+
+        devices: list[AllocatableDevice] = []
+        for d in group.get("devices", []):
+            dev = self._resolve_claimed_device(d["name"])
+            if dev is None:
+                raise LimitResizeError(
+                    f"device {d['name']!r} of claim {claim_uid} is "
+                    "neither allocatable nor pinned in the base spec"
+                )
+            devices.append(dev)
+        session = self.ps_manager.new_session(
+            claim_uid, devices, cfg.sharing.get_process_shared_config()
+        )
+        # Never render a generation at or below one already on disk: a
+        # dead incarnation (an aborted rollback, a crash mid-apply) may
+        # have rendered a HIGHER generation with different limits, and
+        # workloads pinned past ours would silently ignore this render.
+        on_disk = session.current_generation()
+        if on_disk is not None and on_disk >= generation:
+            generation = on_disk + 1
+        # The hitless re-render: store meta + limits file, no process
+        # restart, no hold churn.
+        session.resize(generation)
+
+        # Rewrite the CDI claim spec so containers STARTED after this
+        # resize see the new env too (running processes get the limits
+        # file); admin-group edits are preserved, as in _apply_resize.
+        edits = session.container_edits()
+        claim_device_edits: dict[str, ContainerEdits] = {}
+        visible: list[AllocatableDevice] = list(devices)
+        for d in group.get("devices", []):
+            claim_device_edits[d["name"]] = ContainerEdits(
+                env=dict(edits.env), mounts=list(edits.mounts)
+            )
+        for g in groups:
+            if not (g.get("config") or {}).get("adminAccess"):
+                continue
+            for pd in g.get("devices", []):
+                dev = self._resolve_claimed_device(pd["name"])
+                if dev is None:
+                    continue
+                visible.append(dev)
+                admin_edit = ContainerEdits(env={"TPU_DRA_ADMIN": "1"})
+                existing = claim_device_edits.get(pd["name"])
+                claim_device_edits[pd["name"]] = (
+                    existing.merge(admin_edit) if existing else admin_edit
+                )
+        common_env = self._claim_common_env(visible)
+        self.cdi.create_claim_spec_file(
+            claim_uid, claim_device_edits, common_env
+        )
+
+        new_rec = {k: v for k, v in rec.items() if k != "resize"}
+        new_groups = list(groups)
+        new_groups[gi] = {**group, "config": config}
+        new_rec["groups"] = new_groups
+        new_rec["sharing"] = {
+            **(rec.get("sharing") or {}), "generation": generation,
+        }
+        return new_rec
+
+    def _rollback_limits_resize(
+        self, claim_uid: str, original_rec: dict, prepared_claims: dict
+    ) -> None:
+        """Undo a FAILED limits resize by resizing back to the ORIGINAL
+        limits — same machinery, original values, generation bumped by
+        TWO (the aborted apply may already have rendered generation G+1
+        into the limits file, and workloads must re-apply the restored
+        limits, not ignore them as stale). If the rollback itself fails,
+        the intent is left on disk for the auditor's ``resize`` check —
+        loud, never silent. Caller re-raises the original error."""
+        try:
+            gen = int(
+                (original_rec.get("sharing") or {}).get("generation", 1)
+            )
+            gi = self._limits_group_index(original_rec)
+            psc = (
+                ((original_rec["groups"][gi].get("config") or {})
+                 .get("sharing") or {}).get("processSharedConfig") or {}
+            )
+            rollback_rec = dict(original_rec)
+            rollback_rec["resize"] = {
+                "limits": {
+                    "tensorcorePercent": psc.get(
+                        "defaultActiveCorePercentage"
+                    ),
+                    "hbmLimit": psc.get("defaultHbmLimit"),
+                },
+                "generation": gen + 2,
+            }
+            restored = self._apply_limits_intent(claim_uid, rollback_rec)
+            prepared_claims[claim_uid] = restored
+            self.checkpoint.write(prepared_claims)
+        except Exception:
+            logger.exception(
+                "rollback of failed limits resize of claim %s also "
+                "failed; leaving the intent for the state auditor",
+                claim_uid,
+            )
 
     @staticmethod
     def _gang_view_of(claim_uid: str, rec: dict) -> Optional[dict]:

@@ -124,6 +124,10 @@ class DriverConfig:
     # State-drift auditor pass cadence (plugin/audit.py). 0 disables the
     # periodic thread; run_once stays callable either way (doctor/tests).
     audit_interval_seconds: float = 300.0
+    # Dynamic-sharing rebalancer cadence (plugin/rebalancer.py), ticked
+    # from the device-watch loop. 0 disables the loop; run_once stays
+    # callable either way (sim/tests).
+    rebalance_interval_seconds: float = 60.0
 
     @property
     def plugin_socket(self) -> str:
@@ -277,6 +281,20 @@ class Driver(NodeServicer):
             events=self.events,
             interval_seconds=config.audit_interval_seconds,
         )
+        # SLO-aware dynamic sharing: the closed loop from the usage
+        # accounting above to hitless repartitioning. Ticked from the
+        # device-watch loop; run_once stays callable for the sim.
+        from .rebalancer import Rebalancer
+
+        self.rebalancer = Rebalancer(
+            state=self.state,
+            registry=self.registry,
+            node_name=config.node_name,
+            node_uid=config.node_uid,
+            events=self.events,
+            interval_seconds=config.rebalance_interval_seconds,
+            api_version=self.resource_api.api_version,
+        )
         self.plugin = KubeletPlugin(
             node_server=self,
             driver_name=config.driver_name,
@@ -383,6 +401,13 @@ class Driver(NodeServicer):
                 self._maybe_elastic_resize(transitions)
             except Exception:
                 logger.exception("device inventory refresh failed")
+            try:
+                # Dynamic-sharing tick rides the same wake: paced by its
+                # own interval, and deliberately LAST — rebalancing must
+                # see post-transition health and holds.
+                self.rebalancer.maybe_tick()
+            except Exception:
+                logger.exception("rebalance tick failed")
 
     def _report_health_transitions(self, transitions) -> None:
         """Turn health transitions into the metric and, when the chip

@@ -69,7 +69,33 @@ def collect_live(http_url: str, timeout: float = 3.0) -> dict[str, Any]:
         out["queuedSliceRepublishDetail"] = queued
     out.update(_collect_unsat_allocations(http_url, timeout))
     out.update(_collect_defrag_plans(http_url, timeout))
+    out.update(_collect_rebalance(http_url, timeout))
     return out
+
+
+def _fetch_debug(
+    http_url: str, path: str, timeout: float
+) -> tuple[Optional[str], Optional[str]]:
+    """One debug-endpoint scrape as ``(body, error)``: a 404 yields
+    ``(None, None)`` — the surface simply isn't wired on this process,
+    which is benign — and any OTHER failure yields ``(None, message)``
+    for the caller to surface in-band (silence must mean "nothing to
+    report", never "couldn't look"). Shared by every live collector so
+    the 404-benign/other-loud contract cannot drift per endpoint."""
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+            http_url.rstrip("/") + path, timeout=timeout
+        ) as resp:
+            return resp.read().decode(), None
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return None, None
+        return None, f"HTTP {e.code}"
+    except Exception as e:
+        return None, str(e) or type(e).__name__
 
 
 def _collect_unsat_allocations(
@@ -77,25 +103,12 @@ def _collect_unsat_allocations(
 ) -> dict[str, Any]:
     """Recent unallocatable solve decisions from ``/debug/allocations``,
     each mapped to its runbook hint — the "why won't my claim schedule?"
-    answer, live. The endpoint 404s on processes that don't run the
-    allocator (plain node plugins); absence is normal and yields
-    nothing. Any OTHER failure (500 from a raising provider, timeout) is
-    surfaced, not swallowed — silence must mean "no unsat claims", never
-    "couldn't look" (same split as doctor.collect_node)."""
-    import urllib.error
-    import urllib.request
-
-    try:
-        with urllib.request.urlopen(
-            http_url.rstrip("/") + "/debug/allocations", timeout=timeout
-        ) as resp:
-            text = resp.read().decode()
-    except urllib.error.HTTPError as e:
-        if e.code == 404:
-            return {}
-        return {"unsatAllocationsError": f"HTTP {e.code}"}
-    except Exception as e:
-        return {"unsatAllocationsError": str(e) or type(e).__name__}
+    answer, live (same 404/failure split as doctor.collect_node)."""
+    text, err = _fetch_debug(http_url, "/debug/allocations", timeout)
+    if err is not None:
+        return {"unsatAllocationsError": err}
+    if text is None:
+        return {}
     from ..kube.allocator import RUNBOOK_HINTS
 
     unsat = []
@@ -123,23 +136,16 @@ def _collect_defrag_plans(
     http_url: str, timeout: float, keep: int = 3
 ) -> dict[str, Any]:
     """Recent defrag plans from ``/debug/defrag`` — the actionable half
-    of a ``gang``/``shortfall`` unsat. Same error split as the
-    allocations scrape: 404 means no planner runs here (normal), any
-    other failure is surfaced in-band."""
-    import urllib.error
-    import urllib.request
-
+    of a ``gang``/``shortfall`` unsat."""
+    text, err = _fetch_debug(http_url, "/debug/defrag", timeout)
+    if err is not None:
+        return {"defragPlansError": err}
+    if text is None:
+        return {}
     try:
-        with urllib.request.urlopen(
-            http_url.rstrip("/") + "/debug/defrag", timeout=timeout
-        ) as resp:
-            doc = json.loads(resp.read().decode())
-    except urllib.error.HTTPError as e:
-        if e.code == 404:
-            return {}
-        return {"defragPlansError": f"HTTP {e.code}"}
-    except Exception as e:
-        return {"defragPlansError": str(e) or type(e).__name__}
+        doc = json.loads(text)
+    except ValueError as e:
+        return {"defragPlansError": str(e)}
     plans = [
         {
             "claim": f"{(p.get('claim') or {}).get('namespace', '?')}/"
@@ -151,6 +157,58 @@ def _collect_defrag_plans(
         for p in (doc.get("plans") or []) if isinstance(p, dict)
     ]
     return {"defragPlans": plans[-keep:]} if plans else {}
+
+
+def _collect_rebalance(
+    http_url: str, timeout: float, keep: int = 5
+) -> dict[str, Any]:
+    """Recent dynamic-sharing decisions + per-claim granted-vs-declared
+    shares from ``/debug/rebalance``."""
+    text, err = _fetch_debug(http_url, "/debug/rebalance", timeout)
+    if err is not None:
+        return {"rebalanceError": err}
+    if text is None:
+        return {}
+    try:
+        doc = json.loads(text)
+    except ValueError as e:
+        return {"rebalanceError": str(e)}
+    out: dict[str, Any] = {}
+    decisions = [
+        {
+            "outcome": d.get("outcome", "?"),
+            "action": d.get("action", "?"),
+            "resource": d.get("resource", "?"),
+            "gainer": (d.get("gainer") or {}).get("claim", "?"),
+            "donor": (d.get("donor") or {}).get("claim", "?"),
+            "shares": (
+                f"{(d.get('donor') or {}).get('from')}->"
+                f"{(d.get('donor') or {}).get('to')} / "
+                f"{(d.get('gainer') or {}).get('from')}->"
+                f"{(d.get('gainer') or {}).get('to')}"
+            ),
+        }
+        for d in (doc.get("decisions") or []) if isinstance(d, dict)
+    ]
+    if decisions:
+        out["rebalanceDecisions"] = decisions[-keep:]
+    claims = {
+        uid: {
+            "claim": f"{c.get('namespace', '?')}/{c.get('name', '?')}",
+            "latencyClass": c.get("latencyClass", "?"),
+            "granted": c.get("granted"),
+            "min": c.get("min"),
+            "burst": c.get("burst"),
+            "belowMinSeconds": c.get("belowMinSeconds", 0.0),
+            "graceSeconds": c.get("graceSeconds"),
+            "generation": c.get("generation"),
+        }
+        for uid, c in sorted((doc.get("claims") or {}).items())
+        if isinstance(c, dict)
+    }
+    if claims:
+        out["rebalanceClaims"] = claims
+    return out
 
 
 def collect(
@@ -399,6 +457,48 @@ def render(state: dict[str, Any]) -> str:
                         f"  {p['claim']}: {p['outcome']} "
                         f"({p['migrations']} migration(s)) — "
                         f"{p.get('detail') or 'no detail'}"
+                    )
+            if live.get("rebalanceError"):
+                lines.append(
+                    "  /debug/rebalance scrape FAILED "
+                    f"({live['rebalanceError']}) — SLO/share view "
+                    "unavailable, NOT known-clean"
+                )
+            shares = live.get("rebalanceClaims") or {}
+            if shares:
+                lines.append("")
+                lines.append(
+                    f"dynamic-sharing claims: {len(shares)}"
+                )
+                for uid, c in shares.items():
+                    granted = c.get("granted") or {}
+                    mins = c.get("min") or {}
+                    starving = (
+                        (c.get("graceSeconds") is not None
+                         and (c.get("belowMinSeconds") or 0)
+                         > c["graceSeconds"])
+                    )
+                    lines.append(
+                        f"  {c['claim']} ({uid}): granted "
+                        f"tc={granted.get('tensorcore')}% "
+                        f"hbm={granted.get('hbm')}% vs min "
+                        f"tc={mins.get('tensorcore')}% "
+                        f"hbm={mins.get('hbm')}% "
+                        f"[{c.get('latencyClass')}, gen "
+                        f"{c.get('generation')}]"
+                        + (" SLO-STARVED" if starving else "")
+                    )
+            decisions = live.get("rebalanceDecisions") or []
+            if decisions:
+                lines.append("")
+                lines.append(
+                    f"recent rebalance decisions: {len(decisions)}"
+                )
+                for d in decisions:
+                    lines.append(
+                        f"  {d['outcome']} {d['action']} "
+                        f"{d['resource']}: {d['donor']} -> "
+                        f"{d['gainer']} ({d['shares']})"
                     )
     return "\n".join(lines)
 

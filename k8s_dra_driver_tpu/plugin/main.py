@@ -94,6 +94,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="seconds between state-drift audit passes "
                         "(checkpoint vs CDI vs ResourceSlices vs chip "
                         "inventory); 0 disables [AUDIT_INTERVAL]")
+    p.add_argument("--rebalance-interval", type=float,
+                   default=float(_env("REBALANCE_INTERVAL", "60") or 60),
+                   help="seconds between dynamic-sharing rebalance passes "
+                        "(SLO-aware share moves between ProcessShared "
+                        "co-tenants); 0 disables [REBALANCE_INTERVAL]")
     p.add_argument("--log-level", default=_env("LOG_LEVEL", ""),
                    help="log level; empty falls back to TPU_DRA_LOG_LEVEL "
                         "then INFO [LOG_LEVEL]")
@@ -296,6 +301,7 @@ def main(argv=None) -> int:
             args.plugin_api_versions, node_obj, args.node_name
         ),
         audit_interval_seconds=args.audit_interval,
+        rebalance_interval_seconds=args.rebalance_interval,
     )
     driver = Driver(config, registry=registry)
     driver.start()
@@ -312,9 +318,10 @@ def main(argv=None) -> int:
         for name, check in driver.degraded_checks().items():
             metrics.add_readiness_check(name, check, critical=False)
         metrics.set_usage_provider(driver.usage.snapshot)
+        metrics.set_rebalance_provider(driver.rebalancer.snapshot)
         metrics.start()
         logger.info("metrics on :%d/metrics (+/readyz, /debug/traces, "
-                    "/debug/usage)", metrics.port)
+                    "/debug/usage, /debug/rebalance)", metrics.port)
     logger.info(
         "tpu-dra-plugin started: node=%s devices=%d",
         args.node_name,

@@ -34,6 +34,7 @@ import os
 import shutil
 from typing import Optional
 
+from ..utils import faults
 from ..utils.fs import atomic_write_json
 
 from ..api.v1alpha1 import ProcessSharedConfig, TimeSharedConfig, parse_quantity
@@ -116,11 +117,13 @@ class SharingStateStore:
             ) from e
 
     def put(self, uuid: str, st: _ChipShareState) -> None:
+        faults.fire("sharing.state-write")
         atomic_write_json(
             self._path(uuid), {"mode": st.mode, "claims": st.claims}, indent=None
         )
 
     def clear(self, uuid: str) -> None:
+        faults.fire("sharing.state-write")
         try:
             os.unlink(self._path(uuid))
         except FileNotFoundError:
@@ -239,6 +242,14 @@ def _session_id(claim_uid: str, uuids: list[str]) -> str:
     return f"{claim_uid}-{digest}"
 
 
+# File the node plugin renders a session's CURRENT limits into, inside
+# the session's shared dir (mounted at /var/run/tpu-dra-shared in every
+# container of the claim). The workload shim (parallel/shim.py
+# poll_sharing_update) watches its ``generation`` and re-applies the
+# limits at a safe step boundary — the hitless half of a rebalance.
+LIMITS_FILE = "limits.json"
+
+
 class ProcessShareSession:
     """Per-claim process-share session (MpsControlDaemon analog,
     sharing.go:124-344, minus the daemon)."""
@@ -258,27 +269,19 @@ class ProcessShareSession:
         self.id = _session_id(claim_uid, [d.chip.uuid for d in devices])
         self.shared_dir = os.path.join(manager.run_dir, self.id)
 
-    def start(self) -> None:
-        """Acquire chips + materialise the coordination dir
-        (role of Start's mkdirs + daemon create, sharing.go:185-287;
-        no readiness wait because there is no daemon to wait for)."""
-        uuids = [d.chip.uuid for d in self.devices]
-        for u in uuids:
-            self.manager.state.acquire(
-                u,
-                self.claim_uid,
-                SHARING_PROCESS_SHARED,
-                {"maxProcesses": self.config.max_processes},
-            )
-        self.manager.chiplib.set_sharing_mode(uuids, SHARING_PROCESS_SHARED)
-        os.makedirs(self.shared_dir, exist_ok=True)
-
-    def container_edits(self) -> ContainerEdits:
-        """Env + mounts for the claim's containers
-        (GetCDIContainerEdits analog, sharing.go:346-366)."""
+    def _resolved_limits(self) -> dict:
+        """The session's effective per-process limits, resolved once and
+        shared by container_edits, the limits file, and the store meta —
+        three renderings of one truth that must not drift."""
         chips = [d.chip for d in self.devices]
         uuids = [c.uuid for c in chips]
-        hbm_env: dict[str, str] = {}
+        out: dict = {
+            "maxProcesses": self.config.max_processes,
+            "tensorcorePercent": self.config.default_active_core_percentage,
+            "hbmLimit": self.config.default_hbm_limit,
+            "hbmLimitBytes": None,
+            "chipHbmBytes": None,
+        }
         limits = {}
         if self.config.per_chip_hbm_limit is not None or self.config.default_hbm_limit:
             from ..api.v1alpha1 import PerChipHbmLimit
@@ -288,7 +291,94 @@ class ProcessShareSession:
         if limits:
             # Per-process HBM cap: lowest limit across the claim's chips
             # (one env var governs the process).
-            floor = min(parse_quantity(v) for v in limits.values())
+            out["hbmLimitBytes"] = min(
+                parse_quantity(v) for v in limits.values()
+            )
+            chip_hbm = min(c.hbm_bytes for c in chips)
+            if chip_hbm > 0:
+                out["chipHbmBytes"] = chip_hbm
+        return out
+
+    def state_meta(self, generation: int) -> dict:
+        """Per-chip store meta: the limits this claim holds, stamped with
+        the session generation — what the state auditor's
+        ``sharing-limits`` check compares against the checkpointed
+        config."""
+        res = self._resolved_limits()
+        return {
+            "maxProcesses": res["maxProcesses"],
+            "tensorcorePercent": res["tensorcorePercent"],
+            "hbmLimit": res["hbmLimit"],
+            "generation": generation,
+        }
+
+    def current_generation(self) -> Optional[int]:
+        """Generation of the limits file currently on disk (None when
+        absent/unreadable) — the resize protocol reads it so a replayed
+        apply never renders a generation a dead incarnation already
+        used for DIFFERENT limits (workloads would ignore the render
+        as stale)."""
+        try:
+            with open(os.path.join(self.shared_dir, LIMITS_FILE)) as f:
+                return int(json.load(f).get("generation", 0))
+        except (OSError, ValueError, TypeError):
+            return None
+
+    def write_limits_file(self, generation: int) -> None:
+        """Render the generation-stamped limits document the workload
+        shim polls. Atomic, so a reader never sees a torn rewrite."""
+        res = self._resolved_limits()
+        atomic_write_json(
+            os.path.join(self.shared_dir, LIMITS_FILE),
+            {
+                "generation": generation,
+                "mode": "process-shared",
+                "maxProcesses": res["maxProcesses"],
+                "tensorcorePercent": res["tensorcorePercent"],
+                "hbmLimitBytes": res["hbmLimitBytes"],
+                "chipHbmBytes": res["chipHbmBytes"],
+            },
+            indent=None,
+        )
+
+    def start(self, generation: int = 1) -> None:
+        """Acquire chips + materialise the coordination dir
+        (role of Start's mkdirs + daemon create, sharing.go:185-287;
+        no readiness wait because there is no daemon to wait for)."""
+        uuids = [d.chip.uuid for d in self.devices]
+        meta = self.state_meta(generation)
+        for u in uuids:
+            self.manager.state.acquire(
+                u, self.claim_uid, SHARING_PROCESS_SHARED, meta
+            )
+        self.manager.chiplib.set_sharing_mode(uuids, SHARING_PROCESS_SHARED)
+        os.makedirs(self.shared_dir, exist_ok=True)
+        self.write_limits_file(generation)
+
+    def resize(self, generation: int) -> None:
+        """Hitless limits re-render: update every chip's store meta
+        (same-claim acquire is re-entrant) and bump the limits file to
+        ``generation`` so running workloads re-apply at their next safe
+        step boundary. Idempotent — the two-phase resize protocol
+        (DeviceState.resize_claim_limits) may replay it after a crash.
+        """
+        faults.fire("rebalance.session-resize")
+        uuids = [d.chip.uuid for d in self.devices]
+        meta = self.state_meta(generation)
+        for u in uuids:
+            self.manager.state.acquire(
+                u, self.claim_uid, SHARING_PROCESS_SHARED, meta
+            )
+        os.makedirs(self.shared_dir, exist_ok=True)
+        self.write_limits_file(generation)
+
+    def container_edits(self) -> ContainerEdits:
+        """Env + mounts for the claim's containers
+        (GetCDIContainerEdits analog, sharing.go:346-366)."""
+        res = self._resolved_limits()
+        hbm_env: dict[str, str] = {}
+        floor = res["hbmLimitBytes"]
+        if floor is not None:
             hbm_env["TPU_DRA_HBM_LIMIT_BYTES"] = str(floor)
             # Also cap XLA's premapped buffer so runtimes without the shim
             # still respect the budget.
@@ -296,12 +386,12 @@ class ProcessShareSession:
             # Map the budget onto the knob JAX actually honors: the client
             # allocator fraction. The shim recomputes per-process values;
             # setting it here means even shim-less workloads are capped.
-            chip_hbm = min(c.hbm_bytes for c in chips)
-            if chip_hbm > 0:
+            chip_hbm = res["chipHbmBytes"]
+            if chip_hbm:
                 hbm_env["TPU_DRA_CHIP_HBM_BYTES"] = str(chip_hbm)
                 frac = min(floor / chip_hbm, 1.0)
                 hbm_env["XLA_PYTHON_CLIENT_MEM_FRACTION"] = f"{frac:.4f}"
-        pct = self.config.default_active_core_percentage
+        pct = res["tensorcorePercent"]
         if pct is not None:
             hbm_env["TPU_DRA_ACTIVE_CORE_PERCENTAGE"] = str(pct)
         return ContainerEdits(

@@ -25,7 +25,8 @@ real binary (``TPU_DRA_FAULTS="checkpoint.write@2=oserror,kube.get=api503"``)
 
 Site naming convention: ``<component>.<operation>``. The canonical
 registry of instrumented sites is :data:`ALL_SITES` (grouped by family:
-``kube.*``, ``chiplib.*``, ``checkpoint.*``, ``cdi.*``, and the
+``kube.*``, ``chiplib.*``, ``checkpoint.*``, ``cdi.*``, ``sharing.*``
+and ``rebalance.*`` for the dynamic-sharing state/resize path, and the
 model-side ``train.*`` family — ``train.step`` fires at the top of every
 elastic train step, ``train.reshard`` at the top of every gang resize).
 Seeded schedules should draw their site lists from it via
@@ -65,6 +66,15 @@ ALL_SITES = (
     # CDI spec writes (cdi/spec.py).
     "cdi.base-write",
     "cdi.claim-write",
+    # Durable sharing state (plugin/sharing.py): every acquire/release/
+    # limits-meta rewrite funnels through the state store's put/clear.
+    "sharing.state-write",
+    # Dynamic-sharing rebalance path: the hitless session limits
+    # re-render (plugin/sharing.py ProcessShareSession.resize) and the
+    # workload shim's re-apply of a new limits generation
+    # (parallel/shim.py poll_sharing_update).
+    "rebalance.session-resize",
+    "rebalance.shim-apply",
     # Model-side training loop (parallel/elastic.py): injectable like the
     # driver sites, so chaos schedules can unplug a chip mid-step or
     # crash mid-reshard.

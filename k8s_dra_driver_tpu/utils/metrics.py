@@ -95,6 +95,15 @@ class Gauge:
         with self._lock:
             self._values[key] = value
 
+    def remove(self, **labels) -> None:
+        """Drop one labeled series entirely. For label sets scoped to a
+        finite-lifetime object (a claim UID): zeroing such a series
+        keeps it in every future scrape forever — unbounded cardinality
+        over churn — while removal is the standard end-of-life."""
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values.pop(key, None)
+
     def value(self, **labels) -> float:
         key = tuple(sorted(labels.items()))
         with self._lock:
@@ -329,7 +338,10 @@ class MetricsServer:
     provider was registered with ``set_allocations_provider`` (404
     otherwise). ``/debug/defrag`` serves the defrag planner's JSON plan
     buffer when a provider was registered with ``set_defrag_provider``
-    (404 otherwise). All routes are GET-only; other methods get ``405``
+    (404 otherwise). ``/debug/rebalance`` serves the dynamic-sharing
+    rebalancer's decision ring + per-claim share view when a provider
+    was registered with ``set_rebalance_provider`` (404 otherwise).
+    All routes are GET-only; other methods get ``405``
     with an ``Allow: GET`` header — the scrape surface mutates nothing.
     """
 
@@ -340,6 +352,19 @@ class MetricsServer:
         self.usage_provider: Optional[Callable] = None
         self.allocations_provider: Optional[Callable] = None
         self.defrag_provider: Optional[Callable] = None
+        self.rebalance_provider: Optional[Callable] = None
+        # The JSON debug surfaces share one handler block: path ->
+        # (provider attribute, not-enabled message). /debug/allocations
+        # stays separate (the provider returns pre-rendered JSONL).
+        self._json_debug_routes = {
+            "/debug/usage": (
+                "usage_provider", "usage accounting not enabled"),
+            "/debug/defrag": (
+                "defrag_provider", "defrag planning not enabled"),
+            "/debug/rebalance": (
+                "rebalance_provider",
+                "dynamic-sharing rebalancer not enabled"),
+        }
         registry_ref = registry
         health = self._health = {"ok": True}
         self._ready_checks: dict[str, Callable] = {}
@@ -363,10 +388,13 @@ class MetricsServer:
                 if self.path == "/metrics":
                     body = registry_ref.render().encode()
                     ctype = "text/plain; version=0.0.4"
-                elif self.path == "/debug/usage":
-                    provider = server_ref.usage_provider
+                elif self.path in server_ref._json_debug_routes:
+                    attr, missing = server_ref._json_debug_routes[
+                        self.path
+                    ]
+                    provider = getattr(server_ref, attr)
                     if provider is None:
-                        body = b"usage accounting not enabled\n"
+                        body = (missing + "\n").encode()
                         status = 404
                         ctype = "text/plain"
                     else:
@@ -379,7 +407,10 @@ class MetricsServer:
                             ).encode()
                             ctype = "application/json"
                         except Exception as e:
-                            body = f"usage snapshot failed: {e}\n".encode()
+                            what = self.path.rsplit("/", 1)[-1]
+                            body = (
+                                f"{what} snapshot failed: {e}\n"
+                            ).encode()
                             status = 500
                             ctype = "text/plain"
                 elif self.path == "/debug/allocations":
@@ -396,25 +427,6 @@ class MetricsServer:
                             body = (
                                 f"allocations snapshot failed: {e}\n"
                             ).encode()
-                            status = 500
-                            ctype = "text/plain"
-                elif self.path == "/debug/defrag":
-                    provider = server_ref.defrag_provider
-                    if provider is None:
-                        body = b"defrag planning not enabled\n"
-                        status = 404
-                        ctype = "text/plain"
-                    else:
-                        import json as _json
-
-                        try:
-                            body = (
-                                _json.dumps(provider(), sort_keys=True)
-                                + "\n"
-                            ).encode()
-                            ctype = "application/json"
-                        except Exception as e:
-                            body = f"defrag snapshot failed: {e}\n".encode()
                             status = 500
                             ctype = "text/plain"
                 elif self.path == "/healthz":
@@ -522,6 +534,12 @@ class MetricsServer:
         ``DefragPlanner.export_json``) at ``/debug/defrag``. Safe to
         call after ``start()``."""
         self.defrag_provider = provider
+
+    def set_rebalance_provider(self, provider: Callable) -> None:
+        """Serve ``provider()`` (a JSON-serializable dict, e.g.
+        ``Rebalancer.snapshot``) at ``/debug/rebalance``. Safe to call
+        after ``start()``."""
+        self.rebalance_provider = provider
 
     def add_readiness_check(self, name: str, check: Callable,
                             critical: bool = True) -> None:

@@ -244,3 +244,75 @@ class TestNormalizeValidate:
         cfg = TensorCoreConfig.from_dict({"sharing": {"strategy": "Exclusive"}})
         cfg.normalize()
         cfg.validate()
+
+
+class TestSloConfig:
+    """The dynamic-sharing contract riding inside processSharedConfig."""
+
+    def _psc(self, slo):
+        from k8s_dra_driver_tpu.api.v1alpha1 import ProcessSharedConfig
+
+        return ProcessSharedConfig.from_dict({
+            "maxProcesses": 2, "defaultActiveCorePercentage": 30,
+            "defaultHbmLimit": "4Gi", "slo": slo,
+        })
+
+    def test_round_trip_through_process_shared_config(self):
+        cfg = self._psc({
+            "latencyClass": "realtime",
+            "minTensorCorePercent": 30, "burstTensorCorePercent": 80,
+            "minHbmPercent": 25, "burstHbmPercent": 75,
+            "priority": 10,
+        })
+        cfg.normalize()
+        cfg.validate()
+        wire = cfg.to_dict()["slo"]
+        assert wire["latencyClass"] == "realtime"
+        assert wire["minTensorCorePercent"] == 30
+        assert wire["priority"] == 10
+        assert cfg.slo.grace_seconds() == 5.0
+
+    def test_unknown_fields_and_class_rejected(self):
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="unknown field"):
+            self._psc({"latencyClas": "realtime"})
+        cfg = self._psc({"latencyClass": "warp-speed"})
+        with _pytest.raises(ValueError, match="latencyClass"):
+            cfg.validate()
+
+    def test_min_without_burst_defaults_burst_to_whole_chip(self):
+        cfg = self._psc({"latencyClass": "batch",
+                         "minTensorCorePercent": 20})
+        cfg.normalize()
+        cfg.validate()
+        assert cfg.slo.burst_tensorcore_percent == 100
+
+    def test_min_above_burst_rejected(self):
+        import pytest as _pytest
+
+        cfg = self._psc({
+            "latencyClass": "batch",
+            "minTensorCorePercent": 90, "burstTensorCorePercent": 50,
+        })
+        with _pytest.raises(ValueError, match="exceeds"):
+            cfg.validate()
+
+    def test_out_of_range_percent_rejected(self):
+        import pytest as _pytest
+
+        for bad in (0, 101, -5, "50"):
+            cfg = self._psc({"latencyClass": "batch",
+                             "minTensorCorePercent": bad})
+            with _pytest.raises(ValueError):
+                cfg.validate()
+
+    def test_burst_without_min_rejected(self):
+        """A floorless burst would never participate in rebalancing —
+        an inert SLO must be a loud config error, not a silent no-op."""
+        import pytest as _pytest
+
+        cfg = self._psc({"latencyClass": "batch",
+                         "burstTensorCorePercent": 80})
+        with _pytest.raises(ValueError, match="needs a min floor"):
+            cfg.validate()

@@ -1258,6 +1258,148 @@ class TestElasticCrashConsistency:
         assert run_audit(restarted) == []
 
 
+def make_process_shared_claim(uid, device="tpu-0", pct=30, hbm="4Gi"):
+    """A ResourceClaim process-sharing one chip with a declared SLO —
+    the rebalancer's subject matter."""
+    return {
+        "metadata": {"name": f"ps-{uid}", "namespace": "default",
+                     "uid": uid},
+        "status": {"allocation": {"devices": {"results": [{
+            "request": "r0", "driver": DRIVER, "pool": "node-a",
+            "device": device,
+        }], "config": [{
+            "requests": [], "source": "FromClaim",
+            "opaque": {"driver": DRIVER, "parameters": {
+                "apiVersion": "tpu.google.com/v1alpha1",
+                "kind": "TpuChipConfig",
+                "sharing": {
+                    "strategy": "ProcessShared",
+                    "processSharedConfig": {
+                        "maxProcesses": 2,
+                        "defaultActiveCorePercentage": pct,
+                        "defaultHbmLimit": hbm,
+                        "slo": {"latencyClass": "interactive",
+                                "minTensorCorePercent": 20},
+                    },
+                },
+            }},
+        }]}}},
+    }
+
+
+class TestRebalanceCrashConsistency:
+    """The limits-resize protocol's crash windows: the gang-resize
+    two-phase checkpoint, extended from device-set changes to limit
+    changes, must roll forward at restart with the sharing store, the
+    limits file, and the checkpointed config all agreeing — the new
+    ``sharing-limits`` audit check is the oracle."""
+
+    def test_crash_before_intent_leaves_limits_untouched(self, tmp_path):
+        state, lib = make_state(tmp_path)
+        state.prepare(make_process_shared_claim("uid-l0", pct=30))
+        plan = faults.FaultPlan().crash("checkpoint.write")
+        with faults.armed(plan):
+            with pytest.raises(faults.CrashPoint):
+                state.resize_claim_limits(
+                    "uid-l0", tensorcore_percent=60
+                )
+        restarted, _ = make_state(tmp_path, lib=lib)
+        rec = restarted.checkpoint.read()["uid-l0"]
+        psc = rec["groups"][0]["config"]["sharing"]["processSharedConfig"]
+        assert psc["defaultActiveCorePercentage"] == 30
+        assert "resize" not in rec
+        assert run_audit(restarted) == []
+        assert_invariants(restarted)
+
+    def test_crash_between_intent_and_finalize_rolls_forward(
+        self, tmp_path
+    ):
+        """The narrowest window: limits intent checkpointed, session
+        re-rendered (store meta + limits file at generation 2), crash
+        before the finalize write. Restart recovery re-applies the
+        intent idempotently; the NEW limits are the durable truth in
+        all three renderings and the auditor — including the
+        sharing-limits cross-check — reads clean."""
+        import json as _json
+        import os as _os
+
+        state, lib = make_state(tmp_path)
+        state.prepare(make_process_shared_claim("uid-l1", pct=30))
+        chip = chip_uuid_of(state, "tpu-0")
+        # checkpoint.write hit 1 = the limits intent, hit 2 = finalize.
+        plan = faults.FaultPlan().crash("checkpoint.write", on_call=2)
+        with faults.armed(plan):
+            with pytest.raises(faults.CrashPoint):
+                state.resize_claim_limits(
+                    "uid-l1", tensorcore_percent=60, hbm_limit="8Gi"
+                )
+        # The dead incarnation left the intent on disk.
+        raw = CheckpointManager(
+            str(tmp_path / "checkpoint.json")
+        ).read()
+        assert raw["uid-l1"]["resize"]["limits"] == {
+            "tensorcorePercent": 60, "hbmLimit": "8Gi",
+        }
+
+        restarted, _ = make_state(tmp_path, lib=lib)
+        rec = restarted.checkpoint.read()["uid-l1"]
+        assert "resize" not in rec
+        psc = rec["groups"][0]["config"]["sharing"]["processSharedConfig"]
+        assert psc["defaultActiveCorePercentage"] == 60
+        assert psc["defaultHbmLimit"] == "8Gi"
+        # The dead incarnation already rendered generation 2 into the
+        # limits file before the finalize crash; recovery must render
+        # PAST it (a workload pinned at 2 would ignore a re-render AT
+        # 2), and all three renderings must agree on the final number.
+        gen = rec["sharing"]["generation"]
+        assert gen >= 2
+        meta = restarted.share_state.get(chip).claims["uid-l1"]
+        assert meta["tensorcorePercent"] == 60
+        assert meta["generation"] == gen
+        run_dir = restarted.ps_manager.run_dir
+        sess = [d for d in _os.listdir(run_dir)
+                if d.startswith("uid-l1")]
+        doc = _json.load(open(
+            _os.path.join(run_dir, sess[0], "limits.json")
+        ))
+        assert doc["generation"] == gen
+        assert doc["tensorcorePercent"] == 60
+        # Zero drift: the sharing-limits check sees all three
+        # renderings agreeing.
+        assert run_audit(restarted) == []
+        assert_invariants(restarted)
+        restarted.unprepare("uid-l1")
+        assert run_audit(restarted) == []
+
+    def test_unfinished_intent_surfaces_as_resize_drift(self, tmp_path):
+        """An intent recovery cannot complete (its session re-render
+        keeps failing at restart) is LEFT ON DISK and surfaces as the
+        auditor's resize finding — loud, never silent."""
+        state, lib = make_state(tmp_path)
+        state.prepare(make_process_shared_claim("uid-l2", pct=30))
+        plan = faults.FaultPlan().crash("checkpoint.write", on_call=2)
+        with faults.armed(plan):
+            with pytest.raises(faults.CrashPoint):
+                state.resize_claim_limits(
+                    "uid-l2", tensorcore_percent=55
+                )
+        # Recovery's roll-forward fails too (simulated persistent
+        # session-resize failure at startup).
+        recovery_plan = faults.FaultPlan().fail(
+            "rebalance.session-resize", OSError("still broken"),
+            times=10,
+        )
+        with faults.armed(recovery_plan):
+            restarted, _ = make_state(tmp_path, lib=lib)
+            findings = run_audit(restarted)
+        assert ("resize", "uid-l2") in [
+            (f.check, f.subject) for f in findings
+        ]
+        # Once the condition clears, the next restart heals it.
+        healed, _ = make_state(tmp_path, lib=lib)
+        assert run_audit(healed) == []
+
+
 class TestSeededSchedules:
     def test_acceptance_schedule_fixed_seed(self, tmp_path):
         run_acceptance_schedule(tmp_path, SEED)

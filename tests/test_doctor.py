@@ -424,3 +424,132 @@ class TestMetricsParser:
         assert doctor.metric_value(
             parsed, "m", path="C:\\new", msg="a\nb"
         ) == 1
+
+
+class TestSloCheck:
+    """The `slo` cross-check: a claim the rebalancer reports below its
+    min share for longer than its latency class allows becomes a drift
+    finding; healthy claims and rebalancer-less nodes are silent."""
+
+    @staticmethod
+    def _scrape(below=30.0, grace=5.0, with_rebalance=True):
+        scrape = doctor.NodeScrape(name="node-a", url="http://x")
+        if with_rebalance:
+            scrape.rebalance = {
+                "decisions": [],
+                "claims": {
+                    "uid-starved": {
+                        "namespace": "tenants", "name": "infer",
+                        "latencyClass": "realtime",
+                        "belowMinSeconds": below,
+                        "graceSeconds": grace,
+                    },
+                },
+            }
+        return scrape
+
+    def test_starved_claim_is_drift(self):
+        findings = doctor.fleet_findings([self._scrape()], None, DRIVER)
+        slo = [f for f in findings if f.check == "slo"]
+        assert len(slo) == 1
+        assert slo[0].severity == doctor.SEVERITY_DRIFT
+        assert slo[0].subject == "node-a/tenants/infer"
+        assert "realtime" in slo[0].detail
+
+    def test_within_grace_is_silent(self):
+        findings = doctor.fleet_findings(
+            [self._scrape(below=3.0, grace=5.0)], None, DRIVER
+        )
+        assert [f for f in findings if f.check == "slo"] == []
+
+    def test_rebalancerless_node_is_silent(self):
+        findings = doctor.fleet_findings(
+            [self._scrape(with_rebalance=False)], None, DRIVER
+        )
+        assert [f for f in findings if f.check == "slo"] == []
+
+    def test_live_scrape_and_bundle(self, tmp_path):
+        """Against a real MetricsServer: /debug/rebalance is scraped,
+        the starved claim becomes a finding, and the raw document lands
+        in the support bundle."""
+        from k8s_dra_driver_tpu.utils.metrics import (
+            MetricsServer,
+            Registry,
+        )
+
+        snapshot = {
+            "node": "node-a",
+            "decisions": [{"outcome": "applied", "action": "steal-idle"}],
+            "claims": {"uid-s": {
+                "namespace": "t", "name": "w", "latencyClass": "realtime",
+                "belowMinSeconds": 99.0, "graceSeconds": 5.0,
+            }},
+        }
+        from k8s_dra_driver_tpu.utils.tracing import Tracer
+
+        srv = MetricsServer(Registry(), host="127.0.0.1", port=0,
+                            tracer=Tracer())
+        srv.set_usage_provider(lambda: {"node": "node-a", "holds": []})
+        srv.set_rebalance_provider(lambda: snapshot)
+        srv.start()
+        try:
+            bundle = tmp_path / "bundle.tar"
+            report, findings, status = doctor.run(
+                {"node-a": f"http://127.0.0.1:{srv.port}"},
+                bundle=str(bundle),
+            )
+        finally:
+            srv.stop()
+        assert status == 1
+        assert any(f.check == "slo" for f in findings)
+        with tarfile.open(bundle) as tar:
+            doc = json.load(tar.extractfile("nodes/node-a/rebalance.json"))
+        assert doc["claims"]["uid-s"]["belowMinSeconds"] == 99.0
+
+    def test_rebalance_scrape_failure_is_loud(self, tmp_path):
+        """A non-404 /debug/rebalance failure is a collection error —
+        silence must mean 'no SLO trouble', never 'couldn't look'."""
+        from k8s_dra_driver_tpu.utils.metrics import (
+            MetricsServer,
+            Registry,
+        )
+
+        def boom():
+            raise RuntimeError("provider exploded")
+
+        from k8s_dra_driver_tpu.utils.tracing import Tracer
+
+        srv = MetricsServer(Registry(), host="127.0.0.1", port=0,
+                            tracer=Tracer())
+        srv.set_usage_provider(lambda: {"node": "node-a", "holds": []})
+        srv.set_rebalance_provider(boom)  # provider raising -> HTTP 500
+        srv.start()
+        try:
+            scrape = doctor.collect_node(
+                "node-a", f"http://127.0.0.1:{srv.port}"
+            )
+        finally:
+            srv.stop()
+        assert scrape.rebalance is None
+        assert any("/debug/rebalance" in e for e in scrape.errors)
+
+    def test_404_is_benign(self):
+        from k8s_dra_driver_tpu.utils.metrics import (
+            MetricsServer,
+            Registry,
+        )
+
+        from k8s_dra_driver_tpu.utils.tracing import Tracer
+
+        srv = MetricsServer(Registry(), host="127.0.0.1", port=0,
+                            tracer=Tracer())
+        srv.set_usage_provider(lambda: {"node": "node-a", "holds": []})
+        srv.start()
+        try:
+            scrape = doctor.collect_node(
+                "node-a", f"http://127.0.0.1:{srv.port}"
+            )
+        finally:
+            srv.stop()
+        assert scrape.rebalance is None
+        assert not any("/debug/rebalance" in e for e in scrape.errors)

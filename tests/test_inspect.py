@@ -280,3 +280,103 @@ class TestHealthAndLiveFields:
             assert "NOT known-empty" in text
         finally:
             srv.stop()
+
+
+class TestLiveRebalance:
+    """The /debug/rebalance scrape: granted-vs-declared shares + recent
+    decisions render; the 404/failure split mirrors the other debug
+    endpoints."""
+
+    def _serve(self, snapshot=None, boom=False):
+        from k8s_dra_driver_tpu.utils.metrics import (
+            MetricsServer,
+            Registry,
+        )
+
+        srv = MetricsServer(Registry(), host="127.0.0.1", port=0)
+        srv.add_readiness_check("grpc-serving", lambda: (True, "ok"))
+        if boom:
+            def provider():
+                raise RuntimeError("provider exploded")
+            srv.set_rebalance_provider(provider)
+        elif snapshot is not None:
+            srv.set_rebalance_provider(lambda: snapshot)
+        srv.start()
+        return srv
+
+    def test_shares_and_decisions_render(self, tmp_path):
+        srv = self._serve({
+            "decisions": [{
+                "outcome": "applied", "action": "steal-idle",
+                "resource": "tensorcore",
+                "gainer": {"claim": "uid-i", "from": 30, "to": 40},
+                "donor": {"claim": "uid-b", "from": 70, "to": 60},
+            }],
+            "claims": {"uid-i": {
+                "namespace": "t", "name": "infer",
+                "latencyClass": "realtime", "generation": 2,
+                "granted": {"tensorcore": 40, "hbm": 25},
+                "min": {"tensorcore": 30, "hbm": 25},
+                "burst": {"tensorcore": 80, "hbm": 75},
+                "belowMinSeconds": 0.0, "graceSeconds": 5.0,
+            }},
+        })
+        try:
+            out = collect(
+                str(tmp_path), str(tmp_path / "cdi"),
+                http_url=f"http://127.0.0.1:{srv.port}",
+            )
+            live = out["live"]
+            assert live["rebalanceClaims"]["uid-i"]["claim"] == "t/infer"
+            assert live["rebalanceDecisions"][0]["outcome"] == "applied"
+            text = render(out)
+            assert "dynamic-sharing claims: 1" in text
+            assert "tc=40%" in text and "SLO-STARVED" not in text
+            assert "applied steal-idle tensorcore" in text
+        finally:
+            srv.stop()
+
+    def test_starved_claim_is_marked(self, tmp_path):
+        srv = self._serve({
+            "decisions": [],
+            "claims": {"uid-s": {
+                "namespace": "t", "name": "w",
+                "latencyClass": "realtime", "generation": 4,
+                "granted": {"tensorcore": 10, "hbm": None},
+                "min": {"tensorcore": 30, "hbm": None},
+                "burst": {"tensorcore": 80, "hbm": None},
+                "belowMinSeconds": 44.0, "graceSeconds": 5.0,
+            }},
+        })
+        try:
+            out = collect(
+                str(tmp_path), str(tmp_path / "cdi"),
+                http_url=f"http://127.0.0.1:{srv.port}",
+            )
+            assert "SLO-STARVED" in render(out)
+        finally:
+            srv.stop()
+
+    def test_404_is_quiet_failure_is_loud(self, tmp_path):
+        srv = self._serve()
+        try:
+            out = collect(
+                str(tmp_path), str(tmp_path / "cdi"),
+                http_url=f"http://127.0.0.1:{srv.port}",
+            )
+            assert "rebalanceClaims" not in out["live"]
+            assert "rebalanceError" not in out["live"]
+        finally:
+            srv.stop()
+        srv = self._serve(boom=True)
+        try:
+            out = collect(
+                str(tmp_path), str(tmp_path / "cdi"),
+                http_url=f"http://127.0.0.1:{srv.port}",
+            )
+            assert out["live"]["rebalanceError"] == "HTTP 500"
+            text = render(out)
+            assert "/debug/rebalance scrape FAILED (HTTP 500)" in text
+            assert "NOT known-clean" in text
+        finally:
+            srv.stop()

@@ -13,6 +13,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 from k8s_dra_driver_tpu.parallel.shim import (
     apply_sharing_env,
     timeshare_lease,
@@ -157,6 +159,227 @@ print("slot", rt.slot)
         environ = {"SOME": "ENV"}
         assert apply_sharing_env(environ) is None
         assert environ == {"SOME": "ENV"}
+
+
+class TestSlotCrashConsistency:
+    def test_sigkilled_holder_slot_is_reclaimed(self, tmp_path):
+        """Crash-consistency for the flock'd slot files: a workload
+        process killed with SIGKILL (no atexit, no context-manager
+        cleanup) leaves its slot-N.lock file on disk — the STALE FILE
+        must be reclaimed by the next process, not read as a live
+        holder leaking the share forever."""
+        import signal
+        import subprocess
+        import time
+
+        env = {
+            "TPU_DRA_SHARING": "process-shared",
+            "TPU_DRA_MAX_PROCESSES": "1",
+            "TPU_DRA_SHARED_DIR": str(tmp_path),
+        }
+        marker = tmp_path / "held"
+        code = f"""
+from k8s_dra_driver_tpu.parallel.shim import apply_sharing_env
+import time
+rt = apply_sharing_env()
+assert rt.slot == 0
+open({str(marker)!r}, "w").close()
+time.sleep(60)
+"""
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code],
+            env={**os.environ, **env}, cwd=REPO,
+        )
+        try:
+            deadline = time.monotonic() + 30
+            while not marker.exists():
+                assert time.monotonic() < deadline, "holder never started"
+                assert proc.poll() is None, "holder died early"
+                time.sleep(0.02)
+            # While the holder lives, the single slot is genuinely busy.
+            from k8s_dra_driver_tpu.parallel.shim import (
+                SharingRuntimeError,
+                _acquire_slot,
+            )
+
+            with pytest.raises(SharingRuntimeError):
+                _acquire_slot(str(tmp_path), 1)
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+        # The stale slot file survives the kill...
+        assert (tmp_path / "slot-0.lock").exists()
+        # ...but the flock died with the process: the share is
+        # immediately reusable, no daemon, no lease to expire.
+        environ = dict(env)
+        rt = apply_sharing_env(environ)
+        try:
+            assert rt is not None and rt.slot == 0
+        finally:
+            rt.release()
+
+
+class TestRebalanceShim:
+    """The workload half of the hitless limits-resize contract."""
+
+    def _env(self, tmp_path, gen_doc=None):
+        environ = {
+            "TPU_DRA_SHARING": "process-shared",
+            "TPU_DRA_MAX_PROCESSES": "2",
+            "TPU_DRA_SHARED_DIR": str(tmp_path),
+            "TPU_DRA_CHIP_HBM_BYTES": str(16 << 30),
+        }
+        if gen_doc is not None:
+            (tmp_path / "limits.json").write_text(json.dumps(gen_doc))
+        return environ
+
+    def test_poll_applies_new_generation(self, tmp_path):
+        from k8s_dra_driver_tpu.parallel.shim import poll_sharing_update
+
+        environ = self._env(tmp_path)
+        rt = apply_sharing_env(environ)
+        try:
+            assert poll_sharing_update(environ) is None  # no file yet
+            (tmp_path / "limits.json").write_text(json.dumps({
+                "generation": 2, "tensorcorePercent": 60,
+                "hbmLimitBytes": 8 << 30, "chipHbmBytes": 16 << 30,
+            }))
+            upd = poll_sharing_update(environ)
+            assert upd is not None and upd.generation == 2
+            assert upd.tensorcore_percent == 60
+            assert environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] == "0.5000"
+            assert environ["TPU_DRA_ACTIVE_CORE_PERCENTAGE"] == "60"
+            # Same generation again: nothing to do (idempotent).
+            assert poll_sharing_update(environ) is None
+            # An OLDER generation never regresses the applied state.
+            (tmp_path / "limits.json").write_text(json.dumps({
+                "generation": 1, "tensorcorePercent": 30,
+            }))
+            assert poll_sharing_update(environ) is None
+            assert environ["TPU_DRA_ACTIVE_CORE_PERCENTAGE"] == "60"
+        finally:
+            rt.release()
+
+    def test_startup_sees_current_generation(self, tmp_path):
+        """A process starting AFTER a rebalance must begin on the
+        current limits (the file), not the prepare-time env render."""
+        environ = self._env(tmp_path, {
+            "generation": 3, "tensorcorePercent": 45,
+            "hbmLimitBytes": 4 << 30, "chipHbmBytes": 16 << 30,
+        })
+        environ["TPU_DRA_HBM_LIMIT_BYTES"] = str(8 << 30)  # stale env
+        rt = apply_sharing_env(environ)
+        try:
+            assert environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] == "0.2500"
+            assert environ["TPU_DRA_SHIM_GENERATION"] == "3"
+            from k8s_dra_driver_tpu.parallel.shim import (
+                poll_sharing_update,
+            )
+
+            assert poll_sharing_update(environ) is None  # already there
+        finally:
+            rt.release()
+
+    def test_cleared_limits_clear_the_env(self, tmp_path):
+        """A generation whose limits are null is a CLEAR (a rollback
+        restoring an uncapped claim), not 'nothing to say': the aborted
+        cap must leave the env, or the workload enforces limits the
+        checkpoint no longer grants."""
+        from k8s_dra_driver_tpu.parallel.shim import poll_sharing_update
+
+        environ = self._env(tmp_path, {
+            "generation": 2, "tensorcorePercent": 60,
+            "hbmLimitBytes": 8 << 30, "chipHbmBytes": 16 << 30,
+        })
+        rt = apply_sharing_env(environ)
+        try:
+            assert environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] == "0.5000"
+            (tmp_path / "limits.json").write_text(json.dumps({
+                "generation": 3, "tensorcorePercent": None,
+                "hbmLimitBytes": None, "chipHbmBytes": 16 << 30,
+            }))
+            upd = poll_sharing_update(environ)
+            assert upd is not None and upd.generation == 3
+            assert "XLA_PYTHON_CLIENT_MEM_FRACTION" not in environ
+            assert "TPU_DRA_HBM_LIMIT_BYTES" not in environ
+            assert "TPU_DRA_ACTIVE_CORE_PERCENTAGE" not in environ
+        finally:
+            rt.release()
+
+    def test_operator_pinned_fraction_survives_rebalances(self, tmp_path):
+        """An operator-set XLA_PYTHON_CLIENT_MEM_FRACTION in the pod
+        spec outranks the driver's derived fraction — at startup AND
+        across later limits generations (the pre-rebalancer setdefault
+        contract, preserved)."""
+        from k8s_dra_driver_tpu.parallel.shim import poll_sharing_update
+
+        environ = self._env(tmp_path, {
+            "generation": 1, "tensorcorePercent": 30,
+            "hbmLimitBytes": 12 << 30, "chipHbmBytes": 16 << 30,
+        })
+        environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] = "0.1000"
+        rt = apply_sharing_env(environ)
+        try:
+            assert environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] == "0.1000"
+            (tmp_path / "limits.json").write_text(json.dumps({
+                "generation": 2, "tensorcorePercent": 60,
+                "hbmLimitBytes": 8 << 30, "chipHbmBytes": 16 << 30,
+            }))
+            upd = poll_sharing_update(environ)
+            assert upd is not None and upd.generation == 2
+            assert environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] == "0.1000"
+            # The driver-truth budget env still tracks the rebalance.
+            assert environ["TPU_DRA_HBM_LIMIT_BYTES"] == str(8 << 30)
+        finally:
+            rt.release()
+
+    def test_driver_injected_fraction_is_not_pinned(self, tmp_path):
+        """The CDI claim spec injects XLA_PYTHON_CLIENT_MEM_FRACTION
+        with the driver-derived value — that must NOT read as an
+        operator pin (it would disable every future rebalance fraction
+        update for every real CDI-launched workload). Only a fraction
+        that DIFFERS from the derived value is an operator override."""
+        from k8s_dra_driver_tpu.parallel.shim import poll_sharing_update
+
+        environ = self._env(tmp_path)
+        # Exactly what plugin/sharing.py container_edits injects for a
+        # 12Gi limit on a 16Gi chip.
+        environ["TPU_DRA_HBM_LIMIT_BYTES"] = str(12 << 30)
+        environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] = "0.7500"
+        rt = apply_sharing_env(environ)
+        try:
+            assert "TPU_DRA_MEM_FRACTION_PINNED" not in environ
+            (tmp_path / "limits.json").write_text(json.dumps({
+                "generation": 2, "tensorcorePercent": 40,
+                "hbmLimitBytes": 4 << 30, "chipHbmBytes": 16 << 30,
+            }))
+            upd = poll_sharing_update(environ)
+            assert upd is not None
+            # The rebalance reached the allocator knob.
+            assert environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] == "0.2500"
+        finally:
+            rt.release()
+
+    def test_report_usage_round_trip(self, tmp_path):
+        """report_usage publishes the demand sample FileDemandSource
+        aggregates — the closed loop's sensor."""
+        from k8s_dra_driver_tpu.parallel.shim import report_usage
+
+        environ = self._env(tmp_path)
+        rt = apply_sharing_env(environ)
+        try:
+            assert report_usage(0.9, hbm_fraction=0.4, environ=environ)
+            doc = json.loads(
+                (tmp_path / "usage-slot-0.json").read_text()
+            )
+            assert doc["busy"] == 0.9 and doc["hbm"] == 0.4
+        finally:
+            rt.release()
+        # Off process-shared claims it is a free no-op.
+        assert report_usage(1.0, environ={}) is False
 
 
 class TestTimeShareShim:

@@ -31,8 +31,9 @@ Plus one first-party rule with no ruff analog:
   large fleet (``make verify-metrics`` additionally bounds the rendered
   series count of such families).
 - TPM05: ``plugin/accounting.py`` may only declare ``tpu_dra_usage_*``
-  metrics, ``plugin/audit.py`` only ``tpu_dra_audit_*``, and
-  ``parallel/elastic.py`` only ``tpu_dra_elastic_*`` — each family's
+  metrics, ``plugin/audit.py`` only ``tpu_dra_audit_*``,
+  ``parallel/elastic.py`` only ``tpu_dra_elastic_*``, and
+  ``plugin/rebalancer.py`` only ``tpu_dra_slo_*`` — each family's
   home module stays coherent, so the docs catalog and the
   verify-metrics coverage can reason per-module.
 - TPM06: ``stage=``/``reason=`` label values on the ``tpu_dra_alloc_*``
@@ -214,6 +215,7 @@ _MODULE_FAMILY_PREFIXES = {
     "elastic.py": "tpu_dra_elastic_",
     "allocator.py": "tpu_dra_alloc",
     "defrag.py": "tpu_dra_defrag_",
+    "rebalancer.py": "tpu_dra_slo_",
 }
 _METRIC_METHODS = {"inc", "set", "observe"}
 

@@ -321,6 +321,74 @@ def _self_test_scrape() -> tuple[str, list[str]]:
                 f"with migrations: {newest_plan.get('outcome')!r}"
             )
 
+    # The SLO / dynamic-sharing families (tpu_dra_slo_*), populated
+    # through a REAL rebalance: two ProcessShared co-tenants on one
+    # chip, one bursting and one idle, so the rebalancer applies a
+    # steal-idle move via the two-phase limits-resize protocol — the
+    # decisions counter, granted/min gauges, and latency histogram all
+    # render exactly what production would.
+    from k8s_dra_driver_tpu.plugin.rebalancer import (
+        OUTCOMES as REB_OUTCOMES,
+        Rebalancer,
+    )
+
+    def _shared_claim(uid, pct, hbm, slo):
+        return {
+            "metadata": {"name": f"t-{uid}", "namespace": "verify",
+                         "uid": uid},
+            "status": {"allocation": {"devices": {"results": [{
+                "request": "r", "driver": "tpu.google.com",
+                "pool": "verify", "device": "tpu-0",
+            }], "config": [{
+                "requests": [], "source": "FromClaim",
+                "opaque": {"driver": "tpu.google.com", "parameters": {
+                    "apiVersion": "tpu.google.com/v1alpha1",
+                    "kind": "TpuChipConfig",
+                    "sharing": {
+                        "strategy": "ProcessShared",
+                        "processSharedConfig": {
+                            "maxProcesses": 2,
+                            "defaultActiveCorePercentage": pct,
+                            "defaultHbmLimit": hbm,
+                            "slo": slo,
+                        },
+                    },
+                }},
+            }]}}},
+        }
+
+    slo_demand = {
+        "uid-slo-infer": {"busy": 1.0},
+        "uid-slo-batch": {"busy": 0.0},
+    }
+    with tempfile.TemporaryDirectory(prefix="verify-rebalance-") as tmp:
+        slo_state = DeviceState(
+            chiplib=FakeChipLib(generation="v5e", topology="2x1x1"),
+            cdi=CDIHandler(f"{tmp}/cdi"),
+            checkpoint=CheckpointManager(f"{tmp}/checkpoint.json"),
+            driver_name="tpu.google.com",
+            pool_name="verify",
+            state_dir=f"{tmp}/state",
+        )
+        slo_state.prepare(_shared_claim("uid-slo-infer", 30, "4Gi", {
+            "latencyClass": "realtime", "minTensorCorePercent": 30,
+            "burstTensorCorePercent": 80, "priority": 10,
+        }))
+        slo_state.prepare(_shared_claim("uid-slo-batch", 70, "12Gi", {
+            "latencyClass": "batch", "minTensorCorePercent": 20,
+        }))
+        rebalancer = Rebalancer(
+            slo_state, registry, node_name="verify",
+            demand_source=lambda v: slo_demand.get(v.claim_uid),
+        )
+        slo_records = rebalancer.run_once()
+        if not slo_records or slo_records[-1]["outcome"] != "applied":
+            alloc_errors.append(
+                "rebalance sim produced no applied decision: "
+                f"{slo_records}"
+            )
+        rebalance_snapshot = rebalancer.snapshot()
+
     tracer = Tracer()
     with tracer.span("verify", claim_uid="uid-verify"):
         pass
@@ -331,6 +399,7 @@ def _self_test_scrape() -> tuple[str, list[str]]:
     srv.set_usage_provider(lambda: snapshot)
     srv.set_allocations_provider(allocator.export_allocations_jsonl)
     srv.set_defrag_provider(planner.export_json)
+    srv.set_rebalance_provider(lambda: rebalance_snapshot)
     srv.start()
     try:
         base = f"http://127.0.0.1:{srv.port}"
@@ -423,9 +492,44 @@ def _self_test_scrape() -> tuple[str, list[str]]:
                             f"/debug/defrag: outcome "
                             f"{p.get('outcome')!r} outside OUTCOMES"
                         )
+        # /debug/rebalance: decodable JSON whose newest decision is the
+        # sim's applied steal, outcomes enum-confined, and both
+        # co-tenant claims present with granted-vs-min shares.
+        rebalance_body = urllib.request.urlopen(
+            f"{base}/debug/rebalance"
+        ).read().decode()
+        try:
+            rebalance_doc = json.loads(rebalance_body)
+        except ValueError:
+            errors.append("/debug/rebalance: body is not JSON")
+        else:
+            served_decisions = rebalance_doc.get("decisions") or []
+            if not served_decisions:
+                errors.append("/debug/rebalance: no decisions served")
+            else:
+                for dec in served_decisions:
+                    if dec.get("outcome") not in REB_OUTCOMES:
+                        errors.append(
+                            f"/debug/rebalance: outcome "
+                            f"{dec.get('outcome')!r} outside OUTCOMES"
+                        )
+                if served_decisions[-1].get("outcome") != "applied":
+                    errors.append(
+                        "/debug/rebalance: newest decision is not the "
+                        "applied steal"
+                    )
+            served_claims = rebalance_doc.get("claims") or {}
+            for uid in ("uid-slo-infer", "uid-slo-batch"):
+                c = served_claims.get(uid)
+                if not c or "granted" not in c or "min" not in c:
+                    errors.append(
+                        f"/debug/rebalance: claim {uid} missing its "
+                        "granted-vs-min share view"
+                    )
         # The scrape surface is GET-only by contract — /metrics and the
         # debug endpoints alike.
-        for route in ("/metrics", "/debug/allocations", "/debug/defrag"):
+        for route in ("/metrics", "/debug/allocations", "/debug/defrag",
+                      "/debug/rebalance"):
             try:
                 urllib.request.urlopen(base + route, data=b"x")
                 errors.append(f"{route} accepted a POST (want 405)")
@@ -447,7 +551,12 @@ def _self_test_scrape() -> tuple[str, list[str]]:
                    "tpu_dra_alloc_unsat_total",
                    "tpu_dra_defrag_plans_total",
                    "tpu_dra_defrag_plan_seconds",
-                   "tpu_dra_defrag_last_plan_migrations"):
+                   "tpu_dra_defrag_last_plan_migrations",
+                   "tpu_dra_slo_rebalance_decisions_total",
+                   "tpu_dra_slo_granted_share",
+                   "tpu_dra_slo_min_share",
+                   "tpu_dra_slo_rebalance_seconds",
+                   "tpu_dra_slo_violations_total"):
         if f"\n{family}" not in body and not body.startswith(family):
             errors.append(f"expected family {family} missing from scrape")
     # The rendered stage/reason label values stay inside the enums the
