@@ -2,7 +2,7 @@
 IMAGE ?= tpu-dra-driver
 TAG ?= latest
 
-.PHONY: all native test image lint verify verify-metrics chaos chaos-slow doctor decodebench moebench elastic allocbench allocbench-smoke clean e2e-kind
+.PHONY: all native test image lint verify verify-metrics chaos chaos-slow doctor decodebench moebench elastic allocbench allocbench-smoke gatewaybench clean e2e-kind
 
 all: native
 
@@ -81,11 +81,20 @@ allocbench-smoke:
 	ALLOC_BENCH_SEED=$(ALLOC_BENCH_SEED) \
 		python tools/run_alloc_bench.py --profile smoke
 
+# Fleet-gateway smoke (tools/run_gateway_smoke.py): fixed-seed
+# shared-prefix traffic through two real DecodeEngine replicas on CPU —
+# prefix-affinity routing gated >= 1.3x round-robin fleet req/s
+# (tick-normalized, deterministic) at equal-or-lower p99 token latency,
+# compile-once per replica, plus a scripted-engine drain that must lose
+# zero admitted requests.
+gatewaybench:
+	python tools/run_gateway_smoke.py
+
 # The full local gate: lint + unit/integration tests + chaos schedules +
 # metrics exposition + the doctor/auditor drill + the decode-engine,
-# MoE fast-path, elastic-training, and allocator-bench smokes. What CI
-# runs; what a PR must pass.
-verify: lint test chaos verify-metrics doctor decodebench moebench elastic allocbench-smoke
+# MoE fast-path, elastic-training, allocator-bench, and fleet-gateway
+# smokes. What CI runs; what a PR must pass.
+verify: lint test chaos verify-metrics doctor decodebench moebench elastic allocbench-smoke gatewaybench
 
 # ruff when available (CI installs it; .golangci.yaml analog is
 # [tool.ruff] in pyproject.toml), else the first-party AST lint floor.

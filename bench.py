@@ -311,6 +311,13 @@ def extra_metrics(peak_flops, remat_policy) -> list:
             # rate, speedup in detail).
             ("prefix-cache", "run_prefix_cache_bench",
              dict(preset=decode_preset)),
+            # Fleet-gateway acceptance pair: shared-prefix traffic
+            # through two replicas, prefix-affinity vs round-robin
+            # (fleet req/s at measured p99, hit rate, shed rate;
+            # speedup + deterministic tick-normalized speedup in
+            # detail — the ISSUE-14 >= 1.3x gate).
+            ("gateway", "run_gateway_bench",
+             dict(preset=decode_preset)),
             ("speculative", "run_speculative_bench",
              dict(preset=decode_preset)),
         ):

@@ -29,7 +29,13 @@ ResourceClaims; then it re-runs the audit cross-checks FLEET-wide:
 - SLO starvation surfaced by ``/debug/rebalance`` (the ``slo`` check):
   a claim below its declared min share for longer than its latency
   class allows, with the node's recent rebalance decisions bundled as
-  the evidence trail.
+  the evidence trail;
+- fleet-gateway health surfaced by ``/debug/gateway`` (the ``gateway``
+  check): a most-recent-FAILED autoscale attempt is drift (the load
+  closed loop is broken right now — an old failure a later attempt
+  recovered from is not), an overloaded fleet (queue depth past the
+  shed watermark) is informational with the playbook pointer, and the
+  snapshot is bundled as ``gateway.json``.
 
 ``--bundle`` additionally writes a tar of every raw document (metrics,
 usage JSON, traces JSONL, readyz, cluster objects, findings) for
@@ -145,6 +151,7 @@ class NodeScrape:
     allocations_text: str = ""
     defrag: Optional[dict] = None
     rebalance: Optional[dict] = None
+    gateway: Optional[dict] = None
     errors: list = dataclasses.field(default_factory=list)
 
     @property
@@ -249,6 +256,15 @@ def collect_node(name: str, url: str, timeout: float = 5.0) -> NodeScrape:
         # never "couldn't look".
         if getattr(e, "code", None) != 404:
             scrape.errors.append(f"/debug/rebalance: {e}")
+    try:
+        scrape.gateway = json.loads(
+            _fetch(scrape.url + "/debug/gateway", timeout)
+        )
+    except Exception as e:
+        # Same contract again: the serving gateway only runs on fleet
+        # frontends, so a 404 is a normal node plugin.
+        if getattr(e, "code", None) != 404:
+            scrape.errors.append(f"/debug/gateway: {e}")
     reported = (scrape.usage or {}).get("node")
     if reported and reported != name:
         scrape.errors.append(
@@ -346,6 +362,46 @@ def fleet_findings(
                 "their own min means the node is oversubscribed; "
                 "failed decisions mean the apply path is broken",
             ))
+        # Fleet-gateway health (/debug/gateway): a failed autoscale is
+        # drift (the closed loop is broken — the fleet cannot react to
+        # load); an overloaded-but-scaling fleet is informational with
+        # the playbook pointer.
+        if node.gateway is not None:
+            gw_events = [
+                e for e in (node.gateway.get("events") or [])
+                if isinstance(e, dict)
+            ]
+            # Only the MOST RECENT scale attempt drives the verdict: a
+            # transient failure that a later attempt recovered from
+            # would otherwise sit in the 256-deep ring flagging the
+            # node as drift for days. Damped skips (dwell/cooldown/
+            # clamped) don't overwrite a standing failure — nothing was
+            # retried yet.
+            attempts = [
+                e for e in gw_events
+                if e.get("kind") == "scale"
+                and e.get("outcome") in ("applied", "failed")
+            ]
+            if attempts and attempts[-1].get("outcome") == "failed":
+                last = attempts[-1]
+                findings.append(DoctorFinding(
+                    SEVERITY_DRIFT, "gateway", node.name,
+                    f"autoscale {last.get('direction', '?')} FAILED: "
+                    f"{last.get('detail') or last.get('reason') or '?'}"
+                    " — the fleet cannot react to load; check the "
+                    "provisioner's allocator solve (/debug/allocations "
+                    "explains an unsat) and the overloaded-fleet "
+                    "playbook in docs/operations.md",
+                ))
+            if node.gateway.get("overloaded"):
+                findings.append(DoctorFinding(
+                    SEVERITY_INFO, "gateway", node.name,
+                    f"fleet queue depth "
+                    f"{node.gateway.get('fleetQueueDepth', '?')} is "
+                    "past the shed watermark (batch traffic is being "
+                    "rejected with retry-after) — see the "
+                    "overloaded-fleet playbook in docs/operations.md",
+                ))
 
     claims_by_uid = {
         (c.get("metadata") or {}).get("uid", ""): c
@@ -676,6 +732,9 @@ def write_bundle(
             if node.rebalance is not None:
                 add(tar, f"{base}/rebalance.json",
                     json.dumps(node.rebalance, indent=2, sort_keys=True))
+            if node.gateway is not None:
+                add(tar, f"{base}/gateway.json",
+                    json.dumps(node.gateway, indent=2, sort_keys=True))
             if node.errors:
                 add(tar, f"{base}/errors.txt", "\n".join(node.errors) + "\n")
         if cluster is not None:

@@ -47,7 +47,12 @@ from .decode import (
 )
 from .paged import BlockAllocator, OutOfBlocksError, PrefixCache
 from .quant import QuantTensor, quantize_params, quantize_specs
-from .serving import DecodeEngine, Request, ServingStats
+from .serving import (
+    AdmissionClosedError,
+    DecodeEngine,
+    Request,
+    ServingStats,
+)
 from .speculative import speculative_generate
 
 __all__ += [
@@ -57,6 +62,7 @@ __all__ += [
     "BlockAllocator",
     "OutOfBlocksError",
     "PrefixCache",
+    "AdmissionClosedError",
     "DecodeEngine",
     "Request",
     "ServingStats",

@@ -98,6 +98,13 @@ DRAINING = "draining"   # finished, but a dispatched step still uses its blocks
 FINISHED = "finished"
 
 
+class AdmissionClosedError(RuntimeError):
+    """``submit()`` on an engine whose admission is closed
+    (:meth:`DecodeEngine.stop_admission` / mid-:meth:`DecodeEngine.drain`).
+    Typed so a fleet router can catch it and re-route instead of
+    crashing; the engine itself keeps serving its admitted requests."""
+
+
 @dataclasses.dataclass
 class Request:
     """One generation request and its scheduling state."""
@@ -178,6 +185,39 @@ class ServingStats:
 
     def queue_depth_max(self) -> int:
         return max(self.queue_depth) if self.queue_depth else 0
+
+    # The snapshot key set is a scrape CONTRACT: the fleet gateway's
+    # demand sensor (serving_gateway/router.py) and its bench columns
+    # key on these names, and tests/test_serving.py pins them so a
+    # rename cannot silently zero a routing signal.
+    SNAPSHOT_KEYS = (
+        "completed", "preemptions", "ticks", "decodeSteps",
+        "prefillChunks", "tokensGenerated", "prefixHitRate",
+        "prefillTokensSaved", "cowRecomputes", "queueDepthMean",
+        "queueDepthMax", "ttftP50Ms", "ttftP99Ms", "tokenIntervalP50Ms",
+        "tokenIntervalP99Ms",
+    )
+
+    def snapshot(self) -> dict:
+        """Cheap JSON-ready counters + percentile view for periodic
+        scraping (no array copies beyond the percentile sorts)."""
+        return {
+            "completed": self.completed,
+            "preemptions": self.preemptions,
+            "ticks": self.ticks,
+            "decodeSteps": self.decode_steps,
+            "prefillChunks": self.prefill_chunks,
+            "tokensGenerated": self.tokens_generated,
+            "prefixHitRate": round(self.hit_rate(), 4),
+            "prefillTokensSaved": self.prefix_hit_tokens,
+            "cowRecomputes": self.cow_recomputes,
+            "queueDepthMean": round(self.queue_depth_mean(), 2),
+            "queueDepthMax": self.queue_depth_max(),
+            "ttftP50Ms": round(self._pctl(self.ttft_s, 0.50) * 1e3, 3),
+            "ttftP99Ms": round(self.p99_ttft_ms(), 3),
+            "tokenIntervalP50Ms": round(self.p50_token_ms(), 3),
+            "tokenIntervalP99Ms": round(self.p99_token_ms(), 3),
+        }
 
 
 class DecodeEngine:
@@ -263,6 +303,7 @@ class DecodeEngine:
         self.compile_counts = {"decode_step": 0, "prefill_chunk": 0}
         self._rid = 0
         self._admit_seq = 0
+        self._admission_open = True
         self._rng = jax.random.PRNGKey(0)
         # Double-buffer state: (on-device [B] next-token array, [(req,
         # slot), ...] it was dispatched for). At most one step in flight.
@@ -340,6 +381,11 @@ class DecodeEngine:
     def submit(self, prompt, max_new_tokens: int) -> Request:
         """Queue a request; returns its handle (tokens appear on it as
         generation proceeds)."""
+        if not self._admission_open:
+            raise AdmissionClosedError(
+                "engine admission is closed (draining); re-route this "
+                "request to another replica"
+            )
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -372,6 +418,58 @@ class DecodeEngine:
     def idle(self) -> bool:
         return (self.num_active == 0 and not self.waiting
                 and self._inflight is None)
+
+    @property
+    def admission_open(self) -> bool:
+        return self._admission_open
+
+    def stop_admission(self) -> None:
+        """Close the front door: ``submit()`` raises a typed
+        :class:`AdmissionClosedError` and the scheduler stops admitting
+        requests that were never admitted before. Requests PREEMPTED
+        while closed still re-admit (they were admitted once; dropping
+        them would lose accepted work), which is what lets
+        :meth:`drain` guarantee zero admitted-request loss."""
+        self._admission_open = False
+
+    def resume_admission(self) -> None:
+        self._admission_open = True
+
+    def snapshot(self) -> dict:
+        """Live scheduling state + the stats snapshot — the document a
+        fleet router scrapes per tick. Key set pinned alongside
+        ``ServingStats.SNAPSHOT_KEYS`` in tests/test_serving.py."""
+        return {
+            "queueDepth": len(self.waiting),
+            "slotsBusy": self.num_active,
+            "batchSlots": self.batch_slots,
+            "admissionOpen": self._admission_open,
+            "blocksFree": self.allocator.num_free,
+            "blocksAvailable": self.allocator.num_available,
+            "blocksTotal": self.allocator.num_blocks,
+            **self.stats.snapshot(),
+        }
+
+    def drain(self, max_ticks: int = 100000) -> list[Request]:
+        """Graceful stop: close admission, hand back the never-admitted
+        waiting requests (for the caller to re-route — they hold no
+        blocks and no computed state), and run every ADMITTED request to
+        completion. Afterwards the engine is empty (``assert_no_leaks``
+        holds) but fully reusable via :meth:`resume_admission`.
+
+        Requests preempted mid-drain re-admit and finish too: the
+        zero-admitted-loss guarantee the fleet gateway's failover story
+        is built on."""
+        self.stop_admission()
+        rerouted = [r for r in self.waiting if r.admit_seq < 0]
+        self.waiting = deque(
+            r for r in self.waiting if r.admit_seq >= 0
+        )
+        for _ in range(max_ticks):
+            if self.idle:
+                return rerouted
+            self.tick()
+        raise RuntimeError(f"drain not complete after {max_ticks} ticks")
 
     def tick(self) -> None:
         """One scheduling round: admit, advance one prefill chunk, then
@@ -427,6 +525,12 @@ class DecodeEngine:
             if free_slot is None:
                 return
             req = self.waiting[0]
+            if not self._admission_open and req.admit_seq < 0:
+                # Closed admission: only previously-admitted (preempted)
+                # requests may re-enter. drain() removes fresh requests
+                # from the queue up front, so this head-blocking check
+                # only bites a bare stop_admission().
+                return
             bs = self.block_size
             lifetime = -(-(len(req.prompt) + req.max_new_tokens) // bs)
             hit: list[int] = []

@@ -70,6 +70,7 @@ def collect_live(http_url: str, timeout: float = 3.0) -> dict[str, Any]:
     out.update(_collect_unsat_allocations(http_url, timeout))
     out.update(_collect_defrag_plans(http_url, timeout))
     out.update(_collect_rebalance(http_url, timeout))
+    out.update(_collect_gateway(http_url, timeout))
     return out
 
 
@@ -208,6 +209,45 @@ def _collect_rebalance(
     }
     if claims:
         out["rebalanceClaims"] = claims
+    return out
+
+
+def _collect_gateway(
+    http_url: str, timeout: float, keep: int = 5
+) -> dict[str, Any]:
+    """Fleet-gateway view from ``/debug/gateway``: per-replica state +
+    queue depths, the overloaded marker, and recent scale/drain
+    events."""
+    text, err = _fetch_debug(http_url, "/debug/gateway", timeout)
+    if err is not None:
+        return {"gatewayError": err}
+    if text is None:
+        return {}
+    try:
+        doc = json.loads(text)
+    except ValueError as e:
+        return {"gatewayError": str(e)}
+    out: dict[str, Any] = {
+        "gatewayReplicas": {
+            rid: {
+                "state": r.get("state", "?"),
+                "queueDepth": r.get("queueDepth", 0),
+                "claimUid": r.get("claimUid", ""),
+            }
+            for rid, r in sorted((doc.get("replicas") or {}).items())
+            if isinstance(r, dict)
+        },
+        "gatewayQueues": doc.get("queues") or {},
+        "gatewayOverloaded": bool(doc.get("overloaded")),
+        "gatewayCounters": doc.get("counters") or {},
+    }
+    events = [
+        e for e in (doc.get("events") or [])
+        if isinstance(e, dict)
+        and e.get("kind") in ("scale", "drain", "replica-lost")
+    ]
+    if events:
+        out["gatewayEvents"] = events[-keep:]
     return out
 
 
@@ -499,6 +539,40 @@ def render(state: dict[str, Any]) -> str:
                         f"  {d['outcome']} {d['action']} "
                         f"{d['resource']}: {d['donor']} -> "
                         f"{d['gainer']} ({d['shares']})"
+                    )
+            if live.get("gatewayError"):
+                lines.append(
+                    "  /debug/gateway scrape FAILED "
+                    f"({live['gatewayError']}) — fleet-gateway view "
+                    "unavailable, NOT known-healthy"
+                )
+            gw_replicas = live.get("gatewayReplicas") or {}
+            if gw_replicas:
+                lines.append("")
+                counters = live.get("gatewayCounters") or {}
+                lines.append(
+                    f"serving gateway: {len(gw_replicas)} replica(s), "
+                    f"queues {live.get('gatewayQueues') or {}}, "
+                    f"routed {counters.get('routed', 0)}, shed "
+                    f"{counters.get('shed', 0)}, affinity hit rate "
+                    f"{counters.get('affinityHitRate', 0)}"
+                    + (" OVERLOADED"
+                       if live.get("gatewayOverloaded") else "")
+                )
+                for rid, r in gw_replicas.items():
+                    lines.append(
+                        f"  {rid}: {r['state']}, queue depth "
+                        f"{r['queueDepth']}"
+                        + (f" (claim {r['claimUid']})"
+                           if r.get("claimUid") else "")
+                    )
+                for e in live.get("gatewayEvents") or []:
+                    lines.append(
+                        f"  event: {e.get('kind')} "
+                        + " ".join(
+                            f"{k}={v}" for k, v in sorted(e.items())
+                            if k not in ("kind", "ts", "tick")
+                        )
                     )
     return "\n".join(lines)
 

@@ -1,0 +1,183 @@
+"""A dependency-free DecodeEngine stand-in for gateway sims.
+
+The gateway only needs the engine's *serving surface* — submit / tick /
+drain / snapshot and the queue-depth properties — not its jitted
+programs. :class:`ScriptedEngine` implements exactly that surface with
+deterministic, scriptable timing, so unit tests (tests/test_gateway.py),
+``tools/verify_metrics.py``'s two-replica sim, and chaos schedules can
+drive every REAL gateway code path (routing, shedding, scaling,
+drain/failover, the metrics and the ring) without importing jax or
+compiling anything.
+
+Timing model: a request "prefills" for ``ceil(len(prompt) /
+prefill_chunk)`` ticks after admission, then "decodes" one token per
+tick. ``batch_slots`` bounds concurrency; admission is FIFO like the
+real engine's. There is no KV pool — ``assert_no_leaks`` checks slot
+accounting only — because pool behavior is the real engine's job and is
+covered by the real-engine tests and the bench.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Optional
+
+class SimAdmissionClosedError(RuntimeError):
+    """Mirror of ``models.serving.AdmissionClosedError`` for the sim —
+    its own class so importing this module never drags jax in (the
+    gateway catches engine-submit failures generically, never by the
+    model layer's type)."""
+
+
+@dataclasses.dataclass
+class SimRequest:
+    """Mirror of models/serving.Request's handle surface."""
+
+    rid: int
+    prompt: list[int]
+    max_new_tokens: int
+    state: str = "waiting"
+    prefill_left: int = 0
+    generated: list = dataclasses.field(default_factory=list)
+
+    @property
+    def done(self) -> bool:
+        return self.state == "finished"
+
+    @property
+    def tokens(self) -> list[int]:
+        return list(self.prompt) + list(self.generated)
+
+
+class ScriptedEngine:
+    """See module docstring. ``decode_ticks_per_token`` slows a replica
+    down (a degraded chip); ``stall=True`` freezes it entirely (queue
+    depths grow — the p2c and autoscaler tests' knob)."""
+
+    def __init__(self, *, batch_slots: int = 4, prefill_chunk: int = 32,
+                 decode_ticks_per_token: int = 1, stall: bool = False):
+        self.batch_slots = batch_slots
+        self.prefill_chunk = prefill_chunk
+        self.decode_ticks_per_token = decode_ticks_per_token
+        self.stall = stall
+        self.waiting: deque = deque()
+        self.running: list[SimRequest] = []
+        self._admission_open = True
+        self._rid = 0
+        self._tick_no = 0
+        self.ticks = 0
+        self.completed = 0
+
+    # -- the DecodeEngine serving surface ---------------------------------
+
+    @property
+    def admission_open(self) -> bool:
+        return self._admission_open
+
+    @property
+    def num_active(self) -> int:
+        return len(self.running)
+
+    @property
+    def idle(self) -> bool:
+        return not self.waiting and not self.running
+
+    def submit(self, prompt, max_new_tokens: int) -> SimRequest:
+        if not self._admission_open:
+            raise SimAdmissionClosedError(
+                "sim engine admission is closed"
+            )
+        req = SimRequest(
+            rid=self._rid, prompt=[int(t) for t in prompt],
+            max_new_tokens=max_new_tokens,
+            prefill_left=-(-len(prompt) // self.prefill_chunk),
+        )
+        self._rid += 1
+        self.waiting.append(req)
+        return req
+
+    def stop_admission(self) -> None:
+        self._admission_open = False
+
+    def resume_admission(self) -> None:
+        self._admission_open = True
+
+    def tick(self) -> None:
+        self.ticks += 1
+        if self.stall:
+            return
+        self._tick_no += 1
+        while self.waiting and len(self.running) < self.batch_slots:
+            req = self.waiting.popleft()
+            req.state = "prefill"
+            self.running.append(req)
+        for req in list(self.running):
+            if req.prefill_left > 0:
+                req.prefill_left -= 1
+                continue
+            req.state = "running"
+            if self._tick_no % self.decode_ticks_per_token == 0:
+                req.generated.append(0)
+            if len(req.generated) >= req.max_new_tokens:
+                req.state = "finished"
+                self.running.remove(req)
+                self.completed += 1
+
+    def drain(self) -> list[SimRequest]:
+        self.stop_admission()
+        rerouted = list(self.waiting)
+        self.waiting.clear()
+        stalled = self.stall
+        self.stall = False  # a drain must still finish admitted work
+        for _ in range(100000):
+            if self.idle:
+                self.stall = stalled
+                return rerouted
+            self.tick()
+        raise RuntimeError("sim drain did not complete")
+
+    def assert_no_leaks(self) -> None:
+        if self.running or self.waiting:
+            raise AssertionError("sim engine not idle")
+
+    def snapshot(self) -> dict:
+        return {
+            "queueDepth": len(self.waiting),
+            "slotsBusy": len(self.running),
+            "batchSlots": self.batch_slots,
+            "admissionOpen": self._admission_open,
+            "completed": self.completed,
+            "ticks": self.ticks,
+            "ttftP99Ms": 0.0,
+        }
+
+
+def replica_engines(n: int, **kwargs) -> list[ScriptedEngine]:
+    """n identically configured scripted engines (sim fleets)."""
+    return [ScriptedEngine(**kwargs) for _ in range(n)]
+
+
+def shared_prefix_prompts(
+    n_requests: int, *, n_systems: int = 8, system_len: int = 64,
+    tail_len: int = 8, vocab: int = 1000, seed: int = 0,
+    block_size: Optional[int] = None,
+) -> list[list[int]]:
+    """The production traffic shape (system prompts x random tails)
+    without numpy: deterministic pseudo-random token lists whose leading
+    ``system_len`` tokens repeat across requests with the same system.
+    ``block_size`` only documents intent (affinity keys are block-
+    aligned); lengths should be multiples of it."""
+    del block_size
+    import random
+
+    rng = random.Random(seed)
+    systems = [
+        [rng.randrange(vocab) for _ in range(system_len)]
+        for _ in range(n_systems)
+    ]
+    return [
+        systems[i % n_systems]
+        + [rng.randrange(vocab) for _ in range(tail_len)]
+        for i in range(n_requests)
+    ]

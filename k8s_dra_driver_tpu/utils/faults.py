@@ -26,9 +26,11 @@ real binary (``TPU_DRA_FAULTS="checkpoint.write@2=oserror,kube.get=api503"``)
 Site naming convention: ``<component>.<operation>``. The canonical
 registry of instrumented sites is :data:`ALL_SITES` (grouped by family:
 ``kube.*``, ``chiplib.*``, ``checkpoint.*``, ``cdi.*``, ``sharing.*``
-and ``rebalance.*`` for the dynamic-sharing state/resize path, and the
+and ``rebalance.*`` for the dynamic-sharing state/resize path, the
 model-side ``train.*`` family — ``train.step`` fires at the top of every
-elastic train step, ``train.reshard`` at the top of every gang resize).
+elastic train step, ``train.reshard`` at the top of every gang resize —
+and ``gateway.*`` for the fleet serving gateway's route/drain/scale
+transitions).
 Seeded schedules should draw their site lists from it via
 :func:`sites_in` so new families are automatically soak-covered.
 """
@@ -80,6 +82,12 @@ ALL_SITES = (
     # crash mid-reshard.
     "train.step",
     "train.reshard",
+    # Fleet serving gateway (serving_gateway/gateway.py): the three
+    # state transitions of the cluster-level request path — dispatch
+    # routing, replica drain, and autoscaler apply.
+    "gateway.route",
+    "gateway.drain",
+    "gateway.scale",
 )
 
 

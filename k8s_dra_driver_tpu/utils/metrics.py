@@ -341,6 +341,9 @@ class MetricsServer:
     (404 otherwise). ``/debug/rebalance`` serves the dynamic-sharing
     rebalancer's decision ring + per-claim share view when a provider
     was registered with ``set_rebalance_provider`` (404 otherwise).
+    ``/debug/gateway`` serves the fleet serving gateway's snapshot
+    (replicas, queues, event ring) when a provider was registered with
+    ``set_gateway_provider`` (404 otherwise).
     All routes are GET-only; other methods get ``405``
     with an ``Allow: GET`` header — the scrape surface mutates nothing.
     """
@@ -353,6 +356,7 @@ class MetricsServer:
         self.allocations_provider: Optional[Callable] = None
         self.defrag_provider: Optional[Callable] = None
         self.rebalance_provider: Optional[Callable] = None
+        self.gateway_provider: Optional[Callable] = None
         # The JSON debug surfaces share one handler block: path ->
         # (provider attribute, not-enabled message). /debug/allocations
         # stays separate (the provider returns pre-rendered JSONL).
@@ -364,6 +368,8 @@ class MetricsServer:
             "/debug/rebalance": (
                 "rebalance_provider",
                 "dynamic-sharing rebalancer not enabled"),
+            "/debug/gateway": (
+                "gateway_provider", "serving gateway not enabled"),
         }
         registry_ref = registry
         health = self._health = {"ok": True}
@@ -540,6 +546,12 @@ class MetricsServer:
         ``Rebalancer.snapshot``) at ``/debug/rebalance``. Safe to call
         after ``start()``."""
         self.rebalance_provider = provider
+
+    def set_gateway_provider(self, provider: Callable) -> None:
+        """Serve ``provider()`` (a JSON-serializable dict, e.g.
+        ``ServingGateway.snapshot``) at ``/debug/gateway``. Safe to
+        call after ``start()``."""
+        self.gateway_provider = provider
 
     def add_readiness_check(self, name: str, check: Callable,
                             critical: bool = True) -> None:

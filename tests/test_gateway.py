@@ -1,0 +1,963 @@
+"""Fleet serving gateway tests (serving_gateway/).
+
+The routing invariants (ISSUE 14): prefix affinity beats round-robin on
+shared-prefix traffic, power-of-two-choices bounds queue skew, SLO
+classes dispatch in strict priority under overload, a drain loses zero
+admitted requests (token-exact on real engines), and the gateway.*
+chaos sites recover under seeded schedules. Plus the autoscaler's
+hysteresis/cooldown discipline and the end-to-end acceptance scenario:
+unhealthy replica -> drain -> real allocator solve replaces it -> the
+auditor reports zero drift across the transition.
+
+Scripted engines (serving_gateway/sim.py) drive the scheduling-policy
+tests — deterministic and jax-free; real DecodeEngine replicas back
+the token-fidelity and e2e tests.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_dra_driver_tpu.models.decode import generate
+from k8s_dra_driver_tpu.models.llama import PRESETS, init_params
+from k8s_dra_driver_tpu.models.serving import DecodeEngine
+from k8s_dra_driver_tpu.serving_gateway import (
+    AdmissionPolicy,
+    Autoscaler,
+    AutoscalerPolicy,
+    NoReplicaAvailableError,
+    OverloadedError,
+    Replica,
+    ReplicaLostError,
+    Router,
+    ScaleError,
+    ServingGateway,
+    prefix_affinity_key,
+)
+from k8s_dra_driver_tpu.serving_gateway.sim import (
+    ScriptedEngine,
+    shared_prefix_prompts,
+)
+from k8s_dra_driver_tpu.utils import faults
+from k8s_dra_driver_tpu.utils.metrics import Registry
+
+CHAOS_SEED = int(os.environ.get("TPU_DRA_CHAOS_SEED", "1234"))
+
+TINY = PRESETS["tiny"]
+N_NEW = 6
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(TINY, jax.random.PRNGKey(0))
+
+
+def _prompts(seed, lens):
+    rng = np.random.RandomState(seed)
+    return [list(rng.randint(0, TINY.vocab_size, size=n)) for n in lens]
+
+
+def _reference(params, prompt, n=N_NEW):
+    return np.asarray(
+        generate(params, jnp.asarray([prompt], jnp.int32), TINY, n)
+    )[0].tolist()
+
+
+def _gateway(n_replicas=3, *, policy="affinity", block_size=16,
+             affinity_blocks=2, saturation_depth=None, admission=None,
+             autoscaler=None, clock=None, seed=0, engine_kwargs=None):
+    kwargs = {}
+    if clock is not None:
+        kwargs["clock"] = clock
+    gw = ServingGateway(
+        Registry(),
+        router=Router(policy=policy, block_size=block_size,
+                      affinity_blocks=affinity_blocks,
+                      saturation_depth=saturation_depth, seed=seed),
+        admission_policy=admission,
+        autoscaler=autoscaler,
+        node_name="test",
+        **kwargs,
+    )
+    engines = [
+        ScriptedEngine(**(engine_kwargs or {})) for _ in range(n_replicas)
+    ]
+    for i, e in enumerate(engines):
+        gw.add_replica(e, f"r{i}")
+    return gw, engines
+
+
+class TestAffinityKey:
+    def test_block_granularity(self):
+        assert prefix_affinity_key([1] * 15, 16, 4) is None
+        k1 = prefix_affinity_key([1] * 16, 16, 4)
+        assert k1 is not None
+        # Same leading block, different tail -> same key.
+        assert prefix_affinity_key([1] * 16 + [9, 9], 16, 4) == k1
+        # A different leading block -> different key.
+        assert prefix_affinity_key([2] * 16, 16, 4) != k1
+
+    def test_max_blocks_caps_the_span(self):
+        base = list(range(64))
+        assert prefix_affinity_key(base + [1], 16, 2) == \
+            prefix_affinity_key(base + [2], 16, 2)
+
+
+class TestRoutingInvariants:
+    def test_affinity_pins_each_system_to_one_replica(self):
+        gw, _ = _gateway(4, saturation_depth=10 ** 6)
+        prompts = shared_prefix_prompts(
+            64, n_systems=8, system_len=32, tail_len=4, seed=2
+        )
+        reqs = [gw.submit(p, 2, latency_class="interactive")
+                for p in prompts]
+        gw.tick()  # dispatch everything (capacity unbounded)
+        by_system = {}
+        for p, r in zip(prompts, reqs):
+            key = tuple(p[:32])
+            by_system.setdefault(key, set()).add(r.replica_id)
+        assert all(len(v) == 1 for v in by_system.values()), by_system
+        gw.run()
+        # Gateway-level affinity hit rate: everything after the first
+        # request per system is a hit.
+        assert gw.counters["affinity_lookups"] == 64
+        assert gw.counters["affinity_hits"] == 64 - 8
+        assert gw.affinity_hit_rate() == pytest.approx(56 / 64)
+
+    def test_round_robin_spreads_systems_across_replicas(self):
+        gw, _ = _gateway(4, policy="round-robin")
+        prompts = shared_prefix_prompts(
+            64, n_systems=8, system_len=32, tail_len=4, seed=2
+        )
+        reqs = [gw.submit(p, 2, latency_class="interactive")
+                for p in prompts]
+        gw.tick()
+        by_system = {}
+        for p, r in zip(prompts, reqs):
+            by_system.setdefault(tuple(p[:32]), set()).add(r.replica_id)
+        # Round-robin smears every system over many replicas — the cold
+        # prefill duplication the affinity policy exists to avoid.
+        assert all(len(v) > 1 for v in by_system.values())
+        assert gw.counters["affinity_lookups"] == 0
+        gw.run()
+
+    def test_p2c_bounds_queue_depth_skew(self):
+        # Stalled replicas so depth only grows; prompts shorter than a
+        # block so no affinity key exists and every route is p2c.
+        gw, engines = _gateway(
+            4, saturation_depth=10 ** 6,
+            engine_kwargs=dict(stall=True),
+        )
+        for _ in range(200):
+            gw.submit([1, 2, 3], 1, latency_class="interactive")
+        gw.tick()
+        depths = [len(e.waiting) for e in engines]
+        assert sum(depths) == 200
+        # Power-of-two-choices keeps max/min skew tight (a uniform
+        # random assignment would routinely exceed this).
+        assert max(depths) - min(depths) <= 10, depths
+
+    def test_affinity_spills_to_p2c_when_target_saturated(self):
+        gw, engines = _gateway(2, saturation_depth=3,
+                               engine_kwargs=dict(stall=True))
+        prompts = shared_prefix_prompts(
+            12, n_systems=1, system_len=32, tail_len=4, seed=4
+        )
+        for p in prompts:
+            gw.submit(p, 1, latency_class="interactive")
+        for _ in range(8):
+            gw.tick()
+        # One system hashes to one replica; once that replica holds 3
+        # requests the rest must spill to the other instead of queueing
+        # unboundedly behind cache warmth.
+        depths = sorted(len(e.waiting) + e.num_active for e in engines)
+        assert depths[0] > 0, depths
+
+    def test_no_replicas_is_typed_and_request_stays_queued(self):
+        gw = ServingGateway(Registry(), router=Router())
+        with pytest.raises(NoReplicaAvailableError):
+            gw.router.route([1] * 16)
+        req = gw.submit([1] * 16, 2, latency_class="interactive")
+        gw.tick()
+        assert req.state == "queued"
+        assert gw.admission.depth() == 1
+
+
+class TestAdmission:
+    def test_batch_shed_first_at_watermark(self):
+        gw, _ = _gateway(
+            1, admission=AdmissionPolicy(shed_watermark=4,
+                                         hard_watermark=10,
+                                         retry_after_s=2.5),
+            engine_kwargs=dict(stall=True),
+        )
+        for _ in range(4):
+            gw.submit([1, 2], 1, latency_class="interactive")
+        with pytest.raises(OverloadedError) as ei:
+            gw.submit([1, 2], 1, latency_class="batch")
+        assert ei.value.reason == "watermark"
+        assert ei.value.retry_after_s == 2.5
+        assert ei.value.retryable
+        # Interactive and realtime still admit below the hard mark.
+        gw.submit([1, 2], 1, latency_class="interactive")
+        gw.submit([1, 2], 1, latency_class="realtime")
+        assert gw.counters["shed"] == 1
+
+    def test_hard_watermark_sheds_everything(self):
+        gw, _ = _gateway(
+            1, admission=AdmissionPolicy(shed_watermark=2,
+                                         hard_watermark=4),
+            engine_kwargs=dict(stall=True),
+        )
+        for _ in range(4):
+            gw.submit([1, 2], 1, latency_class="realtime")
+        for lc in ("realtime", "interactive", "batch"):
+            with pytest.raises(OverloadedError):
+                gw.submit([1, 2], 1, latency_class=lc)
+
+    def test_priority_ordering_under_overload(self):
+        # One single-slot replica, gateway holds the queue: dispatch
+        # order must be realtime > interactive > batch regardless of
+        # arrival order.
+        gw, engines = _gateway(
+            1, saturation_depth=1,
+            engine_kwargs=dict(batch_slots=1, prefill_chunk=16),
+        )
+        b = gw.submit([1] * 16, 1, latency_class="batch")
+        i = gw.submit([2] * 16, 1, latency_class="interactive")
+        r = gw.submit([3] * 16, 1, latency_class="realtime")
+        gw.run()
+        assert r.engine_req.rid < i.engine_req.rid < b.engine_req.rid
+
+    def test_deadline_expiry_is_typed_not_silent(self):
+        t = [0.0]
+        gw, engines = _gateway(
+            1, clock=lambda: t[0],
+            admission=AdmissionPolicy(
+                max_queue_delay_s={"batch": 10.0}),
+            engine_kwargs=dict(stall=True),
+        )
+        # Saturate the only replica so the request stays gateway-queued.
+        gw.router.saturation_depth = 0
+        req = gw.submit([1, 2], 1, latency_class="batch")
+        t[0] = 11.0
+        gw.tick()
+        assert req.state == "failed"
+        assert isinstance(req.error, OverloadedError)
+        assert req.error.reason == "deadline"
+        assert gw.counters["shed"] == 1
+
+
+class TestDrainFailover:
+    def test_drain_reroutes_queued_zero_loss(self):
+        gw, engines = _gateway(3, saturation_depth=10 ** 6)
+        prompts = shared_prefix_prompts(
+            30, n_systems=6, system_len=32, tail_len=4, seed=5
+        )
+        reqs = [gw.submit(p, 3, latency_class="interactive")
+                for p in prompts]
+        for _ in range(2):
+            gw.tick()
+        rerouted = gw.drain_replica("r1", remove=True, reason="test")
+        assert "r1" not in [r.replica_id for r in gw.replicas()]
+        gw.run()
+        assert all(r.state == "finished" for r in reqs)
+        assert gw.counters["failed"] == 0
+        assert rerouted >= 0
+        for e in engines:
+            e.assert_no_leaks()
+        # The drain is in the ring and the snapshot replica view.
+        kinds = [e["kind"] for e in gw.snapshot()["events"]]
+        assert "drain" in kinds
+
+    def test_fail_replica_surfaces_typed_retryable_errors(self):
+        gw, engines = _gateway(2, saturation_depth=10 ** 6,
+                               engine_kwargs=dict(batch_slots=2))
+        reqs = [gw.submit([i] * 16, 4, latency_class="interactive")
+                for i in range(8)]
+        gw.tick()  # dispatch; some prefill on each replica
+        lost = gw.fail_replica("r0", reason="chip unplugged")
+        assert lost > 0
+        failed = [r for r in reqs if r.state == "failed"]
+        assert len(failed) == lost
+        for r in failed:
+            assert isinstance(r.error, ReplicaLostError)
+            assert r.error.retryable
+        # The retry contract: resubmit completes on the survivor.
+        retries = [gw.resubmit(r) for r in failed]
+        gw.run()
+        assert all(r.state == "finished" for r in retries)
+        live = [r for r in reqs if r.state == "finished"]
+        assert len(live) + len(failed) == len(reqs)
+
+    def test_drain_is_faultable(self):
+        gw, _ = _gateway(2)
+        plan = faults.FaultPlan()
+        plan.fail("gateway.drain", faults.FaultError("chaos"), times=1)
+        with faults.armed(plan):
+            with pytest.raises(faults.FaultError):
+                gw.drain_replica("r0")
+
+
+class TestChaos:
+    def test_route_fault_retries_next_tick(self):
+        gw, _ = _gateway(2)
+        req = gw.submit([1] * 16, 2, latency_class="interactive")
+        plan = faults.FaultPlan()
+        plan.fail("gateway.route", faults.FaultError("chaos@route"),
+                  times=1)
+        with faults.armed(plan):
+            gw.tick()
+            assert req.state == "queued"  # stayed queued, not lost
+            gw.run()
+        assert req.state == "finished"
+        assert any(e["kind"] == "route-failed"
+                   for e in gw.snapshot()["events"])
+
+    def test_crash_at_route_leaves_request_queued_for_restart(self):
+        gw, engines = _gateway(2)
+        req = gw.submit([1] * 16, 2, latency_class="interactive")
+        plan = faults.FaultPlan()
+        plan.crash("gateway.route", on_call=1)
+        with faults.armed(plan):
+            with pytest.raises(faults.CrashPoint):
+                gw.tick()
+        # "Restart": a fresh gateway over the surviving engines; the
+        # request was never half-dispatched, so a resubmit of its
+        # prompt is exactly-once from the fleet's point of view.
+        assert req.state == "queued"
+        gw2 = ServingGateway(Registry(), router=Router(
+            policy="affinity", block_size=16, affinity_blocks=2))
+        for i, e in enumerate(engines):
+            gw2.add_replica(e, f"r{i}")
+        retry = gw2.submit(req.prompt, req.max_new_tokens,
+                           latency_class=req.latency_class)
+        gw2.run()
+        assert retry.state == "finished"
+        for e in engines:
+            e.assert_no_leaks()
+
+    def test_seeded_schedule_over_gateway_sites_with_recovery(self):
+        """The acceptance-style soak: a seeded schedule over the
+        gateway.* family while traffic, a drain, and a scale-down all
+        happen; after recovery (restart on crash, resubmit on typed
+        failure) every request completes and the engines are clean."""
+        sites = faults.sites_in("gateway.")
+        assert sites == ["gateway.route", "gateway.drain",
+                         "gateway.scale"]
+        plan = faults.FaultPlan.seeded(CHAOS_SEED, sites, rounds=6,
+                                       fail_rate=0.5, max_call=4)
+
+        class Prov:
+            def scale_down(self, replica):
+                pass
+
+            def scale_up(self):
+                raise ScaleError("no capacity in the chaos fleet")
+
+        engines = [ScriptedEngine(batch_slots=2) for _ in range(3)]
+        prompts = shared_prefix_prompts(
+            24, n_systems=4, system_len=32, tail_len=4,
+            seed=CHAOS_SEED,
+        )
+
+        def build():
+            gw = ServingGateway(
+                Registry(),
+                router=Router(policy="affinity", block_size=16,
+                              affinity_blocks=2,
+                              saturation_depth=10 ** 6),
+                autoscaler=Autoscaler(
+                    AutoscalerPolicy(min_replicas=1, max_replicas=3,
+                                     queue_low_water=0.1,
+                                     dwell_ticks=1,
+                                     cooldown_seconds=0.0),
+                    Prov(),
+                ),
+                node_name="chaos",
+            )
+            for i, e in enumerate(engines):
+                e.resume_admission()
+                gw.add_replica(e, f"r{i}")
+            return gw
+
+        gw = build()
+        pending = [
+            gw.submit(p, 2, latency_class="interactive")
+            for p in prompts
+        ]
+        outstanding = {id(r): r for r in pending}
+        with faults.armed(plan):
+            for _ in range(200):
+                if not outstanding:
+                    break
+                try:
+                    gw.tick()
+                    if gw.ticks == 3 and len(gw.replicas()) > 1:
+                        gw.drain_replica(
+                            gw.replicas()[-1].replica_id, remove=True,
+                            reason="chaos drain",
+                        )
+                except faults.CrashPoint:
+                    gw = build()
+                    for r in list(outstanding.values()):
+                        if r.state in ("queued", "dispatched"):
+                            outstanding.pop(id(r))
+                            retry = gw.submit(r.prompt,
+                                              r.max_new_tokens,
+                                              latency_class="interactive")
+                            outstanding[id(retry)] = retry
+                except faults.FaultError:
+                    pass  # typed injected failure: next loop retries
+                for k, r in list(outstanding.items()):
+                    if r.state == "finished":
+                        outstanding.pop(k)
+                    elif r.state == "failed":
+                        outstanding.pop(k)
+                        retry = gw.resubmit(r)
+                        outstanding[id(retry)] = retry
+        assert not outstanding, f"{len(outstanding)} requests stranded"
+        for e in engines:
+            if not e.idle:
+                e.drain()
+            e.assert_no_leaks()
+
+
+class TestAutoscaler:
+    class Prov:
+        def __init__(self):
+            self.ups = 0
+            self.downs = []
+
+        def scale_up(self):
+            self.ups += 1
+            return Replica(f"scaled-{self.ups}", ScriptedEngine())
+
+        def scale_down(self, replica):
+            self.downs.append(replica.replica_id)
+
+    def _gw(self, policy, prov, clock):
+        gw, engines = _gateway(
+            1, clock=clock,
+            autoscaler=Autoscaler(policy, prov),
+            saturation_depth=10 ** 6,
+            engine_kwargs=dict(stall=True),
+        )
+        return gw, engines
+
+    def test_scale_up_waits_for_dwell_then_applies(self):
+        t = [0.0]
+        prov = self.Prov()
+        gw, _ = self._gw(
+            AutoscalerPolicy(min_replicas=1, max_replicas=4,
+                             queue_high_water=2.0, dwell_ticks=3,
+                             cooldown_seconds=0.0),
+            prov, lambda: t[0],
+        )
+        for _ in range(8):
+            gw.submit([1, 2], 1, latency_class="interactive")
+        for i in range(2):
+            gw.tick()
+            t[0] += 1
+        assert prov.ups == 0  # dwell not yet satisfied
+        gw.tick()
+        assert prov.ups == 1
+        assert len(gw.replicas()) == 2
+        outcomes = [e.get("outcome") for e in gw.snapshot()["events"]
+                    if e["kind"] == "scale"]
+        assert outcomes == ["dwell", "dwell", "applied"]
+
+    def test_cooldown_blocks_immediate_rescale(self):
+        t = [0.0]
+        prov = self.Prov()
+        gw, _ = self._gw(
+            AutoscalerPolicy(min_replicas=1, max_replicas=4,
+                             queue_high_water=2.0, dwell_ticks=1,
+                             cooldown_seconds=60.0),
+            prov, lambda: t[0],
+        )
+        for _ in range(30):
+            gw.submit([1, 2], 1, latency_class="interactive")
+        gw.tick()
+        assert prov.ups == 1
+        for _ in range(3):
+            t[0] += 1
+            gw.tick()
+        assert prov.ups == 1  # inside the cooldown
+        t[0] += 120
+        gw.tick()
+        gw.tick()
+        assert prov.ups == 2
+
+    def test_scale_down_drains_before_release(self):
+        t = [0.0]
+        prov = self.Prov()
+        gw, engines = _gateway(
+            3, clock=lambda: t[0],
+            autoscaler=Autoscaler(
+                AutoscalerPolicy(min_replicas=1, max_replicas=4,
+                                 queue_low_water=0.5, dwell_ticks=1,
+                                 cooldown_seconds=0.0),
+                prov,
+            ),
+        )
+        gw.tick()
+        assert prov.downs, "idle fleet did not scale down"
+        assert len(gw.replicas()) == 2
+        drained = gw.snapshot()["events"]
+        assert [e["kind"] for e in drained].count("drain") == 1
+
+    def test_scale_up_failure_is_typed_outcome_not_crash(self):
+        t = [0.0]
+
+        class FailingProv:
+            def scale_up(self):
+                raise ScaleError("allocator unsat: no chips")
+
+            def scale_down(self, replica):
+                pass
+
+        gw, _ = self._gw(
+            AutoscalerPolicy(min_replicas=1, max_replicas=4,
+                             queue_high_water=1.0, dwell_ticks=1,
+                             cooldown_seconds=30.0),
+            FailingProv(), lambda: t[0],
+        )
+        for _ in range(10):
+            gw.submit([1, 2], 1, latency_class="interactive")
+        gw.tick()
+        scales = [e for e in gw.snapshot()["events"]
+                  if e["kind"] == "scale"]
+        assert scales[-1]["outcome"] == "failed"
+        assert "allocator unsat" in scales[-1]["detail"]
+        # The failure cools down too: no per-tick scale storm.
+        t[0] += 1
+        gw.tick()
+        scales2 = [e for e in gw.snapshot()["events"]
+                   if e["kind"] == "scale"]
+        assert scales2[-1]["outcome"] in ("cooldown", "failed")
+        assert len([s for s in scales2 if s["outcome"] == "failed"]) == 1
+
+    def test_clamped_at_max_replicas(self):
+        t = [0.0]
+        prov = self.Prov()
+        gw, _ = self._gw(
+            AutoscalerPolicy(min_replicas=1, max_replicas=1,
+                             queue_high_water=1.0, dwell_ticks=1,
+                             cooldown_seconds=0.0),
+            prov, lambda: t[0],
+        )
+        for _ in range(10):
+            gw.submit([1, 2], 1, latency_class="interactive")
+        gw.tick()
+        assert prov.ups == 0
+        scales = [e for e in gw.snapshot()["events"]
+                  if e["kind"] == "scale"]
+        assert scales and scales[-1]["outcome"] == "clamped"
+
+
+class TestObservability:
+    def test_snapshot_document_shape(self):
+        gw, _ = _gateway(2)
+        gw.submit([1] * 16, 2, latency_class="realtime")
+        gw.run()
+        doc = gw.snapshot()
+        for key in ("node", "generatedAt", "ticks", "policy",
+                    "replicas", "queues", "fleetQueueDepth",
+                    "overloaded", "counters", "events"):
+            assert key in doc, key
+        assert set(doc["queues"]) == {"realtime", "interactive",
+                                      "batch"}
+        import json
+
+        json.dumps(doc)  # must be JSON-serializable as served
+
+    def test_debug_gateway_endpoint_and_405(self):
+        import urllib.error
+        import urllib.request
+
+        from k8s_dra_driver_tpu.utils.metrics import MetricsServer
+
+        reg = Registry()
+        gw = ServingGateway(reg, router=Router(), node_name="obs")
+        gw.add_replica(ScriptedEngine(), "r0")
+        srv = MetricsServer(reg, host="127.0.0.1", port=0)
+        srv.set_gateway_provider(gw.snapshot)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            import json
+
+            doc = json.loads(urllib.request.urlopen(
+                f"{base}/debug/gateway").read().decode())
+            assert doc["node"] == "obs" and "r0" in doc["replicas"]
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{base}/debug/gateway",
+                                       data=b"x")
+            assert ei.value.code == 405
+            assert "GET" in ei.value.headers.get("Allow", "")
+        finally:
+            srv.stop()
+
+    def test_metrics_families_render(self):
+        reg = Registry()
+        gw = ServingGateway(reg, router=Router(block_size=16,
+                                               affinity_blocks=2))
+        gw.add_replica(ScriptedEngine(), "r0")
+        gw.submit([1] * 16, 1, latency_class="interactive")
+        gw.run()
+        body = reg.render()
+        for family in ("tpu_dra_gw_routed_total",
+                       "tpu_dra_gw_affinity_lookups_total",
+                       "tpu_dra_gw_affinity_hits_total",
+                       "tpu_dra_gw_queue_depth",
+                       "tpu_dra_gw_shed_total",
+                       "tpu_dra_gw_replicas",
+                       "tpu_dra_gw_scale_decisions_total",
+                       "tpu_dra_gw_requests_total"):
+            assert family in body, family
+        assert gw._m_routed.value(policy="affinity") == 1
+        assert gw._m_requests.value(outcome="completed") == 1
+
+    def test_doctor_findings_from_gateway_doc(self):
+        from k8s_dra_driver_tpu.doctor import NodeScrape, fleet_findings
+
+        node = NodeScrape(name="n1", url="http://x")
+        node.gateway = {
+            "overloaded": True,
+            "fleetQueueDepth": 999,
+            "events": [
+                {"kind": "scale", "direction": "up",
+                 "outcome": "failed", "reason": "queue high",
+                 "detail": "ScaleError: allocator unsat"},
+            ],
+        }
+        findings = fleet_findings([node], None, "tpu.google.com")
+        gw_findings = [f for f in findings if f.check == "gateway"]
+        assert len(gw_findings) == 2
+        severities = {f.severity for f in gw_findings}
+        assert severities == {"drift", "info"}
+        assert any("allocator unsat" in f.detail for f in gw_findings)
+
+
+class TestEndToEndFailover:
+    """The ISSUE 14 acceptance scenario: a replica marked unhealthy
+    mid-traffic drains with ZERO admitted-request loss, the autoscaler
+    replaces it through a REAL allocator solve in the cluster sim
+    (claim allocated + prepared on DeviceState), and the state auditor
+    reports zero drift across the whole transition. Token streams stay
+    exact against solo generate() for every request, drained or not."""
+
+    @pytest.fixture()
+    def cluster(self, tmp_path):
+        from k8s_dra_driver_tpu.cdi import CDIHandler
+        from k8s_dra_driver_tpu.kube import NODES, FakeKubeClient
+        from k8s_dra_driver_tpu.kube.allocator import ReferenceAllocator
+        from k8s_dra_driver_tpu.kube.resourceslice import (
+            DriverResources,
+            Pool,
+            ResourceSliceController,
+        )
+        from k8s_dra_driver_tpu.plugin.audit import StateAuditor
+        from k8s_dra_driver_tpu.plugin.checkpoint import (
+            CheckpointManager,
+        )
+        from k8s_dra_driver_tpu.plugin.device_state import DeviceState
+        from k8s_dra_driver_tpu.tpulib import FakeChipLib
+        from k8s_dra_driver_tpu.tpulib.deviceinfo import counter_sets
+
+        client = FakeKubeClient()
+        client.create(NODES, {"metadata": {"name": "gw-node",
+                                           "uid": "u-gw"}})
+        lib = FakeChipLib(generation="v5e", topology="4x1x1")
+        devs = lib.enumerate_all_possible_devices({"chip"})
+        ctrl = ResourceSliceController(
+            client, "tpu.google.com", scope="gw-node",
+            owner={"kind": "Node", "name": "gw-node", "uid": "u-gw"},
+        )
+        ctrl.update(DriverResources(pools={"gw-node": Pool(
+            devices=[d.get_device() for _, d in sorted(devs.items())],
+            shared_counters=counter_sets(devs),
+            node_name="gw-node",
+        )}))
+        ctrl.sync_once()
+        state = DeviceState(
+            chiplib=lib,
+            cdi=CDIHandler(f"{tmp_path}/cdi"),
+            checkpoint=CheckpointManager(f"{tmp_path}/checkpoint.json"),
+            driver_name="tpu.google.com",
+            pool_name="gw-node",
+            state_dir=f"{tmp_path}/state",
+        )
+        allocator = ReferenceAllocator(client)
+        auditor = StateAuditor(state=state, registry=Registry())
+        return client, allocator, state, auditor
+
+    def test_unhealthy_drain_allocator_replace_zero_drift(
+        self, cluster, params
+    ):
+        client, allocator, state, auditor = cluster
+
+        class ClaimProvisioner:
+            """Scale-up = real allocator solve + DeviceState.prepare +
+            a real DecodeEngine on the claimed chip; scale-down =
+            unprepare + deallocate. The PR-8/PR-3 layers are the real
+            thing — only the chip itself is fake."""
+
+            def __init__(self):
+                self.n = 0
+
+            def _claim(self):
+                self.n += 1
+                return {
+                    "metadata": {"name": f"gw-replica-{self.n}",
+                                 "namespace": "gw",
+                                 "uid": f"uid-gw-{self.n}"},
+                    "spec": {"devices": {"requests": [{
+                        "name": "chip",
+                        "deviceClassName": "tpu.google.com",
+                    }]}},
+                }
+
+            def scale_up(self):
+                claim = self._claim()
+                allocator.allocate(claim)  # raises AllocationError=unsat
+                state.prepare(claim)
+                engine = DecodeEngine(
+                    params, TINY, batch_slots=2, num_blocks=24,
+                    block_size=8, max_seq_len=40, prefill_chunk=8,
+                )
+                return Replica(
+                    f"replica-{claim['metadata']['uid']}", engine,
+                    claim_uid=claim["metadata"]["uid"],
+                )
+
+            def scale_down(self, replica):
+                state.unprepare(replica.claim_uid)
+                allocator.deallocate(replica.claim_uid)
+
+        prov = ClaimProvisioner()
+        gw = ServingGateway(
+            Registry(),
+            router=Router(policy="affinity", block_size=8,
+                          affinity_blocks=2,
+                          saturation_depth=10 ** 6),
+            autoscaler=Autoscaler(
+                AutoscalerPolicy(min_replicas=2, max_replicas=3,
+                                 queue_high_water=2.0, dwell_ticks=1,
+                                 cooldown_seconds=0.0),
+                prov,
+            ),
+            node_name="gw-node",
+        )
+        first = [gw.add_replica(r.engine, r.replica_id, r.claim_uid)
+                 for r in (prov.scale_up(), prov.scale_up())]
+        assert auditor.run_once() == []  # clean before traffic
+
+        prompts = _prompts(90, (9, 13, 7, 11, 9, 13, 7, 11))
+        reqs = [gw.submit(p, N_NEW, latency_class="interactive")
+                for p in prompts]
+        for _ in range(3):
+            gw.tick()
+        # Mid-traffic: replica 0's chip is reported unhealthy. The
+        # operator path drains it (zero admitted loss), releases its
+        # claim, and the autoscaler's next look at the backlog replaces
+        # it via a fresh allocator solve.
+        sick = first[0]
+        rerouted = gw.drain_replica(sick.replica_id, remove=True,
+                                    reason="chip unhealthy")
+        prov.scale_down(sick)
+        assert auditor.run_once() == []  # release left no drift
+        gw.run()
+        assert prov.n >= 3, "autoscaler never replaced the replica"
+        assert gw.counters["failed"] == 0
+        assert all(r.state == "finished" for r in reqs)
+        # Token-exact for every request, including the re-routed ones.
+        for r, p in zip(reqs, prompts):
+            assert r.tokens == _reference(params, p), r.gid
+        for rep in gw.replicas():
+            rep.engine.assert_no_leaks()
+        # Zero drift across the whole transition, and the claim set the
+        # node holds is EXACTLY the live replicas' (the sick one's is
+        # gone, each replacement's is real — solve, prepare, and
+        # release all happened through the production layers).
+        assert auditor.run_once() == []
+        held = set(state.checkpoint.read())
+        assert held == {r.claim_uid for r in gw.replicas()}
+        assert sick.claim_uid not in held
+        assert rerouted >= 0
+        del client
+
+
+class TestInspectIntegration:
+    def test_collect_and_render_gateway_section(self):
+        from k8s_dra_driver_tpu.plugin.inspect import _collect_gateway
+        from k8s_dra_driver_tpu.utils.metrics import MetricsServer
+
+        reg = Registry()
+        gw = ServingGateway(reg, router=Router(block_size=16,
+                                               affinity_blocks=2),
+                            node_name="insp")
+        gw.add_replica(ScriptedEngine(), "r0")
+        gw.submit([1] * 16, 1, latency_class="interactive")
+        gw.run()
+        gw.drain_replica("r0", reason="inspect test")
+        srv = MetricsServer(reg, host="127.0.0.1", port=0)
+        srv.set_gateway_provider(gw.snapshot)
+        srv.start()
+        try:
+            url = f"http://127.0.0.1:{srv.port}"
+            out = _collect_gateway(url, 3.0)
+            assert out["gatewayReplicas"]["r0"]["state"] == "draining"
+            assert out["gatewayCounters"]["completed"] == 1
+            assert any(e["kind"] == "drain"
+                       for e in out["gatewayEvents"])
+            # A failed scrape is loud, not known-healthy.
+            srv.set_gateway_provider(None)
+            srv.gateway_provider = None
+            miss = _collect_gateway(url, 3.0)
+            assert miss == {}  # 404 = benign absence
+        finally:
+            srv.stop()
+
+    def test_render_includes_gateway_lines(self):
+        from k8s_dra_driver_tpu.plugin.inspect import render
+
+        state = {
+            "stateRoot": "/x", "cdiRoot": "/y", "preparedClaims": [],
+            "sharingState": [], "cdi": {"baseSpec": False,
+                                        "claimSpecs": [],
+                                        "orphanedClaimSpecs": []},
+            "live": {
+                "url": "http://x", "mode": "ready", "degraded": False,
+                "checks": [],
+                "gatewayReplicas": {"r0": {"state": "healthy",
+                                           "queueDepth": 3,
+                                           "claimUid": "uid-1"}},
+                "gatewayQueues": {"realtime": 0, "interactive": 1,
+                                  "batch": 2},
+                "gatewayOverloaded": True,
+                "gatewayCounters": {"routed": 5, "shed": 1,
+                                    "affinityHitRate": 0.5},
+                "gatewayEvents": [{"kind": "scale", "direction": "up",
+                                   "outcome": "failed"}],
+            },
+        }
+        text = render(state)
+        assert "serving gateway: 1 replica(s)" in text
+        assert "OVERLOADED" in text
+        assert "r0: healthy, queue depth 3 (claim uid-1)" in text
+        assert "event: scale" in text
+
+
+class TestReviewRegressions:
+    """Pins for review-found bugs: requeue order, the scale-down
+    clamp/victim population mismatch, and the doctor's stale-failure
+    verdict."""
+
+    def test_drain_requeue_preserves_arrival_order(self):
+        # Two same-class, same-system requests queue behind a busy
+        # single-slot replica; after the drain the OLDER one must
+        # dispatch first (requeue_front pushes in reverse).
+        gw, engines = _gateway(
+            2, saturation_depth=10 ** 6,
+            engine_kwargs=dict(batch_slots=1, prefill_chunk=16,
+                               stall=True),
+        )
+        prompts = shared_prefix_prompts(
+            3, n_systems=1, system_len=32, tail_len=4, seed=9
+        )
+        reqs = [gw.submit(p, 1, latency_class="interactive")
+                for p in prompts]
+        gw.tick()  # all three land on the affinity replica's queue
+        target = reqs[0].replica_id
+        assert all(r.replica_id == target for r in reqs)
+        gw.drain_replica(target, remove=True)
+        requeued = [r for r in reqs if r.state == "queued"]
+        assert len(requeued) >= 2
+        popped = [gw.admission.pop() for _ in requeued]
+        assert [r.gid for r in popped] == sorted(r.gid for r in requeued)
+
+    def test_scale_down_never_drains_last_healthy_replica(self):
+        t = [0.0]
+
+        class Prov:
+            downs = []
+
+            def scale_up(self):
+                raise AssertionError("unexpected scale up")
+
+            def scale_down(self, replica):
+                self.downs.append(replica.replica_id)
+
+        prov = Prov()
+        gw, engines = _gateway(
+            2, clock=lambda: t[0],
+            autoscaler=Autoscaler(
+                AutoscalerPolicy(min_replicas=1, max_replicas=4,
+                                 queue_low_water=0.5, dwell_ticks=1,
+                                 cooldown_seconds=0.0),
+                prov,
+            ),
+        )
+        # One replica is draining (operator kept it around): the
+        # healthy count is 1 == min_replicas, so the idle signal must
+        # CLAMP, not drain the last accepting replica.
+        gw.router.get("r1").state = "draining"
+        gw.tick()
+        assert prov.downs == []
+        assert gw.router.get("r0").state == "healthy"
+        scales = [e for e in gw.snapshot()["events"]
+                  if e["kind"] == "scale"]
+        assert not scales or scales[-1]["outcome"] == "clamped"
+
+    def test_scale_down_remove_pops_dispatched_table(self):
+        # drain_replica(remove=True) must not leave an empty table
+        # behind per departed replica id — an autoscaler cycling load
+        # up/down mints unique ids forever, so the leftovers are an
+        # unbounded leak (the departed-claim gauge-series leak class).
+        gw, engines = _gateway(2)
+        gw.drain_replica("r1", remove=True)
+        assert "r1" not in gw._dispatched
+        gw.fail_replica("r0")
+        assert gw._dispatched == {}
+
+    def test_replica_gauge_renders_registered_states_only(self):
+        # The gauge can only ever see REGISTERED replicas: gone ones
+        # deregister in the same call that marks them, so a gone series
+        # would read 0 forever — it must not exist at all.
+        reg = Registry()
+        gw = ServingGateway(reg, node_name="test")
+        for i in range(2):
+            gw.add_replica(ScriptedEngine(), f"r{i}")
+        gw.drain_replica("r0")          # kept around: draining
+        gw.fail_replica("r1")           # lost: deregistered
+        body = reg.render()
+        assert 'tpu_dra_gw_replicas{state="healthy"} 0' in body
+        assert 'tpu_dra_gw_replicas{state="draining"} 1' in body
+        assert 'state="gone"' not in body
+
+    def test_doctor_ignores_recovered_scale_failure(self):
+        from k8s_dra_driver_tpu.doctor import NodeScrape, fleet_findings
+
+        def scrape(events):
+            n = NodeScrape(name="n1", url="http://x")
+            n.gateway = {"overloaded": False, "events": events}
+            return n
+
+        failed = {"kind": "scale", "direction": "up",
+                  "outcome": "failed", "detail": "transient unsat"}
+        applied = {"kind": "scale", "direction": "up",
+                   "outcome": "applied"}
+        dwell = {"kind": "scale", "direction": "up", "outcome": "dwell"}
+        # Recovered: a later applied attempt clears the verdict.
+        fs = fleet_findings([scrape([failed, applied])], None, "d")
+        assert [f for f in fs if f.check == "gateway"] == []
+        # Standing failure (even with damped skips after): drift.
+        fs = fleet_findings([scrape([applied, failed, dwell])], None,
+                            "d")
+        gw_fs = [f for f in fs if f.check == "gateway"]
+        assert len(gw_fs) == 1 and gw_fs[0].severity == "drift"

@@ -599,3 +599,115 @@ class TestStats:
         assert isinstance(r, Request)
         eng.run()
         assert r.tokens[:3] == [1, 2, 3] and len(r.tokens) == 5
+
+
+class TestDrain:
+    """Graceful stop (ISSUE 14 satellite): admission closes, admitted
+    requests run to completion, queued ones come back for re-routing,
+    and the pool is exactly clean afterwards."""
+
+    def test_stop_admission_closes_submit(self, params):
+        from k8s_dra_driver_tpu.models.serving import (
+            AdmissionClosedError,
+        )
+
+        eng = DecodeEngine(
+            params, TINY, batch_slots=1, num_blocks=8, block_size=8,
+            max_seq_len=32,
+        )
+        eng.stop_admission()
+        assert not eng.admission_open
+        with pytest.raises(AdmissionClosedError):
+            eng.submit([1, 2, 3], max_new_tokens=2)
+        eng.resume_admission()
+        eng.submit([1, 2, 3], max_new_tokens=2)
+        eng.run()
+        eng.assert_no_leaks()
+
+    def test_drain_finishes_admitted_and_returns_queued(self, params):
+        prompts = _prompts(70, (5, 9, 7, 11, 6))
+        eng = DecodeEngine(
+            params, TINY, batch_slots=2, num_blocks=24, block_size=8,
+            max_seq_len=40, prefill_chunk=8,
+        )
+        reqs = [eng.submit(p, max_new_tokens=N_NEW) for p in prompts]
+        eng.tick()  # admits the first two into the slots
+        admitted = [r for r in reqs if r.admit_seq >= 0]
+        assert len(admitted) == 2
+        rerouted = eng.drain()
+        assert [r.rid for r in rerouted] == [
+            r.rid for r in reqs if r.admit_seq < 0
+        ]
+        for r in admitted:
+            assert r.done
+            assert r.tokens == _reference(params, r.prompt)
+        for r in rerouted:
+            assert r.state == "waiting" and not r.generated
+        eng.assert_no_leaks()
+        # The engine is reusable: reopen and serve the returned ones.
+        eng.resume_admission()
+        for r in rerouted:
+            eng.submit(r.prompt, max_new_tokens=N_NEW)
+        eng.run()
+        eng.assert_no_leaks()
+
+    def test_drain_under_block_pressure_loses_nothing(self, params):
+        """A preemption mid-drain must re-admit (the victim was an
+        admitted request): zero admitted-request loss even when the
+        pool is tight enough to preempt."""
+        prompts = _prompts(71, (9, 13, 11))
+        eng = DecodeEngine(
+            params, TINY, batch_slots=3, num_blocks=7, block_size=8,
+            max_seq_len=40, prefill_chunk=8,
+        )
+        reqs = [eng.submit(p, max_new_tokens=N_NEW) for p in prompts]
+        for _ in range(2):
+            eng.tick()
+        admitted = [r for r in reqs if r.admit_seq >= 0]
+        assert admitted, "pressure scenario admitted nobody"
+        eng.drain()
+        for r in admitted:
+            assert r.done, (r.rid, r.state)
+            assert r.tokens == _reference(params, r.prompt)
+        eng.assert_no_leaks()
+
+
+class TestSnapshot:
+    """The scrape contract the fleet gateway's demand sensor keys on:
+    renaming a key must fail HERE, not silently zero a routing signal."""
+
+    def test_stats_snapshot_keys_pinned(self):
+        from k8s_dra_driver_tpu.models.serving import ServingStats
+
+        snap = ServingStats().snapshot()
+        assert tuple(snap) == ServingStats.SNAPSHOT_KEYS
+        assert set(ServingStats.SNAPSHOT_KEYS) == {
+            "completed", "preemptions", "ticks", "decodeSteps",
+            "prefillChunks", "tokensGenerated", "prefixHitRate",
+            "prefillTokensSaved", "cowRecomputes", "queueDepthMean",
+            "queueDepthMax", "ttftP50Ms", "ttftP99Ms",
+            "tokenIntervalP50Ms", "tokenIntervalP99Ms",
+        }
+
+    def test_engine_snapshot_live_fields(self, params):
+        from k8s_dra_driver_tpu.models.serving import ServingStats
+
+        eng = DecodeEngine(
+            params, TINY, batch_slots=2, num_blocks=8, block_size=8,
+            max_seq_len=32,
+        )
+        eng.submit([1, 2, 3, 4, 5], max_new_tokens=2)
+        snap = eng.snapshot()
+        assert set(snap) == {
+            "queueDepth", "slotsBusy", "batchSlots", "admissionOpen",
+            "blocksFree", "blocksAvailable", "blocksTotal",
+            *ServingStats.SNAPSHOT_KEYS,
+        }
+        assert snap["queueDepth"] == 1
+        assert snap["slotsBusy"] == 0
+        assert snap["admissionOpen"] is True
+        assert snap["blocksTotal"] == 8
+        eng.run()
+        done = eng.snapshot()
+        assert done["completed"] == 1
+        assert done["queueDepth"] == 0

@@ -35,7 +35,10 @@ Plus one first-party rule with no ruff analog:
   ``parallel/elastic.py`` only ``tpu_dra_elastic_*``, and
   ``plugin/rebalancer.py`` only ``tpu_dra_slo_*`` — each family's
   home module stays coherent, so the docs catalog and the
-  verify-metrics coverage can reason per-module.
+  verify-metrics coverage can reason per-module. The serving gateway
+  owns ``tpu_dra_gw_*`` at DIRECTORY granularity (``serving_gateway/``
+  spans several modules sharing one family): metrics declared there
+  must use the prefix, and the prefix may not appear anywhere else.
 - TPM06: ``stage=``/``reason=`` label values on the ``tpu_dra_alloc_*``
   explainability families are confined to the ``STAGES``/``REASONS``
   enums declared in ``kube/allocator.py`` (parsed by AST, not imported):
@@ -217,6 +220,14 @@ _MODULE_FAMILY_PREFIXES = {
     "defrag.py": "tpu_dra_defrag_",
     "rebalancer.py": "tpu_dra_slo_",
 }
+# Directory-owned families: every metric declared anywhere under the
+# directory uses its prefix, and (unlike the per-module table, whose
+# filenames are unique) the prefix is also confined TO the directory —
+# the serving gateway spans several modules (router/admission/
+# autoscaler/gateway) that share one family.
+_DIR_FAMILY_PREFIXES = {
+    "serving_gateway": "tpu_dra_gw_",
+}
 _METRIC_METHODS = {"inc", "set", "observe"}
 
 
@@ -268,6 +279,18 @@ def check_metric_conventions(tree: ast.Module, path: Path) -> list[Finding]:
                 path, node.lineno, "TPM05",
                 f"{cls} name {name!r} declared in {path.name} must use "
                 f"the {owned_prefix!r} family prefix"))
+        for dirname, dir_prefix in _DIR_FAMILY_PREFIXES.items():
+            in_dir = dirname in path.parts
+            if in_dir and not name.startswith(dir_prefix):
+                out.append(Finding(
+                    path, node.lineno, "TPM05",
+                    f"{cls} name {name!r} declared under {dirname}/ "
+                    f"must use the {dir_prefix!r} family prefix"))
+            elif not in_dir and name.startswith(dir_prefix):
+                out.append(Finding(
+                    path, node.lineno, "TPM05",
+                    f"{cls} name {name!r} uses the {dir_prefix!r} "
+                    f"family prefix owned by {dirname}/"))
     return out
 
 

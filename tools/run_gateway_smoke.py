@@ -1,0 +1,139 @@
+#!/usr/bin/env python3
+"""Fleet-gateway smoke gate (``make gatewaybench``, wired into ``make
+verify``): fixed-seed shared-prefix traffic through TWO real
+DecodeEngine replicas on CPU, prefix-affinity routing vs the
+round-robin baseline, plus a jax-free drain/failover sanity pass over
+scripted engines.
+
+Gates (ISSUE 14 acceptance), on the DETERMINISTIC tick-normalized
+numbers (`speedup_rps_ticks` / `p99_token_ticks`: one gateway tick = one
+decode dispatch + at most one prefill chunk per engine, and a
+round-robin tick carries MORE prefill work, so the normalization
+understates the affinity advantage — see run_gateway_bench):
+
+1. affinity fleet req/s >= 1.3x round-robin, at equal-or-lower p99
+   token latency, with zero sheds and zero lost requests;
+2. each replica engine compiles exactly two programs (compile-once);
+3. tick counts identical across repeats (the routing-nondeterminism
+   tripwire; wall-clock spread past 2% is a stderr warning only — this
+   host is time-shared);
+4. a mid-traffic replica drain re-routes its queued requests and loses
+   ZERO admitted requests (scripted engines; the real-engine version is
+   tests/test_gateway.py's e2e acceptance).
+
+Exit status 1 on any gate failure, so `make verify` treats regressions
+as build breaks.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+failures: list[str] = []
+
+
+def gate(ok: bool, what: str) -> None:
+    tag = "ok " if ok else "FAIL"
+    print(f"[{tag}] {what}", flush=True)
+    if not ok:
+        failures.append(what)
+
+
+def bench_gate() -> None:
+    from _decodebench import run_gateway_bench, spread_flags
+
+    r = run_gateway_bench(
+        preset="tiny", n_replicas=2, batch_slots=4, n_requests=128,
+        n_systems=16, system_len=64, tail_len=8, max_new_tokens=4,
+        block_size=16, num_blocks=52, seed=0, repeats=2,
+    )
+    d = r["detail"]
+    print(
+        f"gateway {r['metric']}: {r['value']} req/s affinity vs "
+        f"{d['rps_round_robin']} round-robin (wall "
+        f"{d['speedup_rps']}x, tick-normalized "
+        f"{d['speedup_rps_ticks']}x over {d['ticks']:.0f} vs "
+        f"{d['ticks_round_robin']:.0f} ticks), p99 token "
+        f"{d['p99_token_ticks']} vs "
+        f"{d['p99_token_ticks_round_robin']} ticks, hit rate "
+        f"{d['prefix_hit_rate']} vs {d['prefix_hit_rate_round_robin']}",
+        flush=True,
+    )
+    gate(d["speedup_rps_ticks"] >= 1.3,
+         f"affinity speedup {d['speedup_rps_ticks']}x >= 1.3x "
+         "round-robin (tick-normalized)")
+    gate(
+        d["p99_token_ticks"] <= d["p99_token_ticks_round_robin"],
+        f"affinity p99 token {d['p99_token_ticks']} ticks <= "
+        f"round-robin {d['p99_token_ticks_round_robin']}",
+    )
+    gate(d["shed_rate"] == 0, "zero sheds on the throughput profile")
+    gate(
+        all(c == {"decode_step": 1, "prefill_chunk": 1}
+            for c in d["compile_counts"]),
+        f"compile-once per replica: {d['compile_counts']}",
+    )
+    gate(d["prefix_hit_rate"] > d["prefix_hit_rate_round_robin"],
+         "affinity raises the engine-level prefix hit rate")
+    gate(len(set(d["ticks_all"])) == 1,
+         f"tick counts identical across repeats: {d['ticks_all']}")
+    if spread_flags([r]):
+        print(
+            f"WARNING: gateway wall-clock rps spread {r['spread']} "
+            "exceeds 2% of the mean (host is time-shared; the gated "
+            "numbers are tick-normalized)", flush=True,
+        )
+
+
+def drain_gate() -> None:
+    """Scripted-engine drain: zero admitted-request loss, queued
+    requests re-routed, the drained replica removable mid-traffic."""
+    from k8s_dra_driver_tpu.serving_gateway import Router, ServingGateway
+    from k8s_dra_driver_tpu.serving_gateway.sim import (
+        ScriptedEngine,
+        shared_prefix_prompts,
+    )
+
+    gw = ServingGateway(
+        router=Router(policy="affinity", block_size=16,
+                      affinity_blocks=2, seed=0),
+        node_name="smoke",
+    )
+    engines = [ScriptedEngine(batch_slots=2, prefill_chunk=16)
+               for _ in range(3)]
+    for i, e in enumerate(engines):
+        gw.add_replica(e, f"smoke-{i}")
+    reqs = [
+        gw.submit(p, 4, latency_class="interactive")
+        for p in shared_prefix_prompts(36, n_systems=6, system_len=32,
+                                       tail_len=4, seed=1)
+    ]
+    for _ in range(3):
+        gw.tick()
+    rerouted = gw.drain_replica("smoke-1", remove=True,
+                                reason="smoke drain")
+    gw.run()
+    lost = [r for r in reqs if r.state != "finished"]
+    gate(not lost, f"drain loses zero requests ({len(lost)} lost, "
+                   f"{rerouted} re-routed)")
+    for e in engines:
+        e.assert_no_leaks()
+    gate(True, "all scripted engines idle and leak-free after drain")
+
+
+def main() -> int:
+    bench_gate()
+    drain_gate()
+    if failures:
+        print(f"gateway smoke: {len(failures)} gate(s) FAILED",
+              file=sys.stderr, flush=True)
+        return 1
+    print("gateway smoke: all gates passed", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    raise SystemExit(main())

@@ -389,6 +389,88 @@ def _self_test_scrape() -> tuple[str, list[str]]:
             )
         rebalance_snapshot = rebalancer.snapshot()
 
+    # The fleet-gateway families (tpu_dra_gw_*), populated through a
+    # REAL two-replica gateway sim driving all three observable paths:
+    # shared-prefix traffic ROUTES with affinity, a batch request is
+    # SHED at the watermark, and backlog pressure makes the autoscaler
+    # SCALE up through a provisioner — so the policy/outcome/class
+    # label values the scrape renders are exactly the production enums.
+    from k8s_dra_driver_tpu.serving_gateway import (
+        Autoscaler,
+        AutoscalerPolicy,
+        AdmissionPolicy,
+        OverloadedError,
+        Replica,
+        Router,
+        ServingGateway,
+    )
+    from k8s_dra_driver_tpu.serving_gateway.autoscaler import (
+        OUTCOMES as SCALE_OUTCOMES,
+    )
+    from k8s_dra_driver_tpu.serving_gateway.sim import (
+        ScriptedEngine,
+        shared_prefix_prompts,
+    )
+
+    gw_errors: list[str] = []
+
+    class _Provisioner:
+        def __init__(self):
+            self.ups = 0
+
+        def scale_up(self):
+            self.ups += 1
+            return Replica(f"scaled-{self.ups}", ScriptedEngine(
+                batch_slots=2, prefill_chunk=16,
+            ))
+
+        def scale_down(self, replica):
+            pass
+
+    gateway = ServingGateway(
+        registry,
+        router=Router(policy="affinity", block_size=16,
+                      affinity_blocks=2, seed=7),
+        admission_policy=AdmissionPolicy(shed_watermark=16,
+                                         hard_watermark=64),
+        autoscaler=Autoscaler(
+            AutoscalerPolicy(min_replicas=2, max_replicas=4,
+                             queue_high_water=4.0, dwell_ticks=1,
+                             cooldown_seconds=0.0),
+            _Provisioner(),
+        ),
+        node_name="verify",
+    )
+    for i in range(2):
+        gateway.add_replica(
+            ScriptedEngine(batch_slots=2, prefill_chunk=16),
+            f"verify-replica-{i}",
+        )
+    for prompt in shared_prefix_prompts(
+        22, n_systems=4, system_len=32, tail_len=4, seed=11
+    ):
+        gateway.submit(prompt, 2, latency_class="interactive")
+    try:
+        gateway.submit([1] * 16, 2, latency_class="batch")
+        gw_errors.append(
+            "gateway accepted batch traffic past the shed watermark"
+        )
+    except OverloadedError:
+        pass
+    gateway.run()
+    if gateway.counters["completed"] != 22:
+        gw_errors.append(
+            f"gateway sim completed {gateway.counters['completed']} "
+            "of 22 requests"
+        )
+    if not any(
+        r["kind"] == "scale" and r.get("outcome") == "applied"
+        for r in gateway.snapshot()["events"]
+    ):
+        gw_errors.append("gateway sim produced no applied scale-up")
+    gateway_snapshot = gateway.snapshot()
+    alloc_errors.extend(gw_errors)
+
     tracer = Tracer()
     with tracer.span("verify", claim_uid="uid-verify"):
         pass
@@ -400,6 +482,7 @@ def _self_test_scrape() -> tuple[str, list[str]]:
     srv.set_allocations_provider(allocator.export_allocations_jsonl)
     srv.set_defrag_provider(planner.export_json)
     srv.set_rebalance_provider(lambda: rebalance_snapshot)
+    srv.set_gateway_provider(lambda: gateway_snapshot)
     srv.start()
     try:
         base = f"http://127.0.0.1:{srv.port}"
@@ -526,10 +609,45 @@ def _self_test_scrape() -> tuple[str, list[str]]:
                         f"/debug/rebalance: claim {uid} missing its "
                         "granted-vs-min share view"
                     )
+        # /debug/gateway: decodable JSON with both sim replicas, the
+        # shed + applied-scale evidence, and enum-confined outcomes.
+        gateway_body = urllib.request.urlopen(
+            f"{base}/debug/gateway"
+        ).read().decode()
+        try:
+            gateway_doc = json.loads(gateway_body)
+        except ValueError:
+            errors.append("/debug/gateway: body is not JSON")
+        else:
+            served_replicas = gateway_doc.get("replicas") or {}
+            for rid in ("verify-replica-0", "verify-replica-1"):
+                if rid not in served_replicas:
+                    errors.append(
+                        f"/debug/gateway: replica {rid} missing"
+                    )
+            gw_events = gateway_doc.get("events") or []
+            if not any(e.get("kind") == "shed" for e in gw_events):
+                errors.append("/debug/gateway: no shed event recorded")
+            for e in gw_events:
+                if e.get("kind") != "scale":
+                    continue
+                if e.get("outcome") not in SCALE_OUTCOMES:
+                    errors.append(
+                        f"/debug/gateway: scale outcome "
+                        f"{e.get('outcome')!r} outside OUTCOMES"
+                    )
+            if not any(
+                e.get("kind") == "scale"
+                and e.get("outcome") == "applied"
+                for e in gw_events
+            ):
+                errors.append(
+                    "/debug/gateway: no applied scale decision served"
+                )
         # The scrape surface is GET-only by contract — /metrics and the
         # debug endpoints alike.
         for route in ("/metrics", "/debug/allocations", "/debug/defrag",
-                      "/debug/rebalance"):
+                      "/debug/rebalance", "/debug/gateway"):
             try:
                 urllib.request.urlopen(base + route, data=b"x")
                 errors.append(f"{route} accepted a POST (want 405)")
@@ -556,7 +674,15 @@ def _self_test_scrape() -> tuple[str, list[str]]:
                    "tpu_dra_slo_granted_share",
                    "tpu_dra_slo_min_share",
                    "tpu_dra_slo_rebalance_seconds",
-                   "tpu_dra_slo_violations_total"):
+                   "tpu_dra_slo_violations_total",
+                   "tpu_dra_gw_routed_total",
+                   "tpu_dra_gw_affinity_lookups_total",
+                   "tpu_dra_gw_affinity_hits_total",
+                   "tpu_dra_gw_queue_depth",
+                   "tpu_dra_gw_shed_total",
+                   "tpu_dra_gw_replicas",
+                   "tpu_dra_gw_scale_decisions_total",
+                   "tpu_dra_gw_requests_total"):
         if f"\n{family}" not in body and not body.startswith(family):
             errors.append(f"expected family {family} missing from scrape")
     # The rendered stage/reason label values stay inside the enums the
