@@ -177,16 +177,19 @@ def _moe_decode_detail(config, batch) -> dict:
 
 
 def spread_flags(metrics, rel: float = 0.02) -> list:
-    """Flag any ``*_decode_toks_*`` or ``*_gateway_rps_*`` metric whose
-    repeat spread exceeds ``rel`` of its mean — the signature of
-    per-shape recompilation (the BENCH_r05 125-315 tok/s spreads) or,
-    for the fleet bench, of routing nondeterminism. Mutates the dicts
-    in place (``spread_flag: true``) and returns the flagged metric
-    names so bench.py can surface them on stderr."""
+    """Flag any ``*_decode_toks_*``, ``*_prefill_toks_*`` or
+    ``*_gateway_rps_*`` metric whose repeat spread exceeds ``rel`` of
+    its mean — the signature of per-shape recompilation (the BENCH_r05
+    125-315 tok/s spreads; for the packed prefill program, a shape leak
+    in the ragged lanes) or, for the fleet bench, of routing
+    nondeterminism. Mutates the dicts in place (``spread_flag: true``)
+    and returns the flagged metric names so bench.py can surface them
+    on stderr."""
     flagged = []
     for m in metrics:
         name = m.get("metric", "")
         if ("_decode_toks_" not in name
+                and "_prefill_toks_" not in name
                 and "_gateway_rps_" not in name):
             continue
         spread = m.get("spread")
@@ -249,6 +252,9 @@ def run_serving_bench(
     prefix_cache: bool = True,
     overlap: bool = True,
     prefill_chunk: int | None = None,
+    prefill_batch: int | None = None,
+    burst_size: int | None = None,
+    burst_gap_ticks: int = 8,
 ) -> dict:
     """Sustained traffic through the continuous-batching engine:
     requests/s completed at a measured p99 per-token latency.
@@ -259,6 +265,15 @@ def run_serving_bench(
     (see ``_serving_traffic``). ``prefix_cache=False`` is the A/B
     baseline for the shared-prefix profile (the cache-disabled engine
     the >= 1.5x req/s acceptance gate compares against).
+
+    TTFT-focused knobs: ``prefill_batch`` sizes the packed prefill
+    program (``1`` = the serial one-chunk-per-tick baseline;
+    ``run_prefill_bench`` is the dedicated A/B pair). ``burst_size``
+    switches arrivals from all-upfront to a burst profile — requests
+    arrive ``burst_size`` at a time with ``burst_gap_ticks`` engine
+    ticks between bursts, so TTFT measures concurrent same-class
+    arrivals contending for prefill lanes (the gateway admission shape)
+    instead of one deep queue.
     """
     from k8s_dra_driver_tpu.models.llama import PRESETS, init_params
     from k8s_dra_driver_tpu.models.moe import MOE_PRESETS
@@ -294,7 +309,7 @@ def run_serving_bench(
     engine = DecodeEngine(
         params, config, batch_slots=batch_slots, num_blocks=num_blocks,
         block_size=block_size, max_seq_len=span,
-        prefill_chunk=prefill_chunk,
+        prefill_chunk=prefill_chunk, prefill_batch=prefill_batch,
         quantize_cache=quant_kv, prefix_cache=prefix_cache,
         overlap=overlap,
     )
@@ -306,9 +321,18 @@ def run_serving_bench(
                   max_new_tokens=2)
     engine.run()
     engine.stats = ServingStats()
-    for p in prompts:
-        engine.submit(p, max_new_tokens=max_new_tokens)
     t0 = time.perf_counter()
+    if burst_size:
+        # Burst arrivals: each burst lands between serving ticks, so
+        # TTFT reflects concurrent arrivals racing for prefill lanes.
+        for lo in range(0, len(prompts), burst_size):
+            for p in prompts[lo:lo + burst_size]:
+                engine.submit(p, max_new_tokens=max_new_tokens)
+            for _ in range(burst_gap_ticks):
+                engine.tick()
+    else:
+        for p in prompts:
+            engine.submit(p, max_new_tokens=max_new_tokens)
     engine.run()
     wall = time.perf_counter() - t0
     engine.assert_no_leaks()
@@ -330,8 +354,17 @@ def run_serving_bench(
         "vs_baseline": 0.0,
         "detail": {
             "profile": profile,
+            "arrival": (
+                f"bursts of {burst_size} every {burst_gap_ticks} ticks"
+                if burst_size else "upfront"
+            ),
+            "prefill_batch": engine.prefill_batch,
+            "prefill_batch_occupancy": round(
+                s.prefill_batch_occupancy(), 4
+            ),
             "p99_token_ms": round(s.p99_token_ms(), 2),
             "p50_token_ms": round(s.p50_token_ms(), 2),
+            "p50_ttft_ms": round(s.p50_ttft_ms(), 2),
             "p99_ttft_ms": round(s.p99_ttft_ms(), 2),
             "toks_per_s": round(s.tokens_generated / wall, 1),
             # Prefill-vs-decode throughput split: where the wall time's
@@ -400,6 +433,149 @@ def run_prefix_cache_bench(
     )
     hot["detail"]["p99_ttft_ms_cache_off"] = base["detail"]["p99_ttft_ms"]
     return hot
+
+
+def run_prefill_bench(
+    preset: str = "160m",
+    batch_slots: int = 8,
+    n_requests: int = 24,
+    prompt_len: int = 256,
+    prefill_chunk: int = 64,
+    prefill_batch: int = 4,
+    max_new_tokens: int = 8,
+    block_size: int = 64,
+    quant: bool = False,
+    quant_kv: bool = False,
+    seed: int = 0,
+) -> dict:
+    """The prefill fast-path acceptance pair: a burst of concurrent
+    arrivals (all requests land at tick 0 — the gateway admission shape
+    TTFT is measured under) served through two otherwise identical
+    engines — packed prefill at ``prefill_batch`` lanes vs the serial
+    one-chunk-per-tick baseline (``prefill_batch=1``).
+
+    Engines and stats share a VIRTUAL clock advancing one unit per
+    tick, so every TTFT percentile is measured in ticks — deterministic
+    on a noisy host, and the unit the smoke gate pins (tick-normalized
+    TTFT-p99 improvement >= 1.5x at equal-or-better decode-token p99).
+    ``value`` is the batched engine's computed-prompt tokens/s over the
+    wall clock (``llama3_*_prefill_toks_*`` — the throughput leg, with
+    repeat spread as the recompile tripwire for the packed program);
+    the TTFT pair lives in detail. The prefix cache is OFF in both
+    engines: this bench measures raw prefill compute, and a warm cache
+    would zero the very work being timed on repeat runs."""
+    from k8s_dra_driver_tpu.models.llama import PRESETS, init_params
+    from k8s_dra_driver_tpu.models.moe import MOE_PRESETS
+    from k8s_dra_driver_tpu.models.moe import init_params as moe_init_params
+    from k8s_dra_driver_tpu.models.quant import quantize_params
+    from k8s_dra_driver_tpu.models.serving import (
+        DecodeEngine,
+        ServingStats,
+    )
+
+    is_moe = preset in MOE_PRESETS
+    config = MOE_PRESETS[preset] if is_moe else PRESETS[preset]
+    init = moe_init_params if is_moe else init_params
+    params = jax.jit(lambda k: init(config, k))(jax.random.PRNGKey(0))
+    if quant:
+        params = jax.jit(quantize_params)(params)
+
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    prompts = [
+        rng.randint(0, config.vocab_size, size=prompt_len).tolist()
+        for _ in range(n_requests)
+    ]
+    span = prompt_len + max_new_tokens
+    num_blocks = batch_slots * (-(-span // block_size)) + 2
+
+    def make_engine(pb, clk):
+        eng = DecodeEngine(
+            params, config, batch_slots=batch_slots,
+            num_blocks=num_blocks, block_size=block_size,
+            max_seq_len=span, prefill_chunk=prefill_chunk,
+            prefill_batch=pb, quantize_cache=quant_kv,
+            prefix_cache=False, clock=clk,
+        )
+        eng.submit(prompts[0][: prefill_chunk // 2], max_new_tokens=2)
+        eng.run()
+        eng.stats = ServingStats()
+        return eng
+
+    def one_run(eng, clock_box):
+        for p in prompts:                      # the burst: all at once
+            eng.submit(p, max_new_tokens=max_new_tokens)
+        t0 = time.perf_counter()
+        while not eng.idle:
+            eng.tick()
+            clock_box[0] += 1.0
+        wall = time.perf_counter() - t0
+        eng.assert_no_leaks()
+        s, eng.stats = eng.stats, ServingStats()
+        return {
+            "wall": wall,
+            "prefill_toks_per_s": s.prefill_tokens / wall,
+            "prefill_tokens": s.prefill_tokens,
+            "ttft_p50_ticks": s.pctl(s.ttft_s, 0.50),
+            "ttft_p99_ticks": s.pctl(s.ttft_s, 0.99),
+            "token_p99_ticks": s.pctl(s.token_interval_s, 0.99),
+            "occupancy": round(s.prefill_batch_occupancy(), 4),
+            "ticks": s.ticks,
+            "compile_counts": dict(eng.compile_counts),
+        }
+
+    serial_box = [0.0]
+    serial = one_run(make_engine(1, lambda: serial_box[0]), serial_box)
+    n_repeats = max(1, int(os.environ.get("TPU_DRA_BENCH_REPEATS", "3")))
+    batched_box = [0.0]
+    eng = make_engine(prefill_batch, lambda: batched_box[0])
+    runs = [one_run(eng, batched_box) for _ in range(n_repeats)]
+    runs.sort(key=lambda r: r["prefill_toks_per_s"])
+    hot = runs[len(runs) // 2]
+    spread = (runs[-1]["prefill_toks_per_s"]
+              - runs[0]["prefill_toks_per_s"]) / 2
+    tags = "".join(
+        t for t, on in (("-int8", quant), ("-kvq", quant_kv)) if on
+    )
+    family = "mixtral" if is_moe else "llama3"
+    return {
+        "metric": (
+            f"{family}_{preset}{tags}_prefill_toks_b{batch_slots}"
+            f"_pb{prefill_batch}"
+        ),
+        "value": round(hot["prefill_toks_per_s"], 1),
+        "unit": "tokens_per_s",
+        "vs_baseline": 0.0,
+        "repeats": n_repeats,
+        "spread": round(spread, 1),
+        "detail": {
+            "prefill_batch": prefill_batch,
+            "prompt_len": prompt_len,
+            "prefill_chunk": prefill_chunk,
+            "n_requests": n_requests,
+            "ttft_p50_ticks": hot["ttft_p50_ticks"],
+            "ttft_p99_ticks": hot["ttft_p99_ticks"],
+            "ttft_p50_ticks_serial": serial["ttft_p50_ticks"],
+            "ttft_p99_ticks_serial": serial["ttft_p99_ticks"],
+            # The acceptance ratio (gate >= 1.5x in the decode smoke):
+            # deterministic — both legs are tick-counted, same seed.
+            "ttft_p99_speedup_ticks": round(
+                serial["ttft_p99_ticks"] / max(hot["ttft_p99_ticks"], 1e-9),
+                3,
+            ),
+            "token_p99_ticks": hot["token_p99_ticks"],
+            "token_p99_ticks_serial": serial["token_p99_ticks"],
+            "prefill_batch_occupancy": hot["occupancy"],
+            "ticks": hot["ticks"],
+            "ticks_serial": serial["ticks"],
+            "prefill_toks_per_s_serial": round(
+                serial["prefill_toks_per_s"], 1
+            ),
+            "compile_counts": hot["compile_counts"],
+            "compile_counts_serial": serial["compile_counts"],
+        },
+    }
 
 
 def run_gateway_bench(
@@ -500,6 +676,15 @@ def run_gateway_bench(
                 params, config, batch_slots=batch_slots,
                 num_blocks=num_blocks, block_size=block_size,
                 max_seq_len=span, prefill_chunk=block_size,
+                # The virtual clock's device-cost unit is "one decode
+                # dispatch + at most ONE prefill chunk per engine per
+                # tick"; the packed prefill program would let a tick
+                # carry up to prefill_batch chunks for free, silently
+                # discounting exactly the prefill work that round-robin
+                # pays more of. The fleet A/B measures ROUTING, so its
+                # engines pin the serial prefill baseline; the packed
+                # program has its own A/B (run_prefill_bench).
+                prefill_batch=1,
                 quantize_cache=quant_kv, clock=clk,
             )
             for _ in range(n_replicas)
@@ -643,11 +828,16 @@ def run_speculative_bench(
     """Speculative decode with a shallow same-vocab draft, reporting the
     draft-acceptance rate in detail so speculation wins/losses are
     attributable (an untrained random draft pins the floor: acceptance
-    near 0, pure drafting overhead)."""
+    near 0, pure drafting overhead). ``verify_impl`` records which
+    paged-attention path the T=k+1 target verify pass dispatched —
+    "pallas" (the fused prefill kernel) or "xla" (the gather
+    reference) — so a verify-pass regression to the slow rail is
+    visible in the bench record."""
     import dataclasses
 
     from k8s_dra_driver_tpu.models.llama import PRESETS, init_params
     from k8s_dra_driver_tpu.models.speculative import speculative_generate
+    from k8s_dra_driver_tpu.ops.attention import paged_prefill_impl_label
 
     config = PRESETS[preset]
     draft_config = dataclasses.replace(config, n_layers=draft_layers)
@@ -690,6 +880,7 @@ def run_speculative_bench(
             "accepted": int(stats["accepted"]),
             "k": k,
             "draft_layers": draft_layers,
+            "verify_impl": paged_prefill_impl_label(),
         },
     }
 
@@ -731,6 +922,20 @@ def main():
             f"p99 token {s['detail']['p99_token_ms']} ms, "
             f"p99 ttft {s['detail']['p99_ttft_ms']} ms, "
             f"{s['detail']['preemptions']} preemptions", flush=True,
+        )
+        f = run_prefill_bench(
+            preset=os.environ.get("TPU_DRA_DECODE_PRESET", "160m"),
+            quant="int8" in quant_modes,
+            quant_kv="int8-kv" in quant_modes,
+        )
+        print(
+            f"prefill {f['metric']}: {f['value']} tok/s "
+            f"(serial {f['detail']['prefill_toks_per_s_serial']} tok/s), "
+            f"ttft p99 {f['detail']['ttft_p99_ticks']} ticks vs "
+            f"{f['detail']['ttft_p99_ticks_serial']} serial "
+            f"({f['detail']['ttft_p99_speedup_ticks']}x), "
+            f"occupancy {f['detail']['prefill_batch_occupancy']:.0%}",
+            flush=True,
         )
         p = run_prefix_cache_bench(
             preset=os.environ.get("TPU_DRA_DECODE_PRESET", "160m"),

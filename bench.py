@@ -305,6 +305,12 @@ def extra_metrics(peak_flops, remat_policy) -> list:
         # Their detail IS the payload (p99/acceptance), so it stays.
         for name, fn_name, kwargs in (
             ("serving", "run_serving_bench", dict(preset=decode_preset)),
+            # Prefill fast-path pair: a burst of concurrent arrivals
+            # through the packed prefill program vs the serial
+            # one-chunk-per-tick baseline (prefill tokens/s headline;
+            # deterministic tick-normalized TTFT p50/p99 pair + the
+            # >= 1.5x p99 speedup ratio in detail — the ISSUE-15 gate).
+            ("prefill", "run_prefill_bench", dict(preset=decode_preset)),
             # Shared-prefix traffic (16 system prompts x many tails)
             # served cache-on vs cache-off: the BENCH_r06 before/after
             # for prefix-cache KV reuse (req/s at measured p99, hit

@@ -8,8 +8,9 @@ growing sequences advance block-table entries and per-sequence length
 scalars, never retrace — so one compiled step serves from token 1 to
 max_len (the regression oracle in tests/test_decode.py counts traces).
 
-Attention reads the pool through the block table: a fused Pallas kernel
-on TPU for the single-token decode shape and a gather-based XLA path
+Attention reads the pool through the block table: fused Pallas kernels
+on TPU for BOTH hot shapes — the single-token decode step and the
+multi-token prefill/verify window — and a gather-based XLA path
 everywhere else (ops/attention.py). `lax.scan` over layers with stacked
 per-layer pools and greedy generation under `lax.while_loop` keep the
 whole generate loop one program, as before.
@@ -27,7 +28,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from ..ops.attention import paged_attention_reference, paged_decode_attention
+from ..ops.attention import paged_decode_attention, paged_prefill_attention
 from ..ops.norms import rmsnorm
 from ..ops.rotary import rope_frequencies
 from .llama import LlamaConfig, _mlp_block, attn_out, project_qkv
@@ -109,7 +110,7 @@ def _forward_with_cache(
     config: LlamaConfig,
     positions: jax.Array,         # [T] shared or [B, T] per-sequence
     mesh=None,
-    n_valid: jax.Array | None = None,   # [] real tokens in a padded chunk
+    n_valid: jax.Array | None = None,   # [] or [B] real tokens per chunk
     active: jax.Array | None = None,    # [B] bool: slots allowed to write
 ) -> "tuple[jax.Array, PagedKVCache | PagedQuantKVCache]":
     """Run the stack over new tokens, reading+writing the paged cache.
@@ -117,9 +118,11 @@ def _forward_with_cache(
 
     ``positions`` are absolute per-sequence positions of the new tokens.
     ``n_valid`` marks the first n columns of a right-padded chunk as
-    real (prefill chunking); padded columns are neither written to the
-    pool nor advance lengths. ``active`` gates whole sequences: an
-    inactive slot's block table may reference blocks re-owned by another
+    real (prefill chunking) — a scalar shared by every row, or a [B]
+    vector for the ragged multi-request packed prefill (each lane its
+    own valid width); padded columns are neither written to the pool nor
+    advance lengths. ``active`` gates whole sequences: an inactive
+    slot's block table may reference blocks re-owned by another
     sequence, so its writes are dropped and its length frozen."""
     c = config
     b, t = tokens.shape
@@ -144,7 +147,9 @@ def _forward_with_cache(
     valid = None
     if n_valid is not None:
         valid = jnp.broadcast_to(
-            jnp.arange(t, dtype=jnp.int32)[None, :] < n_valid, (b, t)
+            jnp.arange(t, dtype=jnp.int32)[None, :]
+            < jnp.reshape(n_valid, (-1, 1)),
+            (b, t),
         )
     if active is not None:
         valid = (
@@ -197,7 +202,11 @@ def _forward_with_cache(
                 bs, scale, k_scale=ks_pool, v_scale=vs_pool,
             )[:, :, None, :]
         else:
-            o = paged_attention_reference(
+            # Prefill chunks and speculative verify windows: fused paged
+            # prefill kernel on TPU, gather fallback elsewhere (dispatch
+            # inside ops/attention.py; every T>1 caller's positions are
+            # contiguous windows, the kernel-path contract).
+            o = paged_prefill_attention(
                 q, k_pool, v_pool, cache.block_tables, rope_pos, bs,
                 scale, k_scale=ks_pool, v_scale=vs_pool,
             )

@@ -44,10 +44,18 @@ programs per weight/cache variant for its whole lifetime:
   integers; nothing retraces. ``compile_counts`` exposes the trace
   counter — the regression oracle for the per-shape recompile spreads
   of BENCH_r05 (tests/test_decode.py pins it to exactly 1).
-- ``prefill_chunk``: a fixed-width right-padded window of ONE request's
-  prompt. Long prompts are fed chunk by chunk, one chunk per tick,
-  while running sequences keep decoding every tick — a long prompt
-  never stalls the batch (chunked prefill).
+- ``prefill_chunk``: a PACKED program of up to ``prefill_batch``
+  requests' fixed-width right-padded prompt windows, advanced in ONE
+  launch per tick (ragged multi-request prefill batching). Per-lane
+  ``(table row, start, n_valid, active)`` scalars drive placement;
+  right-padded columns and idle lanes are masked — never written to
+  the pool, never visible to a valid query's attention. Long prompts
+  are still fed chunk by chunk while running sequences keep decoding
+  every tick (a long prompt never stalls the batch), but N concurrent
+  arrivals no longer serialize their prefills N ticks deep — the
+  TTFT lever under bursty traffic. The program is fixed-shape
+  regardless of how many lanes are occupied, so ``compile_counts``
+  stays exactly one prefill program for the engine's lifetime.
 
 Scheduling policy (host-side, deliberately simple and auditable):
 
@@ -154,30 +162,50 @@ class ServingStats:
     cow_recomputes: int = 0
     prompt_tokens: int = 0             # admitted prompt tokens
     prefill_tokens: int = 0            # prompt tokens actually computed
+    # Packed-prefill observability: lanes_used counts request chunks
+    # actually advanced, lanes_launched counts prefill_batch per launch
+    # — their ratio is the occupancy (idle-lane waste) of the packed
+    # prefill program.
+    prefill_lanes_used: int = 0
+    prefill_lanes_launched: int = 0
     queue_depth: list = dataclasses.field(default_factory=list)
     ttft_s: list = dataclasses.field(default_factory=list)
     token_interval_s: list = dataclasses.field(default_factory=list)
     request_latency_s: list = dataclasses.field(default_factory=list)
 
     @staticmethod
-    def _pctl(xs, q):
+    def pctl(xs, q):
+        """Percentile over raw latency samples — in the engine clock's
+        unit (seconds on the wall clock, ticks under a virtual one).
+        Public: benches and smokes that gate on tick-normalized
+        percentiles consume the raw samples directly."""
         if not xs:
             return 0.0
         xs = sorted(xs)
         return xs[min(len(xs) - 1, int(q * len(xs)))]
 
     def p50_token_ms(self) -> float:
-        return self._pctl(self.token_interval_s, 0.50) * 1e3
+        return self.pctl(self.token_interval_s, 0.50) * 1e3
 
     def p99_token_ms(self) -> float:
-        return self._pctl(self.token_interval_s, 0.99) * 1e3
+        return self.pctl(self.token_interval_s, 0.99) * 1e3
+
+    def p50_ttft_ms(self) -> float:
+        return self.pctl(self.ttft_s, 0.50) * 1e3
 
     def p99_ttft_ms(self) -> float:
-        return self._pctl(self.ttft_s, 0.99) * 1e3
+        return self.pctl(self.ttft_s, 0.99) * 1e3
 
     def hit_rate(self) -> float:
         """Fraction of admitted prompt tokens served from the cache."""
         return self.prefix_hit_tokens / max(self.prompt_tokens, 1)
+
+    def prefill_batch_occupancy(self) -> float:
+        """Lanes used / lanes launched across every packed prefill
+        launch: 1.0 = every lane advanced a request, lower = idle-lane
+        compute waste (a ``prefill_batch`` oversized for the traffic)."""
+        return (self.prefill_lanes_used
+                / max(self.prefill_lanes_launched, 1))
 
     def queue_depth_mean(self) -> float:
         return (sum(self.queue_depth) / len(self.queue_depth)
@@ -192,10 +220,10 @@ class ServingStats:
     # rename cannot silently zero a routing signal.
     SNAPSHOT_KEYS = (
         "completed", "preemptions", "ticks", "decodeSteps",
-        "prefillChunks", "tokensGenerated", "prefixHitRate",
-        "prefillTokensSaved", "cowRecomputes", "queueDepthMean",
-        "queueDepthMax", "ttftP50Ms", "ttftP99Ms", "tokenIntervalP50Ms",
-        "tokenIntervalP99Ms",
+        "prefillChunks", "prefillBatchOccupancy", "tokensGenerated",
+        "prefixHitRate", "prefillTokensSaved", "cowRecomputes",
+        "queueDepthMean", "queueDepthMax", "ttftP50Ms", "ttftP99Ms",
+        "tokenIntervalP50Ms", "tokenIntervalP99Ms",
     )
 
     def snapshot(self) -> dict:
@@ -207,13 +235,16 @@ class ServingStats:
             "ticks": self.ticks,
             "decodeSteps": self.decode_steps,
             "prefillChunks": self.prefill_chunks,
+            "prefillBatchOccupancy": round(
+                self.prefill_batch_occupancy(), 4
+            ),
             "tokensGenerated": self.tokens_generated,
             "prefixHitRate": round(self.hit_rate(), 4),
             "prefillTokensSaved": self.prefix_hit_tokens,
             "cowRecomputes": self.cow_recomputes,
             "queueDepthMean": round(self.queue_depth_mean(), 2),
             "queueDepthMax": self.queue_depth_max(),
-            "ttftP50Ms": round(self._pctl(self.ttft_s, 0.50) * 1e3, 3),
+            "ttftP50Ms": round(self.p50_ttft_ms(), 3),
             "ttftP99Ms": round(self.p99_ttft_ms(), 3),
             "tokenIntervalP50Ms": round(self.p50_token_ms(), 3),
             "tokenIntervalP99Ms": round(self.p99_token_ms(), 3),
@@ -226,7 +257,12 @@ class DecodeEngine:
     ``prefix_cache=False`` disables cross-request KV reuse (the bench
     baseline); ``overlap=False`` consumes every decode step's tokens
     synchronously (the pre-overlap tick, kept for A/B timing — token
-    streams are identical at temperature 0 either way).
+    streams are identical at temperature 0 either way);
+    ``prefill_batch`` caps how many requests' prompt chunks one packed
+    prefill launch advances (default ``min(4, batch_slots)``;
+    ``prefill_batch=1`` is the serial one-chunk-per-tick A/B baseline —
+    token streams are identical at temperature 0 at any setting, only
+    TTFT changes).
     """
 
     def __init__(
@@ -239,6 +275,7 @@ class DecodeEngine:
         block_size: int = 16,
         max_seq_len: int | None = None,
         prefill_chunk: int = 32,
+        prefill_batch: int | None = None,
         quantize_cache: bool = False,
         eos_id: int | None = None,
         temperature: float = 0.0,
@@ -252,33 +289,52 @@ class DecodeEngine:
         self.batch_slots = batch_slots
         self.block_size = block_size
         self.prefill_chunk = prefill_chunk
+        # Lanes of the packed prefill program: more lanes drain bursty
+        # arrivals faster (TTFT), idle lanes are masked waste. Clamped
+        # to batch_slots (there are never more concurrent prefills).
+        if prefill_batch is None:
+            prefill_batch = min(4, batch_slots)
+        self.prefill_batch = max(1, min(prefill_batch, batch_slots))
         self.quantize_cache = quantize_cache
         self.eos_id = eos_id
         self.temperature = temperature
         self.overlap = overlap
         self.mesh = mesh
         self._clock = clock
-        # What the MoE MLP will actually run per program (decode steps
-        # and prefill chunks resolve independently — both are small
-        # enough for the grouped fast path mesh-free): surfaced so bench
-        # detail and operators see the measured configuration.
+        # What the MoE MLP will actually run per program: surfaced so
+        # bench detail and operators see the measured configuration.
+        # The PREFILL program pins its impl at the per-lane chunk width
+        # (the speculative.py verify-config discipline): auto-resolving
+        # at the packed prefill_batch*chunk token count could flip
+        # dropless -> capacity-dropping einsum on big-expert configs,
+        # and capacity dropping would make packed lanes route
+        # differently than the prefill_batch=1 baseline — silently
+        # breaking the "token streams identical at any prefill_batch"
+        # contract. Pinning per-lane keeps routing semantics a function
+        # of the chunk alone.
         self.moe_impl = {}
+        self._prefill_config = config
         if hasattr(config, "moe_impl"):
+            import dataclasses as _dc
+
             from .moe import resolve_moe_impl
 
             expert_mesh = (
                 mesh is not None and mesh.shape.get("expert", 1) > 1
             )
+            prefill_impl = resolve_moe_impl(
+                config, prefill_chunk, expert_mesh=expert_mesh
+            )
+            self._prefill_config = _dc.replace(
+                config, moe_impl=prefill_impl
+            )
             self.moe_impl = {
-                # Mirrors the traced shapes exactly: _decode_fn runs
-                # [batch_slots, 1] and _prefill_fn runs ONE request's
-                # [1, prefill_chunk] window.
+                # decode_step mirrors its traced [batch_slots, 1] shape;
+                # prefill_chunk is the pinned per-lane resolution above.
                 "decode_step": resolve_moe_impl(
                     config, batch_slots, expert_mesh=expert_mesh
                 ),
-                "prefill_chunk": resolve_moe_impl(
-                    config, prefill_chunk, expert_mesh=expert_mesh
-                ),
+                "prefill_chunk": prefill_impl,
             }
         span = max_seq_len or min(config.max_seq_len,
                                   num_blocks * block_size)
@@ -352,23 +408,36 @@ class DecodeEngine:
                 nxt = jnp.argmax(logits, axis=-1)
             return nxt.astype(jnp.int32), _pools_of(cache)
 
-        def _prefill_fn(params, pools, table_row, start, n_valid, chunk,
-                        key):
+        def _prefill_fn(params, pools, tables, starts, n_valid, active,
+                        chunks, key):
             self.compile_counts["prefill_chunk"] += 1
-            cache = _mk_cache(
-                pools, table_row[None], jnp.broadcast_to(start, (1,))
-            )
-            positions = start + jnp.arange(chunk.shape[0])
+            # The packed prefill program: up to prefill_batch requests'
+            # right-padded chunks advance in one launch. Per-lane
+            # (table row, start, n_valid, active) scalars drive
+            # placement; padded columns and idle lanes never write the
+            # pool (mode="drop" scatter) and never enter a valid
+            # query's attention (per-row causal masking at absolute
+            # positions) — their logits are computed-and-discarded, the
+            # price of the fixed shape.
+            cache = _mk_cache(pools, tables, starts)
+            positions = starts[:, None] + jnp.arange(chunks.shape[1])
             logits, cache = _forward_with_cache(
-                params, chunk[None], cache, config, positions[None],
-                n_valid=n_valid, mesh=mesh,
+                params, chunks, cache, self._prefill_config, positions,
+                n_valid=n_valid, active=active, mesh=mesh,
             )
-            last = logits[0, jnp.maximum(n_valid - 1, 0)]
+            # Each lane's last VALID column samples its first token
+            # (only consumed by the host for lanes finishing their
+            # prompt this launch).
+            last = logits[
+                jnp.arange(chunks.shape[0]), jnp.maximum(n_valid - 1, 0)
+            ]
             if temperature > 0.0:
-                tok = jax.random.categorical(key, last / temperature)
+                toks = jax.random.categorical(
+                    key, last / temperature, axis=-1
+                )
             else:
-                tok = jnp.argmax(last, axis=-1)
-            return tok.astype(jnp.int32), _pools_of(cache)
+                toks = jnp.argmax(last, axis=-1)
+            return toks.astype(jnp.int32), _pools_of(cache)
 
         # Donating the pools keeps the cache update in place on TPU; CPU
         # ignores donation with a warning, so only ask for it there.
@@ -472,9 +541,10 @@ class DecodeEngine:
         raise RuntimeError(f"drain not complete after {max_ticks} ticks")
 
     def tick(self) -> None:
-        """One scheduling round: admit, advance one prefill chunk, then
-        dispatch one decode step for every running slot (consuming the
-        previous step's tokens while the new one runs on device)."""
+        """One scheduling round: admit, advance up to ``prefill_batch``
+        requests' prefill chunks in one packed launch, then dispatch one
+        decode step for every running slot (consuming the previous
+        step's tokens while the new one runs on device)."""
         self.stats.ticks += 1
         self.stats.queue_depth.append(len(self.waiting))
         self._admit()
@@ -694,36 +764,71 @@ class DecodeEngine:
         return sub
 
     def _prefill_tick(self) -> None:
-        req = min(
+        reqs = sorted(
             (r for r in self._slots
              if r is not None and r.state == PREFILL),
             key=lambda r: r.admit_seq,
-            default=None,
-        )
-        if req is None:
+        )[: self.prefill_batch]
+        if not reqs:
             return
-        lo = req.prefilled
-        chunk = req.prompt[lo:lo + self.prefill_chunk]
-        n_valid = len(chunk)
-        padded = np.zeros((self.prefill_chunk,), np.int32)
-        padded[:n_valid] = chunk
-        self._ensure_blocks(req, lo + n_valid)
-        # The row is copied, not viewed: a still-running overlapped
-        # decode step may alias self._tables host memory (see
-        # _decode_tick), and this tick's growth just mutated it.
-        tok, self._pools = self._prefill(
+        # Block growth first, oldest lane first: _ensure_blocks may
+        # preempt, and PREFILL-state requests are the preferred victims
+        # — a younger lane of this very batch can be evicted to feed an
+        # older one. Survivors are re-collected before the launch is
+        # built (the _decode_tick re-collect discipline).
+        for req in reqs:
+            if req.state != PREFILL:
+                continue
+            n = min(self.prefill_chunk, len(req.prompt) - req.prefilled)
+            self._ensure_blocks(req, req.prefilled + n)
+        reqs = [r for r in reqs if r.state == PREFILL]
+        if not reqs:
+            return
+        pb = self.prefill_batch
+        chunks = np.zeros((pb, self.prefill_chunk), np.int32)
+        starts = np.zeros((pb,), np.int32)
+        n_valid = np.zeros((pb,), np.int32)
+        active = np.zeros((pb,), bool)
+        tables = np.zeros((pb, self.max_blocks_per_seq), np.int32)
+        for lane, req in enumerate(reqs):
+            lo = req.prefilled
+            chunk = req.prompt[lo:lo + self.prefill_chunk]
+            chunks[lane, : len(chunk)] = chunk
+            starts[lane] = lo
+            n_valid[lane] = len(chunk)
+            active[lane] = True
+            # Rows are copied out of self._tables (fresh arrays, not
+            # views): a still-running overlapped decode step may alias
+            # that host memory, and this tick's growth just mutated it.
+            # Idle lanes keep all-zero rows + active=False — sentinel
+            # block 0 is read-but-masked, never written.
+            tables[lane] = self._tables[req.slot]
+        toks_dev, self._pools = self._prefill(
             self.params, self._pools,
-            jnp.asarray(self._tables[req.slot].copy()),
-            jnp.asarray(np.int32(lo)),
-            jnp.asarray(np.int32(n_valid)),
-            jnp.asarray(padded),
-            self._next_key(),
+            jnp.asarray(tables), jnp.asarray(starts),
+            jnp.asarray(n_valid), jnp.asarray(active),
+            jnp.asarray(chunks), self._next_key(),
         )
-        self.stats.prefill_chunks += 1
-        self.stats.prefill_tokens += n_valid
-        req.prefilled = lo + n_valid
-        self._lengths[req.slot] = req.prefilled
-        if req.prefilled == len(req.prompt):
+        # Fetch tokens only when some lane finishes its prompt this
+        # launch (host-predictable): a mid-prompt chunk stays fully
+        # async — no device round-trip per tick of a long prefill.
+        toks = (
+            np.asarray(toks_dev)
+            if any(int(starts[i]) + int(n_valid[i]) == len(r.prompt)
+                   for i, r in enumerate(reqs))
+            else None
+        )
+        st = self.stats
+        st.prefill_lanes_used += len(reqs)
+        st.prefill_lanes_launched += pb
+        for lane, req in enumerate(reqs):
+            nv = int(n_valid[lane])
+            st.prefill_chunks += 1
+            st.prefill_tokens += nv
+            req.prefilled = int(starts[lane]) + nv
+            self._lengths[req.slot] = req.prefilled
+            if req.prefilled != len(req.prompt):
+                continue
             if self.prefix_cache is not None:
                 # Promote the prompt's full blocks right away so
                 # concurrent same-prefix requests share them without
@@ -732,13 +837,13 @@ class DecodeEngine:
                 self.prefix_cache.insert(req.prompt, req.blocks)
             # The last prompt logits sample the first generated token.
             now = self._clock()
-            first = int(tok)
+            first = int(toks[lane])
             req.state = RUNNING
             req.first_token_at = now
             req.generated.append(first)
             req.pending = first
-            self.stats.tokens_generated += 1
-            self.stats.ttft_s.append(now - req.arrived_at)
+            st.tokens_generated += 1
+            st.ttft_s.append(now - req.arrived_at)
             self._slot_last_token_t[req.slot] = now
             if self._is_final(req, first):
                 self._complete(req, req.slot)

@@ -7,6 +7,7 @@ from .attention import (
     flash_attention,
     paged_attention_reference,
     paged_decode_attention,
+    paged_prefill_attention,
 )
 from .norms import rmsnorm, rmsnorm_reference
 from .rotary import apply_rope, rope_frequencies
@@ -17,6 +18,7 @@ __all__ = [
     "attention_reference",
     "paged_attention_reference",
     "paged_decode_attention",
+    "paged_prefill_attention",
     "rmsnorm",
     "rmsnorm_reference",
     "apply_rope",

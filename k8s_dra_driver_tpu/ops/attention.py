@@ -546,9 +546,17 @@ _BWD_BLOCK_K = int(os.environ.get("TPU_DRA_ATTN_BWD_BLOCK_K", "0"))
 
 
 def set_attention_impl(impl: str) -> None:
-    """Select the attention backend: "auto" | "pallas" | "xla"."""
+    """Select the attention backend: "auto" | "pallas" | "xla" |
+    "interpret".
+
+    "interpret" forces the PAGED kernels (decode + prefill — the serving
+    hot paths) through the Pallas interpreter even off-TPU, so CPU CI
+    can drive the fused code path end to end (kernel-vs-reference token
+    parity through the engine and the speculative verify pass); the
+    dense flash kernels keep their own interpret coverage in
+    tests/test_ops.py and are unaffected."""
     global _ATTN_IMPL
-    assert impl in ("auto", "pallas", "xla"), impl
+    assert impl in ("auto", "pallas", "xla", "interpret"), impl
     _ATTN_IMPL = impl
 
 
@@ -571,6 +579,17 @@ def attention_impl_label() -> str:
     public so benchmarks don't reach into module privates."""
     on_tpu = jax.default_backend() == "tpu"
     return "pallas" if on_tpu and _ATTN_IMPL != "xla" else "xla"
+
+
+def _paged_pallas_dispatch(force_pallas: bool = False) -> bool:
+    """THE predicate for the paged kernels' pallas-vs-reference choice —
+    one copy shared by both dispatchers and the bench-facing label, so
+    what the label reports can never drift from what actually ran:
+    pallas on TPU unless overridden to "xla"; everywhere under the
+    "interpret" override (Pallas interpreter, the CPU-CI hook)."""
+    return force_pallas or _ATTN_IMPL == "interpret" or (
+        jax.default_backend() == "tpu" and _ATTN_IMPL != "xla"
+    )
 
 
 def attention_blocks() -> tuple[int, int, int, int]:
@@ -723,6 +742,211 @@ def _paged_decode_pallas(
     return out.reshape(b, hq, d)
 
 
+# ---------------------------------------------------------------------------
+# Paged prefill attention: multi-token query windows against the same
+# paged KV pool.
+#
+# The prefill/verify hot path: each sequence contributes a contiguous
+# window of T query tokens starting at its absolute position `start`
+# (chunked prefill advances `start` chunk by chunk; speculative decoding
+# verifies k+1 proposals in one window). The kernel extends the decode
+# kernel with a query-block grid dimension — grid (batch, kv-head,
+# q-block, kv-block) with the kv-block dim innermost so the online-
+# softmax accumulators persist in VMEM across pool blocks — and reuses
+# its whole epilogue: block tables and per-sequence starts ride in as
+# scalar-prefetch operands, softmax runs in base 2, GQA query heads
+# share one [BQ*G, D] accumulator per kv head, and int8 pools fold their
+# per-position scales exactly as in decode (k's into the scores, v's
+# into the probabilities).
+#
+# Causal masking is *within the chunk against absolute positions*: kv
+# rows at pool positions <= start + i are visible to query i. Blocks
+# wholly below the window's first query are full (no mask work); blocks
+# straddling the diagonal run the iota+select; blocks past the last
+# query are skipped entirely (their prefetch DMA reads sentinel block 0,
+# whose values never enter the accumulators — the decode kernel's
+# discipline).
+# ---------------------------------------------------------------------------
+
+
+def _paged_prefill_kernel(
+    tables_ref, start_ref,           # scalar prefetch
+    q_ref, k_ref, v_ref, *rest,
+    scale: float, block_size: int, quantized: bool, t: int, g: int,
+    block_q: int,
+):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
+    b = pl.program_id(0)
+    iq = pl.program_id(2)
+    j = pl.program_id(3)
+    nj = pl.num_programs(3)
+    start = start_ref[b]
+    rows = block_q * g
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _step(masked):
+        # bf16 (or int8-upcast) into the dots, f32 out — the decode
+        # kernel's dtype discipline, shared with _flash_kernel.
+        q = q_ref[0, 0]                              # [BQ*G, D]
+        k = k_ref[0].astype(q.dtype)                 # [Bs, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * (scale * LOG2E)                          # [BQ*G, Bs] base-2
+        if quantized:
+            # k's per-position scale is constant over the contracted D
+            # axis: multiplying the finished scores is exact.
+            s = s * ks_ref[0][None, :]
+        if masked:
+            # Query layout is [T, G] flattened: row f is query token
+            # iq*block_q + f // g at absolute position start + that.
+            qpos = start + iq * block_q + (
+                jax.lax.broadcasted_iota(jnp.int32, (rows, block_size), 0)
+                // g
+            )
+            kpos = j * block_size + jax.lax.broadcasted_iota(
+                jnp.int32, (rows, block_size), 1
+            )
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp2(s - m_new)                      # [BQ*G, Bs]
+        corr = jnp.exp2(m_prev - m_new)
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        if quantized:
+            # v's scale varies over the contraction axis: fold it into
+            # the probabilities (exact), contract against raw int8.
+            p = p * vs_ref[0][None, :]
+            v = v_ref[0].astype(jnp.float32)
+            pv = p
+        else:
+            v = v_ref[0]
+            pv = p.astype(v.dtype)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            pv, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = m_new
+
+    # Three kv-block classes against THIS q-block's absolute window
+    # [start + iq*BQ, start + iq*BQ + BQ - 1] (the flash kernel's
+    # full/straddle/skip split, shifted by the per-sequence start):
+    first_q = start + iq * block_q
+    last_q = first_q + block_q - 1
+    last_k = j * block_size + block_size - 1
+    full = last_k <= first_q
+    straddle = jnp.logical_and(j * block_size <= last_q,
+                               jnp.logical_not(full))
+
+    @pl.when(full)
+    def _full():
+        _step(masked=False)
+
+    @pl.when(straddle)
+    def _straddle():
+        _step(masked=True)
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def _prefill_q_block(t: int, want: int = 128) -> int:
+    """Query-block width for a T-token window: whole-chunk for small T,
+    else the largest divisor of T no larger than ``want`` (chunks are
+    almost always powers of two; odd widths — speculative's k+1 — stay
+    a single block)."""
+    if t <= want:
+        return t
+    for width in range(want, 7, -1):
+        if t % width == 0:
+            return width
+    return t
+
+
+def _paged_prefill_pallas(
+    q: jax.Array,              # [B, Hq, T, D] contiguous query windows
+    k_pool: jax.Array,         # [H_kv, P, D]
+    v_pool: jax.Array,
+    block_tables: jax.Array,   # [B, NBPS] int32
+    start: jax.Array,          # [B] absolute position of each window's
+                               # first query
+    scale: float,
+    block_size: int,
+    k_scale: jax.Array | None = None,   # [H_kv, P] f32
+    v_scale: jax.Array | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    b, hq, t, d = q.shape
+    hkv = k_pool.shape[0]
+    assert hq % hkv == 0, (hq, hkv)
+    g = hq // hkv
+    nbps = block_tables.shape[1]
+    quantized = k_scale is not None
+    block_q = _prefill_q_block(t)
+    nq = t // block_q
+    # [B, Hq, T, D] -> [B, H_kv, T*G, D] with the [T, G] order flat:
+    # query block iq owns the CONTIGUOUS rows [iq*BQ*G, (iq+1)*BQ*G) —
+    # what makes the q BlockSpec a plain slice.
+    qr = q.reshape(b, hkv, g, t, d).transpose(0, 1, 3, 2, 4).reshape(
+        b, hkv, t * g, d
+    )
+    rows = block_q * g
+    kernel = functools.partial(
+        _paged_prefill_kernel,
+        scale=scale, block_size=block_size, quantized=quantized,
+        t=t, g=g, block_q=block_q,
+    )
+    kv_spec = pl.BlockSpec(
+        (1, block_size, d), lambda b_, h, iq, j, tab, st: (h, tab[b_, j], 0)
+    )
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1, rows, d), lambda b_, h, iq, j, tab, st: (b_, h, iq, 0)
+        ),
+        kv_spec,
+        kv_spec,
+    ]
+    operands = [qr, k_pool, v_pool]
+    if quantized:
+        sc_spec = pl.BlockSpec(
+            (1, block_size), lambda b_, h, iq, j, tab, st: (h, tab[b_, j])
+        )
+        in_specs += [sc_spec, sc_spec]
+        operands += [k_scale, v_scale]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hkv, nq, nbps),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, 1, rows, d), lambda b_, h, iq, j, tab, st: (b_, h, iq, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((rows, 1), jnp.float32),    # running max m
+            pltpu.VMEM((rows, 1), jnp.float32),    # running denom l
+            pltpu.VMEM((rows, d), jnp.float32),    # output accumulator
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, t * g, d), q.dtype),
+        interpret=interpret,
+    )(block_tables, start, *operands)
+    return out.reshape(b, hkv, t, g, d).transpose(0, 1, 3, 2, 4).reshape(
+        b, hq, t, d
+    )
+
+
 def paged_attention_reference(
     q: jax.Array,              # [B, Hq, T, D]
     k_pool: jax.Array,         # [H_kv, P, D] (bf16/f32, or int8 + scales)
@@ -736,9 +960,10 @@ def paged_attention_reference(
 ) -> jax.Array:
     """Plain-XLA paged attention: gather each sequence's window from the
     pool through its block table, then grouped-GQA masked attention.
-    Handles any query width T (prefill chunks use T>1; the Pallas kernel
-    covers only the T=1 decode shape). The numerics oracle for the
-    kernel in tests/test_ops.py."""
+    Handles any query width T and arbitrary ``positions`` layouts (the
+    fused kernels specialize: T=1 decode, contiguous T>1 windows for
+    prefill/verify). The numerics oracle for both kernels in
+    tests/test_ops.py, and the CPU fallback behind their dispatchers."""
     # Inside the function: models imports ops at package init, so a
     # module-level import here would be circular.
     from ..models.paged import gather_indices
@@ -800,7 +1025,7 @@ def paged_decode_attention(
     d = q.shape[-1]
     scale = scale if scale is not None else d ** -0.5
     on_tpu = jax.default_backend() == "tpu"
-    if force_pallas or (on_tpu and _ATTN_IMPL != "xla"):
+    if _paged_pallas_dispatch(force_pallas):
         return _paged_decode_pallas(
             q, k_pool, v_pool, block_tables, valid_len, scale, block_size,
             k_scale=k_scale, v_scale=v_scale,
@@ -812,6 +1037,57 @@ def paged_decode_attention(
         k_scale=k_scale, v_scale=v_scale,
     )
     return out[:, :, 0, :]
+
+
+def paged_prefill_impl_label() -> str:
+    """What ``paged_prefill_attention`` will actually dispatch on this
+    backend — public so benches record the verify/prefill impl they
+    measured (fused kernel vs gather reference)."""
+    return "pallas" if _paged_pallas_dispatch() else "xla"
+
+
+def paged_prefill_attention(
+    q: jax.Array,              # [B, Hq, T, D] — T-token query windows
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    block_tables: jax.Array,
+    positions: jax.Array,      # [B, T] absolute query positions
+    block_size: int,
+    scale: float | None = None,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
+    force_pallas: bool = False,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused multi-token paged attention with XLA fallback — the prefill
+    chunk / speculative-verify dispatcher (``set_attention_impl``
+    contract shared with :func:`paged_decode_attention`).
+
+    The kernel path requires each row of ``positions`` to be a
+    CONTIGUOUS ascending window ``start + arange(T)`` — every T>1
+    caller's shape (chunked prefill, the verify chunk, the COW
+    recompute); only ``positions[:, 0]`` reaches the kernel. Right-
+    padded tails (the caller's ``n_valid`` masking) are fine: a padded
+    query's output is garbage-but-finite in both paths and the caller
+    discards it — its KV writes were already dropped *before* attention
+    ran, and the kernel's per-row causal mask keeps every VALID query's
+    visible set exact regardless of what the padded rows pull in. The
+    gather reference remains the numerics oracle and takes the full
+    ``positions`` array (it handles arbitrary layouts)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else d ** -0.5
+    on_tpu = jax.default_backend() == "tpu"
+    if _paged_pallas_dispatch(force_pallas):
+        return _paged_prefill_pallas(
+            q, k_pool, v_pool, block_tables,
+            positions[:, 0].astype(jnp.int32), scale, block_size,
+            k_scale=k_scale, v_scale=v_scale,
+            interpret=interpret or not on_tpu,
+        )
+    return paged_attention_reference(
+        q, k_pool, v_pool, block_tables, positions, block_size, scale,
+        k_scale=k_scale, v_scale=v_scale,
+    )
 
 
 def flash_attention(

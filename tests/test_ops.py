@@ -14,6 +14,7 @@ from k8s_dra_driver_tpu.ops import (
     flash_attention,
     paged_attention_reference,
     paged_decode_attention,
+    paged_prefill_attention,
     rmsnorm,
     rmsnorm_reference,
     rope_frequencies,
@@ -155,6 +156,200 @@ class TestPagedDecodeAttention:
         np.testing.assert_allclose(
             out[0].reshape(2, 4, 32), want, atol=1e-5, rtol=1e-5
         )
+
+
+class TestPagedPrefillAttention:
+    """Fused paged prefill kernel (multi-token query windows) vs the
+    gather-based XLA reference, in interpret mode on CPU — the same
+    code path the TPU compiles. The reference is pinned against dense
+    attention above, so the chain reaches the dense oracle."""
+
+    def _setup(self, b=3, hq=8, hkv=2, d=32, bs=16, nb=14, nbps=4, t=12,
+               seed=0, dtype=jnp.float32,
+               starts=(0, 7, 37)):
+        rng = np.random.RandomState(seed)
+        q = jnp.asarray(rng.randn(b, hq, t, d), dtype)
+        k_pool = jnp.asarray(rng.randn(hkv, nb * bs, d), dtype)
+        v_pool = jnp.asarray(rng.randn(hkv, nb * bs, d), dtype)
+        tables = jnp.asarray(
+            rng.permutation(nb)[: b * nbps].reshape(b, nbps), jnp.int32
+        )
+        positions = (
+            jnp.asarray(starts, jnp.int32)[:b, None]
+            + jnp.arange(t, dtype=jnp.int32)[None, :]
+        )
+        return q, k_pool, v_pool, tables, positions, bs
+
+    def test_kernel_matches_reference(self):
+        """Absolute positions > 0 and a window straddling a block
+        boundary mid-chunk (start=7 with bs=16): the ragged serving
+        shapes."""
+        q, k_pool, v_pool, tables, positions, bs = self._setup()
+        out = paged_prefill_attention(
+            q, k_pool, v_pool, tables, positions, bs,
+            force_pallas=True, interpret=True,
+        )
+        ref = paged_attention_reference(
+            q, k_pool, v_pool, tables, positions, bs,
+        )
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_full_chunk_from_zero_and_single_token(self):
+        """The n_valid extremes as the kernel sees them: a full chunk
+        starting at position 0 (fresh prompt) and a T=1 window (one
+        remaining token), both against the reference."""
+        for t, starts in ((16, (0, 0, 0)), (1, (0, 9, 30))):
+            q, k_pool, v_pool, tables, positions, bs = self._setup(
+                t=t, starts=starts,
+            )
+            out = paged_prefill_attention(
+                q, k_pool, v_pool, tables, positions, bs,
+                force_pallas=True, interpret=True,
+            )
+            ref = paged_attention_reference(
+                q, k_pool, v_pool, tables, positions, bs,
+            )
+            np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_gqa_grouping(self):
+        """8 query heads on 2 kv heads: the GQA-native accumulator must
+        match the reference's grouped einsum."""
+        q, k_pool, v_pool, tables, positions, bs = self._setup(
+            hq=8, hkv=2, seed=3,
+        )
+        out = paged_prefill_attention(
+            q, k_pool, v_pool, tables, positions, bs,
+            force_pallas=True, interpret=True,
+        )
+        ref = paged_attention_reference(
+            q, k_pool, v_pool, tables, positions, bs,
+        )
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_quantized_pools(self):
+        """int8 pools with per-position scales: k's folds into the
+        scores, v's into the probabilities — the decode kernel's exact
+        epilogue at T>1."""
+        q, _, _, tables, positions, bs = self._setup()
+        hkv, d, p = 2, 32, 14 * 16
+        rng = np.random.RandomState(7)
+        k_pool = jnp.asarray(
+            rng.randint(-127, 128, size=(hkv, p, d)), jnp.int8
+        )
+        v_pool = jnp.asarray(
+            rng.randint(-127, 128, size=(hkv, p, d)), jnp.int8
+        )
+        k_scale = jnp.asarray(rng.rand(hkv, p) * 0.02 + 0.001, jnp.float32)
+        v_scale = jnp.asarray(rng.rand(hkv, p) * 0.02 + 0.001, jnp.float32)
+        out = paged_prefill_attention(
+            q, k_pool, v_pool, tables, positions, bs,
+            k_scale=k_scale, v_scale=v_scale,
+            force_pallas=True, interpret=True,
+        )
+        ref = paged_attention_reference(
+            q, k_pool, v_pool, tables, positions, bs,
+            k_scale=k_scale, v_scale=v_scale,
+        )
+        np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
+
+    def test_bf16_runs(self):
+        q, k_pool, v_pool, tables, positions, bs = self._setup(
+            dtype=jnp.bfloat16
+        )
+        out = paged_prefill_attention(
+            q, k_pool, v_pool, tables, positions, bs,
+            force_pallas=True, interpret=True,
+        )
+        ref = paged_attention_reference(
+            q, k_pool, v_pool, tables, positions, bs,
+        )
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            out.astype(np.float32), ref.astype(np.float32),
+            atol=3e-2, rtol=3e-2,
+        )
+
+    def test_multiple_query_blocks(self):
+        """T=256 splits into two 128-wide query blocks: the q-block grid
+        dimension's accumulator re-init and per-block causal classes."""
+        b, hkv, d, bs, nbps = 2, 2, 16, 32, 10
+        nb = b * nbps
+        rng = np.random.RandomState(11)
+        q = jnp.asarray(rng.randn(b, 4, 256, d), jnp.float32)
+        k_pool = jnp.asarray(rng.randn(hkv, nb * bs, d), jnp.float32)
+        v_pool = jnp.asarray(rng.randn(hkv, nb * bs, d), jnp.float32)
+        tables = jnp.asarray(
+            rng.permutation(nb).reshape(b, nbps), jnp.int32
+        )
+        positions = (
+            jnp.asarray([0, 17], jnp.int32)[:, None]
+            + jnp.arange(256, dtype=jnp.int32)[None, :]
+        )
+        out = paged_prefill_attention(
+            q, k_pool, v_pool, tables, positions, bs,
+            force_pallas=True, interpret=True,
+        )
+        ref = paged_attention_reference(
+            q, k_pool, v_pool, tables, positions, bs,
+        )
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_causal_at_absolute_positions(self):
+        """Garbage written at pool positions ABOVE every query's
+        absolute position must not change the output: the causal mask
+        is against absolute positions, not chunk-relative ones."""
+        q, k_pool, v_pool, tables, positions, bs = self._setup(
+            b=2, starts=(5, 21),
+        )
+        out = paged_prefill_attention(
+            q, k_pool, v_pool, tables, positions, bs,
+            force_pallas=True, interpret=True,
+        )
+        # Poison each sequence's pool rows past its last visible
+        # position (start + t - 1).
+        k_np = np.array(k_pool)
+        v_np = np.array(v_pool)
+        t = q.shape[2]
+        for i in range(2):
+            last = int(positions[i, 0]) + t - 1
+            for j in range(tables.shape[1]):
+                blk = int(tables[i, j])
+                for r in range(bs):
+                    if j * bs + r > last:
+                        k_np[:, blk * bs + r] = 1e4
+                        v_np[:, blk * bs + r] = -1e4
+        poisoned = paged_prefill_attention(
+            q, jnp.asarray(k_np), jnp.asarray(v_np), tables, positions,
+            bs, force_pallas=True, interpret=True,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out), np.asarray(poisoned)
+        )
+
+    def test_interpret_impl_override_routes_to_kernel(self):
+        """set_attention_impl("interpret") forces the fused paged paths
+        through the Pallas interpreter off-TPU — the CPU-CI hook the
+        engine-level fused-parity tests ride."""
+        from k8s_dra_driver_tpu.ops.attention import (
+            paged_prefill_impl_label,
+            set_attention_impl,
+        )
+
+        q, k_pool, v_pool, tables, positions, bs = self._setup()
+        try:
+            set_attention_impl("xla")
+            assert paged_prefill_impl_label() == "xla"
+            ref = paged_prefill_attention(
+                q, k_pool, v_pool, tables, positions, bs,
+            )
+            set_attention_impl("interpret")
+            assert paged_prefill_impl_label() == "pallas"
+            out = paged_prefill_attention(
+                q, k_pool, v_pool, tables, positions, bs,
+            )
+        finally:
+            set_attention_impl("auto")
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
 
 class TestFlashAttention:

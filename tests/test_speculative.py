@@ -126,6 +126,84 @@ class TestSpeculative:
         np.testing.assert_array_equal(np.asarray(out), quant_ref)
 
 
+class TestFusedVerify:
+    """The T=k+1 verify pass through the fused paged prefill kernel
+    (set_attention_impl("interpret") forces the Pallas interpreter on
+    CPU — the code path TPU compiles) must emit the same token stream
+    as the gather-reference path: routing the verify chunk off the slow
+    rail may change speed, never output."""
+
+    def test_fused_verify_matches_reference_tokens(self, target_params,
+                                                   prompt):
+        from k8s_dra_driver_tpu.ops.attention import set_attention_impl
+
+        draft = init_params(CONFIG, jax.random.PRNGKey(99))
+        run = lambda: np.asarray(
+            jax.jit(
+                lambda tp, dp, t: speculative_generate(
+                    tp, dp, t, CONFIG, CONFIG, N, k=3
+                )
+            )(target_params, draft, prompt)
+        )
+        ref = run()
+        try:
+            set_attention_impl("interpret")
+            fused = run()
+        finally:
+            set_attention_impl("auto")
+        np.testing.assert_array_equal(fused, ref)
+
+    def test_fused_verify_matches_reference_tokens_bf16(self,
+                                                        target_params,
+                                                        prompt):
+        """The serving dtype: bf16 weights/activations through the
+        fused verify pass vs the reference path, token-pinned."""
+        import dataclasses
+
+        from k8s_dra_driver_tpu.ops.attention import set_attention_impl
+
+        bf16 = dataclasses.replace(CONFIG, dtype=jnp.bfloat16)
+        params = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16)
+            if x.dtype == jnp.float32 else x,
+            target_params,
+        )
+        draft = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16)
+            if x.dtype == jnp.float32 else x,
+            init_params(CONFIG, jax.random.PRNGKey(99)),
+        )
+        run = lambda: np.asarray(
+            jax.jit(
+                lambda tp, dp, t: speculative_generate(
+                    tp, dp, t, bf16, bf16, N, k=3
+                )
+            )(params, draft, prompt)
+        )
+        ref = run()
+        try:
+            set_attention_impl("interpret")
+            fused = run()
+        finally:
+            set_attention_impl("auto")
+        np.testing.assert_array_equal(fused, ref)
+
+    def test_verify_impl_label(self):
+        """The label the speculative bench records: "xla" on this CPU
+        backend by default, "pallas" under the interpret override."""
+        from k8s_dra_driver_tpu.ops.attention import (
+            paged_prefill_impl_label,
+            set_attention_impl,
+        )
+
+        assert paged_prefill_impl_label() == "xla"
+        try:
+            set_attention_impl("interpret")
+            assert paged_prefill_impl_label() == "pallas"
+        finally:
+            set_attention_impl("auto")
+
+
 class TestSharedPrefixBlocks:
     """Speculative decoding against shared/COW prefix blocks
     (decode.prefill_cached over a shared paged pool): draft and verify

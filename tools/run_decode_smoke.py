@@ -25,6 +25,17 @@ all on the hermetic CPU backend with the tiny preset:
    noisier, so the default here is loose (50%) and exists to catch
    order-of-magnitude pathologies (a recompile per step is >10x). Tune
    with TPU_DRA_DECODE_SMOKE_SPREAD.
+5. **Batched-prefill determinism**: the packed multi-request prefill
+   program (prefill_batch=4) vs the serial one-chunk-per-tick engine
+   (prefill_batch=1) must emit token-for-token identical streams per
+   variant, prefix cache on AND off, with compile_counts still exactly
+   one decode + one prefill program — lane packing may only change WHEN
+   prompts are processed, never what comes out.
+6. **TTFT**: under a burst of concurrent arrivals on a shared virtual
+   tick clock, the batched-prefill engine must improve TTFT p99 by
+   >= 1.5x (tick-normalized — deterministic on CPU) over the serial
+   engine at equal-or-better decode-token p99, with identical token
+   streams. The ISSUE-15 acceptance gate.
 
 Exit 0 = all gates pass; 1 = a gate failed.
 """
@@ -41,12 +52,14 @@ SPREAD_LIMIT = float(os.environ.get("TPU_DRA_DECODE_SMOKE_SPREAD", "0.5"))
 SEED = int(os.environ.get("TPU_DRA_DECODE_SMOKE_SEED", "1234"))
 
 
-def build_engine(params, config, quant_kv):
+def build_engine(params, config, quant_kv, **kw):
     from k8s_dra_driver_tpu.models.serving import DecodeEngine
 
+    kw.setdefault("batch_slots", 2)
+    kw.setdefault("num_blocks", 12)
     return DecodeEngine(
-        params, config, batch_slots=2, num_blocks=12, block_size=8,
-        max_seq_len=48, prefill_chunk=8, quantize_cache=quant_kv,
+        params, config, block_size=8,
+        max_seq_len=48, prefill_chunk=8, quantize_cache=quant_kv, **kw,
     )
 
 
@@ -114,6 +127,36 @@ def main() -> int:
                 f"{label}: prefix-cache path compiled extra programs: "
                 f"{hot_eng.compile_counts}"
             )
+        # Batched-prefill determinism: the packed prefill program
+        # (prefill_batch=4) vs the serial one-chunk-per-tick engine,
+        # prefix cache on AND off — token-for-token identical streams,
+        # compile-once intact. Multi-chunk prompts across 4 slots make
+        # lanes actually pack.
+        wide = [
+            rng2.randint(0, config.vocab_size, size=n).tolist()
+            for rng2 in (np.random.RandomState(SEED + 1),)
+            for n in (5, 19, 11, 23, 7, 13)
+        ]
+        for cache_on in (True, False):
+            pair = {}
+            for pb in (4, 1):
+                e = build_engine(
+                    p, config, qkv, batch_slots=4, num_blocks=26,
+                    prefill_batch=pb, prefix_cache=cache_on,
+                )
+                pair[pb] = drive(e, wide, n_new=12)
+                if dict(e.compile_counts) != {
+                    "decode_step": 1, "prefill_chunk": 1,
+                }:
+                    failures.append(
+                        f"{label}: prefill_batch={pb} cache={cache_on} "
+                        f"compiled extra programs: {e.compile_counts}"
+                    )
+            if pair[4] != pair[1]:
+                failures.append(
+                    f"{label}: batched-prefill tokens diverge from the "
+                    f"serial engine (prefix_cache={cache_on})"
+                )
         # Spread: repeat the drained run on the warm engine (compile paid).
         times = []
         for _ in range(3):
@@ -131,12 +174,65 @@ def main() -> int:
                 f"{label}: repeat spread {rel:.1%} > {SPREAD_LIMIT:.0%}"
             )
 
+    # TTFT gate (ISSUE 15): a burst of concurrent arrivals on a shared
+    # virtual tick clock — the batched-prefill engine must cut TTFT p99
+    # by >= 1.5x (tick-normalized, deterministic) over the serial
+    # engine at equal-or-better decode-token p99, with identical token
+    # streams. bf16, prefix cache off: raw prefill drain is what's
+    # being gated.
+    rng3 = np.random.RandomState(SEED + 2)
+    burst = [
+        rng3.randint(0, config.vocab_size, size=24).tolist()
+        for _ in range(8)
+    ]
+
+    def ttft_run(pb):
+        box = [0.0]
+        e = build_engine(
+            params, config, False, batch_slots=4, num_blocks=18,
+            prefill_batch=pb, prefix_cache=False, clock=lambda: box[0],
+        )
+        reqs = [e.submit(q, max_new_tokens=4) for q in burst]
+        while not e.idle:
+            e.tick()
+            box[0] += 1.0
+        e.assert_no_leaks()
+        s = e.stats
+        return (
+            [tuple(r.tokens) for r in reqs],
+            s.pctl(s.ttft_s, 0.99),
+            s.pctl(s.token_interval_s, 0.99),
+            dict(e.compile_counts),
+        )
+
+    toks_b, ttft_b, tok_p99_b, counts_b = ttft_run(4)
+    toks_s, ttft_s, tok_p99_s, counts_s = ttft_run(1)
+    speedup = ttft_s / max(ttft_b, 1e-9)
+    print(f"decodebench ttft: p99 {ttft_b:.0f} ticks batched vs "
+          f"{ttft_s:.0f} serial ({speedup:.2f}x, gate >= 1.5x), "
+          f"decode p99 {tok_p99_b:.0f} vs {tok_p99_s:.0f} ticks")
+    if toks_b != toks_s:
+        failures.append("ttft: batched vs serial token streams diverge")
+    if speedup < 1.5:
+        failures.append(
+            f"ttft: tick-normalized p99 speedup {speedup:.2f}x < 1.5x"
+        )
+    if tok_p99_b > tok_p99_s:
+        failures.append(
+            f"ttft: batched decode-token p99 {tok_p99_b} ticks worse "
+            f"than serial {tok_p99_s}"
+        )
+    for nm, c in (("batched", counts_b), ("serial", counts_s)):
+        if c != {"decode_step": 1, "prefill_chunk": 1}:
+            failures.append(f"ttft: {nm} engine compile counts {c} != 1/1")
+
     if failures:
         for f in failures:
             print(f"FAIL: {f}", file=sys.stderr)
         return 1
-    print("decodebench: all variants compile once, deterministic, "
-          "spread within limit")
+    print("decodebench: all variants compile once, deterministic "
+          "(incl. batched prefill), ttft gate passed, spread within "
+          "limit")
     return 0
 
 
