@@ -2,7 +2,7 @@
 IMAGE ?= tpu-dra-driver
 TAG ?= latest
 
-.PHONY: all native test image lint verify verify-metrics chaos chaos-slow doctor decodebench moebench elastic allocbench allocbench-smoke gatewaybench clean e2e-kind
+.PHONY: all native test image lint verify verify-metrics chaos chaos-slow doctor decodebench moebench elastic allocbench allocbench-smoke gatewaybench tracesmoke clean e2e-kind
 
 all: native
 
@@ -90,11 +90,20 @@ allocbench-smoke:
 gatewaybench:
 	python tools/run_gateway_smoke.py
 
+# Request-observability overhead smoke (tools/run_trace_smoke.py): the
+# same fixed-seed serving profile with telemetry OFF vs ON — token
+# streams, tick counts (the deterministic "within 3% req/s" enforcement)
+# and compile-once must be identical, every submission must seal a
+# timeline, and best-of-N wall clock must stay inside the
+# TPU_DRA_TRACE_SMOKE_OVERHEAD tripwire (loose on CPU; 3% on TPU).
+tracesmoke:
+	python tools/run_trace_smoke.py
+
 # The full local gate: lint + unit/integration tests + chaos schedules +
 # metrics exposition + the doctor/auditor drill + the decode-engine,
-# MoE fast-path, elastic-training, allocator-bench, and fleet-gateway
-# smokes. What CI runs; what a PR must pass.
-verify: lint test chaos verify-metrics doctor decodebench moebench elastic allocbench-smoke gatewaybench
+# MoE fast-path, elastic-training, allocator-bench, fleet-gateway, and
+# request-observability smokes. What CI runs; what a PR must pass.
+verify: lint test chaos verify-metrics doctor decodebench moebench elastic allocbench-smoke gatewaybench tracesmoke
 
 # ruff when available (CI installs it; .golangci.yaml analog is
 # [tool.ruff] in pyproject.toml), else the first-party AST lint floor.

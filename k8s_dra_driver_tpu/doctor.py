@@ -35,7 +35,15 @@ ResourceClaims; then it re-runs the audit cross-checks FLEET-wide:
   closed loop is broken right now — an old failure a later attempt
   recovered from is not), an overloaded fleet (queue depth past the
   shed watermark) is informational with the playbook pointer, and the
-  snapshot is bundled as ``gateway.json``.
+  snapshot is bundled as ``gateway.json``;
+- request-level SLO trouble surfaced by ``/debug/requests`` (the
+  ``slo-exemplar`` check): a latency class with sustained violations
+  in its ``?view=slo`` summary is drift, pointing at the slowest
+  captured violation exemplar's dominant timeline phase and the
+  matching "why was this request slow?" runbook row in
+  docs/operations.md; timelines, exemplars, and the summary are
+  bundled as ``requests.json``. A 404 is benign (request tracing is
+  opt-in); any other failure is a loud collect error.
 
 ``--bundle`` additionally writes a tar of every raw document (metrics,
 usage JSON, traces JSONL, readyz, cluster objects, findings) for
@@ -65,6 +73,11 @@ logger = logging.getLogger(__name__)
 SEVERITY_DRIFT = "drift"
 SEVERITY_INFO = "info"
 SEVERITY_ERROR = "error"
+
+# A latency class with at least this many SLO violations in a node's
+# /debug/requests?view=slo summary is "sustained" — one-off stragglers
+# stay out of the findings, a pattern gets the slo-exemplar diagnosis.
+SLO_SUSTAINED_VIOLATIONS = 3
 
 
 @dataclasses.dataclass(frozen=True)
@@ -152,6 +165,9 @@ class NodeScrape:
     defrag: Optional[dict] = None
     rebalance: Optional[dict] = None
     gateway: Optional[dict] = None
+    requests_text: str = ""
+    slo_summary: Optional[dict] = None
+    exemplars: list = dataclasses.field(default_factory=list)
     errors: list = dataclasses.field(default_factory=list)
 
     @property
@@ -178,6 +194,23 @@ class NodeScrape:
                 continue
             if isinstance(rec, dict):
                 out.append(rec)
+        return out
+
+    @property
+    def timelines(self) -> list[dict]:
+        """Sealed request timelines from /debug/requests (oldest
+        first), undecodable lines skipped — same degrade-don't-abort
+        contract as ``allocations``."""
+        out = []
+        for line in self.requests_text.splitlines():
+            if not line.strip():
+                continue
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(doc, dict):
+                out.append(doc)
         return out
 
     @property
@@ -265,6 +298,32 @@ def collect_node(name: str, url: str, timeout: float = 5.0) -> NodeScrape:
         # frontends, so a 404 is a normal node plugin.
         if getattr(e, "code", None) != 404:
             scrape.errors.append(f"/debug/gateway: {e}")
+    try:
+        scrape.requests_text = _fetch(
+            scrape.url + "/debug/requests", timeout
+        )
+    except Exception as e:
+        # 404 = request tracing is simply not enabled on this process
+        # (telemetry is opt-in) — benign. Any other failure is loud.
+        if getattr(e, "code", None) != 404:
+            scrape.errors.append(f"/debug/requests: {e}")
+    else:
+        # Tracing IS enabled here, so the summary/exemplar views must
+        # answer — their failure is always a collect error.
+        for path, view in (("/debug/requests?view=slo", "slo"),
+                           ("/debug/requests?view=exemplars",
+                            "exemplars")):
+            try:
+                body = _fetch(scrape.url + path, timeout)
+                if view == "slo":
+                    scrape.slo_summary = json.loads(body)
+                else:
+                    scrape.exemplars = [
+                        json.loads(ln)
+                        for ln in body.splitlines() if ln.strip()
+                    ]
+            except Exception as e:
+                scrape.errors.append(f"{path}: {e}")
     reported = (scrape.usage or {}).get("node")
     if reported and reported != name:
         scrape.errors.append(
@@ -402,6 +461,50 @@ def fleet_findings(
                     "rejected with retry-after) — see the "
                     "overloaded-fleet playbook in docs/operations.md",
                 ))
+        # Request-level SLO trouble (/debug/requests?view=slo): a class
+        # with sustained violations gets a finding that already answers
+        # "why was this request slow?" — the slowest captured exemplar's
+        # dominant timeline phase maps to one operations-playbook row.
+        for cls, stats in sorted(
+            ((node.slo_summary or {}).get("classes") or {}).items()
+        ):
+            if not isinstance(stats, dict):
+                continue
+            violations = stats.get("violations") or 0
+            if violations < SLO_SUSTAINED_VIOLATIONS:
+                continue
+            slowest = None
+            for ex in node.exemplars:
+                if not isinstance(ex, dict) \
+                        or ex.get("latencyClass") != cls:
+                    continue
+                if slowest is None or (ex.get("observedS") or 0) \
+                        > (slowest.get("observedS") or 0):
+                    slowest = ex
+            detail = (
+                f"{int(violations)} {cls} SLO violation(s) "
+                f"(e2e p99 {stats.get('e2eP99S', '?')}s, "
+                f"ttft p99 {stats.get('ttftP99S', '?')}s)"
+            )
+            if slowest is not None:
+                detail += (
+                    f"; slowest exemplar missed its {slowest.get('signal')}"
+                    f" budget ({slowest.get('observedS')}s observed vs "
+                    f"{slowest.get('thresholdS')}s allowed, trace "
+                    f"{slowest.get('traceId') or '?'}) with dominant "
+                    f"phase {slowest.get('dominantPhase')!r} — see that "
+                    "phase's row in the \"why was this request slow?\" "
+                    "runbook in docs/operations.md"
+                )
+            else:
+                detail += (
+                    " — no exemplar captured yet; scrape "
+                    "/debug/requests?view=exemplars after the next onset"
+                )
+            findings.append(DoctorFinding(
+                SEVERITY_DRIFT, "slo-exemplar",
+                f"{node.name}/{cls}", detail,
+            ))
 
     claims_by_uid = {
         (c.get("metadata") or {}).get("uid", ""): c
@@ -735,6 +838,12 @@ def write_bundle(
             if node.gateway is not None:
                 add(tar, f"{base}/gateway.json",
                     json.dumps(node.gateway, indent=2, sort_keys=True))
+            if node.requests_text or node.slo_summary is not None:
+                add(tar, f"{base}/requests.json", json.dumps({
+                    "slo": node.slo_summary,
+                    "exemplars": node.exemplars,
+                    "timelines": node.timelines,
+                }, indent=2, sort_keys=True))
             if node.errors:
                 add(tar, f"{base}/errors.txt", "\n".join(node.errors) + "\n")
         if cluster is not None:

@@ -81,6 +81,7 @@ Scheduling policy (host-side, deliberately simple and auditable):
 from __future__ import annotations
 
 import dataclasses
+import logging
 import time
 from collections import deque
 from typing import Optional
@@ -104,6 +105,8 @@ PREFILL = "prefill"
 RUNNING = "running"
 DRAINING = "draining"   # finished, but a dispatched step still uses its blocks
 FINISHED = "finished"
+
+logger = logging.getLogger(__name__)
 
 
 class AdmissionClosedError(RuntimeError):
@@ -132,6 +135,11 @@ class Request:
     arrived_at: float = 0.0
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
+    # Optional per-request event log (serving_gateway/reqtrace.py
+    # RequestTimeline or anything with ``.event(name, t, **attrs)``),
+    # attached by the fleet gateway after submit. None (the default)
+    # keeps every engine hot path on a single attribute check.
+    timeline: Optional[object] = None
 
     @property
     def done(self) -> bool:
@@ -360,6 +368,10 @@ class DecodeEngine:
         self._rid = 0
         self._admit_seq = 0
         self._admission_open = True
+        # Optional tick-phase profiler (serving_gateway/reqtrace.py
+        # TickProfiler), attached via set_profiler; None = untimed ticks.
+        self._profiler = None
+        self._profile_tag = ""
         self._rng = jax.random.PRNGKey(0)
         # Double-buffer state: (on-device [B] next-token array, [(req,
         # slot), ...] it was dispatched for). At most one step in flight.
@@ -540,6 +552,15 @@ class DecodeEngine:
             self.tick()
         raise RuntimeError(f"drain not complete after {max_ticks} ticks")
 
+    def set_profiler(self, profiler, tag: str = "") -> None:
+        """Attach a tick-phase profiler (duck-typed
+        ``serving_gateway/reqtrace.TickProfiler``: ``phase(component,
+        name)`` context managers + ``end_tick``). ``tag`` labels this
+        engine's per-tick ring entries (e.g. the gateway replica id)
+        without adding metric-label cardinality. ``None`` detaches."""
+        self._profiler = profiler
+        self._profile_tag = tag
+
     def tick(self) -> None:
         """One scheduling round: admit, advance up to ``prefill_batch``
         requests' prefill chunks in one packed launch, then dispatch one
@@ -547,9 +568,23 @@ class DecodeEngine:
         step's tokens while the new one runs on device)."""
         self.stats.ticks += 1
         self.stats.queue_depth.append(len(self.waiting))
-        self._admit()
-        self._prefill_tick()
-        self._decode_tick()
+        prof = self._profiler
+        if prof is None:
+            self._admit()
+            self._prefill_tick()
+            self._decode_tick()
+            return
+        # Phase decomposition: admit (incl. prefix-cache ops), packed
+        # prefill launch, decode dispatch; _consume records the host
+        # harvest as its own nested phase, whose time the profiler
+        # subtracts from decode — the four phases partition the tick.
+        with prof.phase("engine", "admit"):
+            self._admit()
+        with prof.phase("engine", "prefill"):
+            self._prefill_tick()
+        with prof.phase("engine", "decode"):
+            self._decode_tick()
+        prof.end_tick("engine", self.stats.ticks, tag=self._profile_tag)
 
     def run(self, max_ticks: int = 100000) -> None:
         """Drive ticks until every submitted request has finished."""
@@ -660,6 +695,13 @@ class DecodeEngine:
             req.cached_tokens = req.prefilled
             st.cow_recomputes += int(cow)
             self._lengths[free_slot] = req.prefilled
+            if req.timeline is not None:
+                req.timeline.event(
+                    "engine-admit", self._clock(), slot=free_slot,
+                    cachedTokens=req.cached_tokens,
+                    cachedBlocks=len(hit), cow=cow,
+                    readmission=req.preemptions > 0,
+                )
 
     def _ensure_blocks(self, req: Request, positions: int) -> None:
         """Grow ``req``'s block table to cover ``positions`` tokens,
@@ -701,8 +743,22 @@ class DecodeEngine:
         in_prefill = [r for r in candidates if r.state == PREFILL]
         pool = in_prefill or candidates
         victim = max(pool, key=lambda r: r.admit_seq)
+        victim_state = victim.state
         self._evict(victim, requeue=True)
         self.stats.preemptions += 1
+        if victim.timeline is not None:
+            victim.timeline.event(
+                "preempted", self._clock(), victimState=victim_state,
+                preemptions=victim.preemptions,
+                forRid=needy.rid,
+            )
+        # Inside a gateway tick span this line carries the trace id
+        # (utils/logging.JsonFormatter reads the contextvar).
+        logger.debug(
+            "preempted request %d (%s, preemption #%d) to feed "
+            "request %d", victim.rid, victim_state,
+            victim.preemptions, needy.rid,
+        )
 
     def _evict(self, req: Request, requeue: bool) -> None:
         slot = req.slot
@@ -739,6 +795,16 @@ class DecodeEngine:
         self.stats.request_latency_s.append(
             req.finished_at - req.arrived_at
         )
+        if req.timeline is not None:
+            req.timeline.event(
+                "engine-retire", req.finished_at,
+                tokens=len(req.generated),
+                preemptions=req.preemptions,
+                cachedTokens=req.cached_tokens,
+                engineLatencyS=round(
+                    req.finished_at - req.arrived_at, 6
+                ),
+            )
         if self._covered_by_inflight(req, slot):
             req.state = DRAINING
         else:
@@ -827,6 +893,13 @@ class DecodeEngine:
             st.prefill_tokens += nv
             req.prefilled = int(starts[lane]) + nv
             self._lengths[req.slot] = req.prefilled
+            if req.timeline is not None:
+                req.timeline.event(
+                    "prefill-chunk", self._clock(), lane=lane,
+                    tokens=nv,
+                    occupancy=round(len(reqs) / pb, 4),
+                    cachedTokensSkipped=req.cached_tokens,
+                )
             if req.prefilled != len(req.prompt):
                 continue
             if self.prefix_cache is not None:
@@ -845,6 +918,11 @@ class DecodeEngine:
             st.tokens_generated += 1
             st.ttft_s.append(now - req.arrived_at)
             self._slot_last_token_t[req.slot] = now
+            if req.timeline is not None:
+                req.timeline.event(
+                    "first-token", now,
+                    engineTtftS=round(now - req.arrived_at, 6),
+                )
             if self._is_final(req, first):
                 self._complete(req, req.slot)
 
@@ -956,6 +1034,16 @@ class DecodeEngine:
             self._consume(cur)
 
     def _consume(self, inflight) -> None:
+        if self._profiler is not None:
+            # Host harvest as its own phase: nested under decode, the
+            # profiler's self-time accounting keeps the two disjoint —
+            # "harvest is 60% of the tick" is exactly this number.
+            with self._profiler.phase("engine", "harvest"):
+                self._consume_inner(inflight)
+            return
+        self._consume_inner(inflight)
+
+    def _consume_inner(self, inflight) -> None:
         nxt_dev, ran = inflight
         nxt = np.asarray(nxt_dev)     # the single batched fetch per tick
         now = self._clock()

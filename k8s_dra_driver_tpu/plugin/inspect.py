@@ -71,6 +71,7 @@ def collect_live(http_url: str, timeout: float = 3.0) -> dict[str, Any]:
     out.update(_collect_defrag_plans(http_url, timeout))
     out.update(_collect_rebalance(http_url, timeout))
     out.update(_collect_gateway(http_url, timeout))
+    out.update(_collect_requests(http_url, timeout))
     return out
 
 
@@ -248,6 +249,51 @@ def _collect_gateway(
     ]
     if events:
         out["gatewayEvents"] = events[-keep:]
+    return out
+
+
+def _collect_requests(
+    http_url: str, timeout: float, keep: int = 3
+) -> dict[str, Any]:
+    """Request-observability view from ``/debug/requests``: the per-class
+    SLO summary plus the most recent violation exemplars — the live "why
+    was this request slow?" answer (dominant phase -> runbook row)."""
+    text, err = _fetch_debug(http_url, "/debug/requests?view=slo", timeout)
+    if err is not None:
+        return {"requestsError": err}
+    if text is None:
+        return {}
+    out: dict[str, Any] = {}
+    try:
+        summary = json.loads(text)
+    except ValueError as e:
+        return {"requestsError": str(e)}
+    if isinstance(summary, dict):
+        out["sloSummary"] = summary
+    text, err = _fetch_debug(
+        http_url, "/debug/requests?view=exemplars", timeout
+    )
+    if err is not None:
+        out["requestsError"] = err
+        return out
+    exemplars = []
+    for line in (text or "").splitlines():
+        try:
+            ex = json.loads(line)
+        except ValueError:
+            continue
+        if not isinstance(ex, dict):
+            continue
+        exemplars.append({
+            "latencyClass": ex.get("latencyClass", "?"),
+            "signal": ex.get("signal", "?"),
+            "observedS": ex.get("observedS"),
+            "thresholdS": ex.get("thresholdS"),
+            "dominantPhase": ex.get("dominantPhase", "?"),
+            "traceId": ex.get("traceId", ""),
+        })
+    if exemplars:
+        out["sloExemplars"] = exemplars[-keep:]
     return out
 
 
@@ -573,6 +619,40 @@ def render(state: dict[str, Any]) -> str:
                             f"{k}={v}" for k, v in sorted(e.items())
                             if k not in ("kind", "ts", "tick")
                         )
+                    )
+            if live.get("requestsError"):
+                lines.append(
+                    "  /debug/requests scrape FAILED "
+                    f"({live['requestsError']}) — request SLO view "
+                    "unavailable, NOT known-healthy"
+                )
+            slo = live.get("sloSummary") or {}
+            if slo:
+                lines.append("")
+                lines.append(
+                    f"request SLOs: {slo.get('requests', 0)} request(s), "
+                    f"{slo.get('violations', 0)} violation(s), "
+                    f"{slo.get('sheds', 0)} shed, affinity hit rate "
+                    f"{slo.get('affinityHitRate', 0)}"
+                )
+                for cls, stats in sorted(
+                    (slo.get("classes") or {}).items()
+                ):
+                    if not isinstance(stats, dict):
+                        continue
+                    lines.append(
+                        f"  {cls}: ttft p99 {stats.get('ttftP99S')}s, "
+                        f"e2e p99 {stats.get('e2eP99S')}s, "
+                        f"{stats.get('violations', 0)} violation(s)"
+                    )
+                for ex in live.get("sloExemplars") or []:
+                    lines.append(
+                        f"  exemplar: {ex['latencyClass']} {ex['signal']} "
+                        f"{ex['observedS']}s > {ex['thresholdS']}s, "
+                        f"dominant phase {ex['dominantPhase']} "
+                        f"(trace {ex['traceId'] or '?'}) — see the "
+                        "\"why was this request slow?\" runbook in "
+                        "docs/operations.md"
                     )
     return "\n".join(lines)
 

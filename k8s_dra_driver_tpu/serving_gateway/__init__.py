@@ -18,6 +18,11 @@ from .gateway import (
     ReplicaLostError,
     ServingGateway,
 )
+from .reqtrace import (
+    RequestTimeline,
+    ServingTelemetry,
+    TickProfiler,
+)
 from .router import (
     NoReplicaAvailableError,
     Replica,
@@ -37,9 +42,12 @@ __all__ = [
     "Replica",
     "ReplicaLostError",
     "ReplicaProvisioner",
+    "RequestTimeline",
     "RouteDecision",
     "Router",
     "ScaleError",
     "ServingGateway",
+    "ServingTelemetry",
+    "TickProfiler",
     "prefix_affinity_key",
 ]

@@ -19,7 +19,10 @@ vocabulary covers both layers of the stack). Admission is three rules:
   error instead of eventually serving an answer nobody is waiting for.
 
 The controller is pure queue arithmetic; metrics, ring-buffer records,
-and Events live in the gateway.
+and Events live in the gateway. The one observability seam here: a
+request carrying a ``timeline`` (serving_gateway/reqtrace.py) gets its
+class-queue transitions recorded — enqueue depth, dequeue wait — since
+only the queue owner can time the class-queue wait precisely.
 """
 
 from __future__ import annotations
@@ -132,19 +135,37 @@ class AdmissionController:
             )
 
     def enqueue(self, request) -> None:
-        self._queues[request.latency_class].append(request)
+        q = self._queues[request.latency_class]
+        q.append(request)
+        tl = getattr(request, "timeline", None)
+        if tl is not None:
+            tl.event(
+                "class-queued", request.submitted_at,
+                latencyClass=request.latency_class, depth=len(q),
+            )
 
     def requeue_front(self, request) -> None:
         """Put a re-routed (drained/failed-over) request back at the
         FRONT of its class queue: it keeps its arrival priority."""
         self._queues[request.latency_class].appendleft(request)
 
-    def pop(self) -> Optional[object]:
+    def pop(self, now: Optional[float] = None) -> Optional[object]:
         """Next request in strict class-priority order (FIFO within a
-        class); None when all queues are empty."""
+        class); None when all queues are empty. ``now`` (when the
+        caller has a clock in hand) times the class-queue wait onto the
+        request's timeline."""
         for lc in CLASS_ORDER:
             if self._queues[lc]:
-                return self._queues[lc].popleft()
+                request = self._queues[lc].popleft()
+                tl = getattr(request, "timeline", None)
+                if tl is not None and now is not None:
+                    tl.event(
+                        "dequeued", now,
+                        waitedS=round(
+                            max(0.0, now - request.submitted_at), 6
+                        ),
+                    )
+                return request
         return None
 
     def push_back(self, request) -> None:

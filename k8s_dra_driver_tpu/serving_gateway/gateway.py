@@ -25,6 +25,17 @@ usage/defrag/rebalance), and deduped ``Gateway*`` Events. Chaos sites
 ``gateway.route`` / ``gateway.drain`` / ``gateway.scale`` make the
 three state transitions injectable (utils/faults.py).
 
+Per-request observability is opt-in via ``telemetry=`` (a
+``serving_gateway/reqtrace.ServingTelemetry``): every submit then opens
+a root span on the contextvars tracer (its trace id is returned on the
+handle — and on the typed shed error — so callers, JSON log lines, and
+engine events all correlate), a timeline follows the request through
+class queue, routing, engine admission, prefill, decode, and its
+terminal outcome, tick wall time decomposes into named phases, and
+per-class SLO histograms/violations/exemplars accumulate for
+``fleet_slo_summary()``. ``telemetry=None`` (the default) keeps every
+hot path on its pre-observability branch.
+
 The tick loop is host-side and single-threaded by design, like the
 engine's: ``tick()`` advances admission, dispatch, every replica's
 engine, and the autoscaler exactly once, so tests and benches replay
@@ -34,6 +45,7 @@ deterministically.
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 import logging
 import time
@@ -43,6 +55,9 @@ from ..api.v1alpha1.slo import BATCH_CLASS, LATENCY_CLASSES
 from ..kube.events import EventRecorder, ObjectRef
 from ..utils import faults
 from ..utils.metrics import Counter, Gauge, Registry
+# Imported as a module: reqtrace's OUTCOME_* terminal-outcome names
+# would shadow the autoscaler's OUTCOME_* decision names below.
+from . import reqtrace
 from .admission import (
     SHED_DEADLINE,
     SHED_REASONS,
@@ -118,6 +133,11 @@ class GatewayRequest:
     error: Optional[BaseException] = None
     dispatches: int = 0
     finished_at: Optional[float] = None
+    # Filled only when the gateway runs with telemetry: the root span's
+    # trace id (joins gateway and engine spans/log lines) and the
+    # request's reqtrace timeline.
+    trace_id: str = ""
+    timeline: Optional[object] = None
 
     @property
     def done(self) -> bool:
@@ -146,11 +166,13 @@ class ServingGateway:
         node_name: str = "",
         node_uid: str = "",
         clock: Callable[[], float] = time.monotonic,
+        telemetry: Optional["reqtrace.ServingTelemetry"] = None,
     ):
         self.router = router or Router()
         self.admission = AdmissionController(admission_policy)
         self.autoscaler = autoscaler
         self.events = events
+        self.telemetry = telemetry
         self.node_name = node_name
         self.node_uid = node_uid
         self._clock = clock
@@ -236,8 +258,20 @@ class ServingGateway:
         replica = Replica(replica_id, engine, claim_uid=claim_uid)
         self.router.add(replica)
         self._dispatched.setdefault(replica_id, {})
+        self._attach_profiler(replica)
         self._refresh_replica_gauge()
         return replica
+
+    def _attach_profiler(self, replica: Replica) -> None:
+        # Engine ticks share ONE TickProfiler (component="engine"); the
+        # replica id travels as the ring entry's free-form tag, never a
+        # metric label (replica ids are unbounded cardinality).
+        if self.telemetry is None:
+            return
+        if hasattr(replica.engine, "set_profiler"):
+            replica.engine.set_profiler(
+                self.telemetry.profiler, tag=replica.replica_id
+            )
 
     def replicas(self) -> list[Replica]:
         return self.router.replicas()
@@ -262,22 +296,54 @@ class ServingGateway:
     def submit(self, prompt, max_new_tokens: int,
                latency_class: str = BATCH_CLASS) -> GatewayRequest:
         """Admit a request into the fleet (or shed it, typed). The
-        handle's tokens fill in as some replica serves it."""
+        handle's tokens fill in as some replica serves it. With
+        telemetry, the handle (and a shed's OverloadedError) carries
+        ``trace_id`` so callers can join gateway and engine records."""
         now = self._clock()
-        try:
-            self.admission.check(latency_class, self.fleet_queue_depth())
-        except OverloadedError as e:
-            self._shed(latency_class, e, now)
-            raise
-        req = GatewayRequest(
-            gid=self._gid, prompt=[int(t) for t in prompt],
-            max_new_tokens=max_new_tokens, latency_class=latency_class,
-            submitted_at=now,
-        )
-        self._gid += 1
-        self._live[req.gid] = req
-        self.admission.enqueue(req)
-        return req
+        tel = self.telemetry
+        span = None
+        tl = None
+        with contextlib.ExitStack() as stack:
+            if tel is not None:
+                span = stack.enter_context(tel.tracer.span(
+                    "gateway/submit", latency_class=latency_class,
+                ))
+                tl = tel.new_timeline(
+                    latency_class, now, trace_id=span.trace_id,
+                    prompt_tokens=len(prompt),
+                )
+            try:
+                self.admission.check(
+                    latency_class, self.fleet_queue_depth()
+                )
+            except OverloadedError as e:
+                if tel is not None:
+                    span.set_error(f"shed: {e.reason}")
+                    e.trace_id = span.trace_id
+                    logger.warning(
+                        "shed a %s request (%s) at fleet queue depth %d",
+                        latency_class, e.reason, e.queue_depth,
+                    )
+                    tel.finish_timeline(
+                        tl, reqtrace.OUTCOME_SHED, now,
+                        reason=e.reason, queueDepth=e.queue_depth,
+                    )
+                self._shed(latency_class, e, now)
+                raise
+            req = GatewayRequest(
+                gid=self._gid, prompt=[int(t) for t in prompt],
+                max_new_tokens=max_new_tokens,
+                latency_class=latency_class, submitted_at=now,
+            )
+            if tel is not None:
+                req.trace_id = span.trace_id
+                req.timeline = tl
+                tl.gid = req.gid
+                span.set_tag("gid", req.gid)
+            self._gid += 1
+            self._live[req.gid] = req
+            self.admission.enqueue(req)
+            return req
 
     def _shed(self, latency_class: str, err: OverloadedError,
               now: float) -> None:
@@ -300,27 +366,45 @@ class ServingGateway:
         """One gateway scheduling round: expire deadlines, dispatch in
         class-priority order while capacity exists, advance every
         replica engine one tick, harvest completions, then let the
-        autoscaler look at the result."""
+        autoscaler look at the result. With telemetry the round runs
+        inside a ``gateway/tick`` span (engine/scale log lines inherit
+        its trace id) and decomposes into the GATEWAY_PHASES buckets of
+        ``tpu_dra_srv_tick_phase_seconds``."""
+        tel = self.telemetry
+        if tel is None:
+            self._tick_once(None)
+            return
+        with tel.tracer.span("gateway/tick", tick=self.ticks + 1):
+            self._tick_once(tel.profiler)
+        tel.profiler.end_tick("gateway", self.ticks)
+
+    def _tick_once(self, prof) -> None:
         now = self._clock()
         self.ticks += 1
-        for req in self.admission.expire(now):
-            err = OverloadedError(
-                "queued past its class deadline",
-                latency_class=req.latency_class, reason=SHED_DEADLINE,
-                retry_after_s=self.admission.policy.retry_after_s,
-                queue_depth=self.fleet_queue_depth(),
-            )
-            self._fail(req, err, now)
-            self._shed(req.latency_class, err, now)
-        self._dispatch(now)
-        for replica in self.router.replicas():
-            if replica.engine.idle:
-                continue
-            replica.engine.tick()
-        for replica in self.router.replicas():
-            self._harvest(replica, now)
-        if self.autoscaler is not None:
-            self._autoscale(now)
+        with reqtrace.phase_ctx(prof, "gateway", "expire"):
+            for req in self.admission.expire(now):
+                err = OverloadedError(
+                    "queued past its class deadline",
+                    latency_class=req.latency_class,
+                    reason=SHED_DEADLINE,
+                    retry_after_s=self.admission.policy.retry_after_s,
+                    queue_depth=self.fleet_queue_depth(),
+                )
+                self._fail(req, err, now)
+                self._shed(req.latency_class, err, now)
+        with reqtrace.phase_ctx(prof, "gateway", "dispatch"):
+            self._dispatch(now)
+        with reqtrace.phase_ctx(prof, "gateway", "replicas"):
+            for replica in self.router.replicas():
+                if replica.engine.idle:
+                    continue
+                replica.engine.tick()
+        with reqtrace.phase_ctx(prof, "gateway", "harvest"):
+            for replica in self.router.replicas():
+                self._harvest(replica, now)
+        with reqtrace.phase_ctx(prof, "gateway", "autoscale"):
+            if self.autoscaler is not None:
+                self._autoscale(now)
         for lc, depth in self.admission.depth_by_class().items():
             self._m_queue_depth.set(depth, latency_class=lc)
 
@@ -338,7 +422,7 @@ class ServingGateway:
 
     def _dispatch(self, now: float) -> None:
         while self.router.has_capacity():
-            req = self.admission.pop()
+            req = self.admission.pop(now)
             if req is None:
                 return
             try:
@@ -385,6 +469,24 @@ class ServingGateway:
                 if decision.affinity_hit:
                     self.counters["affinity_hits"] += 1
                     self._m_affinity_hits.inc()
+            if req.timeline is not None:
+                req.timeline.event(
+                    "routed", now,
+                    replica=decision.replica.replica_id,
+                    policy=decision.policy,
+                    affinityHit=decision.affinity_hit,
+                    affinityKey=decision.affinity_key is not None,
+                    replicaQueueDepth=decision.queue_depth,
+                    dispatch=req.dispatches,
+                )
+                # Hand the timeline to the engine request so engine-side
+                # events (admit, prefill chunks, first token, preemption,
+                # retire) land on the same record.
+                engine_req.timeline = req.timeline
+            if self.telemetry is not None:
+                self.telemetry.note_route(
+                    decision.affinity_key, decision.affinity_hit
+                )
 
     def _harvest(self, replica: Replica, now: float) -> None:
         table = self._dispatched.get(replica.replica_id) or {}
@@ -399,6 +501,15 @@ class ServingGateway:
             self._live.pop(greq.gid, None)
             self.counters["completed"] += 1
             self._m_requests.inc(outcome="completed")
+            if greq.timeline is not None and self.telemetry is not None:
+                # observe_request feeds the per-class SLO histograms and
+                # violation/exemplar ledger, then seals the timeline.
+                self.telemetry.observe_request(
+                    greq.timeline, now,
+                    tokens=len(
+                        getattr(greq.engine_req, "generated", []) or []
+                    ),
+                )
 
     def _fail(self, req: GatewayRequest, err: BaseException,
               now: float) -> None:
@@ -408,8 +519,27 @@ class ServingGateway:
         self._live.pop(req.gid, None)
         self.counters["failed"] += 1
         self._m_requests.inc(outcome="failed")
+        if req.timeline is not None and self.telemetry is not None:
+            outcome = (
+                reqtrace.OUTCOME_EXPIRED
+                if isinstance(err, OverloadedError)
+                and err.reason == SHED_DEADLINE
+                else reqtrace.OUTCOME_FAILED
+            )
+            self.telemetry.finish_timeline(
+                req.timeline, outcome, now,
+                error=f"{type(err).__name__}: {err}",
+            )
 
     # -- drain / failover --------------------------------------------------
+
+    def _maybe_span(self, name: str, **tags):
+        """A tracer span when telemetry is on, else a no-op context —
+        so drain/failover log lines and engine events correlate under
+        one trace id without a second code path."""
+        if self.telemetry is None:
+            return contextlib.nullcontext()
+        return self.telemetry.tracer.span(name, tags=tags)
 
     def drain_replica(self, replica_id: str, *, remove: bool = False,
                       reason: str = "") -> int:
@@ -419,6 +549,13 @@ class ServingGateway:
         admitted-request loss. Returns the number of re-routed
         requests. ``remove=True`` deregisters it afterwards (the
         scale-down path)."""
+        with self._maybe_span("gateway/drain", replica=replica_id,
+                              reason=reason):
+            return self._drain_replica(replica_id, remove=remove,
+                                       reason=reason)
+
+    def _drain_replica(self, replica_id: str, *, remove: bool,
+                       reason: str) -> int:
         faults.fire("gateway.drain")
         now = self._clock()
         replica = self.router.get(replica_id)
@@ -435,6 +572,10 @@ class ServingGateway:
             greq.state = GW_QUEUED
             greq.replica_id = ""
             greq.engine_req = None
+            if greq.timeline is not None:
+                greq.timeline.event(
+                    "requeued", now, replica=replica_id, reason="drain",
+                )
             requeue.append(greq)
         # requeue_front is an appendleft: push in REVERSE so the oldest
         # re-routed request ends up at the head — arrival order within
@@ -442,6 +583,10 @@ class ServingGateway:
         for greq in reversed(requeue):
             self.admission.requeue_front(greq)
         n_rerouted = len(requeue)
+        logger.info(
+            "draining replica %s%s: %d queued request(s) re-routed",
+            replica_id, f" ({reason})" if reason else "", n_rerouted,
+        )
         # Everything admitted finished inside drain(): harvest them.
         self._harvest(replica, now)
         leftovers = list((self._dispatched.get(replica_id) or {}).values())
@@ -478,6 +623,11 @@ class ServingGateway:
         state — and its in-flight ones fail with a typed, retryable
         :class:`ReplicaLostError`. Returns the number of lost in-flight
         requests."""
+        with self._maybe_span("gateway/failover", replica=replica_id,
+                              reason=reason):
+            return self._fail_replica(replica_id, reason)
+
+    def _fail_replica(self, replica_id: str, reason: str) -> int:
         now = self._clock()
         replica = self.router.get(replica_id)
         replica.state = REPLICA_GONE
@@ -492,6 +642,11 @@ class ServingGateway:
                 greq.state = GW_QUEUED
                 greq.replica_id = ""
                 greq.engine_req = None
+                if greq.timeline is not None:
+                    greq.timeline.event(
+                        "requeued", now, replica=replica_id,
+                        reason="replica-lost",
+                    )
                 requeue.append(greq)
             else:
                 lost.append(greq)
@@ -502,6 +657,12 @@ class ServingGateway:
         for greq in reversed(requeue):
             self.admission.requeue_front(greq)
         n_rerouted = len(requeue)
+        logger.warning(
+            "replica %s lost%s: %d queued re-routed, %d in-flight "
+            "failed retryable",
+            replica_id, f" ({reason})" if reason else "",
+            n_rerouted, len(lost),
+        )
         self.router.remove(replica_id)
         self._dispatched.pop(replica_id, None)
         self._refresh_replica_gauge()
@@ -578,6 +739,7 @@ class ServingGateway:
                 replica = self.autoscaler.provisioner.scale_up()
                 self.router.add(replica)
                 self._dispatched.setdefault(replica.replica_id, {})
+                self._attach_profiler(replica)
                 decision = {**decision, "outcome": OUTCOME_APPLIED,
                             "replicaId": replica.replica_id}
                 if self.events is not None:
@@ -613,6 +775,14 @@ class ServingGateway:
             decision = {**decision, "outcome": OUTCOME_FAILED,
                         "detail": f"{type(e).__name__}: {e}"}
             logger.warning("gateway scale %s failed: %s", direction, e)
+        if decision.get("outcome") == OUTCOME_APPLIED:
+            # Inside the tick span when telemetry is on: the log line
+            # carries the tick's trace id.
+            logger.info(
+                "gateway scale %s applied (replica %s): %s",
+                direction, decision.get("replicaId", ""),
+                decision.get("reason", ""),
+            )
         self._refresh_replica_gauge()
         self.autoscaler.note_scaled(now)
         return decision
@@ -629,6 +799,13 @@ class ServingGateway:
     def affinity_hit_rate(self) -> float:
         return (self.counters["affinity_hits"]
                 / max(self.counters["affinity_lookups"], 1))
+
+    def fleet_slo_summary(self) -> Optional[dict]:
+        """The soak-harness SLO artifact (reqtrace's pinned-key JSON
+        document), or None when the gateway runs without telemetry."""
+        if self.telemetry is None:
+            return None
+        return self.telemetry.fleet_slo_summary()
 
     def snapshot(self) -> dict:
         """The /debug/gateway document: replicas, queues, counters,

@@ -136,6 +136,13 @@ class RouteDecision:
     affinity_key: Optional[str] = None
     affinity_hit: bool = False       # key previously routed to replica
     affinity_spilled: bool = False   # key existed but target saturated
+    queue_depth: int = 0             # chosen replica's depth at decision
+
+    def __post_init__(self):
+        # Snapshot the target's load at decision time: the request
+        # timeline records what the router actually saw, not what the
+        # replica looks like when someone reads the timeline later.
+        self.queue_depth = self.replica.queue_depth()
 
 
 class Router:

@@ -15,11 +15,19 @@ tick. ``batch_slots`` bounds concurrency; admission is FIFO like the
 real engine's. There is no KV pool — ``assert_no_leaks`` checks slot
 accounting only — because pool behavior is the real engine's job and is
 covered by the real-engine tests and the bench.
+
+Observability surface parity: like the real engine, a ``SimRequest``
+carrying a ``timeline`` (serving_gateway/reqtrace.py) gets
+``engine-admit`` / ``prefill-chunk`` / ``first-token`` /
+``engine-retire`` events, and ``set_profiler`` decomposes ticks into
+phases — so the telemetry stack is exercisable (and its forced-SLO-
+violation paths testable via ``decode_ticks_per_token``) without jax.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import deque
 from typing import Optional
 
@@ -40,6 +48,9 @@ class SimRequest:
     state: str = "waiting"
     prefill_left: int = 0
     generated: list = dataclasses.field(default_factory=list)
+    # Optional reqtrace timeline, attached by the gateway (mirrors
+    # models/serving.Request.timeline).
+    timeline: Optional[object] = None
 
     @property
     def done(self) -> bool:
@@ -56,11 +67,13 @@ class ScriptedEngine:
     depths grow — the p2c and autoscaler tests' knob)."""
 
     def __init__(self, *, batch_slots: int = 4, prefill_chunk: int = 32,
-                 decode_ticks_per_token: int = 1, stall: bool = False):
+                 decode_ticks_per_token: int = 1, stall: bool = False,
+                 clock=time.monotonic):
         self.batch_slots = batch_slots
         self.prefill_chunk = prefill_chunk
         self.decode_ticks_per_token = decode_ticks_per_token
         self.stall = stall
+        self._clock = clock
         self.waiting: deque = deque()
         self.running: list[SimRequest] = []
         self._admission_open = True
@@ -68,6 +81,14 @@ class ScriptedEngine:
         self._tick_no = 0
         self.ticks = 0
         self.completed = 0
+        self._profiler = None
+        self._profile_tag = ""
+
+    def set_profiler(self, profiler, tag: str = "") -> None:
+        """Mirror of ``DecodeEngine.set_profiler`` (reqtrace
+        TickProfiler duck type)."""
+        self._profiler = profiler
+        self._profile_tag = tag
 
     # -- the DecodeEngine serving surface ---------------------------------
 
@@ -108,21 +129,57 @@ class ScriptedEngine:
         if self.stall:
             return
         self._tick_no += 1
+        prof = self._profiler
+        if prof is None:
+            self._admit_tick()
+            self._decode_tick()
+            return
+        with prof.phase("engine", "admit"):
+            self._admit_tick()
+        with prof.phase("engine", "decode"):
+            self._decode_tick()
+        prof.end_tick("engine", self.ticks, tag=self._profile_tag)
+
+    def _admit_tick(self) -> None:
         while self.waiting and len(self.running) < self.batch_slots:
             req = self.waiting.popleft()
             req.state = "prefill"
             self.running.append(req)
+            if req.timeline is not None:
+                req.timeline.event(
+                    "engine-admit", self._clock(),
+                    slot=self.running.index(req),
+                    cachedTokens=0, cachedBlocks=0, cow=False,
+                    readmission=False,
+                )
+
+    def _decode_tick(self) -> None:
         for req in list(self.running):
             if req.prefill_left > 0:
                 req.prefill_left -= 1
+                if req.timeline is not None:
+                    req.timeline.event(
+                        "prefill-chunk", self._clock(), lane=0,
+                        tokens=min(self.prefill_chunk, len(req.prompt)),
+                        occupancy=1.0, cachedTokensSkipped=0,
+                    )
                 continue
             req.state = "running"
             if self._tick_no % self.decode_ticks_per_token == 0:
+                first = not req.generated
                 req.generated.append(0)
+                if first and req.timeline is not None:
+                    req.timeline.event("first-token", self._clock())
             if len(req.generated) >= req.max_new_tokens:
                 req.state = "finished"
                 self.running.remove(req)
                 self.completed += 1
+                if req.timeline is not None:
+                    req.timeline.event(
+                        "engine-retire", self._clock(),
+                        tokens=len(req.generated), preemptions=0,
+                        cachedTokens=0,
+                    )
 
     def drain(self) -> list[SimRequest]:
         self.stop_admission()

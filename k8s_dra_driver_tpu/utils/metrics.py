@@ -121,7 +121,13 @@ class Gauge:
 
 
 class Histogram:
-    """Fixed-bucket histogram (claim-prepare latencies etc.)."""
+    """Fixed-bucket histogram (claim-prepare latencies etc.). Optionally
+    labeled: ``observe(v, phase="admit")`` keeps an independent bucket
+    series per label set, rendered with ``le`` appended last — how the
+    serving tick profiler keeps one ``tpu_dra_srv_tick_phase_seconds``
+    family across its ``{component, phase}`` enum instead of a family
+    per phase. Label-less use renders exactly as before (including the
+    zeroed series when nothing was observed yet)."""
 
     DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10)
 
@@ -131,24 +137,46 @@ class Histogram:
         self.help = help_
         self.type = "histogram"
         self.buckets = tuple(sorted(buckets))
-        self._counts = [0] * (len(self.buckets) + 1)
-        self._sum = 0.0
-        self._n = 0
+        # key -> [per-bucket counts (+overflow), sum, n]
+        self._series: dict[tuple, list] = {}
         self._lock = threading.Lock()
         registry._register(self)
 
-    def observe(self, value: float) -> None:
+    def _cell(self, labels: dict) -> list:
+        """Caller must hold the lock."""
+        _validate_label_names(labels)
+        if "le" in labels:
+            raise ValueError(
+                "label name 'le' is reserved for histogram buckets"
+            )
+        key = tuple(sorted(labels.items()))
+        cell = self._series.get(key)
+        if cell is None:
+            cell = self._series[key] = [
+                [0] * (len(self.buckets) + 1), 0.0, 0
+            ]
+        return cell
+
+    def observe(self, value: float, **labels) -> None:
         with self._lock:
-            self._sum += value
-            self._n += 1
+            cell = self._cell(labels)
+            cell[1] += value
+            cell[2] += 1
             for i, b in enumerate(self.buckets):
                 if value <= b:
-                    self._counts[i] += 1
+                    cell[0][i] += 1
                     return
-            self._counts[-1] += 1
+            cell[0][-1] += 1
+
+    def zero(self, **labels) -> None:
+        """Materialize an all-zero series for a label set — the explicit-
+        zeros convention for labeled histograms (an unscraped enum cell
+        must read 0, not be absent)."""
+        with self._lock:
+            self._cell(labels)
 
     def time(self):
-        """Context manager: observe elapsed seconds."""
+        """Context manager: observe elapsed seconds (label-less)."""
         hist = self
 
         class _Timer:
@@ -161,10 +189,17 @@ class Histogram:
 
         return _Timer()
 
-    def summary(self) -> tuple[int, float]:
-        """(count, sum) — the scalar view snapshot/doctor reports use."""
+    def summary(self, **labels) -> tuple[int, float]:
+        """(count, sum) — the scalar view snapshot/doctor reports use.
+        With labels: that series only; without: aggregated over all."""
         with self._lock:
-            return self._n, self._sum
+            if labels:
+                key = tuple(sorted(labels.items()))
+                cell = self._series.get(key)
+                return (cell[2], cell[1]) if cell else (0, 0.0)
+            n = sum(c[2] for c in self._series.values())
+            total = sum(c[1] for c in self._series.values())
+            return n, total
 
     def render(self) -> list[str]:
         return self.render_as(self.name, self.help)
@@ -172,14 +207,22 @@ class Histogram:
     def render_as(self, name: str, help_: str) -> list[str]:
         out = [f"# HELP {name} {help_}", f"# TYPE {name} histogram"]
         with self._lock:
-            cum = 0
-            for i, b in enumerate(self.buckets):
-                cum += self._counts[i]
-                out.append(f'{name}_bucket{{le="{_num(b)}"}} {cum}')
-            cum += self._counts[-1]
-            out.append(f'{name}_bucket{{le="+Inf"}} {cum}')
-            out.append(f"{name}_sum {_num(self._sum)}")
-            out.append(f"{name}_count {self._n}")
+            series = self._series or {
+                (): [[0] * (len(self.buckets) + 1), 0.0, 0]
+            }
+            for key, (counts, total, n) in sorted(series.items()):
+                cum = 0
+                for i, b in enumerate(self.buckets):
+                    cum += counts[i]
+                    out.append(
+                        f"{name}_bucket{_bucket_labels(key, _num(b))} {cum}"
+                    )
+                cum += counts[-1]
+                out.append(
+                    f'{name}_bucket{_bucket_labels(key, "+Inf")} {cum}'
+                )
+                out.append(f"{name}_sum{_labels(key)} {_num(total)}")
+                out.append(f"{name}_count{_labels(key)} {n}")
         return out
 
 
@@ -199,6 +242,17 @@ def _labels(key: tuple) -> str:
         return ""
     inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in key)
     return "{" + inner + "}"
+
+
+def _bucket_labels(key: tuple, le: str) -> str:
+    """Histogram bucket label block: the series labels with ``le``
+    appended last (``le`` is reserved by the text format, never a
+    user label — _validate_label_names accepts it, so the histogram
+    label path must not be handed an ``le`` of its own)."""
+    inner = ",".join(
+        f'{k}="{_escape_label_value(v)}"' for k, v in key
+    )
+    return "{" + (inner + "," if inner else "") + f'le="{le}"' + "}"
 
 
 def _num(v: float) -> str:
@@ -344,6 +398,11 @@ class MetricsServer:
     ``/debug/gateway`` serves the fleet serving gateway's snapshot
     (replicas, queues, event ring) when a provider was registered with
     ``set_gateway_provider`` (404 otherwise).
+    ``/debug/requests`` streams the serving telemetry's sealed request
+    timelines as JSONL when a provider was registered with
+    ``set_requests_provider`` (404 otherwise); ``?view=ticks`` /
+    ``exemplars`` / ``slo`` select the tick-profile, violation-exemplar,
+    and fleet-SLO-summary views, and an unknown view is a 400.
     All routes are GET-only; other methods get ``405``
     with an ``Allow: GET`` header — the scrape surface mutates nothing.
     """
@@ -357,6 +416,7 @@ class MetricsServer:
         self.defrag_provider: Optional[Callable] = None
         self.rebalance_provider: Optional[Callable] = None
         self.gateway_provider: Optional[Callable] = None
+        self.requests_provider: Optional[Callable] = None
         # The JSON debug surfaces share one handler block: path ->
         # (provider attribute, not-enabled message). /debug/allocations
         # stays separate (the provider returns pre-rendered JSONL).
@@ -455,6 +515,30 @@ class MetricsServer:
                     else:
                         body = server_ref.tracer.export_jsonl().encode()
                         ctype = "application/x-ndjson"
+                elif self.path.split("?", 1)[0] == "/debug/requests":
+                    provider = server_ref.requests_provider
+                    if provider is None:
+                        body = b"request tracing not enabled\n"
+                        status = 404
+                        ctype = "text/plain"
+                    else:
+                        from urllib.parse import parse_qs, urlparse
+
+                        q = parse_qs(urlparse(self.path).query)
+                        view = q.get("view", [""])[0]
+                        try:
+                            body = provider(view).encode()
+                            ctype = "application/x-ndjson"
+                        except ValueError as e:
+                            body = (str(e) + "\n").encode()
+                            status = 400
+                            ctype = "text/plain"
+                        except Exception as e:
+                            body = (
+                                f"requests snapshot failed: {e}\n"
+                            ).encode()
+                            status = 500
+                            ctype = "text/plain"
                 elif self.path == "/debug/stacks":
                     body = _dump_stacks().encode()
                     ctype = "text/plain"
@@ -552,6 +636,14 @@ class MetricsServer:
         ``ServingGateway.snapshot``) at ``/debug/gateway``. Safe to
         call after ``start()``."""
         self.gateway_provider = provider
+
+    def set_requests_provider(self, provider: Callable) -> None:
+        """Serve ``provider(view)`` (a JSONL string, e.g.
+        ``ServingTelemetry.export_requests``) at ``/debug/requests``;
+        ``view`` is the ``?view=`` query value ("" for the default
+        timeline ring) and a ``ValueError`` from the provider renders
+        as a 400. Safe to call after ``start()``."""
+        self.requests_provider = provider
 
     def add_readiness_check(self, name: str, check: Callable,
                             critical: bool = True) -> None:

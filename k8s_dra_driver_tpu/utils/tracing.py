@@ -239,6 +239,17 @@ class Tracer:
                     return trace
         return None
 
+    def find_trace_by_tag(self, key: str, value) -> Optional[dict]:
+        """Most recent finished trace with any span tagged ``key=value``
+        — the generalization of :meth:`find_trace` the serving gateway
+        uses to join a request's submit span on its gateway id."""
+        with self._lock:
+            for trace in reversed(self._traces):
+                if any(s["tags"].get(key) == value
+                       for s in trace["spans"]):
+                    return trace
+        return None
+
     def export_jsonl(self) -> str:
         """One JSON object per line per finished trace (the
         ``/debug/traces`` wire format)."""

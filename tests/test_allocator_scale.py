@@ -279,6 +279,7 @@ class TestAllocatorScale:
             f"(pool has {pool_devices} devices)"
         )
 
+    @pytest.mark.slow  # O(claims) from-scratch re-solves; dominates tier-1
     def test_parity_oracle_incremental_vs_from_scratch(self):
         """The regression oracle for the incremental solver: one seeded
         churn schedule (allocations, releases, health-flip slice deltas,

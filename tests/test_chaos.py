@@ -695,6 +695,7 @@ class TestElasticGangResize:
         driver.enable_elastic(allocator)
         return driver, client, lib, allocator
 
+    @pytest.mark.slow  # full resize-resume-grow cycle; `make chaos-slow`
     def test_chip_unplug_mid_step_resize_resume_and_grow(self, tmp_path):
         import jax
         import numpy as np

@@ -60,6 +60,7 @@ def run_steps(state, step_fn, toks):
 
 
 class TestElasticResume:
+    @pytest.mark.slow  # trains the same run twice; elastic smoke gates resume
     def test_resume_on_a_different_mesh_matches_uninterrupted(
         self, tmp_path, devices
     ):

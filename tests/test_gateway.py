@@ -696,6 +696,7 @@ class TestEndToEndFailover:
         auditor = StateAuditor(state=state, registry=Registry())
         return client, allocator, state, auditor
 
+    @pytest.mark.slow  # real-engine failover e2e; gatewaybench gates drain
     def test_unhealthy_drain_allocator_replace_zero_drift(
         self, cluster, params
     ):

@@ -525,6 +525,7 @@ class TestRingOverlapEP:
             outs["ring"], outs["psum"], atol=3e-5, rtol=3e-5
         )
 
+    @pytest.mark.slow  # ring+psum grad compiles; moebench gates EP parity
     def test_loss_and_grads_match_psum(self, devices):
         """The EP-overlap-vs-psum parity pin: identical loss AND
         per-parameter gradients (rtol pinned) on 8 virtual devices."""

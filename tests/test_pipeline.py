@@ -79,6 +79,7 @@ class TestPipelinedLlama:
             np.array(out), np.array(ref), atol=2e-5, rtol=2e-5
         )
 
+    @pytest.mark.slow  # two full grad compiles; loss-curve tests stay tier-1
     def test_grads_match_plain(self, devices):
         mesh = build_mesh(MeshConfig(pipe=2), devices=devices[:2])
         params = init_params(CFG, jax.random.PRNGKey(0))

@@ -240,6 +240,7 @@ class TestQuantizedModel:
             f"found {len(int8_dots)} of {len(dots)} dots"
         )
 
+    @pytest.mark.slow  # bf16+int8 decode compiles; decodebench gates variants
     def test_int8_decode_tracks_bf16_decode(self, params, qparams):
         """Numerics-tolerance gate for the fused int8 path: stepwise
         int8-weight decode stays within quantization tolerance of the

@@ -1,0 +1,538 @@
+"""Serving-path request observability (ISSUE 16): request-lifecycle
+timelines, the engine/gateway tick profiler, fleet SLO telemetry with
+violation exemplars, and the ``/debug/requests`` surface.
+
+The contract under test: every submitted request — admitted, shed, and
+expired alike — ends with a sealed timeline whose phase decomposition
+sums to its end-to-end latency; SLO violation *onset* (not every
+violating sample) captures the offending timeline as an exemplar naming
+a dominant phase; and the trace id handed back to the caller joins the
+gateway submit span with the engine-side events.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from k8s_dra_driver_tpu.serving_gateway import (
+    AdmissionPolicy,
+    OverloadedError,
+    Router,
+    ServingGateway,
+    ServingTelemetry,
+)
+from k8s_dra_driver_tpu.serving_gateway import reqtrace
+from k8s_dra_driver_tpu.serving_gateway.sim import ScriptedEngine
+from k8s_dra_driver_tpu.utils.metrics import MetricsServer, Registry
+from k8s_dra_driver_tpu.utils.tracing import Tracer
+
+
+def _gw(n_replicas=2, *, clock=None, admission=None, engine_kwargs=None,
+        saturation_depth=10 ** 6, slo=None, tracer=None):
+    registry = Registry()
+    tel = ServingTelemetry(registry, tracer=tracer, slo=slo)
+    kwargs = {}
+    if clock is not None:
+        kwargs["clock"] = clock
+    gw = ServingGateway(
+        registry,
+        router=Router(saturation_depth=saturation_depth),
+        admission_policy=admission,
+        node_name="trace-test",
+        telemetry=tel,
+        **kwargs,
+    )
+    engines = []
+    for i in range(n_replicas):
+        ek = dict(engine_kwargs or {})
+        if clock is not None:
+            ek.setdefault("clock", clock)
+        e = ScriptedEngine(**ek)
+        engines.append(e)
+        gw.add_replica(e, f"r{i}")
+    return gw, tel, engines
+
+
+def _run(gw, handles, clock_box, step=0.25, max_ticks=2000):
+    for _ in range(max_ticks):
+        if all(h.state in ("finished", "failed") for h in handles):
+            return
+        clock_box[0] += step
+        gw.tick()
+    raise AssertionError("gateway did not drain within the tick budget")
+
+
+class TestTimelines:
+    def test_finished_phase_sums_equal_e2e(self):
+        t = [0.0]
+        gw, tel, _ = _gw(2, clock=lambda: t[0])
+        handles = [gw.submit([i] * 16, 3, latency_class="interactive")
+                   for i in range(6)]
+        _run(gw, handles, t)
+        docs = tel.timelines()
+        assert len(docs) == 6
+        for doc in docs:
+            assert doc["outcome"] == reqtrace.OUTCOME_FINISHED
+            assert doc["traceId"]
+            assert set(doc["phases"]) == set(reqtrace.TIMELINE_PHASES)
+            assert sum(doc["phases"].values()) == \
+                pytest.approx(doc["e2eS"], abs=1e-5)
+            names = [e["event"] for e in doc["events"]]
+            for must in ("class-queued", "routed", "engine-admit",
+                         "prefill-chunk", "first-token", "engine-retire"):
+                assert must in names, (must, names)
+            assert names[-1] == reqtrace.OUTCOME_FINISHED
+        # The trace id the caller got back matches the sealed timeline.
+        assert {h.trace_id for h in handles} == \
+            {d["traceId"] for d in docs}
+
+    def test_shed_request_gets_a_sealed_timeline(self):
+        gw, tel, _ = _gw(
+            1, admission=AdmissionPolicy(shed_watermark=2,
+                                         hard_watermark=10),
+            engine_kwargs=dict(stall=True),
+        )
+        for _ in range(2):
+            gw.submit([1, 2], 1, latency_class="interactive")
+        with pytest.raises(OverloadedError) as ei:
+            gw.submit([1, 2], 1, latency_class="batch")
+        # The shed error carries the trace id for caller-side joins.
+        assert ei.value.trace_id
+        sheds = [d for d in tel.timelines()
+                 if d["outcome"] == reqtrace.OUTCOME_SHED]
+        assert len(sheds) == 1
+        doc = sheds[0]
+        assert doc["traceId"] == ei.value.trace_id
+        last = doc["events"][-1]
+        assert last["event"] == reqtrace.OUTCOME_SHED
+        assert last["reason"] == "watermark"
+        assert tel.fleet_slo_summary()["sheds"] == 1
+
+    def test_deadline_expiry_seals_as_expired(self):
+        t = [0.0]
+        gw, tel, _ = _gw(
+            1, clock=lambda: t[0],
+            admission=AdmissionPolicy(max_queue_delay_s={"batch": 10.0}),
+            engine_kwargs=dict(stall=True),
+        )
+        gw.router.saturation_depth = 0  # keep it gateway-queued
+        h = gw.submit([1, 2], 1, latency_class="batch")
+        t[0] = 11.0
+        gw.tick()
+        assert h.state == "failed"
+        docs = tel.timelines()
+        assert len(docs) == 1
+        assert docs[0]["outcome"] == reqtrace.OUTCOME_EXPIRED
+        assert docs[0]["events"][-1]["event"] == reqtrace.OUTCOME_EXPIRED
+        # Expiry spent its whole life in the class queue.
+        assert docs[0]["phases"]["queueWait"] == \
+            pytest.approx(docs[0]["e2eS"], abs=1e-6)
+
+    def test_every_submission_in_a_burst_is_accounted(self):
+        t = [0.0]
+        gw, tel, _ = _gw(
+            2, clock=lambda: t[0],
+            admission=AdmissionPolicy(shed_watermark=4,
+                                      hard_watermark=6),
+        )
+        admitted, shed = [], 0
+        for i in range(10):
+            try:
+                admitted.append(
+                    gw.submit([i] * 8, 2, latency_class="batch"))
+            except OverloadedError:
+                shed += 1
+        assert shed > 0
+        _run(gw, admitted, t)
+        docs = tel.timelines()
+        assert len(docs) == 10  # one sealed timeline per submission
+        by_outcome = {}
+        for d in docs:
+            by_outcome.setdefault(d["outcome"], []).append(d)
+        assert len(by_outcome[reqtrace.OUTCOME_SHED]) == shed
+        assert len(by_outcome[reqtrace.OUTCOME_FINISHED]) == len(admitted)
+
+
+class TestEngineEvents:
+    def test_preemption_emits_timeline_events(self):
+        """A real DecodeEngine under block starvation marks the victim's
+        timeline with ``preempted`` (and readmission shows up as a second
+        ``engine-admit``)."""
+        import jax
+
+        from k8s_dra_driver_tpu.models.llama import PRESETS, init_params
+        from k8s_dra_driver_tpu.models.serving import DecodeEngine
+
+        tiny = PRESETS["tiny"]
+        params = init_params(tiny, jax.random.PRNGKey(0))
+        eng = DecodeEngine(
+            params, tiny, batch_slots=3, num_blocks=6, block_size=8,
+            max_seq_len=48, prefill_chunk=8,
+        )
+        tel = ServingTelemetry(Registry())
+        import numpy as np
+
+        rng = np.random.RandomState(4)
+        prompts = [rng.randint(0, tiny.vocab_size, size=n).tolist()
+                   for n in (7, 9, 6, 8, 7)]
+        reqs = []
+        for p in prompts:
+            r = eng.submit(p, max_new_tokens=10)
+            r.timeline = tel.new_timeline("interactive", 0.0)
+            reqs.append(r)
+        eng.run()
+        eng.assert_no_leaks()
+        assert eng.stats.preemptions > 0
+        preempted = [r for r in reqs if r.preemptions > 0]
+        assert preempted
+        for r in preempted:
+            names = [e["event"] for e in r.timeline.events]
+            assert "preempted" in names
+            assert names.count("engine-admit") >= 2  # readmitted
+        for r in reqs:
+            names = [e["event"] for e in r.timeline.events]
+            for must in ("engine-admit", "prefill-chunk", "first-token",
+                         "engine-retire"):
+                assert must in names, (r.rid, must, names)
+
+
+class TestExemplars:
+    def _tel(self):
+        return ServingTelemetry(
+            Registry(), slo={"interactive": {"ttftS": 0.5, "e2eS": 1.0}})
+
+    def _observe(self, tel, ttft, e2e):
+        tl = tel.new_timeline("interactive", 0.0)
+        tl.event("first-token", ttft)
+        tel.observe_request(tl, e2e, tokens=2)
+        return tl
+
+    def test_onset_only_capture(self):
+        tel = self._tel()
+        self._observe(tel, ttft=3.0, e2e=5.0)   # onset -> exemplar
+        self._observe(tel, ttft=3.0, e2e=5.0)   # sustained -> no new one
+        assert len(tel.exemplars()) == 1
+        self._observe(tel, ttft=0.1, e2e=0.2)   # compliant -> clears
+        self._observe(tel, ttft=3.0, e2e=5.0)   # re-onset -> second
+        assert len(tel.exemplars()) == 2
+        # All four violating samples counted, onset or not.
+        summary = tel.fleet_slo_summary()
+        assert summary["classes"]["interactive"]["violations"] >= 3
+        assert summary["exemplars"] == 2
+
+    def test_exemplar_names_the_dominant_phase(self):
+        tel = self._tel()
+        self._observe(tel, ttft=3.0, e2e=5.0)
+        (ex,) = tel.exemplars()
+        assert ex["latencyClass"] == "interactive"
+        assert ex["signal"] in reqtrace.SLO_SIGNALS
+        assert ex["observedS"] > ex["thresholdS"]
+        assert ex["dominantPhase"] in reqtrace.TIMELINE_PHASES
+        # The captured timeline is the sealed doc, terminal event included.
+        assert ex["timeline"]["outcome"] == reqtrace.OUTCOME_FINISHED
+        assert ex["timeline"]["events"][-1]["event"] == \
+            reqtrace.OUTCOME_FINISHED
+
+    def test_exemplar_ledger_is_bounded(self):
+        tel = self._tel()
+        for _ in range(reqtrace.EXEMPLAR_DEPTH + 10):
+            self._observe(tel, ttft=3.0, e2e=5.0)   # onset
+            self._observe(tel, ttft=0.1, e2e=0.2)   # clear
+        assert len(tel.exemplars()) == reqtrace.EXEMPLAR_DEPTH
+
+
+class TestBoundsAndThreads:
+    def test_timeline_ring_is_bounded(self):
+        tel = ServingTelemetry(Registry())
+        for i in range(reqtrace.RING_DEPTH + 50):
+            tl = tel.new_timeline("batch", float(i))
+            tel.finish_timeline(tl, reqtrace.OUTCOME_FINISHED, i + 1.0)
+        assert len(tel.timelines()) == reqtrace.RING_DEPTH
+
+    def test_per_timeline_event_bound(self):
+        tel = ServingTelemetry(Registry())
+        tl = tel.new_timeline("batch", 0.0)
+        for i in range(reqtrace.MAX_EVENTS + 100):
+            tl.event("prefill-chunk", float(i))
+        tel.finish_timeline(tl, reqtrace.OUTCOME_FINISHED, 1.0)
+        doc = tel.timelines()[0]
+        assert doc["droppedEvents"] == 100
+        # Bounded events plus the (exempt) terminal event.
+        assert len(doc["events"]) == reqtrace.MAX_EVENTS + 1
+        assert doc["events"][-1]["event"] == reqtrace.OUTCOME_FINISHED
+
+    def test_finish_is_idempotent(self):
+        tel = ServingTelemetry(Registry())
+        tl = tel.new_timeline("batch", 0.0)
+        tel.finish_timeline(tl, reqtrace.OUTCOME_SHED, 1.0)
+        tel.finish_timeline(tl, reqtrace.OUTCOME_FAILED, 2.0)
+        assert len(tel.timelines()) == 1
+        assert tel.timelines()[0]["outcome"] == reqtrace.OUTCOME_SHED
+
+    def test_concurrent_scrape_while_recording(self):
+        """export_requests (every view) racing finish/observe must never
+        throw — the metrics server scrapes while the gateway ticks."""
+        tel = ServingTelemetry(
+            Registry(), slo={"interactive": {"ttftS": 0.1, "e2eS": 0.1}})
+        errors = []
+        stop = threading.Event()
+
+        def scrape():
+            while not stop.is_set():
+                try:
+                    for view in reqtrace.VIEWS:
+                        out = tel.export_requests(view)
+                        for line in out.splitlines():
+                            if line.strip():
+                                json.loads(line)
+                except Exception as e:   # pragma: no cover - failure path
+                    errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=scrape) for _ in range(4)]
+        for th in threads:
+            th.start()
+        try:
+            for i in range(400):
+                tl = tel.new_timeline("interactive", float(i))
+                tl.event("first-token", i + 0.5)
+                with tel.profiler.phase("gateway", "dispatch"):
+                    pass
+                tel.profiler.end_tick("gateway", i)
+                tel.observe_request(tl, i + 1.0, tokens=3)
+        finally:
+            stop.set()
+            for th in threads:
+                th.join()
+        assert not errors
+
+
+class TestFleetSloSummary:
+    def test_summary_keys_are_pinned(self):
+        """The soak harness gates on this document; additions are fine
+        via the pinned tuples, silent renames are not."""
+        tel = ServingTelemetry(Registry())
+        tl = tel.new_timeline("interactive", 0.0)
+        tl.event("first-token", 0.1)
+        tel.observe_request(tl, 0.2, tokens=2)
+        summary = tel.fleet_slo_summary()
+        assert tuple(sorted(summary)) == ServingTelemetry.SLO_SUMMARY_KEYS
+        for stats in summary["classes"].values():
+            assert tuple(sorted(stats)) == ServingTelemetry.SLO_CLASS_KEYS
+        json.dumps(summary)  # served as JSON verbatim
+
+    def test_percentiles_are_nearest_rank(self):
+        tel = ServingTelemetry(
+            Registry(), slo={"batch": {"ttftS": 1e9, "e2eS": 1e9}})
+        samples = [float(i) for i in range(1, 101)]   # e2e = 1..100s
+        for s in samples:
+            tl = tel.new_timeline("batch", 0.0)
+            tl.event("first-token", s)
+            tel.observe_request(tl, s, tokens=1)
+        stats = tel.fleet_slo_summary()["classes"]["batch"]
+        ordered = sorted(samples)
+
+        def nearest_rank(p):
+            idx = max(0, min(len(ordered) - 1,
+                             int(round(p * (len(ordered) - 1)))))
+            return ordered[idx]
+
+        assert stats["e2eP50S"] == pytest.approx(nearest_rank(0.50),
+                                                 rel=0.02)
+        assert stats["e2eP99S"] == pytest.approx(nearest_rank(0.99),
+                                                 rel=0.02)
+        assert stats["requests"] == 100
+
+    def test_gateway_without_telemetry_returns_none(self):
+        gw = ServingGateway(Registry(), router=Router(), node_name="bare")
+        assert gw.telemetry is None
+        assert gw.fleet_slo_summary() is None
+
+
+class TestTickProfiler:
+    def test_gateway_and_engine_phases_recorded(self):
+        t = [0.0]
+        gw, tel, _ = _gw(2, clock=lambda: t[0])
+        handles = [gw.submit([i] * 8, 2, latency_class="interactive")
+                   for i in range(4)]
+        _run(gw, handles, t)
+        summary = tel.profiler.summary()
+        assert summary["kind"] == "summary"
+        for key in ("gateway/dispatch", "gateway/replicas",
+                    "gateway/harvest", "engine/admit", "engine/decode"):
+            assert key in summary["phaseSeconds"], key
+        # Shares are normalized per component ("harvest is 60% of the
+        # gateway tick"), so each component's shares sum to ~1.
+        for comp in ("gateway", "engine"):
+            share = sum(v for k, v in summary["phaseShare"].items()
+                        if k.startswith(comp + "/"))
+            assert share == pytest.approx(1.0, abs=1e-3), comp
+        # Per-tick ring entries carry the component and the replica tag
+        # (free-form tag, never a metric label).
+        lines = tel.profiler.export_jsonl().splitlines()
+        docs = [json.loads(ln) for ln in lines if ln.strip()]
+        assert docs[0]["kind"] == "summary"
+        ticks = [d for d in docs[1:] if d["kind"] == "tick"]
+        components = {d["component"] for d in ticks}
+        assert components == {"gateway", "engine"}
+        assert {d.get("tag") for d in ticks if d["component"] == "engine"} \
+            <= {"r0", "r1"}
+
+    def test_phase_histogram_is_fed(self):
+        registry = Registry()
+        tel = ServingTelemetry(registry)
+        with tel.profiler.phase("gateway", "dispatch"):
+            pass
+        tel.profiler.end_tick("gateway", 0)
+        body = registry.render()
+        assert "tpu_dra_srv_tick_phase_seconds" in body
+        assert 'component="gateway"' in body
+        assert 'phase="dispatch"' in body
+
+
+class TestTraceCorrelation:
+    def test_slow_replica_exemplar_joins_gateway_span(self):
+        """The acceptance scenario: an injected slow replica produces an
+        SLO violation whose exemplar names the dominant phase and whose
+        trace id resolves to the gateway submit span."""
+        t = [0.0]
+        gw, tel, _ = _gw(
+            1, clock=lambda: t[0],
+            slo={"interactive": {"ttftS": 0.5, "e2eS": 2.0}},
+            tracer=Tracer(max_traces=4096),
+            engine_kwargs=dict(decode_ticks_per_token=8),
+        )
+        handles = [gw.submit([i] * 8, 4, latency_class="interactive")
+                   for i in range(4)]
+        _run(gw, handles, t)
+        summary = tel.fleet_slo_summary()
+        assert summary["classes"]["interactive"]["violations"] > 0
+        exemplars = tel.exemplars()
+        assert exemplars
+        ex = exemplars[0]
+        assert ex["dominantPhase"] == "decode"   # the slow part IS decode
+        trace = tel.tracer.find_trace_by_tag(
+            "gid", ex["timeline"]["gid"])
+        assert trace is not None
+        assert trace["traceId"] == ex["traceId"]
+        names = {s["name"] for s in trace["spans"]}
+        assert "gateway/submit" in names
+
+
+class TestDebugRequestsEndpoint:
+    def _serve(self, tel):
+        registry = Registry()
+        srv = MetricsServer(registry, host="127.0.0.1", port=0)
+        if tel is not None:
+            srv.set_requests_provider(tel.export_requests)
+        srv.start()
+        return srv
+
+    def test_endpoint_contract(self):
+        tel = ServingTelemetry(Registry())
+        tl = tel.new_timeline("interactive", 0.0)
+        tl.event("first-token", 0.1)
+        tel.observe_request(tl, 0.2, tokens=2)
+        with tel.profiler.phase("gateway", "dispatch"):
+            pass
+        tel.profiler.end_tick("gateway", 0)
+        srv = self._serve(tel)
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            body = urllib.request.urlopen(
+                f"{base}/debug/requests").read().decode()
+            docs = [json.loads(ln) for ln in body.splitlines()
+                    if ln.strip()]
+            assert len(docs) == 1
+            assert docs[0]["outcome"] == reqtrace.OUTCOME_FINISHED
+            ticks = urllib.request.urlopen(
+                f"{base}/debug/requests?view=ticks").read().decode()
+            first = json.loads(ticks.splitlines()[0])
+            assert first["kind"] == "summary"
+            slo = json.loads(urllib.request.urlopen(
+                f"{base}/debug/requests?view=slo").read().decode())
+            assert tuple(sorted(slo)) == ServingTelemetry.SLO_SUMMARY_KEYS
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"{base}/debug/requests?view=bogus")
+            assert ei.value.code == 400
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{base}/debug/requests",
+                                       data=b"x")
+            assert ei.value.code == 405
+            assert "GET" in ei.value.headers.get("Allow", "")
+        finally:
+            srv.stop()
+
+    def test_404_when_tracing_not_enabled(self):
+        srv = self._serve(None)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/debug/requests")
+            assert ei.value.code == 404
+        finally:
+            srv.stop()
+
+
+class TestDoctorSloExemplar:
+    def _node(self, violations, exemplars):
+        from k8s_dra_driver_tpu.doctor import NodeScrape
+
+        node = NodeScrape(name="n1", url="http://x")
+        node.slo_summary = {
+            "classes": {
+                "interactive": {
+                    "violations": violations,
+                    "e2eP99S": 3.0,
+                    "ttftP99S": 2.0,
+                },
+            },
+        }
+        node.exemplars = exemplars
+        return node
+
+    def test_sustained_violations_point_at_slowest_exemplar(self):
+        from k8s_dra_driver_tpu.doctor import fleet_findings
+
+        node = self._node(5, [
+            {"latencyClass": "interactive", "signal": "e2e",
+             "observedS": 2.0, "thresholdS": 1.0,
+             "dominantPhase": "queueWait", "traceId": "aaa"},
+            {"latencyClass": "interactive", "signal": "e2e",
+             "observedS": 4.0, "thresholdS": 1.0,
+             "dominantPhase": "decode", "traceId": "bbb"},
+            {"latencyClass": "batch", "signal": "e2e",
+             "observedS": 9.0, "thresholdS": 1.0,
+             "dominantPhase": "prefill", "traceId": "ccc"},
+        ])
+        findings = [f for f in fleet_findings([node], None, "tpu")
+                    if f.check == "slo-exemplar"]
+        assert len(findings) == 1
+        f = findings[0]
+        assert f.severity == "drift"
+        assert f.subject == "n1/interactive"
+        # The slowest matching exemplar (4.0s, decode), not the batch one.
+        assert "decode" in f.detail and "bbb" in f.detail
+        assert "docs/operations.md" in f.detail
+
+    def test_below_threshold_is_quiet(self):
+        from k8s_dra_driver_tpu.doctor import (
+            SLO_SUSTAINED_VIOLATIONS,
+            fleet_findings,
+        )
+
+        node = self._node(SLO_SUSTAINED_VIOLATIONS - 1, [])
+        assert not [f for f in fleet_findings([node], None, "tpu")
+                    if f.check == "slo-exemplar"]
+
+    def test_sustained_without_exemplar_still_flags(self):
+        from k8s_dra_driver_tpu.doctor import fleet_findings
+
+        node = self._node(4, [])
+        (f,) = [f for f in fleet_findings([node], None, "tpu")
+                if f.check == "slo-exemplar"]
+        assert "no exemplar captured" in f.detail

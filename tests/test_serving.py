@@ -598,6 +598,7 @@ class TestPrefixReuse:
         assert b.tokens == _reference(params, small, 2)
         assert c.tokens == a.tokens == _reference(params, big, 8)
 
+    @pytest.mark.slow  # churn soak; faster PrefixReuse tests stay tier-1
     def test_leak_oracle_under_shared_and_private_churn(self, params):
         """Satellite: churn shared and private requests through a small
         pool (admissions, cache hits, COW, preemptions, LRU evictions)

@@ -39,6 +39,10 @@ Plus one first-party rule with no ruff analog:
   owns ``tpu_dra_gw_*`` at DIRECTORY granularity (``serving_gateway/``
   spans several modules sharing one family): metrics declared there
   must use the prefix, and the prefix may not appear anywhere else.
+  ``serving_gateway/reqtrace.py`` is the one carve-out: it owns
+  ``tpu_dra_srv_*`` (confined both directions, like a directory
+  family), so its module entry exempts it from the directory's
+  declare-side rule.
 - TPM06: ``stage=``/``reason=`` label values on the ``tpu_dra_alloc_*``
   explainability families are confined to the ``STAGES``/``REASONS``
   enums declared in ``kube/allocator.py`` (parsed by AST, not imported):
@@ -219,6 +223,9 @@ _MODULE_FAMILY_PREFIXES = {
     "allocator.py": "tpu_dra_alloc",
     "defrag.py": "tpu_dra_defrag_",
     "rebalancer.py": "tpu_dra_slo_",
+    # reqtrace.py lives under serving_gateway/ but owns its own family;
+    # a module entry exempts it from the directory rule below.
+    "reqtrace.py": "tpu_dra_srv_",
 }
 # Directory-owned families: every metric declared anywhere under the
 # directory uses its prefix, and (unlike the per-module table, whose
@@ -227,6 +234,14 @@ _MODULE_FAMILY_PREFIXES = {
 # autoscaler/gateway) that share one family.
 _DIR_FAMILY_PREFIXES = {
     "serving_gateway": "tpu_dra_gw_",
+}
+# Module-owned prefixes confined BOTH directions (like the directory
+# rule): tpu_dra_srv_* declared anywhere but reqtrace.py is a vocabulary
+# leak. Only unambiguous prefixes belong here — tpu_dra_alloc is a
+# shared stem (tpu_dra_alloc_* + tpu_dra_allocation_*), so it stays
+# declare-side-only in _MODULE_FAMILY_PREFIXES.
+_CONFINED_MODULE_PREFIXES = {
+    "reqtrace.py": "tpu_dra_srv_",
 }
 _METRIC_METHODS = {"inc", "set", "observe"}
 
@@ -279,8 +294,20 @@ def check_metric_conventions(tree: ast.Module, path: Path) -> list[Finding]:
                 path, node.lineno, "TPM05",
                 f"{cls} name {name!r} declared in {path.name} must use "
                 f"the {owned_prefix!r} family prefix"))
+        for module, mod_prefix in _CONFINED_MODULE_PREFIXES.items():
+            if path.name != module and name.startswith(mod_prefix):
+                out.append(Finding(
+                    path, node.lineno, "TPM05",
+                    f"{cls} name {name!r} uses the {mod_prefix!r} "
+                    f"family prefix owned by {module}"))
         for dirname, dir_prefix in _DIR_FAMILY_PREFIXES.items():
             in_dir = dirname in path.parts
+            # A file with its own module-owned family is exempt from its
+            # directory's declare-side rule (reqtrace.py under
+            # serving_gateway/ declares tpu_dra_srv_*, not tpu_dra_gw_*)
+            # — but never from the confinement arm below.
+            if in_dir and path.name in _MODULE_FAMILY_PREFIXES:
+                continue
             if in_dir and not name.startswith(dir_prefix):
                 out.append(Finding(
                     path, node.lineno, "TPM05",

@@ -403,9 +403,14 @@ def _self_test_scrape() -> tuple[str, list[str]]:
         Replica,
         Router,
         ServingGateway,
+        ServingTelemetry,
     )
     from k8s_dra_driver_tpu.serving_gateway.autoscaler import (
         OUTCOMES as SCALE_OUTCOMES,
+    )
+    from k8s_dra_driver_tpu.serving_gateway.reqtrace import (
+        OUTCOMES as TRACE_OUTCOMES,
+        TIMELINE_PHASES,
     )
     from k8s_dra_driver_tpu.serving_gateway.sim import (
         ScriptedEngine,
@@ -414,6 +419,14 @@ def _self_test_scrape() -> tuple[str, list[str]]:
 
     gw_errors: list[str] = []
 
+    # Deterministic virtual clock shared by the gateway and every engine
+    # (0.25 "seconds" per tick below): latencies, timelines, and the
+    # forced SLO violation are then independent of wall-clock noise.
+    gw_clock_box = [0.0]
+
+    def gw_clock():
+        return gw_clock_box[0]
+
     class _Provisioner:
         def __init__(self):
             self.ups = 0
@@ -421,12 +434,22 @@ def _self_test_scrape() -> tuple[str, list[str]]:
         def scale_up(self):
             self.ups += 1
             return Replica(f"scaled-{self.ups}", ScriptedEngine(
-                batch_slots=2, prefill_chunk=16,
+                batch_slots=2, prefill_chunk=16, clock=gw_clock,
             ))
 
         def scale_down(self, replica):
             pass
 
+    telemetry = ServingTelemetry(
+        registry,
+        # Deep enough that per-tick traces cannot evict the submit
+        # traces the join assertion below looks up.
+        tracer=Tracer(max_traces=4096),
+        # Tight interactive budgets (in virtual seconds), so the slow
+        # replica below forcibly populates the violation counters and
+        # the exemplar ledger through the REAL observe path.
+        slo={"interactive": {"ttftS": 0.5, "e2eS": 2.0}},
+    )
     gateway = ServingGateway(
         registry,
         router=Router(policy="affinity", block_size=16,
@@ -440,29 +463,73 @@ def _self_test_scrape() -> tuple[str, list[str]]:
             _Provisioner(),
         ),
         node_name="verify",
+        clock=gw_clock,
+        telemetry=telemetry,
     )
-    for i in range(2):
-        gateway.add_replica(
-            ScriptedEngine(batch_slots=2, prefill_chunk=16),
-            f"verify-replica-{i}",
-        )
+    # Replica 1 is a degraded chip (8 ticks per decoded token): requests
+    # routed there miss the tight interactive SLO — the forced violation.
+    gateway.add_replica(
+        ScriptedEngine(batch_slots=2, prefill_chunk=16, clock=gw_clock),
+        "verify-replica-0",
+    )
+    gateway.add_replica(
+        ScriptedEngine(batch_slots=2, prefill_chunk=16,
+                       decode_ticks_per_token=8, clock=gw_clock),
+        "verify-replica-1",
+    )
+    gw_handles = []
     for prompt in shared_prefix_prompts(
         22, n_systems=4, system_len=32, tail_len=4, seed=11
     ):
-        gateway.submit(prompt, 2, latency_class="interactive")
+        gw_handles.append(
+            gateway.submit(prompt, 2, latency_class="interactive")
+        )
+    if any(not h.trace_id for h in gw_handles):
+        gw_errors.append("gateway handle missing its trace id")
     try:
         gateway.submit([1] * 16, 2, latency_class="batch")
         gw_errors.append(
             "gateway accepted batch traffic past the shed watermark"
         )
-    except OverloadedError:
-        pass
-    gateway.run()
+    except OverloadedError as shed_err:
+        if not getattr(shed_err, "trace_id", ""):
+            gw_errors.append("shed OverloadedError missing its trace id")
+    for _ in range(100000):
+        if not gateway._live:
+            break
+        gw_clock_box[0] += 0.25
+        gateway.tick()
     if gateway.counters["completed"] != 22:
         gw_errors.append(
             f"gateway sim completed {gateway.counters['completed']} "
             "of 22 requests"
         )
+    gw_summary = gateway.fleet_slo_summary() or {}
+    if not gw_summary.get("violations"):
+        gw_errors.append(
+            "slow replica forced no SLO violation in fleet_slo_summary"
+        )
+    if not telemetry.exemplars():
+        gw_errors.append("no exemplar captured at violation onset")
+    else:
+        exemplar = telemetry.exemplars()[-1]
+        if exemplar.get("dominantPhase") not in TIMELINE_PHASES:
+            gw_errors.append(
+                f"exemplar dominantPhase {exemplar.get('dominantPhase')!r} "
+                "outside TIMELINE_PHASES"
+            )
+        # The trace-id join: the exemplar's gid resolves to the finished
+        # gateway/submit span carrying the same trace id.
+        ex_tl = exemplar.get("timeline") or {}
+        joined = telemetry.tracer.find_trace_by_tag("gid", ex_tl.get("gid"))
+        if not joined:
+            gw_errors.append(
+                "exemplar gid does not resolve to a gateway/submit trace"
+            )
+        elif joined.get("traceId") != exemplar.get("traceId"):
+            gw_errors.append(
+                "exemplar trace id does not match its submit span's"
+            )
     if not any(
         r["kind"] == "scale" and r.get("outcome") == "applied"
         for r in gateway.snapshot()["events"]
@@ -483,6 +550,7 @@ def _self_test_scrape() -> tuple[str, list[str]]:
     srv.set_defrag_provider(planner.export_json)
     srv.set_rebalance_provider(lambda: rebalance_snapshot)
     srv.set_gateway_provider(lambda: gateway_snapshot)
+    srv.set_requests_provider(telemetry.export_requests)
     srv.start()
     try:
         base = f"http://127.0.0.1:{srv.port}"
@@ -644,10 +712,94 @@ def _self_test_scrape() -> tuple[str, list[str]]:
                 errors.append(
                     "/debug/gateway: no applied scale decision served"
                 )
+        # /debug/requests: JSONL of every submitted request's sealed
+        # timeline (22 finished + 1 shed), enum-confined outcomes,
+        # trace ids present; plus the ticks/exemplars/slo views and
+        # the 400 on an unknown view.
+        requests_body = urllib.request.urlopen(
+            f"{base}/debug/requests"
+        ).read().decode()
+        timeline_docs = []
+        for line in filter(None, requests_body.splitlines()):
+            try:
+                timeline_docs.append(json.loads(line))
+            except ValueError:
+                errors.append(
+                    f"/debug/requests: undecodable line {line!r}"
+                )
+        if len(timeline_docs) != 23:
+            errors.append(
+                f"/debug/requests: {len(timeline_docs)} timelines "
+                "(want 23: 22 finished + 1 shed)"
+            )
+        for doc in timeline_docs:
+            if doc.get("outcome") not in TRACE_OUTCOMES:
+                errors.append(
+                    f"/debug/requests: outcome {doc.get('outcome')!r} "
+                    "outside OUTCOMES"
+                )
+            if not doc.get("traceId"):
+                errors.append(
+                    "/debug/requests: timeline missing its trace id"
+                )
+            if doc.get("dominantPhase") not in TIMELINE_PHASES:
+                errors.append(
+                    f"/debug/requests: dominantPhase "
+                    f"{doc.get('dominantPhase')!r} outside TIMELINE_PHASES"
+                )
+        if not any(d.get("outcome") == "shed" for d in timeline_docs):
+            errors.append("/debug/requests: shed timeline missing")
+        ticks_body = urllib.request.urlopen(
+            f"{base}/debug/requests?view=ticks"
+        ).read().decode()
+        tick_lines = [json.loads(ln)
+                      for ln in filter(None, ticks_body.splitlines())]
+        if not tick_lines or tick_lines[0].get("kind") != "summary":
+            errors.append(
+                "/debug/requests?view=ticks: first line is not the "
+                "phase summary"
+            )
+        else:
+            phase_keys = set(tick_lines[0].get("phaseSeconds") or {})
+            for want in ("gateway/dispatch", "engine/decode"):
+                if want not in phase_keys:
+                    errors.append(
+                        f"?view=ticks summary missing phase {want!r}"
+                    )
+        exemplars_body = urllib.request.urlopen(
+            f"{base}/debug/requests?view=exemplars"
+        ).read().decode()
+        if not any(filter(None, exemplars_body.splitlines())):
+            errors.append("/debug/requests?view=exemplars: empty")
+        slo_body = urllib.request.urlopen(
+            f"{base}/debug/requests?view=slo"
+        ).read().decode()
+        try:
+            slo_doc = json.loads(slo_body)
+        except ValueError:
+            errors.append("/debug/requests?view=slo: body is not JSON")
+        else:
+            for key in ServingTelemetry.SLO_SUMMARY_KEYS:
+                if key not in slo_doc:
+                    errors.append(
+                        f"/debug/requests?view=slo missing key {key!r}"
+                    )
+        try:
+            urllib.request.urlopen(f"{base}/debug/requests?view=bogus")
+            errors.append(
+                "/debug/requests served an unknown view (want 400)"
+            )
+        except urllib.error.HTTPError as e:
+            if e.code != 400:
+                errors.append(
+                    f"/debug/requests?view=bogus: HTTP {e.code} "
+                    "(want 400)"
+                )
         # The scrape surface is GET-only by contract — /metrics and the
         # debug endpoints alike.
         for route in ("/metrics", "/debug/allocations", "/debug/defrag",
-                      "/debug/rebalance", "/debug/gateway"):
+                      "/debug/rebalance", "/debug/gateway",
+                      "/debug/requests"):
             try:
                 urllib.request.urlopen(base + route, data=b"x")
                 errors.append(f"{route} accepted a POST (want 405)")
@@ -682,7 +834,15 @@ def _self_test_scrape() -> tuple[str, list[str]]:
                    "tpu_dra_gw_shed_total",
                    "tpu_dra_gw_replicas",
                    "tpu_dra_gw_scale_decisions_total",
-                   "tpu_dra_gw_requests_total"):
+                   "tpu_dra_gw_requests_total",
+                   "tpu_dra_srv_ttft_seconds",
+                   "tpu_dra_srv_e2e_seconds",
+                   "tpu_dra_srv_token_interval_seconds",
+                   "tpu_dra_srv_tick_phase_seconds",
+                   "tpu_dra_srv_slo_violations_total",
+                   "tpu_dra_srv_violation_seconds_total",
+                   "tpu_dra_srv_timelines_total",
+                   "tpu_dra_srv_exemplars_total"):
         if f"\n{family}" not in body and not body.startswith(family):
             errors.append(f"expected family {family} missing from scrape")
     # The rendered stage/reason label values stay inside the enums the
