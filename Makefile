@@ -2,7 +2,7 @@
 IMAGE ?= tpu-dra-driver
 TAG ?= latest
 
-.PHONY: all native test image lint verify verify-metrics chaos chaos-slow doctor decodebench moebench elastic allocbench allocbench-smoke gatewaybench tracesmoke clean e2e-kind
+.PHONY: all native test image lint verify verify-metrics chaos chaos-slow doctor decodebench moebench elastic allocbench allocbench-smoke gatewaybench tracesmoke defragsmoke clean e2e-kind
 
 all: native
 
@@ -90,6 +90,17 @@ allocbench-smoke:
 gatewaybench:
 	python tools/run_gateway_smoke.py
 
+# Defrag-execution smoke (tools/run_defrag_smoke.py): a checkerboarded
+# fleet leaves a 2-chip gang unsat; the DefragPlanner's plan is executed
+# by the DefragExecutor through a seeded crash window at one of the
+# defrag.* sites, then recovered by a "restarted" executor. PASS gates:
+# the gang ends admitted on the freed box, allocator/node-state/checkpoint
+# agree, the StateAuditor reports zero residual drift, no execution
+# intent is orphaned, and every admitted serving request finishes.
+defragsmoke:
+	TPU_DRA_CHAOS_SEED=$(TPU_DRA_CHAOS_SEED) \
+		python tools/run_defrag_smoke.py
+
 # Request-observability overhead smoke (tools/run_trace_smoke.py): the
 # same fixed-seed serving profile with telemetry OFF vs ON — token
 # streams, tick counts (the deterministic "within 3% req/s" enforcement)
@@ -101,9 +112,10 @@ tracesmoke:
 
 # The full local gate: lint + unit/integration tests + chaos schedules +
 # metrics exposition + the doctor/auditor drill + the decode-engine,
-# MoE fast-path, elastic-training, allocator-bench, fleet-gateway, and
-# request-observability smokes. What CI runs; what a PR must pass.
-verify: lint test chaos verify-metrics doctor decodebench moebench elastic allocbench-smoke gatewaybench tracesmoke
+# MoE fast-path, elastic-training, allocator-bench, fleet-gateway,
+# request-observability, and defrag-execution smokes. What CI runs;
+# what a PR must pass.
+verify: lint test chaos verify-metrics doctor decodebench moebench elastic allocbench-smoke gatewaybench tracesmoke defragsmoke
 
 # ruff when available (CI installs it; .golangci.yaml analog is
 # [tool.ruff] in pyproject.toml), else the first-party AST lint floor.

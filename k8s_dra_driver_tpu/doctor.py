@@ -564,6 +564,51 @@ def fleet_findings(
                         "execution reuses the elastic resize protocol",
                     ))
 
+    # Defrag plan→execution trail: every execution record the nodes'
+    # /debug/defrag `executions` views carry. A failed execution left
+    # the intent on disk (the node auditor's `defrag` check agrees) —
+    # DRIFT; an in-flight one is progress — INFO. Completed/rolled-back
+    # records are the trail itself and surface only at -v (INFO), so a
+    # healthy fleet's doctor run stays quiet but the history is there.
+    for node in nodes:
+        for rec in (node.defrag or {}).get("executions", []) or []:
+            claim_ref = rec.get("claim") or {}
+            subject = (
+                f"{claim_ref.get('namespace', '?')}/"
+                f"{claim_ref.get('name') or claim_ref.get('uid', '?')}"
+            )
+            steps = ", ".join(
+                f"{s.get('kind')}[{s.get('claimUid') or '-'}]="
+                f"{s.get('outcome')}"
+                for s in rec.get("steps", [])
+            ) or "no steps recorded"
+            rollbacks = rec.get("rollbacks") or []
+            trail = (
+                f"plan {rec.get('planId')} {rec.get('state')}: "
+                f"{rec.get('detail') or 'no detail'} — steps: {steps}"
+            )
+            if rollbacks:
+                trail += "; rollbacks: " + ", ".join(
+                    f"{r.get('claimUid')}={r.get('outcome')}"
+                    for r in rollbacks
+                )
+            state = rec.get("state")
+            if state == "failed":
+                findings.append(DoctorFinding(
+                    SEVERITY_DRIFT, "defrag-exec", subject,
+                    trail + " — the execution intent is still on disk; "
+                    "restart the plugin (recovery) or abort() the plan",
+                ))
+            elif state == "in-flight":
+                findings.append(DoctorFinding(
+                    SEVERITY_INFO, "defrag-exec", subject,
+                    trail + " — execution in progress",
+                ))
+            else:
+                findings.append(DoctorFinding(
+                    SEVERITY_INFO, "defrag-exec", subject, trail,
+                ))
+
     if cluster is None:
         return findings
     # Nodes whose /debug/usage scrape failed have an UNKNOWN hold set —

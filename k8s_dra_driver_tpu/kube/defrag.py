@@ -16,10 +16,15 @@ The planner attaches to a :class:`~.allocator.ReferenceAllocator`
 calls :meth:`note_unsat` from its unsat path, under its own lock. Plans
 land in a bounded ring buffer served as JSON at ``/debug/defrag``
 (``MetricsServer.set_defrag_provider``) and feed the
-``tpu_dra_defrag_*`` metric families. Execution is deliberately out of
-scope: migrating a live gang is the elastic resize protocol's job
-(DeviceState.resize_claim), and wiring the two together is a controller
-policy decision, not an allocator one.
+``tpu_dra_defrag_*`` metric families.
+
+The planner itself never moves anything. Execution lives in
+:mod:`.defrag_executor` (opt-in, ``--defrag-execute`` on the driver):
+each plan is stamped with a ``planId`` and the ``sig`` (inventory
+generation + reservation version) it was computed against, so the
+executor can refuse a stale plan, and when an executor is attached
+(``planner.executor``, set by its constructor) the ``/debug/defrag``
+payload grows an ``executions`` trail next to the plans.
 """
 
 from __future__ import annotations
@@ -98,6 +103,11 @@ class DefragPlanner:
         # sync period must not re-plan (and re-append near-identical
         # plans, evicting everyone else's) while nothing has changed.
         self._last_sig: dict[str, tuple] = {}
+        # Monotonic plan-id counter; an attached DefragExecutor (set by
+        # its constructor) contributes the executions view to
+        # export_json and keys its trail on these ids.
+        self._plan_seq = 0
+        self.executor = None
         allocator.defrag = self
 
     # -- reading -----------------------------------------------------------
@@ -108,14 +118,18 @@ class DefragPlanner:
 
     def export_json(self) -> dict[str, Any]:
         """The ``/debug/defrag`` payload."""
-        return {
+        out: dict[str, Any] = {
             "plans": self.recent_plans(),
             "note": (
-                "plans are read-only proposals; executing one means "
-                "resizing the listed claims through the elastic resize "
-                "protocol (docs/operations.md: fleet is fragmented)"
+                "plans are proposals until executed; execution (opt-in "
+                "--defrag-execute) drains/reshards the listed claims "
+                "through the gateway and elastic resize protocols "
+                "(docs/operations.md: fleet is fragmented)"
             ),
         }
+        if self.executor is not None:
+            out["executions"] = self.executor.export_executions()
+        return out
 
     # -- planning ----------------------------------------------------------
 
@@ -250,6 +264,16 @@ class DefragPlanner:
         return self._finish(plan, t0)
 
     def _finish(self, plan: dict, t0: float) -> dict:
+        # Execution pinning: the id names this plan in the executor's
+        # trail, and the sig is the exact allocator state the migrations
+        # were computed against — the executor refuses to run a plan
+        # whose sig no longer matches (anything could have moved).
+        self._plan_seq += 1
+        plan["planId"] = f"plan-{self._plan_seq}"
+        plan["sig"] = {
+            "generation": self.allocator.index.generation,
+            "reservationVersion": self.allocator.reservation_version,
+        }
         self._m_plans.inc(outcome=plan["outcome"])
         self._m_seconds.observe(time.monotonic() - t0)
         self._m_migrations.set(len(plan["migrations"]))
@@ -446,6 +470,13 @@ class DefragPlanner:
                 "to": sorted(
                     slice_cells[c]["name"] for c in dest_cells
                     if c in slice_cells
+                ),
+                # Destination coordinates in selector form ("x,y,z"):
+                # the executor re-solves each mover pinned to exactly
+                # these cells, so the applied placement IS the planned
+                # one (not merely a placement of the same shape).
+                "toCoords": sorted(
+                    f"{c[0]},{c[1]},{c[2]}" for c in dest_cells
                 ),
                 "box": f"{dims[0]}x{dims[1]}x{dims[2]}",
                 "score": score,

@@ -227,7 +227,33 @@ class ElasticTrainer:
 
     # -- resize ------------------------------------------------------------
 
-    def resize(self, devices: Sequence, *, reason: str = "") -> ResizeEvent:
+    def relocate(self, devices: Sequence, *, reason: str = "") -> ResizeEvent:
+        """Defrag-migration resize: move the gang onto ``devices``
+        WITHOUT shrinking the mesh. A relocation trades placement for
+        placement — the defrag executor promises loss continuity, so a
+        destination that would silently idle part of the mesh (or force
+        a smaller sub-mesh) is refused up front with
+        :class:`ElasticResizeError` instead of degrading training.
+        Otherwise delegates to :meth:`resize` with the old devices
+        marked still-alive — a migration is a planned move, not a
+        failure, so the live state reshards device-to-device onto the
+        destination (never a checkpoint restore) and the step/loss
+        continuity guarantees apply unchanged."""
+        usable = largest_usable_count(
+            len(devices), self.mesh_config, self.global_batch
+        )
+        if usable < len(self.devices):
+            raise ElasticResizeError(
+                f"relocation target of {len(devices)} device(s) cannot "
+                f"host the current {len(self.devices)}-device mesh "
+                f"(largest valid sub-mesh: {usable}) — a defrag move "
+                "must not shrink the gang"
+            )
+        return self.resize(devices, reason=reason or "defrag relocation",
+                           sources_alive=True)
+
+    def resize(self, devices: Sequence, *, reason: str = "",
+               sources_alive: bool = False) -> ResizeEvent:
         """Reshape the mesh onto ``devices`` and reshard the live state.
 
         ``devices`` is the post-resize gang (survivors first is not
@@ -235,6 +261,11 @@ class ElasticTrainer:
         sub-mesh so transfers stay local). Devices beyond the largest
         valid sub-mesh are idled, not dropped: they remain in the gang
         and re-enter the mesh on the next grow.
+
+        ``sources_alive`` (the :meth:`relocate` path) declares that
+        devices LEAVING the gang still hold readable HBM — a planned
+        migration, not a chip loss — so the live state reshards from
+        them instead of falling back to a checkpoint restore.
         """
         t0 = time.monotonic()
         faults.fire("train.reshard")
@@ -280,8 +311,10 @@ class ElasticTrainer:
 
         # Sources readable for a live reshard: old-mesh devices that are
         # still part of the gang. A device absent from ``devices``
-        # vanished with its HBM — its shards only survive as replicas.
-        available = old_set & set(devices)
+        # vanished with its HBM — its shards only survive as replicas —
+        # UNLESS the caller vouches the sources are alive (a planned
+        # relocation reads every old shard device-to-device).
+        available = old_set if sources_alive else old_set & set(devices)
         path = RESHARD_LIVE
         new_state = None
         if state_covered(self.state, available):

@@ -72,7 +72,7 @@ logger = logging.getLogger(__name__)
 
 # Every check name, so gauges render an explicit zero when clean.
 CHECKS = ("checkpoint", "cdi", "channels", "health", "sharing",
-          "sharing-limits", "resize", "slices")
+          "sharing-limits", "resize", "defrag", "slices")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -113,6 +113,10 @@ class StateAuditor:
         self.node_uid = node_uid
         self.events = events
         self.interval = interval_seconds
+        # Attached by Driver.enable_defrag_execution: lets the resize
+        # check skip claims an in-flight defrag plan is legitimately
+        # moving, and the defrag check report orphaned intents.
+        self.defrag_executor = None
         self.findings: list[AuditFinding] = []
         self.passes = 0
         self._ran = False
@@ -179,6 +183,7 @@ class StateAuditor:
             self._check_sharing(findings, ckpt)
             self._check_sharing_limits(findings, ckpt)
             self._check_resize(findings, ckpt)
+        self._check_defrag(findings)
         # The apiserver comparison runs outside the lock (network) and is
         # skipped — not reported as drift — when the server is dark.
         self._check_slices(findings)
@@ -428,9 +433,15 @@ class StateAuditor:
         added spare vanished while the plugin was down). The claim's
         container env and its checkpointed gang may disagree until an
         operator re-prepares or deletes the claim."""
+        in_flight = frozenset()
+        if self.defrag_executor is not None:
+            # A defrag execution resizes claims mid-pass by design; its
+            # own intent file (not this check) owns their convergence
+            # until the execution finishes.
+            in_flight = self.defrag_executor.in_flight_uids()
         for uid, rec in sorted(ckpt.items()):
             intent = rec.get("resize")
-            if not intent:
+            if not intent or uid in in_flight:
                 continue
             findings.append(AuditFinding(
                 "resize", uid,
@@ -441,6 +452,41 @@ class StateAuditor:
                 "spec may not match its checkpointed gang — re-prepare "
                 "or delete the claim",
             ))
+
+    def _check_defrag(self, findings) -> None:
+        """No defrag execution intent may exist outside an execution.
+
+        The executor clears its intent on completion AND rollback, and
+        recovery converges a crash-left one at startup — so an intent
+        visible here (while nothing is executing) is a plan neither
+        path could finish: holds, node state, or replicas may disagree
+        with the planned placement until an operator intervenes
+        (``docs/operations.md``: fleet is fragmented → aborting a stuck
+        plan)."""
+        if self.defrag_executor is None:
+            return
+        orphan = self.defrag_executor.orphaned_intent()
+        if orphan is None:
+            return
+        if "error" in orphan:
+            findings.append(AuditFinding(
+                "defrag", orphan.get("path", ""), orphan["error"],
+            ))
+            return
+        uid = (orphan.get("claim") or {}).get("uid", "")
+        done = sum(
+            1 for m in orphan.get("migrations", [])
+            if m.get("status") == "done"
+        )
+        findings.append(AuditFinding(
+            "defrag", uid or orphan.get("planId", ""),
+            f"defrag execution intent for plan {orphan.get('planId')} "
+            f"({done}/{len(orphan.get('migrations', []))} migration(s) "
+            "checkpointed done) was left on disk with no execution in "
+            "flight — recovery/rollback could not converge it; run the "
+            "executor's recover() (plugin restart does) or abort() to "
+            "roll it back",
+        ))
 
     def _check_slices(self, findings) -> None:
         """Published ResourceSlice devices vs the local allocatable view.

@@ -128,6 +128,12 @@ class DriverConfig:
     # from the device-watch loop. 0 disables the loop; run_once stays
     # callable either way (sim/tests).
     rebalance_interval_seconds: float = 60.0
+    # Opt-in defrag plan EXECUTION (`--defrag-execute`). Default off:
+    # the planner stays advisory-only and /debug/defrag plans are
+    # proposals. On (and once enable_defrag_execution attaches an
+    # executor), the device-watch loop executes each fresh `planned`
+    # plan through kube/defrag_executor.py.
+    defrag_execute: bool = False
 
     @property
     def plugin_socket(self) -> str:
@@ -140,6 +146,13 @@ class DriverConfig:
     @property
     def checkpoint_path(self) -> str:
         return f"{self.state_root}/checkpoint.json"
+
+    @property
+    def defrag_intent_path(self) -> str:
+        """Per-plan defrag execution intent checkpoint — next to the
+        prepared-claim checkpoint so both survive the same pod
+        restart."""
+        return f"{self.state_root}/defrag-intent.json"
 
 
 class Driver(NodeServicer):
@@ -218,6 +231,11 @@ class Driver(NodeServicer):
             maxlen=ELASTIC_TRACE_DEPTH
         )
         self._resize_listeners: list = []
+        self._defrag_executor = None
+        # Plan ids already attempted (success OR failure): an execution
+        # is tried once per plan — a failed plan is re-planned by the
+        # next unsat solve, never blindly retried.
+        self._executed_defrag_plans: set[str] = set()
         # Failures (and recoveries) become kubectl-visible Events on the
         # ResourceClaim; no-op without a kube client.
         self.events = EventRecorder(
@@ -408,6 +426,12 @@ class Driver(NodeServicer):
                 self.rebalancer.maybe_tick()
             except Exception:
                 logger.exception("rebalance tick failed")
+            try:
+                # Defrag execution rides the same wake, after the
+                # rebalancer: a plan must execute against settled holds.
+                self._maybe_execute_defrag()
+            except Exception:
+                logger.exception("defrag execution tick failed")
 
     def _report_health_transitions(self, transitions) -> None:
         """Turn health transitions into the metric and, when the chip
@@ -461,6 +485,56 @@ class Driver(NodeServicer):
         GangResized Event and the tpu_dra_elastic_* metrics, and is
         delivered to listeners as a typed :class:`GangResize` message."""
         self._elastic_allocator = allocator
+
+    def enable_defrag_execution(self, executor) -> None:
+        """Arm defrag plan execution (the ``--defrag-execute`` path).
+
+        ``executor`` is a :class:`~..kube.defrag_executor.DefragExecutor`
+        wired to the same allocator the planner watches (its intent file
+        belongs under ``config.defrag_intent_path`` so it survives pod
+        restarts). Arming: (1) runs crash recovery NOW, converging any
+        intent a previous incarnation left mid-plan; (2) attaches the
+        executor to the auditor, so in-flight plans are excluded from
+        the resize check and orphaned intents surface as ``defrag``
+        drift; (3) lets the device-watch loop execute each fresh
+        ``planned`` plan (config.defrag_execute gates the loop — an
+        executor attached with the flag off is recovery + observability
+        only, the advisory default)."""
+        try:
+            executor.recover()
+        except Exception:
+            # A failed recovery leaves the intent for the auditor; the
+            # driver still starts (degraded + loud, never dead).
+            logger.exception("defrag intent recovery failed")
+        self._defrag_executor = executor
+        self.auditor.defrag_executor = executor
+
+    def _maybe_execute_defrag(self) -> None:
+        """Watch-loop trigger: execute the newest not-yet-attempted
+        ``planned`` plan. One plan per tick — every execution re-solves
+        under one allocator snapshot, and admitting one gang changes the
+        fleet enough that any other outstanding plan is stale by
+        construction."""
+        executor = self._defrag_executor
+        if not self.config.defrag_execute or executor is None:
+            return
+        planner = executor.planner
+        candidates = [
+            p for p in planner.recent_plans()
+            if p.get("outcome") == "planned"
+            and p.get("planId") not in self._executed_defrag_plans
+        ]
+        if not candidates:
+            return
+        plan = candidates[-1]
+        self._executed_defrag_plans.add(plan["planId"])
+        with self._lock:
+            try:
+                executor.execute(plan)
+            except Exception:
+                logger.exception(
+                    "defrag plan %s execution failed", plan["planId"]
+                )
 
     def add_resize_listener(self, callback) -> None:
         """Register ``callback(GangResize)`` — the workload-side hook.

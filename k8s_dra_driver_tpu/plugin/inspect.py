@@ -152,13 +152,34 @@ def _collect_defrag_plans(
         {
             "claim": f"{(p.get('claim') or {}).get('namespace', '?')}/"
                      f"{(p.get('claim') or {}).get('name', '?')}",
+            "planId": p.get("planId", ""),
             "outcome": p.get("outcome", "?"),
             "migrations": len(p.get("migrations") or []),
             "detail": p.get("detail", ""),
         }
         for p in (doc.get("plans") or []) if isinstance(p, dict)
     ]
-    return {"defragPlans": plans[-keep:]} if plans else {}
+    out: dict[str, Any] = {"defragPlans": plans[-keep:]} if plans else {}
+    # The plan→execution trail (present once an executor is attached):
+    # per-step outcomes and rollbacks, compressed to one row each.
+    execs = [
+        {
+            "planId": e.get("planId", ""),
+            "claim": f"{(e.get('claim') or {}).get('namespace', '?')}/"
+                     f"{(e.get('claim') or {}).get('name', '?')}",
+            "state": e.get("state", "?"),
+            "steps": ", ".join(
+                f"{s.get('kind')}={s.get('outcome')}"
+                for s in (e.get("steps") or [])
+            ),
+            "rollbacks": len(e.get("rollbacks") or []),
+            "detail": e.get("detail", ""),
+        }
+        for e in (doc.get("executions") or []) if isinstance(e, dict)
+    ]
+    if execs:
+        out["defragExecutions"] = execs[-keep:]
+    return out
 
 
 def _collect_rebalance(
@@ -540,10 +561,27 @@ def render(state: dict[str, Any]) -> str:
                 lines.append(f"recent defrag plans: {len(plans)}")
                 for p in plans:
                     lines.append(
-                        f"  {p['claim']}: {p['outcome']} "
+                        f"  {p.get('planId') or '?'} {p['claim']}: "
+                        f"{p['outcome']} "
                         f"({p['migrations']} migration(s)) — "
                         f"{p.get('detail') or 'no detail'}"
                     )
+            execs = live.get("defragExecutions") or []
+            if execs:
+                lines.append("")
+                lines.append(f"defrag executions: {len(execs)}")
+                for e in execs:
+                    lines.append(
+                        f"  {e.get('planId') or '?'} {e['claim']}: "
+                        f"{e['state']} — "
+                        f"{e.get('steps') or 'no steps recorded'}"
+                        + (
+                            f" ({e['rollbacks']} rollback(s))"
+                            if e.get("rollbacks") else ""
+                        )
+                    )
+                    if e.get("detail"):
+                        lines.append(f"    {e['detail']}")
             if live.get("rebalanceError"):
                 lines.append(
                     "  /debug/rebalance scrape FAILED "

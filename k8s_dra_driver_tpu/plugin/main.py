@@ -99,6 +99,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="seconds between dynamic-sharing rebalance passes "
                         "(SLO-aware share moves between ProcessShared "
                         "co-tenants); 0 disables [REBALANCE_INTERVAL]")
+    p.add_argument("--defrag-execute", action="store_true",
+                   default=_env("DEFRAG_EXECUTE", "") == "1",
+                   help="execute defrag migration plans instead of "
+                        "serving them advisory-only; takes effect once "
+                        "an allocator-wired executor is attached via "
+                        "Driver.enable_defrag_execution "
+                        "[DEFRAG_EXECUTE=1]")
     p.add_argument("--log-level", default=_env("LOG_LEVEL", ""),
                    help="log level; empty falls back to TPU_DRA_LOG_LEVEL "
                         "then INFO [LOG_LEVEL]")
@@ -302,6 +309,7 @@ def main(argv=None) -> int:
         ),
         audit_interval_seconds=args.audit_interval,
         rebalance_interval_seconds=args.rebalance_interval,
+        defrag_execute=args.defrag_execute,
     )
     driver = Driver(config, registry=registry)
     driver.start()

@@ -617,6 +617,53 @@ class ServingGateway:
             )
         return n_rerouted
 
+    def drain_claim(self, claim_uid: str, *, reason: str = "") -> list[str]:
+        """Defrag executor drain contract: drain every live replica
+        bound to ``claim_uid`` (each through the zero-loss
+        :meth:`drain_replica` path) and return their ids so the caller
+        can resume them once the claim's devices have moved. A claim
+        with no serving replicas returns ``[]`` — draining is then a
+        no-op, not an error (the claim may be a training gang)."""
+        drained = []
+        for r in self.router.replicas():
+            if r.claim_uid != claim_uid or r.state == REPLICA_GONE:
+                continue
+            if r.state != REPLICA_DRAINING:
+                self.drain_replica(r.replica_id, reason=reason)
+            drained.append(r.replica_id)
+        return drained
+
+    def resume_replica(self, replica_id: str) -> None:
+        """Reopen a drained replica for dispatch (the defrag executor's
+        post-migration counterpart of :meth:`drain_replica`, and the
+        rollback path's undo). Only DRAINING replicas transition; GONE
+        ones stay gone."""
+        now = self._clock()
+        replica = self.router.get(replica_id)
+        if replica.state != REPLICA_DRAINING:
+            return
+        replica.state = REPLICA_HEALTHY
+        replica.state_reason = ""
+        # drain() closed engine-level admission; a resumed replica must
+        # accept dispatches again or it sits healthy-but-deaf.
+        if hasattr(replica.engine, "resume_admission"):
+            replica.engine.resume_admission()
+        self._refresh_replica_gauge()
+        self._record({"kind": "resume", "replicaId": replica_id}, now)
+
+    def resume_claim(self, claim_uid: str) -> list[str]:
+        """Resume every DRAINING replica bound to ``claim_uid``; returns
+        the resumed ids. Idempotent — the executor calls it after a
+        migration lands AND during rollback/recovery, where any subset
+        of the claim's replicas may have been drained."""
+        resumed = []
+        for r in self.router.replicas():
+            if r.claim_uid != claim_uid or r.state != REPLICA_DRAINING:
+                continue
+            self.resume_replica(r.replica_id)
+            resumed.append(r.replica_id)
+        return resumed
+
     def fail_replica(self, replica_id: str, reason: str = "") -> int:
         """Hard failover: the replica is gone (chip unplugged, pod
         killed). Its queued requests re-route — they held no computed

@@ -29,8 +29,9 @@ registry of instrumented sites is :data:`ALL_SITES` (grouped by family:
 and ``rebalance.*`` for the dynamic-sharing state/resize path, the
 model-side ``train.*`` family — ``train.step`` fires at the top of every
 elastic train step, ``train.reshard`` at the top of every gang resize —
-and ``gateway.*`` for the fleet serving gateway's route/drain/scale
-transitions).
+``gateway.*`` for the fleet serving gateway's route/drain/scale
+transitions, and ``defrag.*`` for the defrag executor's
+intent-write/drain/replace/admit orchestration steps).
 Seeded schedules should draw their site lists from it via
 :func:`sites_in` so new families are automatically soak-covered.
 """
@@ -88,6 +89,15 @@ ALL_SITES = (
     "gateway.route",
     "gateway.drain",
     "gateway.scale",
+    # Defrag execution (kube/defrag_executor.py): the orchestration
+    # steps of a live migration plan — the per-plan intent checkpoint,
+    # then per migration the serving drain, the blocker re-place, and
+    # finally the stuck-claim admit. A crash at any of them must leave
+    # state the executor's restart recovery converges (forward or back).
+    "defrag.intent-write",
+    "defrag.drain",
+    "defrag.replace",
+    "defrag.admit",
 )
 
 

@@ -1,0 +1,525 @@
+"""Defrag execution tests (kube/defrag_executor.py).
+
+The ISSUE 17 acceptance surface: on a checkerboarded fleet an unsat
+gang claim goes SAT after one executed plan (movers drained through the
+gateway with zero admitted-request loss, re-placed under one snapshot,
+the stuck claim admitted); a stale plan is refused with nothing moved;
+a non-crash step failure rolls the whole plan back to the pre-execution
+fleet; a crash at any `defrag.*` site plus a restart converges (forward
+or back) with no orphaned intent; and the plan→execution trail renders
+through /debug/defrag, the doctor, and the `tpu_dra_defrag_exec_*`
+metric family.
+"""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import pytest
+
+from test_allocator_explain import chip_claim, publish_host
+from test_defrag import fragmented_4x1
+
+from k8s_dra_driver_tpu.kube import FakeKubeClient
+from k8s_dra_driver_tpu.kube.allocator import (
+    AllocationError,
+    ReferenceAllocator,
+    Selector,
+)
+from k8s_dra_driver_tpu.kube.defrag import DefragPlanner
+from k8s_dra_driver_tpu.kube.defrag_executor import (
+    DefragExecutionError,
+    DefragExecutor,
+    StalePlanError,
+)
+from k8s_dra_driver_tpu.serving_gateway import ServingGateway
+from k8s_dra_driver_tpu.serving_gateway.sim import ScriptedEngine
+from k8s_dra_driver_tpu.utils import faults
+from k8s_dra_driver_tpu.utils.metrics import MetricsServer, Registry
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    yield
+    faults.disarm()
+
+
+def plan_for_stuck_gang(alloc, planner, uid="uid-gang", count=2):
+    """Drive the planner the way production does: the unsat solve."""
+    claim = chip_claim(uid, count=count)
+    with pytest.raises(AllocationError) as ei:
+        alloc.allocate(claim)
+    assert ei.value.reason == "gang"
+    plan = planner.recent_plans()[-1]
+    assert plan["outcome"] == "planned"
+    return plan, chip_claim(uid, count=count)
+
+
+def make_executor(tmp_path, alloc, planner, reg=None, **kwargs):
+    return DefragExecutor(
+        planner, alloc,
+        intent_path=str(tmp_path / "defrag-intent.json"),
+        registry=reg if reg is not None else Registry(),
+        **kwargs,
+    )
+
+
+def held_by(alloc, uid):
+    return {n for (_, n), h in alloc._reservations.items() if h == uid}
+
+
+class TestExecuteEndToEnd:
+    def test_unsat_gang_goes_sat_after_one_executed_plan(self, tmp_path):
+        client, alloc, planner, reg = fragmented_4x1()
+        plan, claim = plan_for_stuck_gang(alloc, planner)
+        mig = plan["migrations"][0]
+        execu = make_executor(tmp_path, alloc, planner, reg)
+
+        record = execu.execute(plan, claim=claim)
+
+        assert record["state"] == "completed"
+        # The stuck gang is SAT: two devices, and the solve mutated the
+        # caller's claim exactly as a normal admission would.
+        results = claim["status"]["allocation"]["devices"]["results"]
+        assert len(results) == 2
+        assert held_by(alloc, "uid-gang") == {
+            r["device"] for r in results
+        }
+        # The mover sits on the planned destination, nowhere else.
+        assert held_by(alloc, mig["claimUid"]) == set(mig["to"])
+        # Every chip on the slice is now reserved (2 mids + 2 gang).
+        assert len(alloc._reservations) == 4
+        # Step trail: intent-write, drain, replace, admit — all ok.
+        assert [(s["kind"], s["outcome"]) for s in record["steps"]] == [
+            ("intent-write", "ok"), ("drain", "ok"),
+            ("replace", "ok"), ("admit", "ok"),
+        ]
+        # The intent was cleared; nothing orphaned.
+        assert execu.orphaned_intent() is None
+        assert not os.path.exists(execu.intent_path)
+        text = reg.render()
+        assert ('tpu_dra_defrag_exec_executions_total'
+                '{outcome="completed"} 1') in text
+        assert ('tpu_dra_defrag_exec_steps_total'
+                '{kind="admit",outcome="ok"} 1') in text
+        assert "tpu_dra_defrag_exec_in_flight 0" in text
+
+    def test_gateway_drain_zero_admitted_loss(self, tmp_path):
+        """A serving replica bound to the mover claim is drained for
+        the move and resumed after it; every admitted request finishes
+        — token-for-token zero loss, per the gateway's drain contract."""
+        client, alloc, planner, reg = fragmented_4x1()
+        plan, claim = plan_for_stuck_gang(alloc, planner)
+        mover_uid = plan["migrations"][0]["claimUid"]
+        gw = ServingGateway(Registry(), node_name="test")
+        engine = ScriptedEngine()
+        gw.add_replica(engine, "r-mover", claim_uid=mover_uid)
+        execu = make_executor(tmp_path, alloc, planner, reg, gateway=gw)
+
+        reqs = [gw.submit([i] * 8, 3) for i in range(6)]
+        gw.tick()  # dispatch some before the migration lands
+
+        record = execu.execute(plan, claim=claim)
+
+        assert record["state"] == "completed"
+        drain = [s for s in record["steps"] if s["kind"] == "drain"][0]
+        assert "1 serving replica" in drain["detail"]
+        # Resumed, not gone: the replica serves the remaining queue.
+        (replica,) = gw.replicas()
+        assert replica.state == "healthy"
+        gw.run()
+        assert all(r.state == "finished" for r in reqs)
+        assert gw.counters["failed"] == 0
+        engine.assert_no_leaks()
+
+    def test_migration_listener_sees_the_new_gang(self, tmp_path):
+        """The live-reshard seam: listeners get (uid, new devices) as
+        the placement applies — what a training harness feeds to
+        ElasticTrainer.relocate for loss continuity."""
+        client, alloc, planner, reg = fragmented_4x1()
+        plan, claim = plan_for_stuck_gang(alloc, planner)
+        mig = plan["migrations"][0]
+        execu = make_executor(tmp_path, alloc, planner, reg)
+        moves = []
+        execu.add_migration_listener(
+            lambda uid, devs: moves.append((uid, sorted(devs)))
+        )
+
+        execu.execute(plan, claim=claim)
+
+        assert moves == [(mig["claimUid"], sorted(mig["to"]))]
+
+    def test_debug_defrag_serves_the_executions_view(self, tmp_path):
+        """/debug/defrag grows an `executions` array when an executor
+        is attached — same GET-only JSON contract as the plans view."""
+        client, alloc, planner, reg = fragmented_4x1()
+        plan, claim = plan_for_stuck_gang(alloc, planner)
+        execu = make_executor(tmp_path, alloc, planner, reg)
+        execu.execute(plan, claim=claim)
+
+        srv = MetricsServer(reg, host="127.0.0.1", port=0)
+        srv.set_defrag_provider(planner.export_json)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            doc = json.loads(
+                urllib.request.urlopen(f"{base}/debug/defrag")
+                .read().decode()
+            )
+            rec = doc["executions"][-1]
+            assert rec["planId"] == plan["planId"]
+            assert rec["state"] == "completed"
+            assert [s["kind"] for s in rec["steps"]] == [
+                "intent-write", "drain", "replace", "admit",
+            ]
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{base}/debug/defrag", data=b"x")
+            assert ei.value.code == 405
+        finally:
+            srv.stop()
+
+
+class TestRefusals:
+    def test_stale_plan_refused_with_nothing_moved(self, tmp_path):
+        """Any reservation churn between plan and execution invalidates
+        the sig: the executor must refuse rather than move claims on a
+        fleet the plan no longer describes."""
+        client, alloc, planner, reg = fragmented_4x1()
+        plan, claim = plan_for_stuck_gang(alloc, planner)
+        # A single-chip admission lands on a free corner and bumps the
+        # reservation version out from under the plan.
+        alloc.allocate(chip_claim("uid-late"))
+        before = dict(alloc._reservations)
+        execu = make_executor(tmp_path, alloc, planner, reg)
+
+        with pytest.raises(StalePlanError):
+            execu.execute(plan, claim=claim)
+
+        assert alloc._reservations == before
+        assert not os.path.exists(execu.intent_path)
+        rec = execu.export_executions()[-1]
+        assert rec["state"] == "refused"
+        assert "re-plan" in rec["detail"]
+        assert ('tpu_dra_defrag_exec_executions_total'
+                '{outcome="stale-plan"} 1') in reg.render()
+
+    def test_only_planned_plans_execute(self, tmp_path):
+        client = FakeKubeClient()
+        publish_host(client, "node-0", topology="4x1x1")
+        reg = Registry()
+        alloc = ReferenceAllocator(client, registry=reg)
+        planner = DefragPlanner(alloc, registry=reg)
+        with pytest.raises(AllocationError):
+            alloc.allocate(chip_claim("uid-big", count=5))
+        plan = planner.recent_plans()[-1]
+        assert plan["outcome"] == "insufficient-capacity"
+        execu = make_executor(tmp_path, alloc, planner, reg)
+
+        with pytest.raises(DefragExecutionError, match="not executable"):
+            execu.execute(plan)
+        assert ('tpu_dra_defrag_exec_executions_total'
+                '{outcome="refused"} 1') in reg.render()
+
+
+class TestRollback:
+    def test_admit_failure_restores_the_whole_fleet(self, tmp_path):
+        """An admit that cannot land (selectors pin a slice that does
+        not exist) must put every mover back: the fleet reads exactly
+        as before the attempt and the intent is gone."""
+        client, alloc, planner, reg = fragmented_4x1()
+        plan, claim = plan_for_stuck_gang(alloc, planner)
+        before = dict(alloc._reservations)
+        execu = make_executor(tmp_path, alloc, planner, reg)
+
+        with pytest.raises(DefragExecutionError, match="rolled back"):
+            execu.execute(
+                plan, claim=claim,
+                selectors={"r0": [Selector("sliceId", "eq", "no-such")]},
+            )
+
+        assert alloc._reservations == before
+        assert held_by(alloc, "uid-gang") == set()
+        assert not os.path.exists(execu.intent_path)
+        rec = execu.export_executions()[-1]
+        assert rec["state"] == "rolled-back"
+        assert [r["outcome"] for r in rec["rollbacks"]] == ["ok"]
+        text = reg.render()
+        assert ('tpu_dra_defrag_exec_executions_total'
+                '{outcome="rolled-back"} 1') in text
+        assert ('tpu_dra_defrag_exec_steps_total'
+                '{kind="admit",outcome="failed"} 1') in text
+
+    def test_drain_fault_rolls_back_before_anything_moves(self, tmp_path):
+        client, alloc, planner, reg = fragmented_4x1()
+        plan, claim = plan_for_stuck_gang(alloc, planner)
+        before = dict(alloc._reservations)
+        execu = make_executor(tmp_path, alloc, planner, reg)
+        fault = faults.FaultPlan().fail(
+            "defrag.drain", faults.FaultError("chaos"), times=1
+        )
+        with faults.armed(fault):
+            with pytest.raises(DefragExecutionError, match="rolled back"):
+                execu.execute(plan, claim=claim)
+        assert alloc._reservations == before
+        assert not os.path.exists(execu.intent_path)
+        assert execu.export_executions()[-1]["state"] == "rolled-back"
+
+    def test_rollback_resumes_drained_replicas(self, tmp_path):
+        client, alloc, planner, reg = fragmented_4x1()
+        plan, claim = plan_for_stuck_gang(alloc, planner)
+        mover_uid = plan["migrations"][0]["claimUid"]
+        gw = ServingGateway(Registry(), node_name="test")
+        gw.add_replica(ScriptedEngine(), "r-mover", claim_uid=mover_uid)
+        execu = make_executor(tmp_path, alloc, planner, reg, gateway=gw)
+
+        with pytest.raises(DefragExecutionError):
+            execu.execute(
+                plan, claim=claim,
+                selectors={"r0": [Selector("sliceId", "eq", "no-such")]},
+            )
+        (replica,) = gw.replicas()
+        assert replica.state == "healthy"
+
+
+class TestCrashRecovery:
+    """Crash at every defrag.* site, restart (a FRESH executor over the
+    same intent path — the process died), recover() converges: forward
+    when the intent is on disk, no-op when the crash preceded it."""
+
+    @pytest.mark.parametrize("site", faults.sites_in("defrag."))
+    def test_crash_then_restart_converges(self, tmp_path, site):
+        client, alloc, planner, reg = fragmented_4x1()
+        plan, claim = plan_for_stuck_gang(alloc, planner)
+        mig = plan["migrations"][0]
+        execu = make_executor(tmp_path, alloc, planner, reg)
+        before = dict(alloc._reservations)
+
+        with faults.armed(faults.FaultPlan().crash(site)):
+            with pytest.raises(faults.CrashPoint):
+                execu.execute(plan, claim=claim)
+
+        # The restarted plugin: fresh executor, fresh registry, same
+        # intent path, same (surviving) allocator state.
+        reg2 = Registry()
+        execu2 = make_executor(tmp_path, alloc, planner, reg2)
+        rec = execu2.recover()
+
+        if site == "defrag.intent-write":
+            # Crash BEFORE the intent landed: nothing to recover and
+            # nothing moved; the still-fresh plan executes cleanly.
+            assert rec is None
+            assert alloc._reservations == before
+            rec = execu2.execute(plan, claim=chip_claim(
+                "uid-gang", count=2
+            ))
+            assert rec["state"] == "completed"
+        else:
+            assert rec["state"] == "completed"
+            assert rec["recovered"] is True
+            assert "crash recovery" in rec["detail"]
+            assert ('tpu_dra_defrag_exec_executions_total'
+                    '{outcome="completed"} 1') in reg2.render()
+        # Either way the fleet converged: gang admitted, mover on its
+        # planned destination, intent gone.
+        assert len(held_by(alloc, "uid-gang")) == 2
+        assert held_by(alloc, mig["claimUid"]) == set(mig["to"])
+        assert execu2.orphaned_intent() is None
+        assert not os.path.exists(execu2.intent_path)
+
+    def test_recovery_is_reentrant_after_crashing_itself(self, tmp_path):
+        """Chaos can crash recovery too (the sites re-fire on the
+        recovery path); a later recover() still converges."""
+        client, alloc, planner, reg = fragmented_4x1()
+        plan, claim = plan_for_stuck_gang(alloc, planner)
+        execu = make_executor(tmp_path, alloc, planner, reg)
+        with faults.armed(faults.FaultPlan().crash("defrag.replace")):
+            with pytest.raises(faults.CrashPoint):
+                execu.execute(plan, claim=claim)
+        execu2 = make_executor(tmp_path, alloc, planner)
+        with faults.armed(faults.FaultPlan().crash("defrag.admit")):
+            with pytest.raises(faults.CrashPoint):
+                execu2.recover()
+        execu3 = make_executor(tmp_path, alloc, planner)
+        rec = execu3.recover()
+        assert rec["state"] == "completed"
+        assert len(held_by(alloc, "uid-gang")) == 2
+        assert execu3.orphaned_intent() is None
+
+    def test_orphaned_intent_is_visible_until_recovered(self, tmp_path):
+        client, alloc, planner, reg = fragmented_4x1()
+        plan, claim = plan_for_stuck_gang(alloc, planner)
+        execu = make_executor(tmp_path, alloc, planner, reg)
+        with faults.armed(faults.FaultPlan().crash("defrag.admit")):
+            with pytest.raises(faults.CrashPoint):
+                execu.execute(plan, claim=claim)
+        execu2 = make_executor(tmp_path, alloc, planner)
+        orphan = execu2.orphaned_intent()
+        assert orphan is not None
+        assert orphan["planId"] == plan["planId"]
+        assert orphan["path"] == execu2.intent_path
+        execu2.recover()
+        assert execu2.orphaned_intent() is None
+
+    def test_abort_rolls_a_crashed_plan_back(self, tmp_path):
+        """The operator escape hatch: after a crash, abort() returns
+        every mover to its original device instead of pressing on."""
+        client, alloc, planner, reg = fragmented_4x1()
+        plan, claim = plan_for_stuck_gang(alloc, planner)
+        before = dict(alloc._reservations)
+        execu = make_executor(tmp_path, alloc, planner, reg)
+        with faults.armed(faults.FaultPlan().crash("defrag.admit")):
+            with pytest.raises(faults.CrashPoint):
+                execu.execute(plan, claim=claim)
+
+        execu2 = make_executor(tmp_path, alloc, planner)
+        rec = execu2.abort()
+        assert rec["state"] == "rolled-back"
+        assert alloc._reservations == before
+        assert held_by(alloc, "uid-gang") == set()
+        assert execu2.orphaned_intent() is None
+        assert not os.path.exists(execu2.intent_path)
+
+    def test_abort_without_intent_is_a_noop(self, tmp_path):
+        client, alloc, planner, reg = fragmented_4x1()
+        execu = make_executor(tmp_path, alloc, planner, reg)
+        assert execu.abort() is None
+
+
+class TestDoctorTrail:
+    def test_completed_execution_renders_as_info_trail(self, tmp_path):
+        from k8s_dra_driver_tpu.doctor import NodeScrape, fleet_findings
+
+        client, alloc, planner, reg = fragmented_4x1()
+        plan, claim = plan_for_stuck_gang(alloc, planner)
+        execu = make_executor(tmp_path, alloc, planner, reg)
+        execu.execute(plan, claim=claim)
+
+        scrape = NodeScrape(
+            name="node-0", url="http://test", readyz_text="ready\n",
+            allocations_text=alloc.export_allocations_jsonl(),
+            defrag=planner.export_json(),
+        )
+        findings = fleet_findings([scrape], None, "tpu.google.com")
+        trail = [f for f in findings if f.check == "defrag-exec"]
+        assert len(trail) == 1
+        assert trail[0].severity == "info"
+        assert plan["planId"] in trail[0].detail
+        assert "admit[uid-gang]=ok" in trail[0].detail
+
+    def test_failed_execution_is_drift_in_flight_is_info(self):
+        from k8s_dra_driver_tpu.doctor import NodeScrape, fleet_findings
+
+        doc = {"plans": [], "executions": [
+            {"planId": "plan-7", "state": "failed",
+             "claim": {"uid": "u1", "name": "gang", "namespace": "ml"},
+             "detail": "rollback failed for mover(s) u2",
+             "steps": [{"kind": "replace", "claimUid": "u2",
+                        "outcome": "failed", "detail": "boom"}],
+             "rollbacks": [{"claimUid": "u2", "outcome": "failed",
+                            "detail": "boom"}]},
+            {"planId": "plan-8", "state": "in-flight",
+             "claim": {"uid": "u1", "name": "gang", "namespace": "ml"},
+             "detail": "", "steps": [], "rollbacks": []},
+        ]}
+        scrape = NodeScrape(
+            name="node-0", url="http://test", readyz_text="ready\n",
+            defrag=doc,
+        )
+        findings = fleet_findings([scrape], None, "tpu.google.com")
+        trail = {f.detail: f for f in findings
+                 if f.check == "defrag-exec"}
+        assert len(trail) == 2
+        failed = [f for f in trail.values() if "plan-7" in f.detail][0]
+        assert failed.severity == "drift"
+        assert "intent is still on disk" in failed.detail
+        inflight = [f for f in trail.values()
+                    if "plan-8" in f.detail][0]
+        assert inflight.severity == "info"
+        assert "in progress" in inflight.detail
+
+
+class TestDriverOptIn:
+    """The `--defrag-execute` wiring: advisory by default, the watch
+    tick executes each fresh planned plan exactly once when armed, and
+    arming runs crash recovery immediately."""
+
+    def _driver(self, tmp_path, execute):
+        from k8s_dra_driver_tpu.kube import NODES
+        from k8s_dra_driver_tpu.plugin.driver import Driver, DriverConfig
+        from k8s_dra_driver_tpu.tpulib import FakeChipLib
+
+        client = FakeKubeClient()
+        client.create(NODES, {"metadata": {"name": "node-d", "uid": "nu"}})
+        config = DriverConfig(
+            node_name="node-d",
+            chiplib=FakeChipLib(generation="v5e", topology="2x2x1"),
+            kube_client=client,
+            cdi_root=str(tmp_path / "cdi"),
+            plugin_root=str(tmp_path / "plugin"),
+            registrar_root=str(tmp_path / "registry"),
+            state_root=str(tmp_path / "state"),
+            node_uid="nu",
+            device_watch_interval_seconds=0,
+            defrag_execute=execute,
+        )
+        return Driver(config)
+
+    def test_advisory_default_never_executes(self, tmp_path):
+        client, alloc, planner, reg = fragmented_4x1()
+        plan_for_stuck_gang(alloc, planner)
+        execu = make_executor(tmp_path, alloc, planner, reg)
+        driver = self._driver(tmp_path, execute=False)
+        driver.enable_defrag_execution(execu)
+
+        driver._maybe_execute_defrag()
+
+        assert execu.export_executions() == []
+        assert held_by(alloc, "uid-gang") == set()
+        # Arming still attaches the executor to the auditor (recovery +
+        # observability are NOT gated by the execute flag).
+        assert driver.auditor.defrag_executor is execu
+
+    def test_opt_in_executes_each_fresh_plan_once(self, tmp_path):
+        client, alloc, planner, reg = fragmented_4x1()
+        plan, _ = plan_for_stuck_gang(alloc, planner)
+        execu = make_executor(tmp_path, alloc, planner, reg)
+        driver = self._driver(tmp_path, execute=True)
+        driver.enable_defrag_execution(execu)
+
+        driver._maybe_execute_defrag()
+
+        records = execu.export_executions()
+        assert [r["state"] for r in records] == ["completed"]
+        assert records[0]["planId"] == plan["planId"]
+        assert len(held_by(alloc, "uid-gang")) == 2
+        # The same plan never re-executes on the next tick.
+        driver._maybe_execute_defrag()
+        assert len(execu.export_executions()) == 1
+
+    def test_arming_recovers_a_crashed_intent(self, tmp_path):
+        client, alloc, planner, reg = fragmented_4x1()
+        plan, _ = plan_for_stuck_gang(alloc, planner)
+        execu = make_executor(tmp_path, alloc, planner, reg)
+        with faults.armed(faults.FaultPlan().crash("defrag.replace")):
+            with pytest.raises(faults.CrashPoint):
+                execu.execute(plan)
+
+        execu2 = make_executor(tmp_path, alloc, planner, Registry())
+        driver = self._driver(tmp_path, execute=True)
+        driver.enable_defrag_execution(execu2)
+
+        # Recovery ran AT arming, before any watch tick.
+        assert execu2.orphaned_intent() is None
+        records = execu2.export_executions()
+        assert records and records[-1]["state"] == "completed"
+        assert records[-1]["recovered"] is True
+        assert len(held_by(alloc, "uid-gang")) == 2
+
+    def test_cli_flag_sets_config(self):
+        from k8s_dra_driver_tpu.plugin.main import build_parser
+
+        base = ["--node-name", "n", "--no-kube"]
+        assert build_parser().parse_args(base).defrag_execute is False
+        on = build_parser().parse_args(base + ["--defrag-execute"])
+        assert on.defrag_execute is True

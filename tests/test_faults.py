@@ -86,6 +86,15 @@ class TestSiteRegistry:
         assert "train.reshard" in faults.ALL_SITES
         assert faults.sites_in("train.") == ["train.step", "train.reshard"]
 
+    def test_defrag_family_registered(self):
+        """The defrag executor's orchestration steps, in execution
+        order: intent checkpoint, then per-migration drain and replace,
+        then the stuck-claim admit."""
+        assert faults.sites_in("defrag.") == [
+            "defrag.intent-write", "defrag.drain",
+            "defrag.replace", "defrag.admit",
+        ]
+
     def test_sites_in_filters_by_family(self):
         assert set(faults.sites_in("checkpoint.")) == {
             "checkpoint.read", "checkpoint.write"

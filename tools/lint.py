@@ -222,6 +222,10 @@ _MODULE_FAMILY_PREFIXES = {
     "elastic.py": "tpu_dra_elastic_",
     "allocator.py": "tpu_dra_alloc",
     "defrag.py": "tpu_dra_defrag_",
+    # The executor's tpu_dra_defrag_exec_* family shares the planner's
+    # stem deliberately (one dashboard groups plan + execution); the
+    # module entry keeps declaration ownership separate.
+    "defrag_executor.py": "tpu_dra_defrag_exec_",
     "rebalancer.py": "tpu_dra_slo_",
     # reqtrace.py lives under serving_gateway/ but owns its own family;
     # a module entry exempts it from the directory rule below.

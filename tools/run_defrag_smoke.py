@@ -1,0 +1,229 @@
+#!/usr/bin/env python3
+"""Defrag-execution smoke: checkerboarded fleet → unsat gang → one
+executed (and crash-recovered) plan → gang admitted (``make defragsmoke``).
+
+Drives the whole orchestration hermetically, jax-free:
+
+1. a 4x1x1 FakeChipLib slice publishes through ResourceSliceController;
+   the two MIDDLE chips are allocated to movable single-chip claims and
+   prepared on a real DeviceState (holds + CDI + checkpoint), so the
+   free corners form no contiguous pair;
+2. both movers serve live traffic through a ServingGateway replica;
+3. a 2-chip gang claim goes unsat on fragmentation and the attached
+   DefragPlanner computes a ``planned`` migration plan;
+4. a seeded crash (``faults.CrashPoint``) lands at one of the
+   ``defrag.*`` execution sites; the "restarted plugin" (fresh
+   DeviceState re-read from disk, fresh DefragExecutor over the same
+   intent path) recovers the plan;
+5. PASS requires: the gang ends ADMITTED on the freed box, the mover's
+   allocator holdings / node state / checkpoint all agree, the
+   StateAuditor (executor attached) reports zero drift, no execution
+   intent is orphaned, and the gateway finishes EVERY admitted request
+   — zero admitted loss across the migration.
+
+Exit 0 on PASS, 1 on any violated gate. TPU_DRA_CHAOS_SEED overrides
+the seed (default 1234) — the same seed replays the same crash window.
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SEED = int(os.environ.get("TPU_DRA_CHAOS_SEED", "1234"))
+
+
+def fail(msg):
+    print(f"FAIL: {msg}")
+    sys.exit(1)
+
+
+def main():
+    import random
+
+    from k8s_dra_driver_tpu.cdi import CDIHandler
+    from k8s_dra_driver_tpu.kube import NODES, FakeKubeClient
+    from k8s_dra_driver_tpu.kube.allocator import (
+        AllocationError,
+        ReferenceAllocator,
+        Selector,
+    )
+    from k8s_dra_driver_tpu.kube.defrag import DefragPlanner
+    from k8s_dra_driver_tpu.kube.defrag_executor import DefragExecutor
+    from k8s_dra_driver_tpu.kube.resourceslice import (
+        DriverResources,
+        Pool,
+        ResourceSliceController,
+    )
+    from k8s_dra_driver_tpu.plugin.audit import StateAuditor
+    from k8s_dra_driver_tpu.plugin.checkpoint import CheckpointManager
+    from k8s_dra_driver_tpu.plugin.device_state import DeviceState
+    from k8s_dra_driver_tpu.serving_gateway import ServingGateway
+    from k8s_dra_driver_tpu.serving_gateway.sim import ScriptedEngine
+    from k8s_dra_driver_tpu.tpulib import FakeChipLib
+    from k8s_dra_driver_tpu.tpulib.deviceinfo import counter_sets
+    from k8s_dra_driver_tpu.utils import faults
+    from k8s_dra_driver_tpu.utils.metrics import Registry
+
+    tmp = tempfile.mkdtemp(prefix="defrag-smoke-")
+    client = FakeKubeClient()
+    client.create(NODES, {"metadata": {"name": "node-a", "uid": "nu-1"}})
+    lib = FakeChipLib(generation="v5p", topology="4x1x1")
+    devs = lib.enumerate_all_possible_devices({"chip"})
+    ctrl = ResourceSliceController(
+        client, "tpu.google.com", scope="node-a",
+        owner={"kind": "Node", "name": "node-a", "uid": "nu-1"},
+    )
+    ctrl.update(DriverResources(pools={"node-a": Pool(
+        devices=[d.get_device() for _, d in sorted(devs.items())],
+        shared_counters=counter_sets(devs),
+        node_name="node-a",
+    )}))
+    ctrl.sync_once()
+
+    reg = Registry()
+    allocator = ReferenceAllocator(client, registry=reg)
+    planner = DefragPlanner(allocator, registry=reg)
+
+    def make_state():
+        return DeviceState(
+            chiplib=lib,
+            cdi=CDIHandler(f"{tmp}/cdi"),
+            checkpoint=CheckpointManager(f"{tmp}/checkpoint.json"),
+            driver_name="tpu.google.com",
+            pool_name="node-a",
+            state_dir=f"{tmp}/state",
+        )
+
+    def gang_claim(uid, count):
+        return {
+            "metadata": {"name": f"wl-{uid}", "namespace": "smoke",
+                         "uid": uid},
+            "spec": {"devices": {"requests": [{
+                "name": "r0", "deviceClassName": "tpu.google.com",
+                "allocationMode": "ExactCount", "count": count,
+            }]}},
+        }
+
+    state = make_state()
+    gw = ServingGateway(Registry(), node_name="node-a")
+    engines = []
+    # Checkerboard: the middle chips are held AND prepared AND serving.
+    for i, coord in enumerate(("1,0,0", "2,0,0")):
+        uid = f"uid-mid-{i}"
+        allocator.allocate(
+            gang_claim(uid, 1),
+            selectors={"r0": [Selector("coord", "eq", coord)]},
+        )
+        state.prepare({
+            "metadata": {"name": f"mid-{i}", "namespace": "smoke",
+                         "uid": uid},
+            "status": {"allocation": {"devices": {"results": [{
+                "request": "r0", "driver": "tpu.google.com",
+                "pool": "node-a", "device": f"tpu-{i + 1}",
+            }], "config": []}}},
+        })
+        engine = ScriptedEngine()
+        engines.append(engine)
+        gw.add_replica(engine, f"r-mid-{i}", claim_uid=uid)
+
+    reqs = [gw.submit([i] * 8, 2) for i in range(8)]
+    gw.tick()  # some requests are admitted before the migration
+
+    try:
+        allocator.allocate(gang_claim("uid-gang", 2))
+        fail("fragmented gang unexpectedly allocated")
+    except AllocationError as e:
+        if e.reason != "gang":
+            fail(f"gang unsat reason {e.reason!r}, want 'gang'")
+    plan = planner.recent_plans()[-1]
+    if plan["outcome"] != "planned" or not plan["migrations"]:
+        fail(f"no executable plan: {plan['outcome']!r} ({plan['detail']})")
+    mover = plan["migrations"][0]
+    print(f"plan {plan['planId']}: move {mover['claimUid']} "
+          f"{mover['devices']} -> {mover['to']} to free box {plan['box']}")
+
+    intent_path = f"{tmp}/defrag-intent.json"
+    executor = DefragExecutor(
+        planner, allocator, intent_path=intent_path,
+        state=state, gateway=gw, registry=Registry(),
+    )
+
+    # Seeded crash window: SIGKILL at one defrag.* orchestration site.
+    site = random.Random(SEED).choice(faults.sites_in("defrag."))
+    print(f"seed={SEED}: crashing at {site}")
+    crashed = False
+    try:
+        with faults.armed(faults.FaultPlan().crash(site)):
+            executor.execute(plan)
+    except faults.CrashPoint:
+        crashed = True
+    except Exception as e:
+        fail(f"execution failed instead of crashing: {e}")
+    if not crashed:
+        fail(f"the {site} crash never fired")
+
+    # "Restart": node state re-reads disk; a fresh executor recovers.
+    state2 = make_state()
+    executor2 = DefragExecutor(
+        planner, allocator, intent_path=intent_path,
+        state=state2, gateway=gw, registry=Registry(),
+    )
+    record = executor2.recover()
+    if record is None:
+        # The crash preceded the intent write: nothing moved, the plan
+        # is still fresh — execute it on the recovered incarnation.
+        record = executor2.execute(plan)
+    if record["state"] != "completed":
+        fail(f"execution did not converge: {record['state']} "
+             f"({record['detail']})")
+
+    # Gate 1: the gang is SAT on the freed contiguous box.
+    gang_holds = sorted(
+        n for (_, n), h in allocator._reservations.items()
+        if h == "uid-gang"
+    )
+    if len(gang_holds) != 2:
+        fail(f"gang holds {gang_holds}, want 2 devices")
+    # Gate 2: allocator and node state agree on every mover.
+    for i in range(2):
+        uid = f"uid-mid-{i}"
+        held = {n for (_, n), h in allocator._reservations.items()
+                if h == uid}
+        view = state2.gang_view(uid)
+        if view is None:
+            fail(f"{uid} lost its prepared state")
+        staged = {n for n, _ in view["devices"]}
+        if held != staged:
+            fail(f"{uid}: allocator holds {sorted(held)} but node "
+                 f"state shows {sorted(staged)}")
+    # Gate 3: no residual drift — auditor silent, no orphaned intent.
+    if executor2.orphaned_intent() is not None:
+        fail(f"orphaned execution intent at {intent_path}")
+    auditor = StateAuditor(
+        state=state2, registry=Registry(), node_name="node-a"
+    )
+    auditor.defrag_executor = executor2
+    findings = auditor.run_once()
+    if findings:
+        fail("auditor drift after execution: "
+             + "; ".join(f"[{f.check}] {f.subject}: {f.detail}"
+                         for f in findings))
+    # Gate 4: zero admitted loss across the migration.
+    gw.run()
+    lost = [r for r in reqs if r.state != "finished"]
+    if lost or gw.counters["failed"]:
+        fail(f"admitted-request loss: {len(lost)} unfinished, "
+             f"{gw.counters['failed']} failed")
+    for engine in engines:
+        engine.assert_no_leaks()
+
+    steps = ", ".join(f"{s['kind']}={s['outcome']}"
+                      for s in record["steps"])
+    print(f"PASS: seed={SEED} site={site} gang on {gang_holds}; "
+          f"steps: {steps}; {len(reqs)} requests finished, 0 lost")
+
+
+if __name__ == "__main__":
+    main()

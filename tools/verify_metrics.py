@@ -321,6 +321,41 @@ def _self_test_scrape() -> tuple[str, list[str]]:
                 f"with migrations: {newest_plan.get('outcome')!r}"
             )
 
+    # The defrag EXECUTION families (tpu_dra_defrag_exec_*), populated
+    # by EXECUTING the plan just computed: the mover re-places onto the
+    # planned corner and the stuck gang admits, so the executions/steps
+    # counters, latency histogram, and gauges render what a real
+    # orchestrated migration produces — and /debug/defrag (checked
+    # below) grows its `executions` view.
+    from k8s_dra_driver_tpu.kube.defrag_executor import DefragExecutor
+
+    if frag_plans and frag_plans[-1].get("outcome") == "planned":
+        with tempfile.TemporaryDirectory(prefix="verify-defrag-") as tmp:
+            executor = DefragExecutor(
+                planner, allocator,
+                intent_path=f"{tmp}/defrag-intent.json",
+                registry=registry,
+            )
+            try:
+                exec_record = executor.execute(
+                    frag_plans[-1],
+                    claim=_verify_claim("uid-frag-gang", 2),
+                    selectors={"r0": [Selector("sliceId", "eq",
+                                               "frag-slice")]},
+                )
+            except Exception as e:
+                alloc_errors.append(f"defrag execution failed: {e}")
+            else:
+                if exec_record.get("state") != "completed":
+                    alloc_errors.append(
+                        "defrag execution did not complete: "
+                        f"{exec_record.get('state')!r}"
+                    )
+                if executor.orphaned_intent() is not None:
+                    alloc_errors.append(
+                        "defrag execution left an orphaned intent"
+                    )
+
     # The SLO / dynamic-sharing families (tpu_dra_slo_*), populated
     # through a REAL rebalance: two ProcessShared co-tenants on one
     # chip, one bursting and one idle, so the rebalancer applies a
@@ -588,24 +623,36 @@ def _self_test_scrape() -> tuple[str, list[str]]:
                 errors.append(
                     f"/debug/allocations: undecodable line {line!r}"
                 )
-        if len(records) != 5:
+        if len(records) != 7:
             errors.append(
-                f"/debug/allocations: {len(records)} records (want 5: "
-                "three ok, the shortfall unsat, the gang unsat)"
+                f"/debug/allocations: {len(records)} records (want 7: "
+                "three ok, the shortfall unsat, the gang unsat, then "
+                "the executed defrag plan's mover re-place and gang "
+                "admit)"
             )
         else:
+            # Newest record: the defrag execution's admit of the
+            # formerly-stuck gang.
             newest = records[-1]
-            if newest.get("outcome") != "unsat":
+            if newest.get("outcome") != "ok" or (
+                newest.get("claim", {}).get("uid") != "uid-frag-gang"
+            ):
                 errors.append(
                     "/debug/allocations: newest record is not the "
-                    "forced unsat"
+                    "defrag-admitted fragmented gang"
                 )
-            if newest.get("reason") not in REASONS:
+            unsats = [r for r in records if r.get("outcome") == "unsat"]
+            if not unsats:
+                errors.append("/debug/allocations: no unsat records")
+                unsats = [{}]
+            latest_unsat = unsats[-1]
+            if latest_unsat.get("reason") not in REASONS:
                 errors.append(
                     f"/debug/allocations: reason "
-                    f"{newest.get('reason')!r} outside the REASONS enum"
+                    f"{latest_unsat.get('reason')!r} outside the "
+                    "REASONS enum"
                 )
-            if not newest.get("funnels"):
+            if not latest_unsat.get("funnels"):
                 errors.append(
                     "/debug/allocations: unsat record carries no funnel"
                 )
@@ -643,6 +690,16 @@ def _self_test_scrape() -> tuple[str, list[str]]:
                             f"/debug/defrag: outcome "
                             f"{p.get('outcome')!r} outside OUTCOMES"
                         )
+            # The executions view: the executed fragmented-gang plan's
+            # record rides the same document.
+            executions = defrag_doc.get("executions") or []
+            if not executions:
+                errors.append("/debug/defrag: no executions served")
+            elif executions[-1].get("state") != "completed":
+                errors.append(
+                    "/debug/defrag: newest execution is not "
+                    f"'completed': {executions[-1].get('state')!r}"
+                )
         # /debug/rebalance: decodable JSON whose newest decision is the
         # sim's applied steal, outcomes enum-confined, and both
         # co-tenant claims present with granted-vs-min shares.
@@ -822,6 +879,11 @@ def _self_test_scrape() -> tuple[str, list[str]]:
                    "tpu_dra_defrag_plans_total",
                    "tpu_dra_defrag_plan_seconds",
                    "tpu_dra_defrag_last_plan_migrations",
+                   "tpu_dra_defrag_exec_executions_total",
+                   "tpu_dra_defrag_exec_steps_total",
+                   "tpu_dra_defrag_exec_seconds",
+                   "tpu_dra_defrag_exec_last_execution_timestamp_seconds",
+                   "tpu_dra_defrag_exec_in_flight",
                    "tpu_dra_slo_rebalance_decisions_total",
                    "tpu_dra_slo_granted_share",
                    "tpu_dra_slo_min_share",
