@@ -2,7 +2,7 @@
 IMAGE ?= tpu-dra-driver
 TAG ?= latest
 
-.PHONY: all native test image lint verify verify-metrics chaos chaos-slow doctor decodebench moebench elastic allocbench allocbench-smoke gatewaybench tracesmoke defragsmoke clean e2e-kind
+.PHONY: all native test image lint verify verify-metrics chaos chaos-slow doctor decodebench moebench elastic allocbench allocbench-smoke gatewaybench tracesmoke defragsmoke fleetsmoke clean e2e-kind
 
 all: native
 
@@ -101,6 +101,19 @@ defragsmoke:
 	TPU_DRA_CHAOS_SEED=$(TPU_DRA_CHAOS_SEED) \
 		python tools/run_defrag_smoke.py
 
+# Fleet soak smoke (tools/run_fleet_smoke.py): the deterministic
+# discrete-event fleet simulator (k8s_dra_driver_tpu/fleetsim/) drives
+# the REAL gateway + plugin loop + allocator through a scripted day —
+# diurnal load per tenant class, a shared-prefix flash crowd, chip
+# unplug/flap chaos, an apiserver blackout, and a fragmentation-stranded
+# gang un-stranded by defrag execution — then gates on zero admitted
+# loss (typed), auditor silence, per-class p99 budgets, autoscaler
+# efficiency vs the oracle schedule, and rebalancer min-share floors.
+# Emits the byte-reproducible FLEET_r01.json artifact at the repo root.
+fleetsmoke:
+	TPU_DRA_CHAOS_SEED=$(TPU_DRA_CHAOS_SEED) \
+		python tools/run_fleet_smoke.py
+
 # Request-observability overhead smoke (tools/run_trace_smoke.py): the
 # same fixed-seed serving profile with telemetry OFF vs ON — token
 # streams, tick counts (the deterministic "within 3% req/s" enforcement)
@@ -113,9 +126,9 @@ tracesmoke:
 # The full local gate: lint + unit/integration tests + chaos schedules +
 # metrics exposition + the doctor/auditor drill + the decode-engine,
 # MoE fast-path, elastic-training, allocator-bench, fleet-gateway,
-# request-observability, and defrag-execution smokes. What CI runs;
-# what a PR must pass.
-verify: lint test chaos verify-metrics doctor decodebench moebench elastic allocbench-smoke gatewaybench tracesmoke defragsmoke
+# request-observability, defrag-execution, and fleet-soak smokes.
+# What CI runs; what a PR must pass.
+verify: lint test chaos verify-metrics doctor decodebench moebench elastic allocbench-smoke gatewaybench tracesmoke defragsmoke fleetsmoke
 
 # ruff when available (CI installs it; .golangci.yaml analog is
 # [tool.ruff] in pyproject.toml), else the first-party AST lint floor.

@@ -869,8 +869,8 @@ class InventoryIndex:
             del self._filters[key]
             self._filters[key] = rec
             return rec
-        rec = _FilterRecord(class_name, list(prog_selectors),
-                            list(cel_exprs))
+        rec = _FilterRecord(class_name, list(cel_exprs),
+                            list(prog_selectors))
         for d in self.devices:
             rec.by_device[d["_key"]] = self.static_verdict(
                 d, class_name, prog_selectors, cel_exprs,

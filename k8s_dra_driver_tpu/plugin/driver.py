@@ -398,40 +398,71 @@ class Driver(NodeServicer):
                     break
             if self._watch_stop.is_set():
                 break
+            self.tick_once()
+
+    def tick_once(self, now: Optional[float] = None) -> dict:
+        """One device-watch tick body, reentrant: health transitions →
+        republish-on-change → elastic resize → rebalancer → defrag
+        execution → audit, in the watch loop's order. The watch thread
+        calls this on every wake; the fleet soak (fleetsim/) calls it
+        directly with its virtual ``now`` so every plugin-side loop
+        advances on one shared clock without threads or sleeps.
+
+        ``now`` (when given) paces the rebalancer's interval; the audit
+        step runs only on such virtual-clock drives, and only while the
+        periodic auditor thread is disabled (``audit_interval_seconds
+        <= 0``) — on the real watch thread the auditor keeps its own
+        pacing, so thread-driven behavior is unchanged. Returns a small
+        report of what the tick did (the soak's per-tick gate input)."""
+        report: dict = {"changed": False, "transitions": 0,
+                       "rebalanced": False, "auditFindings": None}
+        try:
+            changed = self.state.refresh_allocatable()
+            self._last_inventory_ok = time.monotonic()
+            transitions = self.state.drain_health_transitions()
+            report["transitions"] = len(transitions)
+            self._report_health_transitions(transitions)
+            if changed:
+                report["changed"] = True
+                # Trace only actual inventory changes: a root trace per
+                # idle 30s tick would evict the claim traces the ring
+                # buffer exists to keep.
+                with self.tracer.span("inventory-refresh"):
+                    self._m_inventory_refreshes.inc()
+                    logger.info("device inventory changed; republishing")
+                    if self.config.kube_client is not None:
+                        self.publish_resources()
+            # Elastic gang resize runs AFTER the republish: the
+            # re-solve reads published slices, which must already
+            # reflect the transition (a shrink re-solving against
+            # stale slices could pick the dead chip right back).
+            self._maybe_elastic_resize(transitions)
+        except Exception:
+            logger.exception("device inventory refresh failed")
+        try:
+            # Dynamic-sharing tick rides the same wake: paced by its
+            # own interval (against ``now`` when the soak supplies it),
+            # and deliberately after the transitions — rebalancing must
+            # see post-transition health and holds.
+            report["rebalanced"] = self.rebalancer.maybe_tick(now=now)
+        except Exception:
+            logger.exception("rebalance tick failed")
+        try:
+            # Defrag execution rides the same wake, after the
+            # rebalancer: a plan must execute against settled holds.
+            self._maybe_execute_defrag()
+        except Exception:
+            logger.exception("defrag execution tick failed")
+        if now is not None and self.config.audit_interval_seconds <= 0:
+            # Virtual-clock drive with no auditor thread: the audit IS
+            # part of the tick — the soak's "auditor silent at every
+            # tick" gate reads this count.
             try:
-                changed = self.state.refresh_allocatable()
-                self._last_inventory_ok = time.monotonic()
-                transitions = self.state.drain_health_transitions()
-                self._report_health_transitions(transitions)
-                if changed:
-                    # Trace only actual inventory changes: a root trace per
-                    # idle 30s tick would evict the claim traces the ring
-                    # buffer exists to keep.
-                    with self.tracer.span("inventory-refresh"):
-                        self._m_inventory_refreshes.inc()
-                        logger.info("device inventory changed; republishing")
-                        if self.config.kube_client is not None:
-                            self.publish_resources()
-                # Elastic gang resize runs AFTER the republish: the
-                # re-solve reads published slices, which must already
-                # reflect the transition (a shrink re-solving against
-                # stale slices could pick the dead chip right back).
-                self._maybe_elastic_resize(transitions)
+                report["auditFindings"] = len(self.auditor.run_once())
             except Exception:
-                logger.exception("device inventory refresh failed")
-            try:
-                # Dynamic-sharing tick rides the same wake: paced by its
-                # own interval, and deliberately LAST — rebalancing must
-                # see post-transition health and holds.
-                self.rebalancer.maybe_tick()
-            except Exception:
-                logger.exception("rebalance tick failed")
-            try:
-                # Defrag execution rides the same wake, after the
-                # rebalancer: a plan must execute against settled holds.
-                self._maybe_execute_defrag()
-            except Exception:
-                logger.exception("defrag execution tick failed")
+                logger.exception("audit pass failed")
+                report["auditFindings"] = -1
+        return report
 
     def _report_health_transitions(self, transitions) -> None:
         """Turn health transitions into the metric and, when the chip

@@ -164,6 +164,18 @@ class KubeletPlugin:
 
     # -- resource publication (draplugin.go:376-420 analog) ----------------
 
+    def attach_slice_controller(self, controller) -> None:
+        """Inject a pre-built slice controller instead of the lazily
+        started one. The controller is used as-is — in particular, it is
+        NOT started, so a caller that never calls ``start()`` on it owns
+        the sync cadence via ``sync_once()``. The deterministic fleet
+        soak (fleetsim/) uses this to drive slice publication on its
+        virtual clock with no reconciler thread."""
+        with self._lock:
+            if self._slice_controller is not None:
+                raise RuntimeError("slice controller already attached")
+            self._slice_controller = controller
+
     def publish_resources(self, resources: DriverResources) -> None:
         if self.kube_client is None:
             raise RuntimeError("publish_resources requires a kube client")

@@ -431,25 +431,31 @@ class Rebalancer:
 
     # -- wiring ------------------------------------------------------------
 
-    def maybe_tick(self) -> bool:
+    def maybe_tick(self, now: Optional[float] = None) -> bool:
         """Run one pass when the interval has elapsed — the driver's
         device-watch loop calls this every wake, so the rebalancer needs
         no thread of its own. No-op (False) while disabled
-        (``interval <= 0``) or inside the interval."""
+        (``interval <= 0``) or inside the interval. ``now`` overrides
+        the clock for this pacing decision AND the pass itself — the
+        fleet soak (fleetsim/) drives the loop on its virtual clock
+        through ``Driver.tick_once(now=...)``."""
         if self.interval <= 0:
             return False
-        now = self._clock()
+        if now is None:
+            now = self._clock()
         if now - self._last_tick < self.interval:
             return False
-        self.run_once()
+        self.run_once(now=now)
         return True
 
     # -- one pass ----------------------------------------------------------
 
-    def run_once(self) -> list[dict]:
+    def run_once(self, now: Optional[float] = None) -> list[dict]:
         """One observe→decide→apply pass; returns this tick's decision
-        records (also appended to the ring)."""
-        now = self._clock()
+        records (also appended to the ring). ``now`` pins the pass to a
+        caller-supplied (virtual) time instead of the wall clock."""
+        if now is None:
+            now = self._clock()
         self._last_tick = now
         self.ticks += 1
         views = self._claim_views()

@@ -9,12 +9,26 @@ drive every REAL gateway code path (routing, shedding, scaling,
 drain/failover, the metrics and the ring) without importing jax or
 compiling anything.
 
-Timing model: a request "prefills" for ``ceil(len(prompt) /
-prefill_chunk)`` ticks after admission, then "decodes" one token per
-tick. ``batch_slots`` bounds concurrency; admission is FIFO like the
-real engine's. There is no KV pool — ``assert_no_leaks`` checks slot
-accounting only — because pool behavior is the real engine's job and is
-covered by the real-engine tests and the bench.
+Timing model: a request "prefills" for ``ceil((len(prompt) -
+cached_tokens) / prefill_chunk)`` ticks after admission (at least one —
+the real engine recomputes the trailing block copy-on-write even on a
+full cache cover), then "decodes" one token per tick. ``batch_slots``
+bounds concurrency; admission is FIFO like the real engine's. There is
+no KV pool — ``assert_no_leaks`` checks slot accounting only — because
+pool behavior is the real engine's job and is covered by the
+real-engine tests and the bench.
+
+Prefix-cache model: like the real engine's radix cache, each replica
+remembers the leading FULL blocks (``block_size`` tokens each,
+defaulting to ``prefill_chunk``) of every prompt it has prefilled, and
+a later prompt sharing that leading run skips its cached tokens'
+prefill work. Blocks are published when a request's prefill completes,
+first-writer-wins per replica (a block already cached is never
+re-attributed), and the cache is bounded (oldest-block eviction). The
+hit counters in :meth:`snapshot` are what make prefix-affinity routing
+and flash-crowd scenarios *measurable* in the deterministic fleet soak:
+affinity landing same-prefix traffic on one replica shows up directly
+as skipped prefill ticks there.
 
 Observability surface parity: like the real engine, a ``SimRequest``
 carrying a ``timeline`` (serving_gateway/reqtrace.py) gets
@@ -27,6 +41,7 @@ violation paths testable via ``decode_ticks_per_token``) without jax.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import time
 from collections import deque
 from typing import Optional
@@ -47,6 +62,9 @@ class SimRequest:
     max_new_tokens: int
     state: str = "waiting"
     prefill_left: int = 0
+    # Leading tokens served from the replica's prefix cache at submit
+    # time (full blocks only; their prefill ticks are skipped).
+    cached_tokens: int = 0
     generated: list = dataclasses.field(default_factory=list)
     # Optional reqtrace timeline, attached by the gateway (mirrors
     # models/serving.Request.timeline).
@@ -68,12 +86,24 @@ class ScriptedEngine:
 
     def __init__(self, *, batch_slots: int = 4, prefill_chunk: int = 32,
                  decode_ticks_per_token: int = 1, stall: bool = False,
-                 clock=time.monotonic):
+                 clock=time.monotonic, prefix_cache: bool = True,
+                 block_size: Optional[int] = None,
+                 max_cached_blocks: int = 4096):
         self.batch_slots = batch_slots
         self.prefill_chunk = prefill_chunk
         self.decode_ticks_per_token = decode_ticks_per_token
         self.stall = stall
         self._clock = clock
+        # Prefix-cache model (on by default, mirroring the real engine):
+        # a per-replica map of leading-full-block digests. Insertion
+        # order doubles as the eviction order (oldest block first).
+        self.prefix_cache = prefix_cache
+        self.block_size = block_size or prefill_chunk
+        self.max_cached_blocks = max_cached_blocks
+        self._cached_blocks: dict[bytes, None] = {}
+        self.prefix_lookups = 0
+        self.prefix_hits = 0
+        self.prefix_hit_tokens = 0
         self.waiting: deque = deque()
         self.running: list[SimRequest] = []
         self._admission_open = True
@@ -104,15 +134,46 @@ class ScriptedEngine:
     def idle(self) -> bool:
         return not self.waiting and not self.running
 
+    def _block_keys(self, prompt: list[int]) -> list[bytes]:
+        """Digest chain over the prompt's leading FULL blocks: key i
+        commits to blocks 0..i, so a hit on key i means the whole
+        leading run matches (radix-cache semantics without the trie)."""
+        keys = []
+        h = hashlib.blake2b(digest_size=16)
+        for start in range(0, len(prompt) - len(prompt) % self.block_size,
+                           self.block_size):
+            block = prompt[start:start + self.block_size]
+            h.update(b"|".join(str(t).encode() for t in block))
+            keys.append(h.digest())
+        return keys
+
     def submit(self, prompt, max_new_tokens: int) -> SimRequest:
         if not self._admission_open:
             raise SimAdmissionClosedError(
                 "sim engine admission is closed"
             )
+        prompt = [int(t) for t in prompt]
+        cached = 0
+        if self.prefix_cache and prompt:
+            self.prefix_lookups += 1
+            for key in self._block_keys(prompt):
+                if key not in self._cached_blocks:
+                    break
+                cached += self.block_size
+            # Like the real engine, never cover the whole prompt: the
+            # trailing block is recomputed copy-on-write, so at least
+            # one token always prefills.
+            cached = min(cached, len(prompt) - 1)
+            if cached > 0:
+                self.prefix_hits += 1
+                self.prefix_hit_tokens += cached
         req = SimRequest(
-            rid=self._rid, prompt=[int(t) for t in prompt],
+            rid=self._rid, prompt=prompt,
             max_new_tokens=max_new_tokens,
-            prefill_left=-(-len(prompt) // self.prefill_chunk),
+            prefill_left=max(
+                1, -(-(len(prompt) - cached) // self.prefill_chunk)
+            ) if prompt else 0,
+            cached_tokens=cached,
         )
         self._rid += 1
         self.waiting.append(req)
@@ -149,9 +210,24 @@ class ScriptedEngine:
                 req.timeline.event(
                     "engine-admit", self._clock(),
                     slot=self.running.index(req),
-                    cachedTokens=0, cachedBlocks=0, cow=False,
+                    cachedTokens=req.cached_tokens,
+                    cachedBlocks=req.cached_tokens // self.block_size,
+                    cow=req.cached_tokens > 0,
                     readmission=False,
                 )
+
+    def _publish_blocks(self, req: SimRequest) -> None:
+        """Prefill done: publish the prompt's leading full blocks.
+        First-writer-wins (an already-cached block keeps its slot and
+        its age); oldest-block eviction keeps the cache bounded."""
+        if not self.prefix_cache:
+            return
+        for key in self._block_keys(req.prompt):
+            if key in self._cached_blocks:
+                continue
+            self._cached_blocks[key] = None
+            while len(self._cached_blocks) > self.max_cached_blocks:
+                self._cached_blocks.pop(next(iter(self._cached_blocks)))
 
     def _decode_tick(self) -> None:
         for req in list(self.running):
@@ -161,8 +237,11 @@ class ScriptedEngine:
                     req.timeline.event(
                         "prefill-chunk", self._clock(), lane=0,
                         tokens=min(self.prefill_chunk, len(req.prompt)),
-                        occupancy=1.0, cachedTokensSkipped=0,
+                        occupancy=1.0,
+                        cachedTokensSkipped=req.cached_tokens,
                     )
+                if req.prefill_left == 0:
+                    self._publish_blocks(req)
                 continue
             req.state = "running"
             if self._tick_no % self.decode_ticks_per_token == 0:
@@ -178,7 +257,7 @@ class ScriptedEngine:
                     req.timeline.event(
                         "engine-retire", self._clock(),
                         tokens=len(req.generated), preemptions=0,
-                        cachedTokens=0,
+                        cachedTokens=req.cached_tokens,
                     )
 
     def drain(self) -> list[SimRequest]:
@@ -207,6 +286,14 @@ class ScriptedEngine:
             "completed": self.completed,
             "ticks": self.ticks,
             "ttftP99Ms": 0.0,
+            "prefixLookups": self.prefix_lookups,
+            "prefixHits": self.prefix_hits,
+            "prefixHitTokens": self.prefix_hit_tokens,
+            "prefixHitRate": (
+                self.prefix_hits / self.prefix_lookups
+                if self.prefix_lookups else 0.0
+            ),
+            "cachedBlocks": len(self._cached_blocks),
         }
 
 

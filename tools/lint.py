@@ -238,6 +238,7 @@ _MODULE_FAMILY_PREFIXES = {
 # autoscaler/gateway) that share one family.
 _DIR_FAMILY_PREFIXES = {
     "serving_gateway": "tpu_dra_gw_",
+    "fleetsim": "tpu_dra_fleet_",
 }
 # Module-owned prefixes confined BOTH directions (like the directory
 # rule): tpu_dra_srv_* declared anywhere but reqtrace.py is a vocabulary

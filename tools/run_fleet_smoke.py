@@ -1,0 +1,98 @@
+#!/usr/bin/env python3
+"""Fleet soak smoke: a full scripted day of diurnal traffic + chaos
+against the REAL driver/gateway stack, gated, emitting FLEET_r01.json
+(``make fleetsmoke``).
+
+One deterministic discrete-event run (fleetsim/) drives the production
+subsystems — gateway admission/routing/autoscaling, the plugin loop
+(health transitions, elastic resize, rebalancer, defrag execution,
+state auditor), and the reference allocator — through all five
+acceptance axes on one virtual clock:
+
+1. diurnal load per tenant class (realtime / interactive / batch);
+2. a flash crowd pinned to one shared prefix (affinity + prefix cache);
+3. chip chaos: a flapping free chip, a serving-chip unplug (gateway
+   failover + typed retries), a training-chip unplug (elastic
+   shrink/grow);
+4. an apiserver blackout window (auditor and slice publication degrade
+   without findings, then converge);
+5. a 2-chip gang arrival stranded by fragmentation until the defrag
+   executor migrates a serving replica and frees a contiguous box.
+
+PASS requires every gate in the report: zero admitted loss (typed
+classification — lost/unclassified/expired all zero), auditor silence
+at every tick, the stranded gang admitted via an executed plan,
+per-class TTFT/e2e p99 within budget, autoscaler efficiency at or
+above the oracle floor, and zero rebalancer below-min seconds.
+
+Exit 0 on PASS, 1 on any violated gate. TPU_DRA_CHAOS_SEED overrides
+the seed (default 1234) — the same seed replays the same soak
+byte-for-byte; only the artifact's ``wallClock`` section differs
+between runs.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SEED = int(os.environ.get("TPU_DRA_CHAOS_SEED", "1234"))
+ARTIFACT = os.environ.get(
+    "TPU_DRA_FLEET_ARTIFACT",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "FLEET_r01.json"),
+)
+
+
+def fail(msg):
+    print(f"FAIL: {msg}")
+    sys.exit(1)
+
+
+def main():
+    from k8s_dra_driver_tpu.fleetsim import (
+        FleetSim,
+        smoke_scenario,
+        write_artifact,
+    )
+
+    spec = smoke_scenario(seed=SEED)
+    t0 = time.time()
+    report = FleetSim(spec).run()
+    wall_s = time.time() - t0
+
+    write_artifact(report, ARTIFACT, wall_clock={
+        "generatedAt": round(t0, 3),
+        "runSeconds": round(wall_s, 3),
+    })
+    print(f"wrote {ARTIFACT} ({wall_s:.1f}s wall for "
+          f"{spec.duration_s:.0f} virtual seconds)")
+
+    failed = [g for g, v in sorted(report["gates"].items())
+              if not v["pass"]]
+    for g, v in sorted(report["gates"].items()):
+        status = "ok" if v["pass"] else "FAIL"
+        print(f"  gate {g}: {status} value={json.dumps(v['value'])} "
+              f"budget={json.dumps(v['budget'])}")
+    if failed:
+        fail(f"gates violated: {', '.join(failed)}")
+    if not report["pass"]:
+        fail("report['pass'] is false with no failed gate "
+             "(gate accounting drift)")
+
+    loss = report["loss"]
+    print(
+        f"PASS: seed={SEED} {loss['submitted']} requests "
+        f"({loss['served']} served, {loss.get('retried', 0)} retried, "
+        f"{loss['shed-watermark']} shed), "
+        f"{report['chaos']['failovers']} failovers, "
+        f"{report['audit']['passes']} silent audit passes, "
+        f"gang on {report['defrag']['gangDevices']}, "
+        f"efficiency {report['autoscaler']['efficiency']}"
+    )
+
+
+if __name__ == "__main__":
+    main()

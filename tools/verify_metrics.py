@@ -573,6 +573,33 @@ def _self_test_scrape() -> tuple[str, list[str]]:
     gateway_snapshot = gateway.snapshot()
     alloc_errors.extend(gw_errors)
 
+    # The fleet-soak families (tpu_dra_fleet_*), populated by a REAL
+    # mini soak: the deterministic fleet simulator (fleetsim/) drives
+    # the full driver+gateway stack through the compressed five-axis
+    # scenario. Only the tpu_dra_fleet_* family lands on the scraped
+    # registry — the soak's component families (gateway, allocator,
+    # driver, ...) live on the FleetCluster's own registry, because this
+    # process already populated those names with the sims above.
+    from k8s_dra_driver_tpu.fleetsim import FleetSim, mini_scenario
+
+    fleet_errors: list[str] = []
+    try:
+        fleet_report = FleetSim(
+            mini_scenario(), registry=registry
+        ).run()
+        if not fleet_report["pass"]:
+            failed_gates = sorted(
+                g for g, v in fleet_report["gates"].items()
+                if not v["pass"]
+            )
+            fleet_errors.append(
+                "fleet mini-soak violated gates: "
+                + ", ".join(failed_gates)
+            )
+    except Exception as e:
+        fleet_errors.append(f"fleet mini-soak crashed: {e!r}")
+    alloc_errors.extend(fleet_errors)
+
     tracer = Tracer()
     with tracer.span("verify", claim_uid="uid-verify"):
         pass
@@ -904,7 +931,14 @@ def _self_test_scrape() -> tuple[str, list[str]]:
                    "tpu_dra_srv_slo_violations_total",
                    "tpu_dra_srv_violation_seconds_total",
                    "tpu_dra_srv_timelines_total",
-                   "tpu_dra_srv_exemplars_total"):
+                   "tpu_dra_srv_exemplars_total",
+                   "tpu_dra_fleet_ticks_total",
+                   "tpu_dra_fleet_requests_total",
+                   "tpu_dra_fleet_slo_p99_seconds",
+                   "tpu_dra_fleet_chip_seconds",
+                   "tpu_dra_fleet_autoscaler_efficiency_ratio",
+                   "tpu_dra_fleet_audit_findings_total",
+                   "tpu_dra_fleet_gate_failures_total"):
         if f"\n{family}" not in body and not body.startswith(family):
             errors.append(f"expected family {family} missing from scrape")
     # The rendered stage/reason label values stay inside the enums the
