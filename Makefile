@@ -2,7 +2,7 @@
 IMAGE ?= tpu-dra-driver
 TAG ?= latest
 
-.PHONY: all native test image lint verify verify-metrics chaos chaos-slow doctor decodebench moebench elastic allocbench allocbench-smoke gatewaybench tracesmoke defragsmoke fleetsmoke clean e2e-kind
+.PHONY: all native test image lint verify verify-metrics chaos chaos-slow doctor decodebench moebench elastic allocbench allocbench-smoke gatewaybench tracesmoke kvsmoke defragsmoke fleetsmoke clean e2e-kind
 
 all: native
 
@@ -123,12 +123,22 @@ fleetsmoke:
 tracesmoke:
 	python tools/run_trace_smoke.py
 
+# KV-telemetry zero-cost smoke (tools/run_kv_smoke.py): the same
+# fixed-seed churn profile per quantization variant (bf16/int8/kvq)
+# with the KV lifecycle ledger unexported vs exported (KVTelemetry +
+# registry scrapes mid-run) — token streams, tick counts, and
+# compile-once must be bitwise identical, the residency digest must
+# stay self-consistent under eviction churn, and best-of-N wall clock
+# must stay inside the TPU_DRA_KV_SMOKE_OVERHEAD tripwire.
+kvsmoke:
+	python tools/run_kv_smoke.py
+
 # The full local gate: lint + unit/integration tests + chaos schedules +
 # metrics exposition + the doctor/auditor drill + the decode-engine,
 # MoE fast-path, elastic-training, allocator-bench, fleet-gateway,
-# request-observability, defrag-execution, and fleet-soak smokes.
-# What CI runs; what a PR must pass.
-verify: lint test chaos verify-metrics doctor decodebench moebench elastic allocbench-smoke gatewaybench tracesmoke defragsmoke fleetsmoke
+# request-observability, KV-telemetry, defrag-execution, and fleet-soak
+# smokes. What CI runs; what a PR must pass.
+verify: lint test chaos verify-metrics doctor decodebench moebench elastic allocbench-smoke gatewaybench tracesmoke kvsmoke defragsmoke fleetsmoke
 
 # ruff when available (CI installs it; .golangci.yaml analog is
 # [tool.ruff] in pyproject.toml), else the first-party AST lint floor.

@@ -36,6 +36,14 @@ ResourceClaims; then it re-runs the audit cross-checks FLEET-wide:
   recovered from is not), an overloaded fleet (queue depth past the
   shed watermark) is informational with the playbook pointer, and the
   snapshot is bundled as ``gateway.json``;
+- measured KV residency surfaced by ``/debug/residency`` (the
+  ``kv-residency`` check): a replica whose measured digest violates its
+  own lifecycle counters (``indexedBlocks != insertedBlocks -
+  evictedBlocks`` — it claims residency for blocks its eviction
+  counters say are gone) is drift; router-ledger keys the measured
+  digest no longer holds (evicted-but-ledgered staleness) surface as
+  informational with the warm-cache playbook pointer, and the snapshot
+  is bundled as ``residency.json``;
 - request-level SLO trouble surfaced by ``/debug/requests`` (the
   ``slo-exemplar`` check): a latency class with sustained violations
   in its ``?view=slo`` summary is drift, pointing at the slowest
@@ -165,6 +173,7 @@ class NodeScrape:
     defrag: Optional[dict] = None
     rebalance: Optional[dict] = None
     gateway: Optional[dict] = None
+    residency: Optional[dict] = None
     requests_text: str = ""
     slo_summary: Optional[dict] = None
     exemplars: list = dataclasses.field(default_factory=list)
@@ -298,6 +307,15 @@ def collect_node(name: str, url: str, timeout: float = 5.0) -> NodeScrape:
         # frontends, so a 404 is a normal node plugin.
         if getattr(e, "code", None) != 404:
             scrape.errors.append(f"/debug/gateway: {e}")
+    try:
+        scrape.residency = json.loads(
+            _fetch(scrape.url + "/debug/residency", timeout)
+        )
+    except Exception as e:
+        # 404 = no ResidencyIndex on this process (node plugins don't
+        # front a fleet) — benign; anything else is loud.
+        if getattr(e, "code", None) != 404:
+            scrape.errors.append(f"/debug/residency: {e}")
     try:
         scrape.requests_text = _fetch(
             scrape.url + "/debug/requests", timeout
@@ -460,6 +478,42 @@ def fleet_findings(
                     "past the shed watermark (batch traffic is being "
                     "rejected with retry-after) — see the "
                     "overloaded-fleet playbook in docs/operations.md",
+                ))
+        # Measured KV residency (/debug/residency): a replica whose
+        # digest disagrees with its own lifecycle counters claims
+        # residency for blocks its eviction counters say are gone —
+        # the measurement substrate itself is broken, which is drift.
+        # Evicted-but-ledgered staleness (router predicts warm, engine
+        # measures cold) is expected after churn and stays
+        # informational, pointing at the warm-cache playbook.
+        for rid, rep in sorted(
+            ((node.residency or {}).get("replicas") or {}).items()
+        ):
+            if not isinstance(rep, dict):
+                continue
+            if rep.get("counterDrift"):
+                findings.append(DoctorFinding(
+                    SEVERITY_DRIFT, "kv-residency",
+                    f"{node.name}/{rid}",
+                    f"measured digest holds {rep.get('indexedBlocks')} "
+                    f"indexed block(s) but the replica's own lifecycle "
+                    f"counters say {rep.get('insertedBlocks')} inserted "
+                    f"- {rep.get('evictedBlocks')} evicted — it claims "
+                    "residency for blocks its eviction counters say are "
+                    "gone; the /debug/kv ledger on that replica is the "
+                    "evidence trail",
+                ))
+            ledger = rep.get("ledger") or {}
+            stale = ledger.get("staleKeys") or 0
+            if stale > 0:
+                findings.append(DoctorFinding(
+                    SEVERITY_INFO, "kv-residency",
+                    f"{node.name}/{rid}",
+                    f"{int(stale)} router-ledger key(s) predicted warm "
+                    f"are no longer measured resident (divergence "
+                    f"{ledger.get('divergence')}) — eviction outpaced "
+                    "affinity; see the \"is my fleet's KV cache "
+                    "actually warm?\" playbook in docs/operations.md",
                 ))
         # Request-level SLO trouble (/debug/requests?view=slo): a class
         # with sustained violations gets a finding that already answers
@@ -883,6 +937,9 @@ def write_bundle(
             if node.gateway is not None:
                 add(tar, f"{base}/gateway.json",
                     json.dumps(node.gateway, indent=2, sort_keys=True))
+            if node.residency is not None:
+                add(tar, f"{base}/residency.json",
+                    json.dumps(node.residency, indent=2, sort_keys=True))
             if node.requests_text or node.slo_summary is not None:
                 add(tar, f"{base}/requests.json", json.dumps({
                     "slo": node.slo_summary,

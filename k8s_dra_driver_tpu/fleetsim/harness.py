@@ -75,6 +75,7 @@ GATES = (
     "p99-batch",
     "autoscaler-efficiency",
     "rebalancer-min-floor",
+    "kv-hit-rate",
 )
 
 SLO_SIGNALS = ("ttft", "e2e")
@@ -361,6 +362,12 @@ class FleetSim:
             for c in reb.get("claims", {}).values()
         )
 
+        # Two independent KV rollups for the kv-hit-rate gate: the
+        # gateway's measured ResidencyIndex vs a direct walk of every
+        # engine's own counters.
+        residency = gw.residency.snapshot()
+        prefix_rollup = self._prefix_cache_rollup(cluster)
+
         loss = {"submitted": 0}
         for (cls_name, outcome), n in sorted(stats.items()):
             loss.setdefault(outcome, 0)
@@ -400,6 +407,26 @@ class FleetSim:
                 "pass": below_min_s == 0.0,
                 "value": round(below_min_s, 6),
                 "budget": 0,
+            },
+            # Measured, not predicted: the ResidencyIndex aggregation
+            # must agree with a direct walk of the engines' own hit
+            # counters (two independent rollup paths), and the agreed
+            # number must clear the scenario floor.
+            "kv-hit-rate": {
+                "pass": (residency["fleet"]["hits"]
+                         == prefix_rollup["hits"]
+                         and residency["fleet"]["measuredHitRate"]
+                         >= spec.min_fleet_hit_rate),
+                "value": {
+                    "measuredHitRate":
+                        residency["fleet"]["measuredHitRate"],
+                    "measuredHits": residency["fleet"]["hits"],
+                    "engineHits": prefix_rollup["hits"],
+                },
+                "budget": {
+                    "measuredHitRate": spec.min_fleet_hit_rate,
+                    "agreement": "measuredHits == engineHits",
+                },
             },
         }
         for name, ttft_budget, e2e_budget in spec.p99_budgets:
@@ -477,7 +504,23 @@ class FleetSim:
                 "sliceSyncErrors": cluster.slice_controller.sync_errors,
                 "drainedTicks": drained_ticks,
             },
-            "prefixCache": self._prefix_cache_rollup(cluster),
+            "prefixCache": prefix_rollup,
+            # Post-drain measured residency: fleet duplication ratio
+            # plus, per surviving replica, the measured digest counters
+            # and the predicted-vs-measured ledger divergence.
+            "kvResidency": {
+                "fleet": residency["fleet"],
+                "replicas": {
+                    rid: {
+                        "indexedBlocks": rep["indexedBlocks"],
+                        "evictedBlocks": rep["evictedBlocks"],
+                        "measuredKeys": rep["measuredKeys"],
+                        "counterDrift": rep["counterDrift"],
+                        "ledger": rep["ledger"],
+                    }
+                    for rid, rep in sorted(residency["replicas"].items())
+                },
+            },
             "counters": dict(sorted(gw.counters.items())),
         }
         self._publish_metrics(report, stats, summary)

@@ -141,6 +141,14 @@ class ScenarioSpec:
 
     vocab: int = 997
 
+    # kv-hit-rate gate floor: the fleet's MEASURED prefix hit rate
+    # (summed engine counters via the gateway's ResidencyIndex, not the
+    # router's predicted affinity rate) must end the soak at or above
+    # this. The default is deliberately modest — chaos drains/failovers
+    # dump warm caches mid-soak — while still catching an accidentally
+    # disabled or never-warming prefix cache.
+    min_fleet_hit_rate: float = 0.5
+
     # -- derived views -----------------------------------------------------
 
     def rate(self, cls: TrafficClass, t: float) -> float:

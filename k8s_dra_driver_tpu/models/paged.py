@@ -41,7 +41,8 @@ sees the resulting tables.
 from __future__ import annotations
 
 import dataclasses
-from collections import OrderedDict
+import hashlib
+from collections import OrderedDict, deque
 from typing import Callable, Optional
 
 import jax
@@ -51,6 +52,29 @@ import jax.numpy as jnp
 #: little pool, large enough that the kernel's per-block DMA amortizes
 #: (a [64, 128] bf16 block is 16 KiB — comfortably above the DMA knee).
 DEFAULT_BLOCK_SIZE = 64
+
+#: Bound on the LRU-age-at-eviction sample ring (telemetry reads it at
+#: scrape time; without a reader the ring stays this small forever).
+_EVICTION_AGE_SAMPLES = 512
+
+#: Residency-digest caps: at most this many prefix runs per digest, and
+#: affinity keys exported for at most this many leading blocks per run —
+#: covers any router ``affinity_blocks`` ≤ 8 (the fleet uses 4).
+_DIGEST_MAX_RUNS = 32
+_DIGEST_KEY_BLOCKS = 8
+
+
+def prefix_run_key(span) -> str:
+    """Digest of a block-aligned leading token span — the measured-
+    residency analog of ``serving_gateway.router.prefix_affinity_key``:
+    byte-identical payload and digest, so the residency digests engines
+    export join directly against the router's affinity ledger. Kept as a
+    duplicate (not an import) because the gateway must stay importable
+    without jax and the model layer never imports the gateway; a test
+    pins the two implementations equal."""
+    return hashlib.blake2b(
+        ",".join(str(int(t)) for t in span).encode(), digest_size=8
+    ).hexdigest()
 
 
 class OutOfBlocksError(RuntimeError):
@@ -99,7 +123,15 @@ class BlockAllocator:
     ``on_evict(block)`` fires when a cached block is reclaimed so the
     prefix index can drop its entry; ``evict_filter(block)`` lets the
     index steer reclamation (the radix cache evicts leaf blocks first so
-    widely shared prefix roots survive longest)."""
+    widely shared prefix roots survive longest).
+
+    The lifecycle ledger (plain int counters — free on the serving
+    path, read only at scrape time): ``evictions`` (cached blocks
+    reclaimed under pressure), ``alloc_misses`` (allocations the pool
+    could not cover, the OutOfBlocksError count), ``revivals`` (cache
+    hits that pulled a zero-ref block back out of the LRU), and
+    ``eviction_ages`` (LRU residence, in allocator ops, of each evicted
+    block at the moment it was reclaimed — a bounded sample ring)."""
 
     def __init__(self, num_blocks: int):
         if num_blocks <= 0:
@@ -112,6 +144,13 @@ class BlockAllocator:
         self.on_evict: Optional[Callable[[int], None]] = None
         self.evict_filter: Optional[Callable[[int], bool]] = None
         self.evictions = 0
+        self.alloc_misses = 0
+        self.revivals = 0
+        # Logical op clock: bumped per alloc/free call. LRU ages are
+        # measured in it so they stay deterministic under virtual time.
+        self._op = 0
+        self._lru_entered: dict[int, int] = {}
+        self.eviction_ages: deque = deque(maxlen=_EVICTION_AGE_SAMPLES)
 
     @property
     def num_free(self) -> int:
@@ -145,7 +184,9 @@ class BlockAllocator:
         only path that ever drops cached KV."""
         if n < 0:
             raise ValueError(f"cannot allocate {n} blocks")
+        self._op += 1
         if n > self.num_available:
+            self.alloc_misses += 1
             raise OutOfBlocksError(n, len(self._free), self.num_blocks,
                                    reclaimable=len(self._lru))
         out = []
@@ -169,6 +210,9 @@ class BlockAllocator:
         del self._lru[victim]
         self._cached_flag.discard(victim)
         self.evictions += 1
+        self.eviction_ages.append(
+            self._op - self._lru_entered.pop(victim, self._op)
+        )
         if self.on_evict is not None:
             # The index drops its entry; orphaned descendants come back
             # through uncache() and may grow the free list further.
@@ -181,7 +225,9 @@ class BlockAllocator:
             self._refs[block] += 1
         elif block in self._lru:
             del self._lru[block]
+            self._lru_entered.pop(block, None)
             self._refs[block] = 1
+            self.revivals += 1
         else:
             raise ValueError(
                 f"block {block} is neither held nor cached (foreign id)"
@@ -197,6 +243,7 @@ class BlockAllocator:
         returns to the free list — unless the prefix cache registered it,
         in which case it parks in the reclaimable LRU with its KV intact.
         Double-free and foreign ids fail loudly."""
+        self._op += 1
         for b in blocks:
             r = self._refs.get(b)
             if r is None:
@@ -209,6 +256,7 @@ class BlockAllocator:
                 del self._refs[b]
                 if b in self._cached_flag:
                     self._lru[b] = None   # newest LRU entry
+                    self._lru_entered[b] = self._op
                 else:
                     self._free.append(b)
 
@@ -225,17 +273,50 @@ class BlockAllocator:
         self._cached_flag.discard(block)
         if block in self._lru:
             del self._lru[block]
+            self._lru_entered.pop(block, None)
             self._free.append(block)
+
+    def occupancy(self) -> dict[str, int]:
+        """Pool decomposition by block state — mutually exclusive, sums
+        to ``num_blocks``:
+
+        - ``free``: on the free list, no KV content;
+        - ``private``: refcount 1, not indexed by the prefix cache
+          (a request's own working blocks);
+        - ``indexed``: refcount 1, registered with the prefix cache
+          (held by one owner, reusable on retire);
+        - ``shared``: refcount ≥ 2 (a prefix mapped by several
+          sequences — the blocks paying for themselves);
+        - ``cached``: refcount 0 but retained in the reclaimable LRU
+          (a warm cache's inventory).
+
+        O(held blocks); scrape-time only, never on the serving path."""
+        private = indexed = shared = 0
+        for b, r in self._refs.items():
+            if r >= 2:
+                shared += 1
+            elif b in self._cached_flag:
+                indexed += 1
+            else:
+                private += 1
+        return {
+            "free": len(self._free),
+            "private": private,
+            "indexed": indexed,
+            "shared": shared,
+            "cached": len(self._lru),
+        }
 
 
 class _RadixNode:
-    __slots__ = ("key", "block", "parent", "children")
+    __slots__ = ("key", "block", "parent", "children", "last_touch")
 
     def __init__(self, key, block, parent):
         self.key = key
         self.block = block
         self.parent = parent
         self.children: dict[tuple, "_RadixNode"] = {}
+        self.last_touch = 0
 
 
 class PrefixCache:
@@ -270,6 +351,9 @@ class PrefixCache:
         self.hit_blocks = 0
         self.inserted_blocks = 0
         self.evicted_blocks = 0
+        # Logical touch clock for residency digests: bumped once per
+        # lookup/insert; nodes on the walked path are stamped with it.
+        self._touch = 0
 
     def __len__(self) -> int:
         return len(self._by_block)
@@ -280,10 +364,12 @@ class PrefixCache:
         bs = self.block_size
         node = self._root
         out: list[int] = []
+        self._touch += 1
         for i in range(len(tokens) // bs):
             child = node.children.get(tuple(tokens[i * bs:(i + 1) * bs]))
             if child is None:
                 break
+            child.last_touch = self._touch
             out.append(child.block)
             node = child
         self.lookups += 1
@@ -299,6 +385,7 @@ class PrefixCache:
         bs = self.block_size
         node = self._root
         new = 0
+        self._touch += 1
         for i in range(min(len(tokens) // bs, len(blocks))):
             key = tuple(tokens[i * bs:(i + 1) * bs])
             child = node.children.get(key)
@@ -311,9 +398,72 @@ class PrefixCache:
                 self._by_block[b] = child
                 self.allocator.mark_cached(b)
                 new += 1
+            child.last_touch = self._touch
             node = child
         self.inserted_blocks += new
         return new
+
+    def residency_digest(
+        self,
+        max_runs: int = _DIGEST_MAX_RUNS,
+        key_blocks: int = _DIGEST_KEY_BLOCKS,
+    ) -> dict:
+        """The measured-residency export: every root-to-leaf radix path
+        is a cached prefix *run*, described by its affinity key chain
+        (``prefix_run_key`` over the leading 1..``key_blocks`` blocks —
+        the router's ledger joins on these), its block count, its ref
+        distribution (cached / live / shared), and the newest
+        ``last_touch`` stamp along the path.
+
+        Runs share interior nodes, so ``sum(run blocks)`` can exceed
+        ``indexedBlocks``; the gateway joins on keys, not block sums.
+        The counter triple satisfies ``indexedBlocks == insertedBlocks -
+        evictedBlocks`` on a healthy cache — the doctor's drift oracle.
+        Computed on demand only (debug endpoints, replica scrapes),
+        never on the serving path."""
+        paths: list[list[_RadixNode]] = []
+        stack = [(c, [c]) for c in self._root.children.values()]
+        while stack:
+            node, path = stack.pop()
+            if node.children:
+                for c in node.children.values():
+                    stack.append((c, path + [c]))
+            else:
+                paths.append(path)
+        alloc = self.allocator
+        runs = []
+        for path in paths:
+            tokens: list[int] = []
+            keys: list[str] = []
+            for node in path[:key_blocks]:
+                tokens.extend(node.key)
+                keys.append(prefix_run_key(tokens))
+            refs = {"cached": 0, "live": 0, "shared": 0}
+            for node in path:
+                r = alloc.ref_count(node.block)
+                if r == 0:
+                    refs["cached"] += 1
+                elif r == 1:
+                    refs["live"] += 1
+                else:
+                    refs["shared"] += 1
+            runs.append({
+                "keys": keys,
+                "blocks": len(path),
+                "refs": refs,
+                "lastTouch": max(n.last_touch for n in path),
+            })
+        runs.sort(key=lambda r: (-r["blocks"], r["keys"][0] if r["keys"]
+                                 else ""))
+        return {
+            "schema": "tpu-dra-kv-residency-v1",
+            "blockSize": self.block_size,
+            "indexedBlocks": len(self._by_block),
+            "insertedBlocks": self.inserted_blocks,
+            "evictedBlocks": self.evicted_blocks,
+            "runs": runs[:max_runs],
+            "truncatedRuns": max(0, len(runs) - max_runs),
+        }
 
     def _evictable(self, block: int) -> bool:
         node = self._by_block.get(block)

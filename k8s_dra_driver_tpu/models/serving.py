@@ -176,6 +176,15 @@ class ServingStats:
     # prefill program.
     prefill_lanes_used: int = 0
     prefill_lanes_launched: int = 0
+    # Per-request KV footprint (blocks held at retire) — a bounded
+    # sample ring so long-lived engines keep a recent-window view;
+    # kv_footprint_total counts every sample ever taken (the ring
+    # drops old ones) so pull-model exporters can drain exactly the
+    # new samples per scrape.
+    kv_footprint_blocks: deque = dataclasses.field(
+        default_factory=lambda: deque(maxlen=1024)
+    )
+    kv_footprint_total: int = 0
     queue_depth: list = dataclasses.field(default_factory=list)
     ttft_s: list = dataclasses.field(default_factory=list)
     token_interval_s: list = dataclasses.field(default_factory=list)
@@ -230,6 +239,8 @@ class ServingStats:
         "completed", "preemptions", "ticks", "decodeSteps",
         "prefillChunks", "prefillBatchOccupancy", "tokensGenerated",
         "prefixHitRate", "prefillTokensSaved", "cowRecomputes",
+        "prefixLookups", "prefixHits", "prefixHitTokens",
+        "kvFootprintBlocksP50", "kvFootprintBlocksMax",
         "queueDepthMean", "queueDepthMax", "ttftP50Ms", "ttftP99Ms",
         "tokenIntervalP50Ms", "tokenIntervalP99Ms",
     )
@@ -250,6 +261,16 @@ class ServingStats:
             "prefixHitRate": round(self.hit_rate(), 4),
             "prefillTokensSaved": self.prefix_hit_tokens,
             "cowRecomputes": self.cow_recomputes,
+            "prefixLookups": self.prefix_lookups,
+            "prefixHits": self.prefix_hits,
+            "prefixHitTokens": self.prefix_hit_tokens,
+            "kvFootprintBlocksP50": self.pctl(
+                list(self.kv_footprint_blocks), 0.50
+            ),
+            "kvFootprintBlocksMax": (
+                max(self.kv_footprint_blocks)
+                if self.kv_footprint_blocks else 0
+            ),
             "queueDepthMean": round(self.queue_depth_mean(), 2),
             "queueDepthMax": self.queue_depth_max(),
             "ttftP50Ms": round(self.p50_ttft_ms(), 3),
@@ -520,6 +541,12 @@ class DecodeEngine:
         """Live scheduling state + the stats snapshot — the document a
         fleet router scrapes per tick. Key set pinned alongside
         ``ServingStats.SNAPSHOT_KEYS`` in tests/test_serving.py."""
+        occ = self.allocator.occupancy()
+        pc = self.prefix_cache
+        evicted_blocks = (
+            pc.evicted_blocks if pc is not None
+            else self.allocator.evictions
+        )
         return {
             "queueDepth": len(self.waiting),
             "slotsBusy": self.num_active,
@@ -528,7 +555,72 @@ class DecodeEngine:
             "blocksFree": self.allocator.num_free,
             "blocksAvailable": self.allocator.num_available,
             "blocksTotal": self.allocator.num_blocks,
+            # KV lifecycle ledger: the pool decomposition plus the
+            # eviction/revival counters the fleet residency index and
+            # the doctor's drift check consume.
+            "blocksPrivate": occ["private"],
+            "blocksIndexed": occ["indexed"],
+            "blocksShared": occ["shared"],
+            "blocksCached": occ["cached"],
+            "kvEvictedBlocks": evicted_blocks,
+            "kvEvictedTokens": evicted_blocks * self.block_size,
+            "kvRevivals": self.allocator.revivals,
+            "kvAllocMisses": self.allocator.alloc_misses,
             **self.stats.snapshot(),
+        }
+
+    def kv_residency(self) -> dict:
+        """The replica's measured-residency digest (see
+        ``PrefixCache.residency_digest``) — published through the
+        gateway's replica snapshot scrape so the fleet ResidencyIndex
+        can join it against the router's affinity ledger. With the
+        prefix cache disabled the digest is empty but well-formed."""
+        if self.prefix_cache is None:
+            return {
+                "schema": "tpu-dra-kv-residency-v1",
+                "blockSize": self.block_size,
+                "indexedBlocks": 0,
+                "insertedBlocks": 0,
+                "evictedBlocks": 0,
+                "runs": [],
+                "truncatedRuns": 0,
+            }
+        return self.prefix_cache.residency_digest()
+
+    def kv_debug(self) -> dict:
+        """The ``/debug/kv`` document: pool occupancy, the eviction/
+        reclaim ledger, LRU-age and footprint sample summaries, and the
+        full residency digest. Computed on demand only — wire it up via
+        ``MetricsServer.set_kv_provider(engine.kv_debug)``."""
+        a = self.allocator
+        ages = sorted(a.eviction_ages)
+        feet = sorted(self.stats.kv_footprint_blocks)
+
+        def _pct(xs, q):
+            return xs[min(len(xs) - 1, int(q * len(xs)))] if xs else 0
+
+        return {
+            "schema": "tpu-dra-kv-debug-v1",
+            "blockSize": self.block_size,
+            "blocksTotal": a.num_blocks,
+            "occupancy": a.occupancy(),
+            "evictions": a.evictions,
+            "allocMisses": a.alloc_misses,
+            "revivals": a.revivals,
+            "cowRecomputes": self.stats.cow_recomputes,
+            "prefixLookups": self.stats.prefix_lookups,
+            "prefixHits": self.stats.prefix_hits,
+            "prefixHitTokens": self.stats.prefix_hit_tokens,
+            "evictionAgeOps": {
+                "samples": len(ages), "p50": _pct(ages, 0.50),
+                "p99": _pct(ages, 0.99),
+                "max": ages[-1] if ages else 0,
+            },
+            "footprintBlocks": {
+                "samples": len(feet), "p50": _pct(feet, 0.50),
+                "max": feet[-1] if feet else 0,
+            },
+            "residency": self.kv_residency(),
         }
 
     def drain(self, max_ticks: int = 100000) -> list[Request]:
@@ -821,6 +913,9 @@ class DecodeEngine:
         mode are indexed (the last generated token's KV may not be), so
         cache content is identical with the overlap on or off."""
         req.state = FINISHED
+        # Footprint sampled before _evict clears the block list.
+        self.stats.kv_footprint_blocks.append(len(req.blocks))
+        self.stats.kv_footprint_total += 1
         if self.prefix_cache is not None:
             self.prefix_cache.insert(req.tokens[:-1], req.blocks)
         self._evict(req, requeue=False)
@@ -1066,3 +1161,157 @@ class DecodeEngine:
             self._slot_last_token_t[slot] = now
             if self._is_final(r, tok):
                 self._complete(r, slot)
+
+
+class KVTelemetry:
+    """Pull-model exporter for the ``tpu_dra_kv_*`` family.
+
+    The serving path never touches a metric object: engines keep plain
+    int counters and bounded sample rings (models/paged.py's lifecycle
+    ledger), and this class syncs them into the registry from a render
+    hook — i.e. at scrape time only. That is the whole zero-cost
+    contract ``make kvsmoke`` enforces: telemetry ON vs OFF leaves
+    tokens, tick counts, and compile counts bitwise identical, because
+    ON only adds a reader.
+
+    Usage::
+
+        telemetry = KVTelemetry(registry)
+        telemetry.attach(engine, replica="r0")
+
+    Counters are published as deltas against the engines' cumulative
+    ledger values; histograms drain exactly the samples that arrived
+    since the previous scrape (the rings are bounded, so a long
+    scrape gap keeps at most the newest ring's worth)."""
+
+    def __init__(self, registry):
+        from ..utils.metrics import Counter, Gauge, Histogram
+
+        self._engines: dict[str, DecodeEngine] = {}
+        self._published: dict[tuple, int] = {}
+        self._g_pool = Gauge(
+            "tpu_dra_kv_pool_blocks",
+            "KV pool occupancy by block state (free/private/indexed/"
+            "shared/cached); states are mutually exclusive and sum to "
+            "the pool size.",
+            registry,
+        )
+        self._g_indexed = Gauge(
+            "tpu_dra_kv_indexed_blocks",
+            "Blocks currently indexed by the prefix-cache radix tree "
+            "(insertedBlocks - evictedBlocks on a healthy cache).",
+            registry,
+        )
+        self._g_runs = Gauge(
+            "tpu_dra_kv_prefix_runs",
+            "Cached prefix runs (root-to-leaf radix paths) in the "
+            "replica's residency digest.",
+            registry,
+        )
+        self._c_evicted_blocks = Counter(
+            "tpu_dra_kv_evicted_blocks_total",
+            "Prefix-cached KV blocks dropped under allocation pressure "
+            "(LRU-leaf-first reclaim).",
+            registry,
+        )
+        self._c_evicted_tokens = Counter(
+            "tpu_dra_kv_evicted_tokens_total",
+            "Prompt tokens whose cached KV was dropped with evicted "
+            "blocks (evicted blocks x block size).",
+            registry,
+        )
+        self._c_misses = Counter(
+            "tpu_dra_kv_alloc_misses_total",
+            "Block allocations the pool could not cover even after "
+            "reclaiming cached blocks (OutOfBlocksError raises).",
+            registry,
+        )
+        self._c_revivals = Counter(
+            "tpu_dra_kv_revivals_total",
+            "Cache hits that revived a zero-ref block out of the "
+            "reclaimable LRU back into the held state.",
+            registry,
+        )
+        self._c_cow = Counter(
+            "tpu_dra_kv_cow_recomputes_total",
+            "Full-prompt cache hits whose trailing block was recomputed "
+            "into a private copy (copy-on-write by recompute).",
+            registry,
+        )
+        self._h_age = Histogram(
+            "tpu_dra_kv_eviction_lru_age_ops",
+            "LRU residence, in allocator ops, of each cached block at "
+            "the moment it was reclaimed — low ages mean the cache is "
+            "churning faster than it is reused.",
+            registry,
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+        )
+        self._h_foot = Histogram(
+            "tpu_dra_kv_request_footprint_blocks",
+            "KV blocks a request held at retire (its pool footprint).",
+            registry,
+            buckets=(1, 2, 4, 8, 16, 32, 64),
+        )
+        registry.add_render_hook(self._sync)
+
+    def attach(self, engine: "DecodeEngine", replica: str = "r0") -> None:
+        """Register ``engine``'s ledger under the ``replica`` label and
+        materialize its series (the explicit-zeros convention: an
+        unchurned replica must read 0, not be absent)."""
+        self._engines[replica] = engine
+        for c in (self._c_evicted_blocks, self._c_evicted_tokens,
+                  self._c_misses, self._c_revivals, self._c_cow):
+            c.inc(0.0, replica=replica)
+        self._h_age.zero(replica=replica)
+        self._h_foot.zero(replica=replica)
+        self._sync()
+
+    def detach(self, replica: str) -> None:
+        """Stop syncing a departed replica. Its counter/histogram series
+        keep their final values (monotone history); the per-replica
+        gauges are removed so a gone replica does not scrape as a live
+        zero forever."""
+        self._engines.pop(replica, None)
+        for state in ("free", "private", "indexed", "shared", "cached"):
+            self._g_pool.remove(replica=replica, state=state)
+        self._g_indexed.remove(replica=replica)
+        self._g_runs.remove(replica=replica)
+
+    def _bump(self, counter, replica: str, current: int) -> None:
+        key = (counter.name, replica)
+        delta = current - self._published.get(key, 0)
+        if delta > 0:
+            counter.inc(delta, replica=replica)
+        self._published[key] = current
+
+    def _sync(self) -> None:
+        for rid, eng in self._engines.items():
+            a = eng.allocator
+            for state, n in a.occupancy().items():
+                self._g_pool.set(n, replica=rid, state=state)
+            digest = eng.kv_residency()
+            self._g_indexed.set(digest["indexedBlocks"], replica=rid)
+            self._g_runs.set(
+                len(digest["runs"]) + digest["truncatedRuns"],
+                replica=rid,
+            )
+            self._bump(self._c_evicted_blocks, rid,
+                       digest["evictedBlocks"])
+            self._bump(self._c_evicted_tokens, rid,
+                       digest["evictedBlocks"] * eng.block_size)
+            self._bump(self._c_misses, rid, a.alloc_misses)
+            self._bump(self._c_revivals, rid, a.revivals)
+            self._bump(self._c_cow, rid, eng.stats.cow_recomputes)
+            new = a.evictions - self._published.get(("ages", rid), 0)
+            if new > 0:
+                ring = list(a.eviction_ages)
+                for v in ring[-min(new, len(ring)):]:
+                    self._h_age.observe(v, replica=rid)
+            self._published[("ages", rid)] = a.evictions
+            total = eng.stats.kv_footprint_total
+            new = total - self._published.get(("feet", rid), 0)
+            if new > 0:
+                ring = list(eng.stats.kv_footprint_blocks)
+                for v in ring[-min(new, len(ring)):]:
+                    self._h_foot.observe(v, replica=rid)
+            self._published[("feet", rid)] = total

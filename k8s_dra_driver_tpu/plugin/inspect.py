@@ -71,6 +71,7 @@ def collect_live(http_url: str, timeout: float = 3.0) -> dict[str, Any]:
     out.update(_collect_defrag_plans(http_url, timeout))
     out.update(_collect_rebalance(http_url, timeout))
     out.update(_collect_gateway(http_url, timeout))
+    out.update(_collect_residency(http_url, timeout))
     out.update(_collect_requests(http_url, timeout))
     return out
 
@@ -271,6 +272,39 @@ def _collect_gateway(
     if events:
         out["gatewayEvents"] = events[-keep:]
     return out
+
+
+def _collect_residency(
+    http_url: str, timeout: float
+) -> dict[str, Any]:
+    """Measured KV residency from ``/debug/residency``: the fleet's
+    measured hit rate and duplication ratio plus each replica's
+    predicted-vs-measured ledger divergence."""
+    text, err = _fetch_debug(http_url, "/debug/residency", timeout)
+    if err is not None:
+        return {"residencyError": err}
+    if text is None:
+        return {}
+    try:
+        doc = json.loads(text)
+    except ValueError as e:
+        return {"residencyError": str(e)}
+    return {
+        "residencyFleet": doc.get("fleet") or {},
+        "residencyReplicas": {
+            rid: {
+                "indexedBlocks": r.get("indexedBlocks", 0),
+                "evictedBlocks": r.get("evictedBlocks", 0),
+                "counterDrift": bool(r.get("counterDrift")),
+                "staleKeys": (r.get("ledger") or {}).get("staleKeys", 0),
+                "divergence": (r.get("ledger") or {}).get(
+                    "divergence", 0.0
+                ),
+            }
+            for rid, r in sorted((doc.get("replicas") or {}).items())
+            if isinstance(r, dict)
+        },
+    }
 
 
 def _collect_requests(
@@ -657,6 +691,34 @@ def render(state: dict[str, Any]) -> str:
                             f"{k}={v}" for k, v in sorted(e.items())
                             if k not in ("kind", "ts", "tick")
                         )
+                    )
+            if live.get("residencyError"):
+                lines.append(
+                    "  /debug/residency scrape FAILED "
+                    f"({live['residencyError']}) — measured KV "
+                    "residency view unavailable, NOT known-healthy"
+                )
+            res_fleet = live.get("residencyFleet") or {}
+            if res_fleet:
+                lines.append("")
+                lines.append(
+                    "measured KV residency: fleet hit rate "
+                    f"{res_fleet.get('measuredHitRate', 0)} "
+                    f"({res_fleet.get('hits', 0)}/"
+                    f"{res_fleet.get('lookups', 0)}), "
+                    f"{res_fleet.get('uniqueKeys', 0)} unique prefix "
+                    "key(s), duplication ratio "
+                    f"{res_fleet.get('duplicationRatio', 1.0)}"
+                )
+                for rid, r in (
+                    live.get("residencyReplicas") or {}
+                ).items():
+                    lines.append(
+                        f"  {rid}: {r['indexedBlocks']} indexed, "
+                        f"{r['evictedBlocks']} evicted, "
+                        f"{r['staleKeys']} stale ledger key(s) "
+                        f"(divergence {r['divergence']})"
+                        + (" COUNTER-DRIFT" if r["counterDrift"] else "")
                     )
             if live.get("requestsError"):
                 lines.append(

@@ -74,6 +74,7 @@ from .autoscaler import (
     Autoscaler,
     ScaleError,
 )
+from .residency import ResidencyIndex
 from .router import (
     POLICIES,
     REPLICA_DRAINING,
@@ -233,6 +234,13 @@ class ServingGateway:
             "Gateway requests finished, by outcome (completed, failed)",
             registry,
         )
+        self._m_affinity_ledger = Gauge(
+            "tpu_dra_gw_affinity_ledger_keys",
+            "Prefix keys in the router's per-replica affinity ledger "
+            "(seen_keys), by replica; the series is removed when the "
+            "replica deregisters",
+            registry,
+        )
         # Explicit zeros: dashboards must see every family (and the
         # label enums) before the first shed/scale ever happens.
         for policy in POLICIES:
@@ -248,6 +256,32 @@ class ServingGateway:
             self._m_replicas.set(0, state=state)
         for outcome in ("completed", "failed"):
             self._m_requests.inc(0.0, outcome=outcome)
+        # Fleet-wide measured KV residency (residency.py): joins every
+        # replica's engine-published digest against the affinity ledger
+        # above. Shares this registry — its tpu_dra_residency_* gauges
+        # refresh at scrape, and the /debug/residency provider is
+        # self.residency.snapshot.
+        self.residency = ResidencyIndex(self.router, registry=registry)
+        registry.add_render_hook(self._sync_ledger_gauge)
+
+    def _sync_ledger_gauge(self) -> None:
+        # Scrape-time sync: ledger size changes on every dispatch, so a
+        # render hook beats touching the gauge on the serving path.
+        for r in self.router.replicas():
+            self._m_affinity_ledger.set(
+                len(r.seen_keys), replica=r.replica_id
+            )
+
+    def _forget_replica_series(self, replica: Replica) -> None:
+        # Honest ledger bounds on deregistration (drain(remove=True) /
+        # fail): drop the ledger itself — the Replica handle outlives
+        # the router entry and must not pin thousands of keys — and
+        # remove, not zero, its per-replica gauge series (the departed-
+        # claim series pattern; a dead replica scraping as a live 0
+        # forever is unbounded cardinality over churn).
+        replica.seen_keys.clear()
+        self._m_affinity_ledger.remove(replica=replica.replica_id)
+        self.residency.forget_replica(replica.replica_id)
 
     # -- replica lifecycle -------------------------------------------------
 
@@ -599,6 +633,7 @@ class ServingGateway:
             replica.state = REPLICA_GONE
             self.router.remove(replica_id)
             self._dispatched.pop(replica_id, None)
+            self._forget_replica_series(replica)
         else:
             self._dispatched[replica_id] = {}
         self._refresh_replica_gauge()
@@ -712,6 +747,7 @@ class ServingGateway:
         )
         self.router.remove(replica_id)
         self._dispatched.pop(replica_id, None)
+        self._forget_replica_series(replica)
         self._refresh_replica_gauge()
         self._record({
             "kind": "replica-lost", "replicaId": replica_id,

@@ -118,6 +118,12 @@ class Replica:
         return hit
 
     def snapshot(self) -> dict:
+        # kvResidency is the measured prefix-residency digest (engines
+        # that predate the ledger, or run with caching off, publish
+        # None). Duck-typed so sim engines can participate; computed
+        # here — not in engine.snapshot() — because the digest walks
+        # the radix index and only the scrape path should pay for it.
+        kv = getattr(self.engine, "kv_residency", None)
         return {
             "replicaId": self.replica_id,
             "claimUid": self.claim_uid,
@@ -125,6 +131,7 @@ class Replica:
             "stateReason": self.state_reason,
             "queueDepth": self.queue_depth(),
             "affinityKeys": len(self.seen_keys),
+            "kvResidency": kv() if callable(kv) else None,
             "engine": self.engine.snapshot(),
         }
 

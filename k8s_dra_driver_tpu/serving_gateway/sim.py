@@ -46,6 +46,9 @@ import time
 from collections import deque
 from typing import Optional
 
+from .router import prefix_affinity_key
+
+
 class SimAdmissionClosedError(RuntimeError):
     """Mirror of ``models.serving.AdmissionClosedError`` for the sim —
     its own class so importing this module never drags jax in (the
@@ -97,13 +100,20 @@ class ScriptedEngine:
         # Prefix-cache model (on by default, mirroring the real engine):
         # a per-replica map of leading-full-block digests. Insertion
         # order doubles as the eviction order (oldest block first).
+        # Each entry carries residency metadata (depth, the router-
+        # scheme affinity key for its span, its parent chain digest,
+        # last-touch stamp) so kv_residency() can publish the same
+        # measured digest the real engine does.
         self.prefix_cache = prefix_cache
         self.block_size = block_size or prefill_chunk
         self.max_cached_blocks = max_cached_blocks
-        self._cached_blocks: dict[bytes, None] = {}
+        self._cached_blocks: dict[bytes, dict] = {}
         self.prefix_lookups = 0
         self.prefix_hits = 0
         self.prefix_hit_tokens = 0
+        self.inserted_blocks = 0
+        self.evicted_blocks = 0
+        self._touch = 0
         self.waiting: deque = deque()
         self.running: list[SimRequest] = []
         self._admission_open = True
@@ -156,9 +166,12 @@ class ScriptedEngine:
         cached = 0
         if self.prefix_cache and prompt:
             self.prefix_lookups += 1
+            self._touch += 1
             for key in self._block_keys(prompt):
-                if key not in self._cached_blocks:
+                meta = self._cached_blocks.get(key)
+                if meta is None:
                     break
+                meta["touch"] = self._touch
                 cached += self.block_size
             # Like the real engine, never cover the whole prompt: the
             # trailing block is recomputed copy-on-write, so at least
@@ -222,12 +235,25 @@ class ScriptedEngine:
         its age); oldest-block eviction keeps the cache bounded."""
         if not self.prefix_cache:
             return
-        for key in self._block_keys(req.prompt):
+        self._touch += 1
+        prev = None
+        for i, key in enumerate(self._block_keys(req.prompt)):
             if key in self._cached_blocks:
+                prev = key
                 continue
-            self._cached_blocks[key] = None
+            self._cached_blocks[key] = {
+                "depth": i + 1,
+                "key": prefix_affinity_key(
+                    req.prompt, self.block_size, i + 1
+                ),
+                "parent": prev,
+                "touch": self._touch,
+            }
+            self.inserted_blocks += 1
+            prev = key
             while len(self._cached_blocks) > self.max_cached_blocks:
                 self._cached_blocks.pop(next(iter(self._cached_blocks)))
+                self.evicted_blocks += 1
 
     def _decode_tick(self) -> None:
         for req in list(self.running):
@@ -276,6 +302,52 @@ class ScriptedEngine:
     def assert_no_leaks(self) -> None:
         if self.running or self.waiting:
             raise AssertionError("sim engine not idle")
+
+    def kv_residency(self) -> dict:
+        """Measured residency digest, same schema the real engine's
+        ``DecodeEngine.kv_residency`` publishes (models/paged.py) so
+        sim fleets exercise the gateway's ResidencyIndex for real.
+        Runs are the cache's maximal digest chains (leaf back to root,
+        truncating where an interior block was already evicted); keys
+        use the router's affinity scheme, so the ledger join is exact.
+        Invariant: indexedBlocks == insertedBlocks - evictedBlocks."""
+        parents = {
+            meta["parent"] for meta in self._cached_blocks.values()
+            if meta["parent"] is not None
+        }
+        runs = []
+        for digest, meta in self._cached_blocks.items():
+            if digest in parents:
+                continue
+            chain = []
+            node = digest
+            while node is not None:
+                m = self._cached_blocks.get(node)
+                if m is None:
+                    break  # parent evicted under it: truncated chain
+                chain.append(m)
+                node = m["parent"]
+            chain.reverse()
+            runs.append({
+                "keys": [m["key"] for m in chain[:8] if m["key"]],
+                "blocks": len(chain),
+                # The sim holds no refcounts: everything resident is a
+                # parked cached block.
+                "refs": {"cached": len(chain), "live": 0, "shared": 0},
+                "lastTouch": max(m["touch"] for m in chain),
+            })
+        runs.sort(
+            key=lambda r: (-r["blocks"], r["keys"][0] if r["keys"] else "")
+        )
+        return {
+            "schema": "tpu-dra-kv-residency-v1",
+            "blockSize": self.block_size,
+            "indexedBlocks": len(self._cached_blocks),
+            "insertedBlocks": self.inserted_blocks,
+            "evictedBlocks": self.evicted_blocks,
+            "runs": runs[:32],
+            "truncatedRuns": max(0, len(runs) - 32),
+        }
 
     def snapshot(self) -> dict:
         return {

@@ -417,6 +417,8 @@ class MetricsServer:
         self.rebalance_provider: Optional[Callable] = None
         self.gateway_provider: Optional[Callable] = None
         self.requests_provider: Optional[Callable] = None
+        self.kv_provider: Optional[Callable] = None
+        self.residency_provider: Optional[Callable] = None
         # The JSON debug surfaces share one handler block: path ->
         # (provider attribute, not-enabled message). /debug/allocations
         # stays separate (the provider returns pre-rendered JSONL).
@@ -430,6 +432,10 @@ class MetricsServer:
                 "dynamic-sharing rebalancer not enabled"),
             "/debug/gateway": (
                 "gateway_provider", "serving gateway not enabled"),
+            "/debug/kv": (
+                "kv_provider", "kv telemetry not enabled"),
+            "/debug/residency": (
+                "residency_provider", "residency index not enabled"),
         }
         registry_ref = registry
         health = self._health = {"ok": True}
@@ -636,6 +642,18 @@ class MetricsServer:
         ``ServingGateway.snapshot``) at ``/debug/gateway``. Safe to
         call after ``start()``."""
         self.gateway_provider = provider
+
+    def set_kv_provider(self, provider: Callable) -> None:
+        """Serve ``provider()`` (a JSON-serializable dict, e.g.
+        ``DecodeEngine.kv_debug``) at ``/debug/kv``. Safe to call
+        after ``start()``."""
+        self.kv_provider = provider
+
+    def set_residency_provider(self, provider: Callable) -> None:
+        """Serve ``provider()`` (a JSON-serializable dict, e.g.
+        ``ResidencyIndex.snapshot``) at ``/debug/residency``. Safe to
+        call after ``start()``."""
+        self.residency_provider = provider
 
     def set_requests_provider(self, provider: Callable) -> None:
         """Serve ``provider(view)`` (a JSONL string, e.g.

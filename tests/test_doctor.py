@@ -553,3 +553,61 @@ class TestSloCheck:
             srv.stop()
         assert scrape.rebalance is None
         assert not any("/debug/rebalance" in e for e in scrape.errors)
+
+
+class TestKvResidencyCheck:
+    """The measured-residency drift check: a digest that disagrees with
+    its own lifecycle counters is DRIFT (the measurement substrate is
+    broken); evicted-but-ledgered staleness is INFO with the warm-cache
+    playbook pointer."""
+
+    def _scrape(self, replicas):
+        scrape = doctor.NodeScrape(name="node-a", url="http://x")
+        scrape.residency = {
+            "schema": "tpu-dra-residency-v1",
+            "replicas": replicas,
+            "fleet": {"lookups": 0, "hits": 0, "measuredHitRate": 0.0},
+        }
+        return scrape
+
+    def test_counter_drift_is_drift(self):
+        scrape = self._scrape({
+            "r-bad": {
+                "counterDrift": True, "indexedBlocks": 5,
+                "insertedBlocks": 9, "evictedBlocks": 5,
+                "ledger": {"staleKeys": 0, "divergence": 0.0},
+            },
+            "r-ok": {
+                "counterDrift": False, "indexedBlocks": 4,
+                "insertedBlocks": 9, "evictedBlocks": 5,
+                "ledger": {"staleKeys": 0, "divergence": 0.0},
+            },
+        })
+        findings = doctor.fleet_findings([scrape], None, DRIVER)
+        kv = [f for f in findings if f.check == "kv-residency"]
+        assert len(kv) == 1
+        assert kv[0].severity == doctor.SEVERITY_DRIFT
+        assert kv[0].subject == "node-a/r-bad"
+        assert "/debug/kv" in kv[0].detail
+
+    def test_stale_ledger_keys_are_info_with_playbook(self):
+        scrape = self._scrape({
+            "r0": {
+                "counterDrift": False, "indexedBlocks": 2,
+                "insertedBlocks": 6, "evictedBlocks": 4,
+                "ledger": {"staleKeys": 3, "divergence": 0.75},
+            },
+        })
+        findings = doctor.fleet_findings([scrape], None, DRIVER)
+        kv = [f for f in findings if f.check == "kv-residency"]
+        assert len(kv) == 1
+        assert kv[0].severity == doctor.SEVERITY_INFO
+        assert "actually warm" in kv[0].detail
+        assert "docs/operations.md" in kv[0].detail
+
+    def test_missing_residency_document_is_benign(self):
+        scrape = doctor.NodeScrape(name="node-a", url="http://x")
+        assert scrape.residency is None
+        findings = doctor.fleet_findings([scrape], None, DRIVER)
+        assert not [f for f in findings if f.check == "kv-residency"]
+        assert not [f for f in findings if f.check == "collect"]

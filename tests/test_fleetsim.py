@@ -268,3 +268,23 @@ def test_smoke_profile_reproducible():
     a = FleetSim(smoke_scenario()).run()
     b = FleetSim(smoke_scenario()).run()
     assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+
+def test_kv_hit_rate_gate_measures_and_agrees(mini_report):
+    """The kv-hit-rate gate: the gateway's measured fleet hit rate
+    (summed engine counters through the ResidencyIndex) must agree
+    exactly with the engines' own prefix-cache rollup — predicted
+    affinity never substitutes for measurement."""
+    gate = mini_report["gates"]["kv-hit-rate"]
+    assert gate["pass"]
+    assert gate["value"]["measuredHits"] == gate["value"]["engineHits"]
+    assert gate["value"]["measuredHitRate"] >= (
+        gate["budget"]["measuredHitRate"]
+    )
+    res = mini_report["kvResidency"]
+    assert res["fleet"]["hits"] == gate["value"]["measuredHits"]
+    for rid, rep in res["replicas"].items():
+        assert not rep["counterDrift"], rid
+        assert rep["ledger"]["staleKeys"] <= (
+            rep["ledger"]["predictedKeys"]
+        )

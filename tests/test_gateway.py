@@ -962,3 +962,187 @@ class TestReviewRegressions:
                             "d")
         gw_fs = [f for f in fs if f.check == "gateway"]
         assert len(gw_fs) == 1 and gw_fs[0].severity == "drift"
+
+
+class TestResidency:
+    """The gateway-global measured-residency index: engine digests
+    joined against the router's affinity ledger, with departed-replica
+    series hygiene."""
+
+    def _affinity_gateway(self, *engines):
+        reg = Registry()
+        gw = ServingGateway(
+            reg,
+            router=Router(policy="affinity", block_size=16,
+                          affinity_blocks=2, seed=3),
+        )
+        for i, eng in enumerate(engines):
+            gw.add_replica(eng, f"r{i}")
+        return reg, gw
+
+    def test_affinity_key_schemes_pinned_equal(self):
+        """router.prefix_affinity_key and paged.prefix_run_key are
+        deliberate duplicates (the gateway must import without jax);
+        this pin is what lets measured digests join the ledger."""
+        from k8s_dra_driver_tpu.models.paged import prefix_run_key
+
+        rng = np.random.RandomState(9)
+        prompt = [int(t) for t in rng.randint(0, 997, size=41)]
+        for block_size, max_blocks in ((8, 1), (8, 2), (8, 5), (16, 2)):
+            n = min(len(prompt) // block_size, max_blocks)
+            assert prefix_affinity_key(
+                prompt, block_size, max_blocks
+            ) == prefix_run_key(prompt[: n * block_size])
+        assert prefix_affinity_key([1, 2], 16, 2) is None
+
+    def test_fleet_hits_agree_with_engine_counters(self):
+        reg, gw = self._affinity_gateway(ScriptedEngine(),
+                                         ScriptedEngine())
+        prompts = shared_prefix_prompts(
+            8, n_systems=2, system_len=32, tail_len=4, seed=5
+        )
+        # Two waves so the second wave's lookups land after the first
+        # wave's blocks were published (hits require resident blocks).
+        for p in prompts[:4]:
+            gw.submit(p, 2, latency_class="interactive")
+        gw.run()
+        for p in prompts[4:]:
+            gw.submit(p, 2, latency_class="interactive")
+        gw.run()
+        doc = gw.residency.snapshot()
+        assert doc["schema"] == "tpu-dra-residency-v1"
+        assert set(doc["replicas"]) == {"r0", "r1"}
+        engine_hits = sum(
+            r.engine.snapshot()["prefixHits"]
+            for r in gw.router.replicas()
+        )
+        assert engine_hits > 0, "wave 2 must hit wave 1's blocks"
+        assert doc["fleet"]["hits"] == engine_hits
+        assert doc["fleet"]["uniqueKeys"] > 0
+        assert doc["fleet"]["duplicationRatio"] >= 1.0
+        for rep in doc["replicas"].values():
+            assert not rep["counterDrift"]
+            assert rep["indexedBlocks"] == (
+                rep["insertedBlocks"] - rep["evictedBlocks"]
+            )
+
+    def test_stale_ledger_keys_and_divergence(self):
+        # A 2-block cache under 6 distinct system prompts: the router
+        # remembers every key it routed, the engine measures almost
+        # none of them still resident.
+        reg, gw = self._affinity_gateway(
+            ScriptedEngine(max_cached_blocks=2)
+        )
+        for p in shared_prefix_prompts(
+            6, n_systems=6, system_len=32, tail_len=2, seed=7
+        ):
+            gw.submit(p, 1, latency_class="interactive")
+            gw.run()
+        doc = gw.residency.snapshot()
+        rep = doc["replicas"]["r0"]
+        assert rep["evictedBlocks"] > 0
+        ledger = rep["ledger"]
+        assert ledger["predictedKeys"] > 0
+        assert ledger["staleKeys"] > 0
+        assert ledger["divergence"] > 0
+        assert ledger["staleKeys"] <= ledger["predictedKeys"]
+
+    def test_departed_replica_series_removed(self):
+        reg, gw = self._affinity_gateway(ScriptedEngine(),
+                                         ScriptedEngine())
+        for p in shared_prefix_prompts(
+            6, n_systems=2, system_len=32, tail_len=4, seed=13
+        ):
+            gw.submit(p, 1, latency_class="interactive")
+        gw.run()
+        body = reg.render()
+        per_replica = ("tpu_dra_gw_affinity_ledger_keys",
+                       "tpu_dra_residency_stale_ledger_keys",
+                       "tpu_dra_residency_replica_indexed_blocks")
+        for family in per_replica:
+            assert f'{family}{{replica="r1"}}' in body, family
+        r1 = next(r for r in gw.router.replicas()
+                  if r.replica_id == "r1")
+        gw.drain_replica("r1", remove=True)
+        assert not r1.seen_keys, "departed ledger must be dropped"
+        after = reg.render()
+        for line in after.splitlines():
+            if 'replica="r1"' in line:
+                assert not line.startswith(per_replica), line
+        # The survivor keeps scraping.
+        for family in per_replica:
+            assert f'{family}{{replica="r0"}}' in after, family
+        assert "r1" not in gw.residency.snapshot()["replicas"]
+
+    def test_failed_replica_series_removed(self):
+        reg, gw = self._affinity_gateway(ScriptedEngine(),
+                                         ScriptedEngine())
+        for p in shared_prefix_prompts(
+            4, n_systems=2, system_len=32, tail_len=4, seed=17
+        ):
+            gw.submit(p, 1, latency_class="interactive")
+        gw.run()
+        gw.fail_replica("r0", "chip unplugged")
+        gw.run()
+        body = reg.render()
+        for line in body.splitlines():
+            if 'replica="r0"' in line:
+                assert not line.startswith(
+                    ("tpu_dra_gw_affinity_ledger_keys",
+                     "tpu_dra_residency_")), line
+
+    def test_scripted_engine_digest_matches_real_schema(self):
+        eng = ScriptedEngine(max_cached_blocks=3)
+        for p in shared_prefix_prompts(
+            5, n_systems=5, system_len=32, tail_len=2, seed=23
+        ):
+            eng.submit(p, 1)
+        while eng.waiting or eng.running:
+            eng.tick()
+        digest = eng.kv_residency()
+        assert digest["schema"] == "tpu-dra-kv-residency-v1"
+        assert digest["evictedBlocks"] > 0
+        assert digest["indexedBlocks"] == (
+            digest["insertedBlocks"] - digest["evictedBlocks"]
+        )
+        assert digest["indexedBlocks"] == len(eng._cached_blocks)
+        for run in digest["runs"]:
+            assert run["blocks"] > 0 and run["keys"]
+
+    def test_debug_residency_endpoint_and_405(self):
+        import json
+        import urllib.error
+        import urllib.request
+
+        from k8s_dra_driver_tpu.utils.metrics import MetricsServer
+
+        reg, gw = self._affinity_gateway(ScriptedEngine())
+        gw.submit([1] * 32, 1, latency_class="interactive")
+        gw.run()
+        srv = MetricsServer(reg, host="127.0.0.1", port=0)
+        srv.set_residency_provider(gw.residency.snapshot)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            doc = json.loads(urllib.request.urlopen(
+                f"{base}/debug/residency").read().decode())
+            assert doc["schema"] == "tpu-dra-residency-v1"
+            assert "r0" in doc["replicas"]
+            for key in ("lookups", "hits", "measuredHitRate",
+                        "uniqueKeys", "duplicationRatio"):
+                assert key in doc["fleet"], key
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{base}/debug/residency",
+                                       data=b"x")
+            assert ei.value.code == 405
+        finally:
+            srv.stop()
+
+    def test_replica_snapshot_publishes_digest(self):
+        _, gw = self._affinity_gateway(ScriptedEngine())
+        gw.submit([1] * 32, 1, latency_class="interactive")
+        gw.run()
+        rep_doc = gw.snapshot()["replicas"]["r0"]
+        assert rep_doc["kvResidency"]["schema"] == (
+            "tpu-dra-kv-residency-v1"
+        )

@@ -803,6 +803,8 @@ class TestSnapshot:
             "completed", "preemptions", "ticks", "decodeSteps",
             "prefillChunks", "prefillBatchOccupancy", "tokensGenerated",
             "prefixHitRate", "prefillTokensSaved", "cowRecomputes",
+            "prefixLookups", "prefixHits", "prefixHitTokens",
+            "kvFootprintBlocksP50", "kvFootprintBlocksMax",
             "queueDepthMean", "queueDepthMax", "ttftP50Ms", "ttftP99Ms",
             "tokenIntervalP50Ms", "tokenIntervalP99Ms",
         }
@@ -819,6 +821,9 @@ class TestSnapshot:
         assert set(snap) == {
             "queueDepth", "slotsBusy", "batchSlots", "admissionOpen",
             "blocksFree", "blocksAvailable", "blocksTotal",
+            "blocksPrivate", "blocksIndexed", "blocksShared",
+            "blocksCached", "kvEvictedBlocks", "kvEvictedTokens",
+            "kvRevivals", "kvAllocMisses",
             *ServingStats.SNAPSHOT_KEYS,
         }
         assert snap["queueDepth"] == 1
@@ -829,3 +834,133 @@ class TestSnapshot:
         done = eng.snapshot()
         assert done["completed"] == 1
         assert done["queueDepth"] == 0
+
+
+class TestKVLedger:
+    """KV residency observability: the block-lifecycle ledger and the
+    measured-residency digest stay consistent through eviction and
+    preemption churn (``assert_no_leaks`` is the ground truth), and
+    the exported telemetry is a pure observer."""
+
+    def _churn_engine(self, params, **kw):
+        kw.setdefault("batch_slots", 2)
+        kw.setdefault("num_blocks", 12)
+        kw.setdefault("block_size", 8)
+        kw.setdefault("max_seq_len", 48)
+        kw.setdefault("prefill_chunk", 8)
+        return DecodeEngine(params, TINY, **kw)
+
+    def _churn_prompts(self):
+        # Shared 16-token system prefix x varied tails, each submitted
+        # twice: repeats hit the radix cache (COW on the trailing
+        # block); variety against the 12-block pool forces evictions.
+        base = _prompts(11, (16,))[0]
+        tails = _prompts(12, (5, 8, 11, 14))
+        return [base + t for t in tails] * 2
+
+    def test_digest_consistent_after_eviction_churn(self, params):
+        eng = self._churn_engine(params)
+        reqs = [eng.submit(p, max_new_tokens=12)
+                for p in self._churn_prompts()]
+        eng.run()
+        eng.assert_no_leaks()
+        assert all(r.done for r in reqs)
+        digest = eng.kv_residency()
+        assert digest["schema"] == "tpu-dra-kv-residency-v1"
+        assert digest["evictedBlocks"] > 0, "scenario must evict"
+        assert digest["indexedBlocks"] == (
+            digest["insertedBlocks"] - digest["evictedBlocks"]
+        )
+        occ = eng.allocator.occupancy()
+        assert sum(occ.values()) == eng.allocator.num_blocks
+        for run in digest["runs"]:
+            assert run["blocks"] > 0
+            assert set(run["refs"]) == {"cached", "live", "shared"}
+
+    def test_digest_consistent_after_preemption_churn(self, params):
+        # The TestPreemption starvation profile, with the ledger now
+        # audited after the dust settles.
+        eng = self._churn_engine(params, batch_slots=3, num_blocks=6)
+        reqs = [eng.submit(p, max_new_tokens=10)
+                for p in _prompts(4, (7, 9, 6, 8, 7))]
+        eng.run()
+        eng.assert_no_leaks()
+        assert eng.stats.preemptions > 0, "scenario must preempt"
+        assert all(r.done for r in reqs)
+        digest = eng.kv_residency()
+        assert digest["indexedBlocks"] == (
+            digest["insertedBlocks"] - digest["evictedBlocks"]
+        )
+        occ = eng.allocator.occupancy()
+        assert sum(occ.values()) == eng.allocator.num_blocks
+
+    def test_kv_debug_document_and_endpoint(self, params):
+        import json
+        import urllib.error
+        import urllib.request
+
+        from k8s_dra_driver_tpu.utils.metrics import (
+            MetricsServer,
+            Registry,
+        )
+
+        eng = self._churn_engine(params)
+        reqs = [eng.submit(p, max_new_tokens=12)
+                for p in self._churn_prompts()]
+        eng.run()
+        doc = eng.kv_debug()
+        assert doc["schema"] == "tpu-dra-kv-debug-v1"
+        assert sum(doc["occupancy"].values()) == doc["blocksTotal"]
+        assert doc["footprintBlocks"]["samples"] == len(reqs)
+        json.dumps(doc)
+        srv = MetricsServer(Registry(), host="127.0.0.1", port=0)
+        srv.set_kv_provider(eng.kv_debug)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            served = json.loads(urllib.request.urlopen(
+                f"{base}/debug/kv").read().decode())
+            assert served["schema"] == "tpu-dra-kv-debug-v1"
+            assert served["residency"]["indexedBlocks"] == (
+                served["residency"]["insertedBlocks"]
+                - served["residency"]["evictedBlocks"]
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{base}/debug/kv", data=b"x")
+            assert ei.value.code == 405
+        finally:
+            srv.stop()
+
+    def test_telemetry_mirrors_ledger_and_detaches(self, params):
+        from k8s_dra_driver_tpu.models.serving import KVTelemetry
+        from k8s_dra_driver_tpu.utils.metrics import Registry
+
+        registry = Registry()
+        tel = KVTelemetry(registry)
+        eng = self._churn_engine(params)
+        tel.attach(eng, replica="kv-test")
+        [eng.submit(p, max_new_tokens=12) for p in self._churn_prompts()]
+        eng.run()
+        body = registry.render()
+        for family in ("tpu_dra_kv_pool_blocks",
+                       "tpu_dra_kv_indexed_blocks",
+                       "tpu_dra_kv_prefix_runs",
+                       "tpu_dra_kv_evicted_blocks_total",
+                       "tpu_dra_kv_evicted_tokens_total",
+                       "tpu_dra_kv_alloc_misses_total",
+                       "tpu_dra_kv_revivals_total",
+                       "tpu_dra_kv_cow_recomputes_total",
+                       "tpu_dra_kv_eviction_lru_age_ops",
+                       "tpu_dra_kv_request_footprint_blocks"):
+            assert family in body, family
+        evicted = eng.kv_residency()["evictedBlocks"]
+        assert evicted > 0
+        assert (f'tpu_dra_kv_evicted_blocks_total{{replica="kv-test"}} '
+                f"{evicted}") in body
+        tel.detach("kv-test")
+        after = registry.render()
+        assert ('tpu_dra_kv_pool_blocks{replica="kv-test"'
+                not in after), "departed replica's pool gauges linger"
+        # Monotone history stays: counters keep their final values.
+        assert (f'tpu_dra_kv_evicted_blocks_total{{replica="kv-test"}} '
+                f"{evicted}") in after

@@ -39,10 +39,14 @@ Plus one first-party rule with no ruff analog:
   owns ``tpu_dra_gw_*`` at DIRECTORY granularity (``serving_gateway/``
   spans several modules sharing one family): metrics declared there
   must use the prefix, and the prefix may not appear anywhere else.
-  ``serving_gateway/reqtrace.py`` is the one carve-out: it owns
-  ``tpu_dra_srv_*`` (confined both directions, like a directory
-  family), so its module entry exempts it from the directory's
-  declare-side rule.
+  ``serving_gateway/reqtrace.py`` and ``serving_gateway/residency.py``
+  are the carve-outs: they own ``tpu_dra_srv_*`` and
+  ``tpu_dra_residency_*`` (confined both directions, like a directory
+  family), so their module entries exempt them from the directory's
+  declare-side rule. ``tpu_dra_kv_*`` is the one two-owner family:
+  ``models/paged.py`` holds the lifecycle ledger and
+  ``models/serving.py`` exports it, so both may declare under the
+  prefix and nobody else may.
 - TPM06: ``stage=``/``reason=`` label values on the ``tpu_dra_alloc_*``
   explainability families are confined to the ``STAGES``/``REASONS``
   enums declared in ``kube/allocator.py`` (parsed by AST, not imported):
@@ -206,7 +210,8 @@ _METRIC_CLASSES = {"Counter", "Gauge", "Histogram"}
 _METRIC_PREFIX = "tpu_dra_"
 # _total is a counter-only suffix (it would collide with histogram series
 # naming), so histograms get the unit suffixes without it.
-_HISTOGRAM_UNIT_SUFFIXES = ("_seconds", "_bytes", "_celsius", "_ratio")
+_HISTOGRAM_UNIT_SUFFIXES = ("_seconds", "_bytes", "_celsius", "_ratio",
+                            "_ops", "_blocks")
 # TPM04: label names whose values scale with the device inventory, and
 # the only modules allowed to emit them (their series counts are bounded
 # by the node's chip count by construction).
@@ -227,9 +232,15 @@ _MODULE_FAMILY_PREFIXES = {
     # module entry keeps declaration ownership separate.
     "defrag_executor.py": "tpu_dra_defrag_exec_",
     "rebalancer.py": "tpu_dra_slo_",
-    # reqtrace.py lives under serving_gateway/ but owns its own family;
-    # a module entry exempts it from the directory rule below.
+    # reqtrace.py and residency.py live under serving_gateway/ but own
+    # their own families; a module entry exempts them from the
+    # directory rule below.
     "reqtrace.py": "tpu_dra_srv_",
+    "residency.py": "tpu_dra_residency_",
+    # The KV lifecycle family: paged.py holds the plain-int ledger,
+    # serving.py's KVTelemetry declares the exported series.
+    "paged.py": "tpu_dra_kv_",
+    "serving.py": "tpu_dra_kv_",
 }
 # Directory-owned families: every metric declared anywhere under the
 # directory uses its prefix, and (unlike the per-module table, whose
@@ -241,12 +252,16 @@ _DIR_FAMILY_PREFIXES = {
     "fleetsim": "tpu_dra_fleet_",
 }
 # Module-owned prefixes confined BOTH directions (like the directory
-# rule): tpu_dra_srv_* declared anywhere but reqtrace.py is a vocabulary
-# leak. Only unambiguous prefixes belong here — tpu_dra_alloc is a
-# shared stem (tpu_dra_alloc_* + tpu_dra_allocation_*), so it stays
+# rule), keyed prefix -> owner set: tpu_dra_srv_* declared anywhere but
+# reqtrace.py is a vocabulary leak; tpu_dra_kv_* has TWO legitimate
+# owners (the paged pool's ledger and the serving engine's exporter).
+# Only unambiguous prefixes belong here — tpu_dra_alloc is a shared
+# stem (tpu_dra_alloc_* + tpu_dra_allocation_*), so it stays
 # declare-side-only in _MODULE_FAMILY_PREFIXES.
 _CONFINED_MODULE_PREFIXES = {
-    "reqtrace.py": "tpu_dra_srv_",
+    "tpu_dra_srv_": frozenset({"reqtrace.py"}),
+    "tpu_dra_kv_": frozenset({"paged.py", "serving.py"}),
+    "tpu_dra_residency_": frozenset({"residency.py"}),
 }
 _METRIC_METHODS = {"inc", "set", "observe"}
 
@@ -299,12 +314,13 @@ def check_metric_conventions(tree: ast.Module, path: Path) -> list[Finding]:
                 path, node.lineno, "TPM05",
                 f"{cls} name {name!r} declared in {path.name} must use "
                 f"the {owned_prefix!r} family prefix"))
-        for module, mod_prefix in _CONFINED_MODULE_PREFIXES.items():
-            if path.name != module and name.startswith(mod_prefix):
+        for mod_prefix, owners in _CONFINED_MODULE_PREFIXES.items():
+            if path.name not in owners and name.startswith(mod_prefix):
                 out.append(Finding(
                     path, node.lineno, "TPM05",
                     f"{cls} name {name!r} uses the {mod_prefix!r} "
-                    f"family prefix owned by {module}"))
+                    f"family prefix owned by "
+                    f"{'/'.join(sorted(owners))}"))
         for dirname, dir_prefix in _DIR_FAMILY_PREFIXES.items():
             in_dir = dirname in path.parts
             # A file with its own module-owned family is exempt from its

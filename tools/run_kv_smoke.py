@@ -1,0 +1,197 @@
+#!/usr/bin/env python3
+"""KV-telemetry zero-cost smoke (``make kvsmoke``, wired into ``make
+verify``): the same fixed-seed churn profile driven through a real
+DecodeEngine twice per quantization variant (bf16 / int8 / kvq) —
+lifecycle ledger unexported (no KVTelemetry; the allocator/cache always
+keep their plain-int counters) vs exported (KVTelemetry attached, the
+registry scraped between rounds so the render hook actually runs) —
+with gates proving the PR-16 tracesmoke discipline holds for the KV
+ledger too: telemetry changes what we KNOW, never what the engine DOES.
+
+1. **Token streams identical** ON vs OFF, warm run and every repeat:
+   the ledger must not touch allocation order, eviction choice,
+   prefix-cache behavior, or sampling.
+2. **Tick counts identical** ON vs OFF: identical tick-normalized
+   throughput (the same trick the 3% req/s bar rides on in tracesmoke).
+3. **Compile-once unchanged** in both runs: exactly one decode step and
+   one prefill chunk program — the ledger is host-side integers, never
+   traced.
+4. **Ledger self-consistent** ON: the residency digest's invariant
+   ``indexedBlocks == insertedBlocks - evictedBlocks`` holds after
+   churn, pool occupancy states sum to the pool size, the request
+   footprint histogram saw every retired request, and /debug/kv's
+   document is JSON-serializable.
+5. **Wall-clock tripwire**: best-of-N ON within
+   ``TPU_DRA_KV_SMOKE_OVERHEAD`` (default 50%; same CPU-noise rationale
+   as tracesmoke — the TPU bar runs with the env knob tightened) of OFF.
+
+Exit 0 = all gates pass; 1 = a gate failed.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+OVERHEAD_LIMIT = float(os.environ.get("TPU_DRA_KV_SMOKE_OVERHEAD", "0.50"))
+SEED = int(os.environ.get("TPU_DRA_KV_SMOKE_SEED", "1234"))
+N_NEW = 12
+REPEATS = 5
+
+failures: list[str] = []
+
+
+def gate(ok: bool, what: str) -> None:
+    tag = "ok " if ok else "FAIL"
+    print(f"[{tag}] {what}", flush=True)
+    if not ok:
+        failures.append(what)
+
+
+def build_engine(params, config, quant_kv):
+    from k8s_dra_driver_tpu.models.serving import DecodeEngine
+
+    # A deliberately tight pool (12 blocks, 2 slots): the shared-prefix
+    # traffic below must force real evictions, revivals, and COW
+    # recomputes so the ledger has lifecycle events to get wrong.
+    return DecodeEngine(
+        params, config, batch_slots=2, num_blocks=12, block_size=8,
+        max_seq_len=48, prefill_chunk=8, quantize_cache=quant_kv,
+    )
+
+
+def drive(engine, prompts):
+    reqs = [engine.submit(p, max_new_tokens=N_NEW) for p in prompts]
+    engine.run()
+    engine.assert_no_leaks()
+    return [tuple(r.tokens) for r in reqs]
+
+
+def check_ledger(label, eng):
+    digest = eng.kv_residency()
+    gate(
+        digest["indexedBlocks"]
+        == digest["insertedBlocks"] - digest["evictedBlocks"],
+        f"{label}: digest invariant indexed == inserted - evicted "
+        f"({digest['indexedBlocks']} == {digest['insertedBlocks']} - "
+        f"{digest['evictedBlocks']})",
+    )
+    debug = eng.kv_debug()
+    occ = debug["occupancy"]
+    gate(
+        sum(occ.values()) == debug["blocksTotal"],
+        f"{label}: occupancy states sum to the pool "
+        f"({occ} vs {debug['blocksTotal']})",
+    )
+    gate(
+        debug["footprintBlocks"]["samples"] > 0,
+        f"{label}: footprint histogram saw retired requests "
+        f"({debug['footprintBlocks']['samples']} samples)",
+    )
+    try:
+        json.dumps(debug)
+        json.dumps(digest)
+        gate(True, f"{label}: /debug/kv + residency docs JSON-clean")
+    except (TypeError, ValueError) as e:
+        gate(False, f"{label}: debug docs not JSON-serializable: {e}")
+
+
+def main() -> int:
+    import jax
+    import numpy as np
+
+    from k8s_dra_driver_tpu.models.llama import PRESETS, init_params
+    from k8s_dra_driver_tpu.models.quant import quantize_params
+    from k8s_dra_driver_tpu.models.serving import KVTelemetry
+    from k8s_dra_driver_tpu.utils.metrics import Registry
+
+    config = PRESETS["tiny"]
+    params = init_params(config, jax.random.PRNGKey(0))
+    qparams = quantize_params(params)
+    rng = np.random.RandomState(SEED)
+    base = rng.randint(0, config.vocab_size, size=16).tolist()
+    tails = [
+        rng.randint(0, config.vocab_size, size=int(n)).tolist()
+        for n in rng.randint(1, 14, size=4)
+    ]
+    # Shared system prefix x varied tails, each submitted twice per
+    # round: the repeats hit the radix cache (COW on the trailing
+    # block), the variety plus the 12-block pool forces evictions.
+    prompts = [base + t for t in tails] * 2
+
+    for label, p, qkv in (
+        ("bf16", params, False),
+        ("int8", qparams, False),
+        ("kvq", params, True),
+    ):
+        runs = {}
+        for on in (False, True):
+            eng = build_engine(p, config, qkv)
+            registry = None
+            if on:
+                registry = Registry()
+                KVTelemetry(registry).attach(eng, replica="r0")
+            warm = drive(eng, prompts)   # compiles
+            if on:
+                registry.render()        # first scrape: hook + deltas
+            times, rounds = [], []
+            for _ in range(REPEATS):
+                t0 = time.perf_counter()
+                tokens = drive(eng, prompts)
+                times.append(time.perf_counter() - t0)
+                rounds.append(tokens)
+                if on:
+                    # Scrape between rounds: the render hook must
+                    # observe mid-churn state without perturbing it.
+                    registry.render()
+            runs[on] = {
+                "warm": warm, "rounds": rounds,
+                "ticks": eng.stats.ticks, "best": min(times),
+                "eng": eng, "registry": registry,
+            }
+
+        off, on_run = runs[False], runs[True]
+        gate(off["warm"] == on_run["warm"]
+             and off["rounds"] == on_run["rounds"],
+             f"{label}: token streams identical with KV telemetry "
+             "ON vs OFF")
+        gate(off["ticks"] == on_run["ticks"],
+             f"{label}: tick counts identical ON vs OFF "
+             f"({on_run['ticks']} vs {off['ticks']})")
+        for tag, run in (("OFF", off), ("ON", on_run)):
+            counts = dict(run["eng"].compile_counts)
+            gate(counts == {"decode_step": 1, "prefill_chunk": 1},
+                 f"{label}: compile-once unchanged {tag}: {counts}")
+        check_ledger(label, on_run["eng"])
+        text = on_run["registry"].render()
+        gate("tpu_dra_kv_pool_blocks" in text
+             and "tpu_dra_kv_evicted_blocks_total" in text,
+             f"{label}: tpu_dra_kv_* families render")
+        evicted = on_run["eng"].kv_residency()["evictedBlocks"]
+        print(f"  {label}: {evicted} block(s) evicted over the run "
+              "(churn the ledger must survive)", flush=True)
+
+        ratio = on_run["best"] / max(off["best"], 1e-9)
+        print(f"  {label} wall: best-of-{REPEATS} {on_run['best']:.3f}s "
+              f"ON vs {off['best']:.3f}s OFF ({(ratio - 1):+.1%}, limit "
+              f"+{OVERHEAD_LIMIT:.0%} CPU tripwire)", flush=True)
+        gate(ratio <= 1.0 + OVERHEAD_LIMIT,
+             f"{label}: wall-clock overhead {(ratio - 1):+.1%} within "
+             f"+{OVERHEAD_LIMIT:.0%}")
+
+    if failures:
+        print(f"kv smoke: {len(failures)} gate(s) failed",
+              file=sys.stderr)
+        return 1
+    print("kv smoke: the KV ledger is a pure observer — tokens, ticks, "
+          "and compile counts unchanged; digest self-consistent under "
+          "churn")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -573,6 +573,54 @@ def _self_test_scrape() -> tuple[str, list[str]]:
     gateway_snapshot = gateway.snapshot()
     alloc_errors.extend(gw_errors)
 
+    # The KV-lifecycle families (tpu_dra_kv_*), populated through REAL
+    # engine churn: a deliberately tight paged pool (12 blocks, 2 slots)
+    # under shared-prefix traffic forces evictions, revivals, and COW
+    # recomputes, and KVTelemetry mirrors the ledger onto this registry
+    # so the rendered exposition carries lifecycle series a production
+    # replica would emit. The engine's /debug/kv document backs the
+    # endpoint check below; the gateway sim's ResidencyIndex (measured
+    # ScriptedEngine digests joined against the affinity ledger) backs
+    # /debug/residency.
+    import jax
+
+    from k8s_dra_driver_tpu.models.llama import PRESETS, init_params
+    from k8s_dra_driver_tpu.models.serving import DecodeEngine, KVTelemetry
+
+    kv_errors: list[str] = []
+    kv_config = PRESETS["tiny"]
+    kv_engine = DecodeEngine(
+        init_params(kv_config, jax.random.PRNGKey(0)), kv_config,
+        batch_slots=2, num_blocks=12, block_size=8, max_seq_len=48,
+        prefill_chunk=8,
+    )
+    KVTelemetry(registry).attach(kv_engine, replica="verify-kv")
+    kv_base = list(range(1, 17))
+    kv_prompts = [
+        kv_base + [40 + t] * (5 + 3 * t) for t in range(4)
+    ] * 2
+    kv_reqs = [
+        kv_engine.submit(p, max_new_tokens=12) for p in kv_prompts
+    ]
+    kv_engine.run()
+    kv_engine.assert_no_leaks()
+    if any(not r.tokens for r in kv_reqs):
+        kv_errors.append("kv churn: a request retired with no tokens")
+    kv_digest = kv_engine.kv_residency()
+    if kv_digest["indexedBlocks"] != (
+        kv_digest["insertedBlocks"] - kv_digest["evictedBlocks"]
+    ):
+        kv_errors.append(
+            "kv churn: residency digest violates indexed == inserted - "
+            "evicted"
+        )
+    if not kv_digest["evictedBlocks"]:
+        kv_errors.append(
+            "kv churn: the tight pool forced no evictions — the "
+            "lifecycle families render unexercised"
+        )
+    alloc_errors.extend(kv_errors)
+
     # The fleet-soak families (tpu_dra_fleet_*), populated by a REAL
     # mini soak: the deterministic fleet simulator (fleetsim/) drives
     # the full driver+gateway stack through the compressed five-axis
@@ -613,6 +661,8 @@ def _self_test_scrape() -> tuple[str, list[str]]:
     srv.set_rebalance_provider(lambda: rebalance_snapshot)
     srv.set_gateway_provider(lambda: gateway_snapshot)
     srv.set_requests_provider(telemetry.export_requests)
+    srv.set_kv_provider(kv_engine.kv_debug)
+    srv.set_residency_provider(gateway.residency.snapshot)
     srv.start()
     try:
         base = f"http://127.0.0.1:{srv.port}"
@@ -879,11 +929,87 @@ def _self_test_scrape() -> tuple[str, list[str]]:
                     f"/debug/requests?view=bogus: HTTP {e.code} "
                     "(want 400)"
                 )
+        # /debug/kv: the churned engine's lifecycle ledger — decodable
+        # JSON, occupancy states summing to the pool, and a residency
+        # digest honoring its counter invariant.
+        kv_body = urllib.request.urlopen(
+            f"{base}/debug/kv"
+        ).read().decode()
+        try:
+            kv_doc = json.loads(kv_body)
+        except ValueError:
+            errors.append("/debug/kv: body is not JSON")
+        else:
+            if kv_doc.get("schema") != "tpu-dra-kv-debug-v1":
+                errors.append(
+                    f"/debug/kv: schema {kv_doc.get('schema')!r} "
+                    "(want tpu-dra-kv-debug-v1)"
+                )
+            kv_occ = kv_doc.get("occupancy") or {}
+            if sum(kv_occ.values()) != kv_doc.get("blocksTotal"):
+                errors.append(
+                    "/debug/kv: occupancy states do not sum to the "
+                    f"pool ({kv_occ} vs {kv_doc.get('blocksTotal')})"
+                )
+            kv_res = kv_doc.get("residency") or {}
+            if kv_res.get("indexedBlocks") != (
+                kv_res.get("insertedBlocks", 0)
+                - kv_res.get("evictedBlocks", 0)
+            ):
+                errors.append(
+                    "/debug/kv: served digest violates indexed == "
+                    "inserted - evicted"
+                )
+        # /debug/residency: the gateway-global measured view — both sim
+        # replicas' digests, the fleet rollup keys, and no counter
+        # drift on healthy engines.
+        res_body = urllib.request.urlopen(
+            f"{base}/debug/residency"
+        ).read().decode()
+        try:
+            res_doc = json.loads(res_body)
+        except ValueError:
+            errors.append("/debug/residency: body is not JSON")
+        else:
+            if res_doc.get("schema") != "tpu-dra-residency-v1":
+                errors.append(
+                    f"/debug/residency: schema {res_doc.get('schema')!r} "
+                    "(want tpu-dra-residency-v1)"
+                )
+            res_replicas = res_doc.get("replicas") or {}
+            for rid in ("verify-replica-0", "verify-replica-1"):
+                if rid not in res_replicas:
+                    errors.append(
+                        f"/debug/residency: replica {rid} missing"
+                    )
+            drifted = sorted(
+                rid for rid, doc in res_replicas.items()
+                if doc.get("counterDrift")
+            )
+            if drifted:
+                errors.append(
+                    "/debug/residency: healthy sim replicas report "
+                    f"counter drift: {drifted}"
+                )
+            res_fleet = res_doc.get("fleet") or {}
+            for key in ("lookups", "hits", "hitTokens",
+                        "measuredHitRate", "uniqueKeys", "keyInstances",
+                        "duplicationRatio"):
+                if key not in res_fleet:
+                    errors.append(
+                        f"/debug/residency: fleet view missing {key!r}"
+                    )
+            if not res_fleet.get("uniqueKeys"):
+                errors.append(
+                    "/debug/residency: no measured-resident keys — the "
+                    "sim replicas published no blocks"
+                )
         # The scrape surface is GET-only by contract — /metrics and the
         # debug endpoints alike.
         for route in ("/metrics", "/debug/allocations", "/debug/defrag",
                       "/debug/rebalance", "/debug/gateway",
-                      "/debug/requests"):
+                      "/debug/requests", "/debug/kv",
+                      "/debug/residency"):
             try:
                 urllib.request.urlopen(base + route, data=b"x")
                 errors.append(f"{route} accepted a POST (want 405)")
@@ -932,6 +1058,22 @@ def _self_test_scrape() -> tuple[str, list[str]]:
                    "tpu_dra_srv_violation_seconds_total",
                    "tpu_dra_srv_timelines_total",
                    "tpu_dra_srv_exemplars_total",
+                   "tpu_dra_kv_pool_blocks",
+                   "tpu_dra_kv_indexed_blocks",
+                   "tpu_dra_kv_prefix_runs",
+                   "tpu_dra_kv_evicted_blocks_total",
+                   "tpu_dra_kv_evicted_tokens_total",
+                   "tpu_dra_kv_alloc_misses_total",
+                   "tpu_dra_kv_revivals_total",
+                   "tpu_dra_kv_cow_recomputes_total",
+                   "tpu_dra_kv_eviction_lru_age_ops",
+                   "tpu_dra_kv_request_footprint_blocks",
+                   "tpu_dra_residency_fleet_hit_rate_ratio",
+                   "tpu_dra_residency_duplication_ratio",
+                   "tpu_dra_residency_unique_keys",
+                   "tpu_dra_residency_stale_ledger_keys",
+                   "tpu_dra_residency_replica_indexed_blocks",
+                   "tpu_dra_gw_affinity_ledger_keys",
                    "tpu_dra_fleet_ticks_total",
                    "tpu_dra_fleet_requests_total",
                    "tpu_dra_fleet_slo_p99_seconds",
