@@ -2,7 +2,7 @@
 IMAGE ?= tpu-dra-driver
 TAG ?= latest
 
-.PHONY: all native test image lint verify verify-metrics chaos chaos-slow doctor decodebench moebench elastic allocbench allocbench-smoke gatewaybench tracesmoke kvsmoke defragsmoke fleetsmoke clean e2e-kind
+.PHONY: all native test image lint verify verify-metrics chaos chaos-slow doctor decodebench moebench elastic allocbench allocbench-smoke gatewaybench tracesmoke kvsmoke computesmoke defragsmoke fleetsmoke clean e2e-kind
 
 all: native
 
@@ -133,12 +133,24 @@ tracesmoke:
 kvsmoke:
 	python tools/run_kv_smoke.py
 
+# Compute-telemetry zero-cost smoke (tools/run_compute_smoke.py): the
+# same fixed-seed serving profile per quantization variant (bf16/int8/
+# kvq) with the compute plane unobserved vs observed (ComputeTelemetry
+# + registry scrapes mid-run) — token streams, tick counts, and
+# compile-once must be bitwise identical, the CompileLedger must match
+# the engine's compile_counts exactly with zero recompiles past the
+# warm horizon, and best-of-N wall clock must stay inside the
+# TPU_DRA_COMPUTE_SMOKE_OVERHEAD tripwire.
+computesmoke:
+	python tools/run_compute_smoke.py
+
 # The full local gate: lint + unit/integration tests + chaos schedules +
 # metrics exposition + the doctor/auditor drill + the decode-engine,
 # MoE fast-path, elastic-training, allocator-bench, fleet-gateway,
-# request-observability, KV-telemetry, defrag-execution, and fleet-soak
-# smokes. What CI runs; what a PR must pass.
-verify: lint test chaos verify-metrics doctor decodebench moebench elastic allocbench-smoke gatewaybench tracesmoke kvsmoke defragsmoke fleetsmoke
+# request-observability, KV-telemetry, compute-telemetry,
+# defrag-execution, and fleet-soak smokes. What CI runs; what a PR must
+# pass.
+verify: lint test chaos verify-metrics doctor decodebench moebench elastic allocbench-smoke gatewaybench tracesmoke kvsmoke computesmoke defragsmoke fleetsmoke
 
 # ruff when available (CI installs it; .golangci.yaml analog is
 # [tool.ruff] in pyproject.toml), else the first-party AST lint floor.

@@ -136,6 +136,37 @@ def run_decode_bench(
     )
     c_bytes = 1 if quant_kv else 2
     roofline_s = (streamed * w_bytes + cache_elems * c_bytes) / HBM_BW
+
+    # Cost-model cross-check (models/compute_telemetry.py): the SAME
+    # deterministic estimator the serving-path CompileLedger records at
+    # build time, evaluated against this bench's measured step. If
+    # "predicted vs measured" drifts round-over-round the estimator (or
+    # the chip) changed — doctor's mfu-regression check consumes the
+    # serving-side twin of this number.
+    from k8s_dra_driver_tpu.models.compute_telemetry import (
+        device_peaks, estimate_decode_step_cost, roofline,
+    )
+    pred_flops, pred_bytes = estimate_decode_step_cost(
+        config, batch=batch, context=mean_len,
+        streamed_bytes=streamed * w_bytes,
+        kv_bytes_per_token=(
+            2 * config.n_layers * config.n_kv_heads
+            * config.head_dim * c_bytes
+        ),
+    )
+    peaks = device_peaks()
+    roof = roofline(pred_flops, pred_bytes, step,
+                    peaks["peakFlopsPerS"], peaks["peakBytesPerS"])
+    cost_model = {
+        "predicted_flops": round(pred_flops),
+        "predicted_bytes": round(pred_bytes),
+        "measured_flops_per_s": round(roof["flopsPerS"]),
+        "measured_bytes_per_s": round(roof["bytesPerS"]),
+        "mfu": round(roof["mfu"], 5),
+        "bound_by": roof["boundBy"],
+        "device": peaks["matched"],
+    }
+
     tags = "".join(
         t for t, on in (("-int8", quant), ("-kvq", quant_kv)) if on
     )
@@ -155,6 +186,7 @@ def run_decode_bench(
             "step_ms": round(step * 1e3, 3),
             "hbm_roofline_ms": round(roofline_s * 1e3, 3),
             "compile_s": round(gen_compile_s + pre_compile_s, 1),
+            "costModel": cost_model,
             **(_moe_decode_detail(config, batch) if is_moe else {}),
         },
     }

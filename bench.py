@@ -178,6 +178,38 @@ def run_bench(preset, batch, seq, peak_flops, remat_policy="flash_qkv",
     mfus = sorted(flops_tok * n_tokens / d / peak_flops for d in dts)
     spread = (mfus[-1] - mfus[0]) / 2
 
+    # Cost-model cross-check (models/compute_telemetry.py): backend
+    # cost_analysis() FLOPs/bytes when the lowering exposes them (a
+    # re-lower of the already-jitted step is a trace, not a compile),
+    # else the same 6N analytic estimate the serving-path CompileLedger
+    # falls back to — scored against the measured step so "predicted vs
+    # measured" is machine-comparable round over round.
+    from k8s_dra_driver_tpu.models.compute_telemetry import (
+        cost_from_lowered, device_peaks, roofline,
+    )
+    try:
+        lowered_cost = cost_from_lowered(step_fn.lower(params, batches[0]))
+    except Exception:
+        lowered_cost = None
+    pred_flops = (
+        lowered_cost["flops"] if lowered_cost and lowered_cost["flops"]
+        else flops_tok * n_tokens
+    )
+    pred_bytes = lowered_cost["bytes"] if lowered_cost else 0.0
+    peaks = device_peaks()
+    roof = roofline(pred_flops, pred_bytes, dt,
+                    peaks["peakFlopsPerS"], peaks["peakBytesPerS"])
+    cost_model = {
+        "predicted_flops": round(pred_flops),
+        "predicted_bytes": round(pred_bytes),
+        "measured_flops_per_s": round(roof["flopsPerS"]),
+        "measured_bytes_per_s": round(roof["bytesPerS"]),
+        "mfu": round(roof["mfu"], 5),
+        "bound_by": roof["boundBy"],
+        "source": "cost_analysis" if lowered_cost else "estimator",
+        "device": peaks["matched"],
+    }
+
     family = "mixtral" if model == "moe" else "llama3"
     return {
         "metric": f"{family}_{preset}_train_mfu_b{batch}_s{seq}",
@@ -213,6 +245,7 @@ def run_bench(preset, batch, seq, peak_flops, remat_policy="flash_qkv",
             "device": str(jax.devices()[0].device_kind),
             "achieved_tflops": round(achieved / 1e12, 2),
             "mfu_all": [round(v, 4) for v in mfus],
+            "costModel": cost_model,
         },
     }
 
@@ -295,7 +328,13 @@ def extra_metrics(peak_flops, remat_policy) -> list:
                 from _decodebench import run_decode_bench
 
                 r = run_decode_bench(**kwargs)
-                r.pop("detail", None)
+                # Keep only the cost-model cross-check (predicted vs
+                # measured FLOPs/bytes) — the round-over-round signal
+                # the doctor's mfu-regression baseline joins against;
+                # the rest of the decode detail stays bench-local.
+                decode_detail = r.pop("detail", None) or {}
+                if "costModel" in decode_detail:
+                    r["detail"] = {"costModel": decode_detail["costModel"]}
                 out.append(r)
             except Exception as e:
                 print(f"decode metric {kwargs} failed: "

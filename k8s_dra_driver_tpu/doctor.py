@@ -44,6 +44,14 @@ ResourceClaims; then it re-runs the audit cross-checks FLEET-wide:
   digest no longer holds (evicted-but-ledgered staleness) surface as
   informational with the warm-cache playbook pointer, and the snapshot
   is bundled as ``residency.json``;
+- compute-plane trouble surfaced by ``/debug/compute``: a program that
+  recompiled after its replica's warmup horizon is drift (the
+  ``recompile-storm`` check — every recompile re-pays trace+XLA time on
+  the serving path), and a program whose measured MFU has fallen below
+  half the committed ``BENCH_r*.json`` trajectory's best (``--bench-dir``,
+  the ``mfu-regression`` check) is drift — perf regressions surface in a
+  support bundle, not just at bench time; the snapshot is bundled as
+  ``compute.json``;
 - request-level SLO trouble surfaced by ``/debug/requests`` (the
   ``slo-exemplar`` check): a latency class with sustained violations
   in its ``?view=slo`` summary is drift, pointing at the slowest
@@ -86,6 +94,12 @@ SEVERITY_ERROR = "error"
 # /debug/requests?view=slo summary is "sustained" — one-off stragglers
 # stay out of the findings, a pattern gets the slo-exemplar diagnosis.
 SLO_SUSTAINED_VIOLATIONS = 3
+
+# A program whose measured MFU drops below this fraction of the best
+# committed BENCH_r*.json mfu_fraction round is an mfu-regression drift
+# finding. Generous on purpose: the doctor flags "half the machine went
+# missing", the bench spread tripwire owns the fine-grained trend.
+MFU_REGRESSION_RATIO = 0.5
 
 
 @dataclasses.dataclass(frozen=True)
@@ -174,6 +188,7 @@ class NodeScrape:
     rebalance: Optional[dict] = None
     gateway: Optional[dict] = None
     residency: Optional[dict] = None
+    compute: Optional[dict] = None
     requests_text: str = ""
     slo_summary: Optional[dict] = None
     exemplars: list = dataclasses.field(default_factory=list)
@@ -317,6 +332,15 @@ def collect_node(name: str, url: str, timeout: float = 5.0) -> NodeScrape:
         if getattr(e, "code", None) != 404:
             scrape.errors.append(f"/debug/residency: {e}")
     try:
+        scrape.compute = json.loads(
+            _fetch(scrape.url + "/debug/compute", timeout)
+        )
+    except Exception as e:
+        # 404 = compute telemetry not attached on this process (it is
+        # opt-in, like request tracing) — benign; anything else is loud.
+        if getattr(e, "code", None) != 404:
+            scrape.errors.append(f"/debug/compute: {e}")
+    try:
         scrape.requests_text = _fetch(
             scrape.url + "/debug/requests", timeout
         )
@@ -376,7 +400,8 @@ def collect_cluster(client, driver_name: str) -> dict[str, Any]:
 # ---------------------------------------------------------------------------
 
 def fleet_findings(
-    nodes: list[NodeScrape], cluster: Optional[dict], driver_name: str
+    nodes: list[NodeScrape], cluster: Optional[dict], driver_name: str,
+    bench_mfu: Optional[float] = None,
 ) -> list[DoctorFinding]:
     findings: list[DoctorFinding] = []
 
@@ -515,6 +540,51 @@ def fleet_findings(
                     "affinity; see the \"is my fleet's KV cache "
                     "actually warm?\" playbook in docs/operations.md",
                 ))
+        # Compute plane (/debug/compute): a program recompiling AFTER
+        # the replica's warmup horizon re-pays trace + XLA compile on
+        # the serving path — the recompile-storm signal the bench spread
+        # tripwire can only infer. And measured MFU far below the
+        # committed bench trajectory means the machine regressed in a
+        # way the in-process roofline can already see.
+        if node.compute is not None:
+            recompiles = node.compute.get("recompilesSinceWarm") or {}
+            if node.compute.get("warm"):
+                for program, count in sorted(recompiles.items()):
+                    if count > 0:
+                        findings.append(DoctorFinding(
+                            SEVERITY_DRIFT, "recompile-storm",
+                            f"{node.name}/{program}",
+                            f"{int(count)} recompile(s) of {program!r} "
+                            "after the warmup horizon — every one "
+                            "re-pays trace+XLA time on the serving "
+                            "path; the CompileLedger records in "
+                            "compute.json carry the shapes that "
+                            "triggered them (see the \"why is my step "
+                            "slow?\" runbook in docs/operations.md)",
+                        ))
+            if bench_mfu is not None and bench_mfu > 0:
+                for program, replicas in sorted(
+                    (node.compute.get("programs") or {}).items()
+                ):
+                    if not isinstance(replicas, dict):
+                        continue
+                    for rid, roof in sorted(replicas.items()):
+                        mfu = (roof or {}).get("mfu")
+                        if mfu is None or not (roof.get("steps") or 0):
+                            continue
+                        if mfu < MFU_REGRESSION_RATIO * bench_mfu:
+                            findings.append(DoctorFinding(
+                                SEVERITY_DRIFT, "mfu-regression",
+                                f"{node.name}/{rid}/{program}",
+                                f"measured MFU {mfu:.4f} is below "
+                                f"{MFU_REGRESSION_RATIO:.0%} of the "
+                                f"committed bench trajectory's best "
+                                f"({bench_mfu:.4f}) — the roofline "
+                                f"classifies this program as "
+                                f"{roof.get('boundBy', '?')}-bound; "
+                                "see the \"why is my step slow?\" "
+                                "runbook in docs/operations.md",
+                            ))
         # Request-level SLO trouble (/debug/requests?view=slo): a class
         # with sustained violations gets a finding that already answers
         # "why was this request slow?" — the slowest captured exemplar's
@@ -940,6 +1010,9 @@ def write_bundle(
             if node.residency is not None:
                 add(tar, f"{base}/residency.json",
                     json.dumps(node.residency, indent=2, sort_keys=True))
+            if node.compute is not None:
+                add(tar, f"{base}/compute.json",
+                    json.dumps(node.compute, indent=2, sort_keys=True))
             if node.requests_text or node.slo_summary is not None:
                 add(tar, f"{base}/requests.json", json.dumps({
                     "slo": node.slo_summary,
@@ -965,6 +1038,7 @@ def run(
     driver_name: str = "tpu.google.com",
     bundle: Optional[str] = None,
     timeout: float = 5.0,
+    bench_dir: Optional[str] = None,
 ) -> tuple[str, list[DoctorFinding], int]:
     """The doctor's whole pass, kube-client-injectable so the cluster sim
     (FakeKubeClient) exercises the identical code path as production.
@@ -995,7 +1069,16 @@ def run(
             cluster_error = DoctorFinding(
                 SEVERITY_ERROR, "collect", "cluster", str(e)
             )
-    findings = fleet_findings(nodes, cluster, driver_name)
+    bench_mfu = None
+    if bench_dir:
+        from .models.compute_telemetry import (
+            bench_mfu_baseline, load_bench_trajectory,
+        )
+
+        bench_mfu = bench_mfu_baseline(load_bench_trajectory(bench_dir))
+    findings = fleet_findings(
+        nodes, cluster, driver_name, bench_mfu=bench_mfu
+    )
     if cluster_error is not None:
         findings.append(cluster_error)
     report = render_report(nodes, cluster, findings, driver_name)
@@ -1030,6 +1113,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "to this path")
     p.add_argument("--timeout", type=float, default=5.0,
                    help="per-request scrape timeout, seconds")
+    p.add_argument("--bench-dir", default="",
+                   help="directory of committed BENCH_r*.json rounds; "
+                        "enables the mfu-regression cross-check against "
+                        "the trajectory's best mfu_fraction round")
     p.add_argument("--json", action="store_true",
                    help="emit findings as JSON instead of the report")
     return p
@@ -1066,6 +1153,7 @@ def main(argv: Optional[list[str]] = None) -> int:
         driver_name=args.driver_name,
         bundle=args.bundle or None,
         timeout=args.timeout,
+        bench_dir=args.bench_dir or None,
     )
     if args.json:
         print(json.dumps(

@@ -54,6 +54,7 @@ __all__ = [
     "decode_step",
     "generate",
     "TRACE_COUNTS",
+    "TRACE_OBSERVERS",
 ]
 
 NEG_INF = -1e30
@@ -63,6 +64,13 @@ NEG_INF = -1e30
 #: shape leak (anything still depending on sequence length) shows up as
 #: a count > 1 when decoding from length 1 to max_len.
 TRACE_COUNTS: "collections.Counter[str]" = collections.Counter()
+
+#: Optional trace-seam observers (models/compute_telemetry.py's
+#: CompileLedger): called host-side at TRACE time, right where
+#: TRACE_COUNTS bumps, with (program, variant, abstract-shape dict).
+#: Empty by default — the seam costs one truthiness check per trace
+#: and nothing per executed step.
+TRACE_OBSERVERS: list = []
 
 
 def variant_label(params: dict, cache) -> str:
@@ -134,6 +142,12 @@ def _forward_with_cache(
     TRACE_COUNTS[
         f"forward:{variant_label(params, cache)}:t{t}"
     ] += 1
+    if TRACE_OBSERVERS:
+        for _observer in TRACE_OBSERVERS:
+            _observer(
+                "forward", variant_label(params, cache),
+                {"batch": b, "tokens": t},
+            )
 
     x = q_lookup(params["embed"], tokens, c.dtype)
     cos, sin = rope_frequencies(

@@ -42,6 +42,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..ops import moe_dispatch
 from ..ops.norms import rmsnorm
 from ..ops.rotary import rope_frequencies
+from ..parallel import collectives
 from .llama import (
     LlamaConfig,
     _attention_block,
@@ -166,6 +167,11 @@ MOE_PRESETS: dict[str, MoeConfig] = {
 #: routing/dispatch shows up as a key tracing more than once for the
 #: same static geometry, mirroring decode.TRACE_COUNTS.
 MOE_TRACE_COUNTS: "collections.Counter[str]" = collections.Counter()
+
+#: Optional trace-seam observers (models/compute_telemetry.py's
+#: CompileLedger), called host-side at trace time next to the
+#: MOE_TRACE_COUNTS bump — same contract as decode.TRACE_OBSERVERS.
+TRACE_OBSERVERS: list = []
 
 # `auto` selection thresholds (see resolve_moe_impl). Measured on v5e at
 # the bench geometries (BENCH_r05/r06): the einsum path's one-hot
@@ -731,6 +737,16 @@ def _moe_block_dropless_ep_psum(x, layer, config: MoeConfig, mesh: Mesh):
             lo=lo, e_loc=e_loc, pallas_ok=ep_only,
         )
         out = jax.lax.psum(contrib, "expert")
+        # Host-side collective accounting, fires once per trace: the
+        # full [T, H] reduction is this path's per-hop-traffic downside
+        # vs the ring (see _moe_block_dropless_ep_ring).
+        collectives.emit(
+            "moe.ep_psum.combine", collectives.MEDIUM_ICI,
+            collectives.all_reduce_bytes(
+                collectives.payload_bytes(contrib.shape, contrib.dtype),
+                n_ep,
+            ),
+        )
         # aux is computed from replicated probs: identical on every
         # expert shard, no reduction needed.
         return out.reshape(b, s, h), aux
@@ -835,7 +851,8 @@ def _moe_block_dropless_ep_ring(x, layer, config: MoeConfig, mesh: Mesh):
                 # under the grouped matmuls below (double buffering —
                 # x_nxt lands while x_cur is being consumed).
                 x_nxt = ring_permute(
-                    x_cur, "expert", n_ep, impl=ring_impl
+                    x_cur, "expert", n_ep, impl=ring_impl,
+                    site="moe.ep_ring.x",
                 )
             contrib = _pairs_mlp(
                 x_cur, gates, experts, w_gu, w_down, c,
@@ -844,13 +861,27 @@ def _moe_block_dropless_ep_ring(x, layer, config: MoeConfig, mesh: Mesh):
             # The carrier rotates with its chunk; its transfer overlaps
             # the NEXT hop's routing + dispatch up to the accumulate.
             y = ring_permute(
-                y + contrib, "expert", n_ep, impl=ring_impl
+                y + contrib, "expert", n_ep, impl=ring_impl,
+                site="moe.ep_ring.y",
             )
             if hop < n_ep - 1:
                 x_cur = x_nxt
         out = jax.lax.all_gather(y, "expert", axis=0, tiled=True)
+        collectives.emit(
+            "moe.ep_ring.all_gather", collectives.MEDIUM_ICI,
+            collectives.all_gather_bytes(
+                collectives.payload_bytes(y.shape, y.dtype), n_ep,
+            ),
+        )
         frac = jax.lax.pmean(frac, "expert")
         meanprob = jax.lax.pmean(meanprob, "expert")
+        collectives.emit(
+            "moe.ep_ring.aux", collectives.MEDIUM_ICI,
+            2 * collectives.all_reduce_bytes(
+                collectives.payload_bytes(frac.shape, frac.dtype), n_ep,
+            ),
+            invocations=2,
+        )
         aux = e * jnp.sum(frac * meanprob)
         return out.reshape(b, s, h), aux
 
@@ -892,6 +923,13 @@ def _moe_block(x, layer, config: MoeConfig, mesh: Optional[Mesh],
         f"{impl}:{moe_dispatch.dispatch_impl_label(c.hidden, c.mlp_hidden)}"
         f":t{x.shape[0] * x.shape[1]}"
     ] += 1
+    if TRACE_OBSERVERS:
+        dispatch = moe_dispatch.dispatch_impl_label(c.hidden, c.mlp_hidden)
+        for _observer in TRACE_OBSERVERS:
+            _observer(
+                "moe_block", f"{impl}:{dispatch}",
+                {"tokens": x.shape[0] * x.shape[1]},
+            )
     if impl in ("binned", "grouped") and expert_mesh:
         # binned emits no sharding constraints: silently dropping the
         # expert axis would mean no expert all-to-alls and wrong

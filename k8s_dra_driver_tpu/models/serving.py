@@ -566,6 +566,10 @@ class DecodeEngine:
             "kvEvictedTokens": evicted_blocks * self.block_size,
             "kvRevivals": self.allocator.revivals,
             "kvAllocMisses": self.allocator.alloc_misses,
+            # Compute plane: per-program build counts — the scrape-level
+            # view of the compile-once invariant (a fleet router or the
+            # doctor can spot a recompile storm without /debug/compute).
+            "computeCompiles": dict(self.compile_counts),
             **self.stats.snapshot(),
         }
 

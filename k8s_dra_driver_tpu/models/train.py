@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+from collections import Counter
 from typing import Any
 
 import jax
@@ -17,7 +18,16 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..parallel import collectives
 from .llama import LlamaConfig
+
+# Trace seam, mirror of decode.TRACE_COUNTS / moe.MOE_TRACE_COUNTS: the
+# jitted train step bumps a key per (batch, seq) retrace so tests and the
+# compile ledger can pin "compiled exactly once". TRACE_OBSERVERS is the
+# compute-telemetry hook — callbacks fire at trace time, never inside the
+# compiled program.
+TRACE_COUNTS: Counter = Counter()
+TRACE_OBSERVERS: list = []
 
 
 def _model_fns(config: LlamaConfig):
@@ -107,6 +117,11 @@ def make_train_step(
     batch_sharding = NamedSharding(mesh, P(("data", "fsdp"), None))
 
     def step(state: TrainState, tokens: jax.Array):
+        b, s = tokens.shape
+        TRACE_COUNTS[f"train_step:b{b}:s{s}"] += 1
+        if TRACE_OBSERVERS:
+            for _observer in TRACE_OBSERVERS:
+                _observer("train_step", "", {"batch": b, "seq": s})
         loss, grads = jax.value_and_grad(loss_fn)(
             state.params, tokens, config, mesh, use_ring, remat
         )
@@ -160,6 +175,16 @@ def reshard_train_state(state: TrainState, mesh: Mesh) -> TrainState:
     are not, restore from the last checkpoint instead
     (``checkpoint.restore_template`` + ``restore_checkpoint``).
     """
+    if collectives._LEDGERS:
+        # Worst-case volume: every leaf moves in full. Host-level site,
+        # so this fires per call — and only when a ledger is installed
+        # (the tree walk isn't free).
+        collectives.emit(
+            "train.reshard", collectives.MEDIUM_DCN,
+            jax.tree.reduce(
+                lambda acc, x: acc + int(getattr(x, "nbytes", 0)), state, 0
+            ),
+        )
     return jax.device_put(state, state_shardings(state, mesh))
 
 

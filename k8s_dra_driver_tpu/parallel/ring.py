@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from . import collectives
 from .compat import shard_map_compat as _shard_map_compat
 
 NEG_INF = -1e30
@@ -257,6 +258,22 @@ def _ring_attention_local(
     return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
+def _emit_ring_attention_kv(k, v, n_seq: int) -> None:
+    """Forward-ring accounting: k/v are the GLOBAL arrays at the head
+    counts that actually rotate (native GQA on the flash path, repeated
+    on the xla path). Each of the n hops moves every shard's local k/v
+    chunk — global k+v bytes per hop, n_seq hops."""
+    if n_seq <= 1:
+        return
+    collectives.emit(
+        "ring_attention.kv", collectives.MEDIUM_ICI,
+        n_seq * (
+            collectives.payload_bytes(k.shape, k.dtype)
+            + collectives.payload_bytes(v.shape, v.dtype)
+        ),
+    )
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -310,6 +327,7 @@ def ring_attention(
             k = jnp.repeat(k, r, axis=1)
             v = jnp.repeat(v, r, axis=1)
         kv_spec = P(batch_axes, head_axis, axis_name, None)
+        _emit_ring_attention_kv(k, v, n_seq)
         fn = _shard_map_compat(
             # custom_vjp nondiff args must stay positional.
             lambda q_, k_, v_: _ring_flash(
@@ -326,6 +344,7 @@ def ring_attention(
         k = jnp.repeat(k, reps, axis=1)
         v = jnp.repeat(v, reps, axis=1)
     spec = P(batch_axes, head_axis, axis_name, None)
+    _emit_ring_attention_kv(k, v, n_seq)
     fn = _shard_map_compat(
         functools.partial(
             _ring_attention_local,
@@ -439,6 +458,7 @@ def ring_permute(
     shift: int = 1,
     impl: str = "auto",
     interpret: Optional[bool] = None,
+    site: str = "ring.permute",
 ) -> jax.Array:
     """Move shard i's ``x`` to shard (i + shift) mod n along
     ``axis_name`` (call inside a shard_map manual over that axis).
@@ -448,8 +468,19 @@ def ring_permute(
     (ring axis must be the only nontrivial mesh axis — caller's
     contract — and each call completes its DMA before returning, see
     the section comment).
+
+    ``site`` labels this hop in the collective ledger; callers with a
+    named schedule (the MoE EP ring) pass their own.
     """
     assert impl in ("auto", "pallas", "xla"), impl
+    # x is the per-shard buffer here (we're inside a shard_map), so one
+    # hop ships n * payload across the fabric. Fires at trace time.
+    collectives.emit(
+        site, collectives.MEDIUM_ICI,
+        collectives.permute_bytes(
+            collectives.payload_bytes(x.shape, x.dtype), n
+        ),
+    )
     on_tpu = jax.default_backend() == "tpu"
     if impl == "pallas":
         return _ring_permute_pallas(
@@ -489,6 +520,20 @@ def ulysses_attention(
     attn = attn_fn or (
         lambda q_, k_, v_: flash_attention(q_, k_, v_, causal, scale)
     )
+    n = mesh.shape[axis_name]
+    if n > 1:
+        # Four a2as: q, k, v in; output (q-shaped) back out. Local
+        # buffer per shard is global/n.
+        collectives.emit(
+            "ulysses.all_to_all", collectives.MEDIUM_ICI,
+            sum(
+                collectives.all_to_all_bytes(
+                    collectives.payload_bytes(t.shape, t.dtype) // n, n
+                )
+                for t in (q, k, v, q)
+            ),
+            invocations=4,
+        )
 
     def local(q, k, v):
         # [B, H, S/n, D] → all-to-all → [B, H/n, S, D]

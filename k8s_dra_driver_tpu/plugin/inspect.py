@@ -72,6 +72,7 @@ def collect_live(http_url: str, timeout: float = 3.0) -> dict[str, Any]:
     out.update(_collect_rebalance(http_url, timeout))
     out.update(_collect_gateway(http_url, timeout))
     out.update(_collect_residency(http_url, timeout))
+    out.update(_collect_compute(http_url, timeout))
     out.update(_collect_requests(http_url, timeout))
     return out
 
@@ -303,6 +304,55 @@ def _collect_residency(
             }
             for rid, r in sorted((doc.get("replicas") or {}).items())
             if isinstance(r, dict)
+        },
+    }
+
+
+def _collect_compute(
+    http_url: str, timeout: float
+) -> dict[str, Any]:
+    """Compute-plane summary from ``/debug/compute``: per-program MFU
+    and bound classification, recompiles since the warmup horizon, and
+    the per-replica HBM decomposition."""
+    text, err = _fetch_debug(http_url, "/debug/compute", timeout)
+    if err is not None:
+        return {"computeError": err}
+    if text is None:
+        return {}
+    try:
+        doc = json.loads(text)
+    except ValueError as e:
+        return {"computeError": str(e)}
+    return {
+        "computeDevice": doc.get("device") or {},
+        "computeWarm": bool(doc.get("warm")),
+        "computeBuilds": doc.get("builds") or {},
+        "computeRecompiles": doc.get("recompilesSinceWarm") or {},
+        "computePrograms": {
+            program: {
+                rid: {
+                    "mfu": roof.get("mfu"),
+                    "boundBy": roof.get("boundBy", "?"),
+                    "steps": roof.get("steps", 0),
+                }
+                for rid, roof in sorted(replicas.items())
+                if isinstance(roof, dict)
+            }
+            for program, replicas in sorted(
+                (doc.get("programs") or {}).items()
+            )
+            if isinstance(replicas, dict)
+        },
+        "computeHbm": {
+            rid: {
+                "weightsBytes": h.get("weightsBytes", 0),
+                "kvPoolBytes": h.get("kvPoolBytes", 0),
+                "kvUsedBytes": h.get("kvUsedBytes", 0),
+                "watermarkBytes": h.get("watermarkBytes", 0),
+                "totalBytes": h.get("totalBytes", 0),
+            }
+            for rid, h in sorted((doc.get("hbm") or {}).items())
+            if isinstance(h, dict)
         },
     }
 
@@ -719,6 +769,51 @@ def render(state: dict[str, Any]) -> str:
                         f"{r['staleKeys']} stale ledger key(s) "
                         f"(divergence {r['divergence']})"
                         + (" COUNTER-DRIFT" if r["counterDrift"] else "")
+                    )
+            if live.get("computeError"):
+                lines.append(
+                    "  /debug/compute scrape FAILED "
+                    f"({live['computeError']}) — compute-plane view "
+                    "unavailable, NOT known-healthy"
+                )
+            if live.get("computePrograms") or live.get("computeHbm"):
+                dev = live.get("computeDevice") or {}
+                recompiles = live.get("computeRecompiles") or {}
+                total_recompiles = sum(recompiles.values())
+                lines.append("")
+                lines.append(
+                    f"compute plane ({dev.get('kind', '?')}): "
+                    f"{sum((live.get('computeBuilds') or {}).values())} "
+                    "program build(s), "
+                    + (
+                        f"{total_recompiles} recompile(s) since warmup"
+                        + (
+                            " RECOMPILE-STORM"
+                            if live.get("computeWarm")
+                            and total_recompiles else ""
+                        )
+                        if live.get("computeWarm")
+                        else "warmup horizon not marked"
+                    )
+                )
+                for program, replicas in (
+                    live.get("computePrograms") or {}
+                ).items():
+                    for rid, roof in replicas.items():
+                        mfu = roof.get("mfu")
+                        lines.append(
+                            f"  {program}@{rid}: mfu "
+                            + (f"{mfu:.4f}" if mfu is not None else "?")
+                            + f", {roof['boundBy']}-bound over "
+                            f"{roof['steps']} step(s)"
+                        )
+                for rid, hbm in (live.get("computeHbm") or {}).items():
+                    lines.append(
+                        f"  hbm@{rid}: {hbm['totalBytes']} B total = "
+                        f"{hbm['weightsBytes']} weights + "
+                        f"{hbm['kvPoolBytes']} kv pool "
+                        f"({hbm['kvUsedBytes']} used), watermark "
+                        f"{hbm['watermarkBytes']}"
                     )
             if live.get("requestsError"):
                 lines.append(

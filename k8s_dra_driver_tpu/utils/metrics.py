@@ -398,6 +398,10 @@ class MetricsServer:
     ``/debug/gateway`` serves the fleet serving gateway's snapshot
     (replicas, queues, event ring) when a provider was registered with
     ``set_gateway_provider`` (404 otherwise).
+    ``/debug/compute`` serves the compute telemetry's snapshot (compile
+    ledger, per-program rooflines, HBM decomposition, collective
+    accounting) when a provider was registered with
+    ``set_compute_provider`` (404 otherwise).
     ``/debug/requests`` streams the serving telemetry's sealed request
     timelines as JSONL when a provider was registered with
     ``set_requests_provider`` (404 otherwise); ``?view=ticks`` /
@@ -419,6 +423,7 @@ class MetricsServer:
         self.requests_provider: Optional[Callable] = None
         self.kv_provider: Optional[Callable] = None
         self.residency_provider: Optional[Callable] = None
+        self.compute_provider: Optional[Callable] = None
         # The JSON debug surfaces share one handler block: path ->
         # (provider attribute, not-enabled message). /debug/allocations
         # stays separate (the provider returns pre-rendered JSONL).
@@ -436,6 +441,8 @@ class MetricsServer:
                 "kv_provider", "kv telemetry not enabled"),
             "/debug/residency": (
                 "residency_provider", "residency index not enabled"),
+            "/debug/compute": (
+                "compute_provider", "compute telemetry not enabled"),
         }
         registry_ref = registry
         health = self._health = {"ok": True}
@@ -654,6 +661,12 @@ class MetricsServer:
         ``ResidencyIndex.snapshot``) at ``/debug/residency``. Safe to
         call after ``start()``."""
         self.residency_provider = provider
+
+    def set_compute_provider(self, provider: Callable) -> None:
+        """Serve ``provider()`` (a JSON-serializable dict, e.g.
+        ``ComputeTelemetry.compute_debug``) at ``/debug/compute``. Safe
+        to call after ``start()``."""
+        self.compute_provider = provider
 
     def set_requests_provider(self, provider: Callable) -> None:
         """Serve ``provider(view)`` (a JSONL string, e.g.

@@ -823,7 +823,7 @@ class TestSnapshot:
             "blocksFree", "blocksAvailable", "blocksTotal",
             "blocksPrivate", "blocksIndexed", "blocksShared",
             "blocksCached", "kvEvictedBlocks", "kvEvictedTokens",
-            "kvRevivals", "kvAllocMisses",
+            "kvRevivals", "kvAllocMisses", "computeCompiles",
             *ServingStats.SNAPSHOT_KEYS,
         }
         assert snap["queueDepth"] == 1

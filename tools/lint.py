@@ -241,6 +241,11 @@ _MODULE_FAMILY_PREFIXES = {
     # serving.py's KVTelemetry declares the exported series.
     "paged.py": "tpu_dra_kv_",
     "serving.py": "tpu_dra_kv_",
+    # The compute-plane family: compute_telemetry.py owns the catalog,
+    # collectives.py declares the collective counters beside their
+    # site vocabulary — the same two-owner split as tpu_dra_kv_.
+    "compute_telemetry.py": "tpu_dra_compute_",
+    "collectives.py": "tpu_dra_compute_",
 }
 # Directory-owned families: every metric declared anywhere under the
 # directory uses its prefix, and (unlike the per-module table, whose
@@ -262,6 +267,9 @@ _CONFINED_MODULE_PREFIXES = {
     "tpu_dra_srv_": frozenset({"reqtrace.py"}),
     "tpu_dra_kv_": frozenset({"paged.py", "serving.py"}),
     "tpu_dra_residency_": frozenset({"residency.py"}),
+    "tpu_dra_compute_": frozenset(
+        {"compute_telemetry.py", "collectives.py"}
+    ),
 }
 _METRIC_METHODS = {"inc", "set", "observe"}
 

@@ -595,6 +595,12 @@ def _self_test_scrape() -> tuple[str, list[str]]:
         prefill_chunk=8,
     )
     KVTelemetry(registry).attach(kv_engine, replica="verify-kv")
+    from k8s_dra_driver_tpu.models.compute_telemetry import ComputeTelemetry
+
+    compute_tel = ComputeTelemetry(registry)
+    compute_tel.attach(
+        kv_engine, replica="verify-kv", claim_uid="uid-verify"
+    )
     kv_base = list(range(1, 17))
     kv_prompts = [
         kv_base + [40 + t] * (5 + 3 * t) for t in range(4)
@@ -620,6 +626,61 @@ def _self_test_scrape() -> tuple[str, list[str]]:
             "lifecycle families render unexercised"
         )
     alloc_errors.extend(kv_errors)
+
+    # The compute-plane families (tpu_dra_compute_*), populated through
+    # the SAME real engine: the churn above was the warmup (both
+    # programs built under the compile ledger's wrappers), so marking
+    # the warm horizon and replaying identically-shaped steady-state
+    # traffic must record ZERO recompiles — the recompile-storm signal
+    # verified quiet on a healthy engine. The collective families get a
+    # real site too: an elastic reshard of a tiny TrainState.
+    compute_errors: list[str] = []
+    compute_tel.mark_warm()
+    steady_reqs = [
+        kv_engine.submit(kv_base + [90 + t] * 4, max_new_tokens=8)
+        for t in range(2)
+    ]
+    kv_engine.run()
+    kv_engine.assert_no_leaks()
+    if any(not r.tokens for r in steady_reqs):
+        compute_errors.append(
+            "compute steady-state: a request retired with no tokens"
+        )
+    compute_snap = compute_tel.ledger.snapshot()
+    for program in ("decode_step", "prefill_chunk"):
+        if compute_snap["builds"].get(program) != (
+            kv_engine.compile_counts.get(program)
+        ):
+            compute_errors.append(
+                f"compile ledger counts {program} "
+                f"{compute_snap['builds'].get(program)} time(s) but the "
+                "engine's compile_counts says "
+                f"{kv_engine.compile_counts.get(program)}"
+            )
+    if compute_snap["recompilesSinceWarm"]:
+        compute_errors.append(
+            "steady-state traffic recompiled after the warm horizon: "
+            f"{compute_snap['recompilesSinceWarm']}"
+        )
+    from k8s_dra_driver_tpu.models.train import (
+        init_train_state, make_optimizer, reshard_train_state,
+    )
+    from k8s_dra_driver_tpu.parallel.mesh import build_mesh
+
+    reshard_mesh = build_mesh()
+    reshard_state = init_train_state(
+        kv_config, reshard_mesh, make_optimizer(), seed=0
+    )
+    reshard_train_state(reshard_state, reshard_mesh)
+    compute_coll = {
+        row["site"]: row for row in compute_tel.collectives.snapshot()
+    }
+    reshard_row = compute_coll.get("train.reshard")
+    if reshard_row is None or reshard_row["bytes"] <= 0:
+        compute_errors.append(
+            "elastic reshard emitted no train.reshard collective bytes"
+        )
+    alloc_errors.extend(compute_errors)
 
     # The fleet-soak families (tpu_dra_fleet_*), populated by a REAL
     # mini soak: the deterministic fleet simulator (fleetsim/) drives
@@ -663,6 +724,7 @@ def _self_test_scrape() -> tuple[str, list[str]]:
     srv.set_requests_provider(telemetry.export_requests)
     srv.set_kv_provider(kv_engine.kv_debug)
     srv.set_residency_provider(gateway.residency.snapshot)
+    srv.set_compute_provider(compute_tel.compute_debug)
     srv.start()
     try:
         base = f"http://127.0.0.1:{srv.port}"
@@ -1004,12 +1066,57 @@ def _self_test_scrape() -> tuple[str, list[str]]:
                     "/debug/residency: no measured-resident keys — the "
                     "sim replicas published no blocks"
                 )
+        # /debug/compute: the compute telemetry's document — decodable
+        # JSON, the churned engine's programs and exact HBM
+        # decomposition, and the reshard's collective row.
+        comp_body = urllib.request.urlopen(
+            f"{base}/debug/compute"
+        ).read().decode()
+        try:
+            comp_doc = json.loads(comp_body)
+        except ValueError:
+            errors.append("/debug/compute: body is not JSON")
+        else:
+            if comp_doc.get("schema") != "tpu-dra-compute-debug-v1":
+                errors.append(
+                    f"/debug/compute: schema {comp_doc.get('schema')!r} "
+                    "(want tpu-dra-compute-debug-v1)"
+                )
+            if not comp_doc.get("warm"):
+                errors.append(
+                    "/debug/compute: warm horizon not marked"
+                )
+            comp_programs = comp_doc.get("programs") or {}
+            for program in ("decode_step", "prefill_chunk"):
+                if "verify-kv" not in (comp_programs.get(program) or {}):
+                    errors.append(
+                        f"/debug/compute: program {program} has no "
+                        "verify-kv roofline"
+                    )
+            comp_hbm = (comp_doc.get("hbm") or {}).get("verify-kv") or {}
+            if comp_hbm.get("totalBytes") != (
+                comp_hbm.get("weightsBytes", 0)
+                + comp_hbm.get("kvPoolBytes", 0)
+            ):
+                errors.append(
+                    "/debug/compute: hbm decomposition does not sum "
+                    f"({comp_hbm})"
+                )
+            comp_sites = {
+                row.get("site")
+                for row in comp_doc.get("collectives") or []
+            }
+            if "train.reshard" not in comp_sites:
+                errors.append(
+                    "/debug/compute: train.reshard collective row "
+                    "missing"
+                )
         # The scrape surface is GET-only by contract — /metrics and the
         # debug endpoints alike.
         for route in ("/metrics", "/debug/allocations", "/debug/defrag",
                       "/debug/rebalance", "/debug/gateway",
                       "/debug/requests", "/debug/kv",
-                      "/debug/residency"):
+                      "/debug/residency", "/debug/compute"):
             try:
                 urllib.request.urlopen(base + route, data=b"x")
                 errors.append(f"{route} accepted a POST (want 405)")
@@ -1068,6 +1175,17 @@ def _self_test_scrape() -> tuple[str, list[str]]:
                    "tpu_dra_kv_cow_recomputes_total",
                    "tpu_dra_kv_eviction_lru_age_ops",
                    "tpu_dra_kv_request_footprint_blocks",
+                   "tpu_dra_compute_compiles_total",
+                   "tpu_dra_compute_recompiles_total",
+                   "tpu_dra_compute_steps_total",
+                   "tpu_dra_compute_compile_seconds",
+                   "tpu_dra_compute_mfu_ratio",
+                   "tpu_dra_compute_achieved_flops_per_s",
+                   "tpu_dra_compute_achieved_bytes_per_s",
+                   "tpu_dra_compute_hbm_bytes",
+                   "tpu_dra_compute_hbm_watermark_bytes",
+                   "tpu_dra_compute_collective_bytes_total",
+                   "tpu_dra_compute_collective_invocations_total",
                    "tpu_dra_residency_fleet_hit_rate_ratio",
                    "tpu_dra_residency_duplication_ratio",
                    "tpu_dra_residency_unique_keys",
